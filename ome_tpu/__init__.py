@@ -1,0 +1,28 @@
+"""ome_tpu — a TPU-native open model engine.
+
+A from-scratch, TPU-first re-design of the capabilities of OME
+(sgl-project/ome, surveyed in SURVEY.md): a model-serving control plane
+(models as first-class resources, weighted runtime selection,
+accelerator-aware scheduling, single-host / multi-host / PD-disaggregated
+deployment patterns, autoscaling, benchmarking) plus a JAX/XLA/Pallas
+serving data plane (the part the reference delegates to SGLang/vLLM).
+
+Layout:
+  core/        k8s-style object model, in-memory API, workqueue, manager
+  apis/        CRD-equivalent typed specs (v1)
+  selection/   runtime + accelerator selection engines
+  controllers/ reconcilers (InferenceService, BaseModel, BenchmarkJob, AcceleratorClass)
+  webhooks/    defaulting / validation / pod mutation (TPU env injection)
+  modelagent/  node-side model staging (scout, gopher, parsers, labels)
+  hfconfig/    per-architecture HuggingFace config.json parsers
+  storage/     storage URI abstraction + providers (+ native C++ chunk downloader)
+  models/      JAX model families (flagship: Llama-class decoder)
+  ops/         Pallas TPU kernels (flash attention, paged attention, ...)
+  parallel/    mesh / sharding / pipeline / ring-attention utilities
+  engine/      TPU serving engine (continuous batching, paged KV, sampling)
+  train/       sharded training step (for multi-chip validation)
+"""
+
+__version__ = "0.1.0"
+
+GROUP = "ome.io"

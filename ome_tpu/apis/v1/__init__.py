@@ -1,0 +1,89 @@
+"""ome.io/v1 API types (CRD equivalents of the reference's
+pkg/apis/ome/v1beta1)."""
+
+from .accelerator_class import (
+    GKE_TPU_ACCELERATOR_LABEL,
+    GKE_TPU_TOPOLOGY_LABEL,
+    TPU_RESOURCE,
+    AcceleratorCapabilities,
+    AcceleratorClass,
+    AcceleratorClassSpec,
+    AcceleratorClassStatus,
+    AcceleratorCost,
+    AcceleratorDiscovery,
+    TopologySpec,
+    parse_topology,
+)
+from .benchmark_job import (
+    BenchmarkJob,
+    BenchmarkJobSpec,
+    BenchmarkJobStatus,
+    EndpointSpec,
+    InferenceServiceRef,
+)
+from .component import (
+    ComponentExtensionSpec,
+    ComponentStatusSpec,
+    DeploymentStrategy,
+    KedaConfig,
+    ScaleMetric,
+)
+from .inference_service import (
+    DECODER,
+    DECODER_READY,
+    ENGINE,
+    ENGINE_READY,
+    INGRESS_READY,
+    READY,
+    ROUTER,
+    ROUTER_READY,
+    AcceleratorSelector,
+    AcceleratorSelectorPolicy,
+    DeploymentMode,
+    EngineSpec,
+    InferenceService,
+    InferenceServiceSpec,
+    InferenceServiceStatus,
+    LeaderSpec,
+    ModelRef,
+    ModelStatus,
+    RouterSpec,
+    RuntimeRef,
+    WorkerSpec,
+)
+from .model import (
+    BaseModel,
+    BaseModelSpec,
+    ClusterBaseModel,
+    DownloadPolicy,
+    FineTunedWeight,
+    FineTunedWeightSpec,
+    ModelCapability,
+    ModelFormat,
+    ModelFrameworkSpec,
+    ModelQuantization,
+    ModelState,
+    ModelStatusSpec,
+    StorageSpec,
+    format_parameter_size,
+    parse_parameter_size,
+)
+from .serving_runtime import (
+    AcceleratorModelConfig,
+    AcceleratorRequirements,
+    ClusterServingRuntime,
+    EngineConfig,
+    ModelSizeRangeSpec,
+    ParallelismConfig,
+    RouterConfig,
+    RunnerSpec,
+    ServingRuntime,
+    ServingRuntimeSpec,
+    ServingRuntimeStatus,
+    SupportedModelFormat,
+)
+
+ALL_KINDS = [
+    InferenceService, BaseModel, ClusterBaseModel, FineTunedWeight,
+    ServingRuntime, ClusterServingRuntime, AcceleratorClass, BenchmarkJob,
+]

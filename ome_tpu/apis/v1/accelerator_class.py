@@ -1,0 +1,115 @@
+"""AcceleratorClass — TPU-first accelerator abstraction.
+
+Mirrors /root/reference/pkg/apis/ome/v1beta1/accelerator_class.go:19-221
+(vendor/family/model, discovery, capabilities, cost, resources, status)
+but designed around TPU pod slices: discovery keys on GKE TPU node labels
+(cloud.google.com/gke-tpu-accelerator / gke-tpu-topology), capabilities
+carry HBM per chip, ICI/DCN bandwidth and supported slice topologies,
+and the schedulable resource is google.com/tpu — zero nvidia.com/gpu.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import ClassVar, Dict, List, Optional
+
+from ...core.meta import Resource
+
+# GKE node label keys for TPU discovery (the TPU analog of the reference's
+# nvidia PCI-id discovery, accelerator_class.go Discovery block)
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+TPU_RESOURCE = "google.com/tpu"
+
+
+@dataclass
+class AcceleratorDiscovery:
+    """How nodes carrying this accelerator are recognized."""
+
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    node_affinity: Optional[dict] = None
+    # GPU-era PCI vendor/device ids kept for API parity (unused on TPU)
+    pci_vendor_ids: List[str] = field(default_factory=list)
+    pci_device_ids: List[str] = field(default_factory=list)
+
+
+@dataclass
+class TopologySpec:
+    """A slice shape this accelerator family supports, e.g. v5e 4x4."""
+
+    name: str = ""  # "2x2" | "2x4" | "4x4" | "4x8" | "2x2x2" ...
+    chips: int = 0
+    hosts: int = 0
+    chips_per_host: int = 0
+
+
+@dataclass
+class AcceleratorCapabilities:
+    """accelerator_class.go Capabilities — TPU-flavored."""
+
+    memory_gb: Optional[float] = None  # HBM per chip
+    compute_capability: Optional[str] = None  # TPU generation, e.g. "v5e"
+    memory_bandwidth_gbps: Optional[float] = None  # HBM BW per chip
+    interconnect_bandwidth_gbps: Optional[float] = None  # ICI per link
+    dcn_bandwidth_gbps: Optional[float] = None  # cross-slice
+    bf16_tflops: Optional[float] = None  # per chip
+    int8_tops: Optional[float] = None
+    features: List[str] = field(default_factory=list)  # ["megacore","sparsecore",...]
+    topologies: List[TopologySpec] = field(default_factory=list)
+
+
+@dataclass
+class AcceleratorCost:
+    per_chip_hour_usd: Optional[float] = None
+    currency: str = "USD"
+
+
+@dataclass
+class AcceleratorClassSpec:
+    vendor: str = ""  # "google"
+    family: str = ""  # "tpu"
+    model: str = ""  # "v5e" | "v5p" | "v6e"
+    discovery: AcceleratorDiscovery = field(default_factory=AcceleratorDiscovery)
+    capabilities: AcceleratorCapabilities = field(default_factory=AcceleratorCapabilities)
+    cost: Optional[AcceleratorCost] = None
+    # schedulable resource name -> amount per chip (e.g. google.com/tpu: "1")
+    resources: Dict[str, str] = field(default_factory=dict)
+    # scheduler integration refs (Kueue/Volcano in the reference)
+    queue_name: Optional[str] = None
+
+
+@dataclass
+class AcceleratorClassStatus:
+    nodes: List[str] = field(default_factory=list)
+    node_count: int = 0
+    total_chips: int = 0
+    available_chips: int = 0
+    conditions: List[dict] = field(default_factory=list)
+
+
+@dataclass
+class AcceleratorClass(Resource):
+    KIND: ClassVar[str] = "AcceleratorClass"
+    PLURAL: ClassVar[str] = "acceleratorclasses"
+    NAMESPACED: ClassVar[bool] = False
+    spec: AcceleratorClassSpec = field(default_factory=AcceleratorClassSpec)
+    status: AcceleratorClassStatus = field(default_factory=AcceleratorClassStatus)
+
+
+def parse_topology(name: str) -> Optional[TopologySpec]:
+    """'4x4' -> chips=16; '2x2x2' (v5p 3D) -> chips=8.
+
+    Host math follows GKE podslice shapes: v5e/v6e hosts have 4 chips
+    (1 for 1x1), v5p hosts have 4 chips per host in a 2x2x1 subcube.
+    """
+    try:
+        dims = [int(d) for d in name.lower().split("x")]
+    except (ValueError, AttributeError):
+        return None
+    chips = 1
+    for d in dims:
+        chips *= d
+    chips_per_host = min(4, chips)
+    hosts = max(1, chips // chips_per_host)
+    return TopologySpec(name=name, chips=chips, hosts=hosts,
+                        chips_per_host=chips_per_host)

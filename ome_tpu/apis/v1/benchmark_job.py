@@ -1,0 +1,66 @@
+"""BenchmarkJob CRD.
+
+Mirrors /root/reference/pkg/apis/ome/v1beta1/benchmark_job.go:27-92:
+endpoint (isvc ref or raw URL), task, traffic scenarios x concurrency
+iteration model, time/request bounds, dataset + output storage, pod
+override, and Job-driven status.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import ClassVar, Dict, List, Optional
+
+from ...core.k8s import PodSpec
+from ...core.meta import Resource
+from .model import StorageSpec
+
+
+@dataclass
+class InferenceServiceRef:
+    name: str = ""
+    namespace: Optional[str] = None
+
+
+@dataclass
+class EndpointSpec:
+    """benchmark_job.go — either an isvc reference or a literal endpoint."""
+
+    inference_service: Optional[InferenceServiceRef] = None
+    url: Optional[str] = None
+    api_format: Optional[str] = None  # openai | ...
+    model_name: Optional[str] = None
+
+
+@dataclass
+class BenchmarkJobSpec:
+    endpoint: EndpointSpec = field(default_factory=EndpointSpec)
+    task: str = "text-to-text"
+    traffic_scenarios: List[str] = field(default_factory=list)  # e.g. "D(100,100)"
+    num_concurrency: List[int] = field(default_factory=list)
+    max_time_per_iteration: Optional[int] = None  # minutes
+    max_requests_per_iteration: Optional[int] = None
+    additional_request_params: Dict[str, str] = field(default_factory=dict)
+    dataset: Optional[StorageSpec] = None
+    output_location: Optional[StorageSpec] = None
+    result_folder_name: Optional[str] = None
+    service_account_name: Optional[str] = None
+    pod_override: Optional[PodSpec] = None
+
+
+@dataclass
+class BenchmarkJobStatus:
+    state: Optional[str] = None  # Pending | Running | Completed | Failed
+    start_time: Optional[str] = None
+    completion_time: Optional[str] = None
+    last_reconcile_time: Optional[str] = None
+    failure_message: Optional[str] = None
+    details: Optional[str] = None
+
+
+@dataclass
+class BenchmarkJob(Resource):
+    KIND: ClassVar[str] = "BenchmarkJob"
+    PLURAL: ClassVar[str] = "benchmarkjobs"
+    spec: BenchmarkJobSpec = field(default_factory=BenchmarkJobSpec)
+    status: BenchmarkJobStatus = field(default_factory=BenchmarkJobStatus)

@@ -1,0 +1,66 @@
+"""Shared component extension spec.
+
+Mirrors ComponentExtensionSpec in the reference
+(/root/reference/pkg/apis/ome/v1beta1/component.go:9-68): replica bounds,
+scale metric/target, canary traffic, deployment strategy, KEDA config.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+class ScaleMetric(str, enum.Enum):
+    CPU = "cpu"
+    MEMORY = "memory"
+    CONCURRENCY = "concurrency"
+    RPS = "rps"
+
+
+@dataclass
+class KedaConfig:
+    """KEDA autoscale trigger config (reference kedaconfig.go:5-45)."""
+
+    enable_keda: bool = False
+    prom_server_address: Optional[str] = None
+    custom_prom_query: Optional[str] = None
+    scaling_threshold: Optional[str] = None
+    scaling_operator: Optional[str] = None  # GreaterThanOrEqual etc.
+    polling_interval: Optional[int] = None
+    cooldown_period: Optional[int] = None
+
+
+@dataclass
+class DeploymentStrategy:
+    type: Optional[str] = None  # RollingUpdate | Recreate
+    rolling_update: Optional[dict] = None
+
+
+@dataclass
+class ComponentExtensionSpec:
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+    scale_target: Optional[int] = None
+    scale_metric: Optional[ScaleMetric] = None
+    container_concurrency: Optional[int] = None
+    timeout_seconds: Optional[int] = None
+    canary_traffic_percent: Optional[int] = None
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    deployment_strategy: Optional[DeploymentStrategy] = None
+    keda_config: Optional[KedaConfig] = None
+
+
+@dataclass
+class ComponentStatusSpec:
+    """Per-component status entry (inference_service_status.go:86-120)."""
+
+    latest_created_revision: Optional[str] = None
+    latest_ready_revision: Optional[str] = None
+    previous_rolledout_revision: Optional[str] = None
+    traffic_percent: Optional[int] = None
+    url: Optional[str] = None
+    rest_url: Optional[str] = None
+    grpc_url: Optional[str] = None

@@ -1,0 +1,155 @@
+"""InferenceService — the central CRD.
+
+Mirrors /root/reference/pkg/apis/ome/v1beta1/inference_service.go:9-266:
+Engine/Decoder (PD disaggregation), Model + Runtime references, Router,
+AcceleratorSelector policies, Leader/Worker multi-host specs, plus the
+Knative-style status block (inference_service_status.go).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import ClassVar, Dict, List, Optional
+
+from ...core.k8s import Container, PodSpec
+from ...core.meta import Condition, Resource, get_condition
+from .component import ComponentExtensionSpec, ComponentStatusSpec, KedaConfig
+
+
+class AcceleratorSelectorPolicy(str, enum.Enum):
+    """inference_service.go:119-131."""
+
+    BEST_FIT = "BestFit"
+    CHEAPEST = "Cheapest"
+    MOST_CAPABLE = "MostCapable"
+    FIRST_AVAILABLE = "FirstAvailable"
+
+
+class DeploymentMode(str, enum.Enum):
+    """constants/constants.go:438-446."""
+
+    RAW = "RawDeployment"
+    MULTI_NODE = "MultiNode"
+    SERVERLESS = "Serverless"
+    PD_DISAGGREGATED = "PDDisaggregated"
+    VIRTUAL = "VirtualDeployment"
+
+
+@dataclass
+class ModelRef:
+    name: str = ""
+    kind: Optional[str] = None  # BaseModel | ClusterBaseModel
+    api_group: Optional[str] = None
+    fine_tuned_weights: List[str] = field(default_factory=list)
+
+
+@dataclass
+class RuntimeRef:
+    name: str = ""
+    kind: Optional[str] = None  # ServingRuntime | ClusterServingRuntime
+    api_group: Optional[str] = None
+
+
+@dataclass
+class AcceleratorSelector:
+    """inference_service.go:119-131 — how to pick an AcceleratorClass."""
+
+    accelerator_class: Optional[str] = None  # explicit pin
+    policy: Optional[AcceleratorSelectorPolicy] = None
+    # TPU: desired slice topology, e.g. "4x4"; overrides policy sizing
+    topology: Optional[str] = None
+
+
+@dataclass
+class LeaderSpec:
+    """inference_service.go:215-232."""
+
+    pod: Optional[PodSpec] = None
+    runner: Optional[Container] = None
+
+
+@dataclass
+class WorkerSpec:
+    """inference_service.go:235-248 — Size = number of worker pods."""
+
+    pod: Optional[PodSpec] = None
+    runner: Optional[Container] = None
+    size: Optional[int] = None
+
+
+@dataclass
+class EngineSpec(ComponentExtensionSpec):
+    """inference_service.go:138-210 — inline pod pieces + runner override
+    + leader/worker for multi-host; same shape reused for Decoder."""
+
+    pod: Optional[PodSpec] = None
+    runner: Optional[Container] = None
+    leader: Optional[LeaderSpec] = None
+    worker: Optional[WorkerSpec] = None
+    accelerator_override: Optional[str] = None
+
+
+@dataclass
+class RouterSpec(ComponentExtensionSpec):
+    """inference_service.go:251-266."""
+
+    pod: Optional[PodSpec] = None
+    runner: Optional[Container] = None
+    config: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class InferenceServiceSpec:
+    """inference_service.go:9-56."""
+
+    model: Optional[ModelRef] = None
+    runtime: Optional[RuntimeRef] = None
+    engine: Optional[EngineSpec] = None
+    decoder: Optional[EngineSpec] = None
+    router: Optional[RouterSpec] = None
+    accelerator_selector: Optional[AcceleratorSelector] = None
+    keda_config: Optional[KedaConfig] = None
+
+
+# condition types (inference_service_status.go:29+)
+ENGINE_READY = "EngineReady"
+DECODER_READY = "DecoderReady"
+ROUTER_READY = "RouterReady"
+INGRESS_READY = "IngressReady"
+READY = "Ready"
+
+ENGINE = "engine"
+DECODER = "decoder"
+ROUTER = "router"
+
+
+@dataclass
+class ModelStatus:
+    """Model readiness as seen by this isvc."""
+
+    name: Optional[str] = None
+    state: Optional[str] = None
+
+
+@dataclass
+class InferenceServiceStatus:
+    conditions: List[Condition] = field(default_factory=list)
+    components: Dict[str, ComponentStatusSpec] = field(default_factory=dict)
+    model_status: Optional[ModelStatus] = None
+    url: Optional[str] = None
+    address: Optional[dict] = None
+    observed_generation: Optional[int] = None
+    deployment_mode: Optional[str] = None
+
+    def is_ready(self) -> bool:
+        c = get_condition(self.conditions, READY)
+        return c is not None and c.is_true()
+
+
+@dataclass
+class InferenceService(Resource):
+    KIND: ClassVar[str] = "InferenceService"
+    PLURAL: ClassVar[str] = "inferenceservices"
+    spec: InferenceServiceSpec = field(default_factory=InferenceServiceSpec)
+    status: InferenceServiceStatus = field(default_factory=InferenceServiceStatus)

@@ -1,0 +1,174 @@
+"""ServingRuntime / ClusterServingRuntime types.
+
+Mirrors /root/reference/pkg/apis/ome/v1beta1/servingruntime_types.go:
+supported model formats with auto-select + priority, model size range,
+engine/decoder/router configs, worker pod spec, accelerator requirements,
+and the per-accelerator parallelism override hook
+(AcceleratorModelConfig/TensorParallelismConfig, :65-101) — extended here
+with TPU ICI-mesh axes so a runtime can be retargeted per slice shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import ClassVar, Dict, List, Optional
+
+from ...core.k8s import Container, PodSpec
+from ...core.meta import Resource
+
+
+@dataclass
+class SupportedModelFormat:
+    """servingruntime_types.go — one (format|framework|arch|quant) tuple
+    a runtime can serve, with auto-select participation + priority."""
+
+    name: str = ""
+    version: Optional[str] = None
+    model_framework: Optional[dict] = None  # {"name":..., "version":...}
+    model_format: Optional[dict] = None  # {"name":..., "version":...}
+    model_architecture: Optional[str] = None
+    quantization: Optional[str] = None
+    auto_select: Optional[bool] = None
+    priority: Optional[int] = None
+
+
+@dataclass
+class ModelSizeRangeSpec:
+    """servingruntime_types.go — min/max parameter size, e.g. '1B'..'70B'."""
+
+    min: Optional[str] = None
+    max: Optional[str] = None
+
+
+@dataclass
+class ParallelismConfig:
+    """Per-accelerator parallelism override
+    (TensorParallelismConfig, servingruntime_types.go:88-101), TPU-first:
+    sizes map to ICI mesh axes rather than NCCL world sizes."""
+
+    tensor_parallel_size: Optional[int] = None
+    pipeline_parallel_size: Optional[int] = None
+    data_parallel_size: Optional[int] = None
+    expert_parallel_size: Optional[int] = None
+    sequence_parallel_size: Optional[int] = None
+    # TPU ICI mesh axes, e.g. "4,4" for a v5e-16 2D slice; engines that
+    # take a mesh string (MaxText/JetStream) consume this directly.
+    ici_mesh: Optional[str] = None
+    dcn_mesh: Optional[str] = None  # multislice data axes over DCN
+
+
+@dataclass
+class AcceleratorModelConfig:
+    """Per-AcceleratorClass override block (servingruntime_types.go:65-87)."""
+
+    accelerator_class: str = ""
+    parallelism: Optional[ParallelismConfig] = None
+    args: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    runner_image: Optional[str] = None
+
+
+@dataclass
+class AcceleratorRequirements:
+    """servingruntime_types.go:233-265 — what hardware a runtime needs."""
+
+    accelerator_classes: List[str] = field(default_factory=list)
+    min_memory_gb: Optional[int] = None
+    min_chips: Optional[int] = None
+    required_features: List[str] = field(default_factory=list)
+    # TPU: acceptable slice topologies, e.g. ["2x4", "4x4"]
+    topologies: List[str] = field(default_factory=list)
+
+
+@dataclass
+class RunnerSpec:
+    """Main engine container override (Container + extras)."""
+
+    container: Container = field(default_factory=Container)
+
+
+@dataclass
+class EngineConfig:
+    """ServingRuntime engine/decoder pod recipe."""
+
+    runner: Optional[RunnerSpec] = None
+    pod: Optional[PodSpec] = None
+    leader: Optional[PodSpec] = None
+    worker: Optional[PodSpec] = None
+    worker_size: Optional[int] = None
+    annotations: Dict[str, str] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+
+
+@dataclass
+class RouterConfig:
+    runner: Optional[RunnerSpec] = None
+    config: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ServingRuntimePodSpec:
+    """Flattened pod spec carried by the runtime (servingruntime_types.go)."""
+
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    volumes: List[dict] = field(default_factory=list)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[dict] = None
+    tolerations: List[dict] = field(default_factory=list)
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    service_account_name: Optional[str] = None
+    scheduler_name: Optional[str] = None
+    host_ipc: Optional[bool] = None
+
+
+@dataclass
+class ServingRuntimeSpec:
+    """servingruntime_types.go:190-229."""
+
+    supported_model_formats: List[SupportedModelFormat] = field(default_factory=list)
+    model_size_range: Optional[ModelSizeRangeSpec] = None
+    disabled: Optional[bool] = None
+    protocol_versions: List[str] = field(default_factory=list)  # openAI | ...
+    engine_config: Optional[EngineConfig] = None
+    decoder_config: Optional[EngineConfig] = None
+    router_config: Optional[RouterConfig] = None
+    accelerator_requirements: Optional[AcceleratorRequirements] = None
+    accelerator_configs: List[AcceleratorModelConfig] = field(default_factory=list)
+    # catch-all pod spec for simple single-container runtimes
+    containers: List[Container] = field(default_factory=list)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+
+    def is_disabled(self) -> bool:
+        return bool(self.disabled)
+
+    def accelerator_config_for(self, ac_name: str) -> Optional[AcceleratorModelConfig]:
+        for cfg in self.accelerator_configs:
+            if cfg.accelerator_class == ac_name:
+                return cfg
+        return None
+
+
+@dataclass
+class ServingRuntimeStatus:
+    conditions: List[dict] = field(default_factory=list)
+
+
+@dataclass
+class ServingRuntime(Resource):
+    KIND: ClassVar[str] = "ServingRuntime"
+    spec: ServingRuntimeSpec = field(default_factory=ServingRuntimeSpec)
+    status: ServingRuntimeStatus = field(default_factory=ServingRuntimeStatus)
+
+
+@dataclass
+class ClusterServingRuntime(Resource):
+    KIND: ClassVar[str] = "ClusterServingRuntime"
+    NAMESPACED: ClassVar[bool] = False
+    spec: ServingRuntimeSpec = field(default_factory=ServingRuntimeSpec)
+    status: ServingRuntimeStatus = field(default_factory=ServingRuntimeStatus)

@@ -1,0 +1,21 @@
+"""API errors (k8s apierrors equivalents)."""
+
+
+class APIError(Exception):
+    pass
+
+
+class NotFoundError(APIError):
+    pass
+
+
+class AlreadyExistsError(APIError):
+    pass
+
+
+class ConflictError(APIError):
+    pass
+
+
+class ValidationError(APIError):
+    """Webhook admission denial."""

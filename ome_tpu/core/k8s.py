@@ -1,0 +1,356 @@
+"""Kubernetes built-in types (the subset the control plane stamps out).
+
+The reference emits corev1/appsv1/batchv1/autoscaling/networking objects
+plus LeaderWorkerSet and KEDA ScaledObject CRs (SURVEY.md §2.3 reconcilers
+table). These dataclasses model the fields our reconcilers read or write;
+loosely-structured corners (affinity, probe handlers) stay plain dicts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, ClassVar, Dict, List, Optional
+
+from .meta import Resource
+
+# --------------------------------------------------------------------------
+# core/v1 pod primitives
+
+
+@dataclass
+class EnvVar:
+    name: str = ""
+    value: Optional[str] = None
+    value_from: Optional[dict] = None
+
+
+@dataclass
+class VolumeMount:
+    name: str = ""
+    mount_path: str = ""
+    read_only: Optional[bool] = None
+    sub_path: Optional[str] = None
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    host_path: Optional[dict] = None
+    empty_dir: Optional[dict] = None
+    config_map: Optional[dict] = None
+    secret: Optional[dict] = None
+    persistent_volume_claim: Optional[dict] = None
+
+
+@dataclass
+class ContainerPort:
+    name: Optional[str] = None
+    container_port: int = 0
+    protocol: Optional[str] = None
+
+
+@dataclass
+class ResourceRequirements:
+    requests: Dict[str, str] = field(default_factory=dict)
+    limits: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Probe:
+    http_get: Optional[dict] = None
+    tcp_socket: Optional[dict] = None
+    exec: Optional[dict] = None
+    initial_delay_seconds: Optional[int] = None
+    period_seconds: Optional[int] = None
+    timeout_seconds: Optional[int] = None
+    failure_threshold: Optional[int] = None
+    success_threshold: Optional[int] = None
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    ports: List[ContainerPort] = field(default_factory=list)
+    resources: Optional[ResourceRequirements] = None
+    volume_mounts: List[VolumeMount] = field(default_factory=list)
+    liveness_probe: Optional[Probe] = None
+    readiness_probe: Optional[Probe] = None
+    startup_probe: Optional[Probe] = None
+    security_context: Optional[dict] = None
+    working_dir: Optional[str] = None
+    image_pull_policy: Optional[str] = None
+
+    def env_dict(self) -> Dict[str, str]:
+        return {e.name: (e.value or "") for e in self.env}
+
+    def set_env(self, name: str, value: str):
+        for e in self.env:
+            if e.name == name:
+                e.value = value
+                return
+        self.env.append(EnvVar(name=name, value=value))
+
+    def get_env(self, name: str) -> Optional[str]:
+        for e in self.env:
+            if e.name == name:
+                return e.value
+        return None
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    volumes: List[Volume] = field(default_factory=list)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[dict] = None
+    tolerations: List[dict] = field(default_factory=list)
+    service_account_name: Optional[str] = None
+    host_network: Optional[bool] = None
+    host_ipc: Optional[bool] = None
+    scheduler_name: Optional[str] = None
+    termination_grace_period_seconds: Optional[int] = None
+    image_pull_secrets: List[dict] = field(default_factory=list)
+    subdomain: Optional[str] = None
+    restart_policy: Optional[str] = None
+
+    def container(self, name: str) -> Optional[Container]:
+        for c in self.containers:
+            if c.name == name:
+                return c
+        return None
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: "ObjectMeta" = None
+    spec: PodSpec = field(default_factory=PodSpec)
+
+    def __post_init__(self):
+        from .meta import ObjectMeta
+        if self.metadata is None:
+            self.metadata = ObjectMeta()
+
+
+from .meta import ObjectMeta  # noqa: E402  (for PodTemplateSpec default)
+
+
+@dataclass
+class Pod(Resource):
+    KIND: ClassVar[str] = "Pod"
+    API_VERSION: ClassVar[str] = "v1"
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: dict = field(default_factory=dict)
+
+
+@dataclass
+class NodeStatus:
+    capacity: Dict[str, str] = field(default_factory=dict)
+    allocatable: Dict[str, str] = field(default_factory=dict)
+    conditions: List[dict] = field(default_factory=list)
+
+
+@dataclass
+class Node(Resource):
+    KIND: ClassVar[str] = "Node"
+    API_VERSION: ClassVar[str] = "v1"
+    NAMESPACED: ClassVar[bool] = False
+    spec: dict = field(default_factory=dict)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+
+@dataclass
+class ConfigMap(Resource):
+    KIND: ClassVar[str] = "ConfigMap"
+    API_VERSION: ClassVar[str] = "v1"
+    data: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Secret(Resource):
+    KIND: ClassVar[str] = "Secret"
+    API_VERSION: ClassVar[str] = "v1"
+    data: Dict[str, str] = field(default_factory=dict)
+    type: Optional[str] = None
+
+
+@dataclass
+class ServicePort:
+    name: Optional[str] = None
+    port: int = 0
+    target_port: Any = None
+    protocol: Optional[str] = None
+
+
+@dataclass
+class ServiceSpec:
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list)
+    cluster_ip: Optional[str] = None
+    type: Optional[str] = None
+
+
+@dataclass
+class Service(Resource):
+    KIND: ClassVar[str] = "Service"
+    API_VERSION: ClassVar[str] = "v1"
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+    status: dict = field(default_factory=dict)
+
+
+# --------------------------------------------------------------------------
+# apps/v1, batch/v1
+
+
+@dataclass
+class DeploymentSpec:
+    replicas: int = 1
+    selector: Dict[str, Any] = field(default_factory=dict)
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    strategy: Optional[dict] = None
+
+
+@dataclass
+class DeploymentStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    available_replicas: int = 0
+    conditions: List[dict] = field(default_factory=list)
+
+
+@dataclass
+class Deployment(Resource):
+    KIND: ClassVar[str] = "Deployment"
+    API_VERSION: ClassVar[str] = "apps/v1"
+    spec: DeploymentSpec = field(default_factory=DeploymentSpec)
+    status: DeploymentStatus = field(default_factory=DeploymentStatus)
+
+
+@dataclass
+class JobSpec:
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    backoff_limit: Optional[int] = None
+    ttl_seconds_after_finished: Optional[int] = None
+    completions: Optional[int] = None
+    parallelism: Optional[int] = None
+
+
+@dataclass
+class JobStatus:
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    conditions: List[dict] = field(default_factory=list)
+
+
+@dataclass
+class Job(Resource):
+    KIND: ClassVar[str] = "Job"
+    API_VERSION: ClassVar[str] = "batch/v1"
+    spec: JobSpec = field(default_factory=JobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+
+# --------------------------------------------------------------------------
+# autoscaling, policy, networking
+
+
+@dataclass
+class HorizontalPodAutoscaler(Resource):
+    KIND: ClassVar[str] = "HorizontalPodAutoscaler"
+    API_VERSION: ClassVar[str] = "autoscaling/v2"
+    spec: dict = field(default_factory=dict)
+    status: dict = field(default_factory=dict)
+
+
+@dataclass
+class PodDisruptionBudget(Resource):
+    KIND: ClassVar[str] = "PodDisruptionBudget"
+    API_VERSION: ClassVar[str] = "policy/v1"
+    spec: dict = field(default_factory=dict)
+    status: dict = field(default_factory=dict)
+
+
+@dataclass
+class Ingress(Resource):
+    KIND: ClassVar[str] = "Ingress"
+    API_VERSION: ClassVar[str] = "networking.k8s.io/v1"
+    spec: dict = field(default_factory=dict)
+    status: dict = field(default_factory=dict)
+
+
+@dataclass
+class HTTPRoute(Resource):
+    KIND: ClassVar[str] = "HTTPRoute"
+    API_VERSION: ClassVar[str] = "gateway.networking.k8s.io/v1"
+    spec: dict = field(default_factory=dict)
+    status: dict = field(default_factory=dict)
+
+
+@dataclass
+class VirtualService(Resource):
+    KIND: ClassVar[str] = "VirtualService"
+    API_VERSION: ClassVar[str] = "networking.istio.io/v1beta1"
+    spec: dict = field(default_factory=dict)
+    status: dict = field(default_factory=dict)
+
+
+# --------------------------------------------------------------------------
+# LeaderWorkerSet (leaderworkerset.x-k8s.io) — multi-host slice groups
+
+
+@dataclass
+class LeaderWorkerTemplate:
+    leader_template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    worker_template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    size: int = 1
+    restart_policy: Optional[str] = None  # RecreateGroupOnPodRestart
+
+
+@dataclass
+class LeaderWorkerSetSpec:
+    replicas: int = 1
+    leader_worker_template: LeaderWorkerTemplate = field(default_factory=LeaderWorkerTemplate)
+    rollout_strategy: Optional[dict] = None
+    startup_policy: Optional[str] = None
+    network_config: Optional[dict] = None
+
+
+@dataclass
+class LeaderWorkerSetStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    conditions: List[dict] = field(default_factory=list)
+
+
+@dataclass
+class LeaderWorkerSet(Resource):
+    KIND: ClassVar[str] = "LeaderWorkerSet"
+    API_VERSION: ClassVar[str] = "leaderworkerset.x-k8s.io/v1"
+    spec: LeaderWorkerSetSpec = field(default_factory=LeaderWorkerSetSpec)
+    status: LeaderWorkerSetStatus = field(default_factory=LeaderWorkerSetStatus)
+
+
+# --------------------------------------------------------------------------
+# KEDA ScaledObject, Knative Service (loose specs)
+
+
+@dataclass
+class ScaledObject(Resource):
+    KIND: ClassVar[str] = "ScaledObject"
+    API_VERSION: ClassVar[str] = "keda.sh/v1alpha1"
+    spec: dict = field(default_factory=dict)
+    status: dict = field(default_factory=dict)
+
+
+@dataclass
+class KnativeService(Resource):
+    KIND: ClassVar[str] = "KnativeService"
+    PLURAL: ClassVar[str] = "services.serving.knative.dev"
+    API_VERSION: ClassVar[str] = "serving.knative.dev/v1"
+    spec: dict = field(default_factory=dict)
+    status: dict = field(default_factory=dict)

@@ -1,0 +1,151 @@
+"""Controller manager (controller-runtime manager + controller equivalents).
+
+Mirrors the wiring in the reference's cmd/manager/main.go:145-368: each
+controller declares the primary kind it reconciles plus watch mappings
+from other kinds to reconcile keys; the manager fans API watch events
+into per-controller rate-limited workqueues drained by worker threads.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import traceback
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple, Type
+
+from .client import Event, InMemoryClient
+from .meta import Resource
+from .queue import WorkQueue
+
+log = logging.getLogger("ome.manager")
+
+ReconcileKey = Tuple[str, str]  # (namespace, name)
+
+
+@dataclass
+class Result:
+    requeue: bool = False
+    requeue_after: float = 0.0
+
+
+class Reconciler:
+    """Subclasses implement reconcile(key) and declare watches()."""
+
+    #: primary kind this controller reconciles
+    FOR: Type[Resource] = None
+
+    def __init__(self, client: InMemoryClient):
+        self.client = client
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        raise NotImplementedError
+
+    def watches(self) -> List[Tuple[Type[Resource], Callable[[Resource], List[ReconcileKey]]]]:
+        """Extra (kind, mapper) pairs; mapper maps an event object to keys."""
+        return []
+
+    def owns(self) -> List[Type[Resource]]:
+        """Kinds whose owner references should trigger the owning primary."""
+        return []
+
+
+class Manager:
+    def __init__(self, client: InMemoryClient):
+        self.client = client
+        self._controllers: List[Tuple[Reconciler, WorkQueue]] = []
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._cancel_watch = None
+
+    def register(self, reconciler: Reconciler):
+        self._controllers.append((reconciler, WorkQueue()))
+
+    def _route(self, ev: Event):
+        obj = ev.obj
+        kind = type(obj).KIND
+        for rec, q in self._controllers:
+            if rec.FOR is not None and kind == rec.FOR.KIND:
+                q.add((obj.metadata.namespace, obj.metadata.name))
+            for ref in obj.metadata.owner_references:
+                for owned_parent in [rec.FOR] if rec.FOR else []:
+                    if ref.controller and ref.kind == owned_parent.KIND and any(
+                            type(obj) is k or type(obj).KIND == k.KIND for k in rec.owns()):
+                        q.add((obj.metadata.namespace, ref.name))
+            for watched_cls, mapper in rec.watches():
+                if kind == watched_cls.KIND:
+                    for key in mapper(obj):
+                        q.add(key)
+
+    def start(self, workers_per_controller: int = 1):
+        self._cancel_watch = self.client.watch(self._route)
+        # seed initial reconciles for pre-existing objects
+        for rec, q in self._controllers:
+            if rec.FOR is not None:
+                for obj in self.client.list(rec.FOR):
+                    q.add((obj.metadata.namespace, obj.metadata.name))
+        for rec, q in self._controllers:
+            for i in range(workers_per_controller):
+                t = threading.Thread(target=self._worker, args=(rec, q),
+                                     name=f"{type(rec).__name__}-{i}", daemon=True)
+                t.start()
+                self._threads.append(t)
+
+    def _worker(self, rec: Reconciler, q: WorkQueue):
+        while not self._stop.is_set():
+            item = q.get(timeout=0.2)
+            if item is None:
+                continue
+            ns, name = item
+            try:
+                res = rec.reconcile(ns, name) or Result()
+                q.forget(item)
+                if res.requeue_after > 0:
+                    q.add_after(item, res.requeue_after)
+                elif res.requeue:
+                    q.add_rate_limited(item)
+            except Exception:
+                log.error("reconcile %s %s/%s failed:\n%s",
+                          type(rec).__name__, ns, name, traceback.format_exc())
+                q.add_rate_limited(item)
+            finally:
+                q.done(item)
+
+    def stop(self):
+        self._stop.set()
+        if self._cancel_watch:
+            self._cancel_watch()
+        for rec, q in self._controllers:
+            q.shutdown()
+        for t in self._threads:
+            t.join(timeout=2)
+
+    def reconcile_once(self, drain: bool = True, max_iters: int = 200):
+        """Synchronously drain all queues — deterministic mode for tests
+        (replaces the reference's ginkgo Eventually() polling)."""
+        if self._cancel_watch is None:
+            self._cancel_watch = self.client.watch(self._route)
+            for rec, q in self._controllers:
+                if rec.FOR is not None:
+                    for obj in self.client.list(rec.FOR):
+                        q.add((obj.metadata.namespace, obj.metadata.name))
+        for _ in range(max_iters):
+            progressed = False
+            for rec, q in self._controllers:
+                item = q.get(timeout=0)
+                if item is None:
+                    continue
+                progressed = True
+                ns, name = item
+                try:
+                    res = rec.reconcile(ns, name) or Result()
+                    q.forget(item)
+                    if res.requeue:
+                        q.add_rate_limited(item)
+                except Exception:
+                    log.error("reconcile %s %s/%s failed:\n%s",
+                              type(rec).__name__, ns, name, traceback.format_exc())
+                finally:
+                    q.done(item)
+            if not progressed or not drain:
+                return

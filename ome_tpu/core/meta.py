@@ -1,0 +1,134 @@
+"""Object metadata and condition types (apimachinery equivalents).
+
+Mirrors the subset of k8s.io/apimachinery used by the reference operator:
+ObjectMeta (labels/annotations/ownerRefs/finalizers/resourceVersion),
+Knative-style Conditions used throughout InferenceServiceStatus
+(/root/reference/pkg/apis/ome/v1beta1/inference_service_status.go).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import itertools
+from dataclasses import dataclass, field
+from typing import ClassVar, Dict, List, Optional
+
+from . import serde
+
+_now_counter = itertools.count()
+
+
+def now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+@dataclass
+class OwnerReference:
+    api_version: str = ""
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: Optional[bool] = None
+    block_owner_deletion: Optional[bool] = None
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = ""
+    resource_version: str = ""
+    generation: int = 0
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    owner_references: List[OwnerReference] = field(default_factory=list)
+    finalizers: List[str] = field(default_factory=list)
+    creation_timestamp: Optional[str] = None
+    deletion_timestamp: Optional[str] = None
+
+
+@dataclass
+class Condition:
+    """Knative-ish condition (type/status/reason/message/severity)."""
+
+    type: str = ""
+    status: str = "Unknown"  # True | False | Unknown
+    reason: Optional[str] = None
+    message: Optional[str] = None
+    severity: Optional[str] = None
+    last_transition_time: Optional[str] = None
+
+    def is_true(self) -> bool:
+        return self.status == "True"
+
+
+def set_condition(conditions: List[Condition], cond: Condition) -> List[Condition]:
+    """Upsert a condition by type, bumping lastTransitionTime on status change."""
+    out = []
+    replaced = False
+    for c in conditions:
+        if c.type == cond.type:
+            if c.status != cond.status or cond.last_transition_time is None:
+                cond.last_transition_time = now()
+            out.append(cond)
+            replaced = True
+        else:
+            out.append(c)
+    if not replaced:
+        if cond.last_transition_time is None:
+            cond.last_transition_time = now()
+        out.append(cond)
+    return out
+
+
+def get_condition(conditions: List[Condition], ctype: str) -> Optional[Condition]:
+    for c in conditions:
+        if c.type == ctype:
+            return c
+    return None
+
+
+@dataclass
+class Resource:
+    """Base for all API objects. Subclasses set KIND / API_VERSION /
+    NAMESPACED class vars and declare `spec` / `status` dataclass fields."""
+
+    KIND: ClassVar[str] = ""
+    API_VERSION: ClassVar[str] = "ome.io/v1"
+    NAMESPACED: ClassVar[bool] = True
+    PLURAL: ClassVar[str] = ""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    def key(self) -> str:
+        if type(self).NAMESPACED:
+            return f"{self.metadata.namespace}/{self.metadata.name}"
+        return self.metadata.name
+
+    def deepcopy(self):
+        return serde.deepcopy_resource(self)
+
+    def to_dict(self) -> dict:
+        d = {"apiVersion": type(self).API_VERSION, "kind": type(self).KIND}
+        d.update(serde.to_dict(self))
+        return d
+
+    @classmethod
+    def from_dict(cls, data: dict):
+        data = dict(data)
+        data.pop("apiVersion", None)
+        data.pop("kind", None)
+        return serde.from_dict(cls, data)
+
+
+def plural_of(cls) -> str:
+    return cls.PLURAL or cls.KIND.lower() + "s"

@@ -1,0 +1,104 @@
+"""Rate-limited work queue (controller-runtime workqueue equivalent).
+
+Deduplicates keys while queued, supports delayed re-enqueue (RequeueAfter)
+and per-item exponential backoff, like the client-go workqueue the
+reference's controllers run on.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Dict, Hashable, List, Optional, Set, Tuple
+
+
+class WorkQueue:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 30.0):
+        self._cond = threading.Condition()
+        self._queue: List[Hashable] = []
+        self._dirty: Set[Hashable] = set()
+        self._processing: Set[Hashable] = set()
+        self._delayed: List[Tuple[float, int, Hashable]] = []
+        self._seq = 0
+        self._failures: Dict[Hashable, int] = {}
+        self._base_delay = base_delay
+        self._max_delay = max_delay
+        self._shutdown = False
+
+    def add(self, item: Hashable):
+        with self._cond:
+            if self._shutdown or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item not in self._processing:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def add_after(self, item: Hashable, delay: float):
+        if delay <= 0:
+            return self.add(item)
+        with self._cond:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (time.monotonic() + delay, self._seq, item))
+            self._cond.notify()
+
+    def add_rate_limited(self, item: Hashable):
+        with self._cond:
+            n = self._failures.get(item, 0)
+            self._failures[item] = n + 1
+        self.add_after(item, min(self._base_delay * (2 ** n), self._max_delay))
+
+    def forget(self, item: Hashable):
+        with self._cond:
+            self._failures.pop(item, None)
+
+    def _pump_delayed(self) -> Optional[float]:
+        """Move due delayed items into the queue; return wait for next one."""
+        nowt = time.monotonic()
+        while self._delayed and self._delayed[0][0] <= nowt:
+            _, _, item = heapq.heappop(self._delayed)
+            if item not in self._dirty:
+                self._dirty.add(item)
+                if item not in self._processing:
+                    self._queue.append(item)
+        if self._delayed:
+            return max(0.0, self._delayed[0][0] - nowt)
+        return None
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Hashable]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                wait = self._pump_delayed()
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._dirty.discard(item)
+                    self._processing.add(item)
+                    return item
+                if self._shutdown:
+                    return None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    wait = remaining if wait is None else min(wait, remaining)
+                self._cond.wait(wait)
+
+    def done(self, item: Hashable):
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    def shutdown(self):
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self):
+        with self._cond:
+            return len(self._queue) + len(self._delayed)

@@ -1,0 +1,101 @@
+"""Dataclass <-> plain-dict serialization with k8s-style camelCase keys.
+
+The reference's API types are Go structs with JSON tags (e.g.
+/root/reference/pkg/apis/ome/v1beta1/inference_service.go); here the same
+role is played by Python dataclasses and this serde layer, which converts
+snake_case field names to camelCase and back, drops None/empty values on
+output (like `omitempty`), and recurses through nested dataclasses,
+lists, dicts and enums.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import typing
+from typing import Any, Optional, Type, TypeVar, Union, get_args, get_origin
+
+T = TypeVar("T")
+
+
+def camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p[:1].upper() + p[1:] for p in parts[1:])
+
+
+def _json_name(f: dataclasses.Field) -> str:
+    return f.metadata.get("json", camel(f.name))
+
+
+def to_dict(obj: Any, keep_empty: bool = False) -> Any:
+    """Serialize a dataclass tree to plain dicts (camelCase keys, omitempty)."""
+    if obj is None:
+        return None
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            if not f.metadata.get("serialize", True):
+                continue
+            v = to_dict(getattr(obj, f.name), keep_empty)
+            if v is None and not keep_empty:
+                continue
+            if v in ({}, []) and not keep_empty:
+                continue
+            out[_json_name(f)] = v
+        return out
+    if isinstance(obj, dict):
+        return {k: to_dict(v, keep_empty) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(v, keep_empty) for v in obj]
+    return obj
+
+
+def _strip_optional(tp: Any) -> Any:
+    if get_origin(tp) is Union:
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def from_dict(cls: Type[T], data: Any) -> T:
+    """Deserialize plain dicts (camelCase keys) into dataclass `cls`."""
+    return _from_value(cls, data)
+
+
+def _from_value(tp: Any, data: Any) -> Any:
+    if data is None:
+        return None
+    tp = _strip_optional(tp)
+    if isinstance(tp, str):  # forward reference left unresolved
+        raise TypeError(f"unresolved forward reference {tp!r}")
+    origin = get_origin(tp)
+    if origin in (list, tuple):
+        (item_tp,) = get_args(tp) or (Any,)
+        return [_from_value(item_tp, v) for v in data]
+    if origin is dict:
+        args = get_args(tp)
+        val_tp = args[1] if len(args) == 2 else Any
+        return {k: _from_value(val_tp, v) for k, v in data.items()}
+    if isinstance(tp, type) and issubclass(tp, enum.Enum):
+        return tp(data)
+    if dataclasses.is_dataclass(tp):
+        hints = typing.get_type_hints(tp)
+        kwargs = {}
+        for f in dataclasses.fields(tp):
+            key = _json_name(f)
+            if key in data:
+                kwargs[f.name] = _from_value(hints[f.name], data[key])
+        return tp(**kwargs)
+    if tp in (Any, object) or origin is not None:
+        return data
+    return data
+
+
+def deepcopy_resource(obj: T) -> T:
+    """DeepCopy equivalent (zz_generated.deepcopy.go in the reference)."""
+    if obj is None:
+        return None
+    return from_dict(type(obj), to_dict(obj, keep_empty=True))
