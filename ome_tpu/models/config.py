@@ -1,0 +1,118 @@
+"""Model configuration for the JAX data plane.
+
+The reference operator parses HF config.json into metadata
+(pkg/hfutil/modelconfig) and delegates math to SGLang/vLLM; here the data
+plane is in-repo, so the same parsed config drives real JAX models.
+Covers the Llama family superset: GQA, RoPE scaling, tied embeddings,
+MoE (Mixtral/Qwen-MoE/DeepSeek-style) and sliding-window knobs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    intermediate_size: int = 14336
+    rope_theta: float = 500000.0
+    rope_scaling: Optional[Dict[str, Any]] = None
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    # MoE (0 experts -> dense MLP)
+    num_experts: int = 0
+    experts_per_token: int = 0
+    moe_intermediate_size: int = 0
+    num_shared_experts: int = 0
+    # attention extras
+    sliding_window: Optional[int] = None
+    attn_logit_softcap: Optional[float] = None
+    qk_norm: bool = False
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    def replace(self, **kw) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)
+
+    @classmethod
+    def from_hf_config(cls, cfg: Dict[str, Any]) -> "ModelConfig":
+        """Build from a HuggingFace config.json dict (llama/qwen2/mixtral)."""
+        hidden = cfg.get("hidden_size", 4096)
+        heads = cfg.get("num_attention_heads", 32)
+        return cls(
+            vocab_size=cfg.get("vocab_size", 32000),
+            hidden_size=hidden,
+            num_layers=cfg.get("num_hidden_layers", 32),
+            num_heads=heads,
+            num_kv_heads=cfg.get("num_key_value_heads", heads),
+            head_dim=cfg.get("head_dim", hidden // heads),
+            intermediate_size=cfg.get("intermediate_size", 4 * hidden),
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            rope_scaling=cfg.get("rope_scaling"),
+            rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            max_seq_len=cfg.get("max_position_embeddings", 8192),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            num_experts=cfg.get("num_local_experts",
+                                cfg.get("num_experts",
+                                        cfg.get("n_routed_experts", 0))) or 0,
+            experts_per_token=cfg.get("num_experts_per_tok", 0) or 0,
+            moe_intermediate_size=cfg.get("moe_intermediate_size", 0) or 0,
+            num_shared_experts=cfg.get("n_shared_experts", 0) or 0,
+            sliding_window=cfg.get("sliding_window"),
+        )
+
+
+# -- presets ---------------------------------------------------------------
+
+def llama3_8b() -> ModelConfig:
+    return ModelConfig(vocab_size=128256, hidden_size=4096, num_layers=32,
+                       num_heads=32, num_kv_heads=8, head_dim=128,
+                       intermediate_size=14336, rope_theta=500000.0,
+                       max_seq_len=8192)
+
+
+def llama3_70b() -> ModelConfig:
+    return ModelConfig(vocab_size=128256, hidden_size=8192, num_layers=80,
+                       num_heads=64, num_kv_heads=8, head_dim=128,
+                       intermediate_size=28672, rope_theta=500000.0,
+                       max_seq_len=8192)
+
+
+def qwen25_05b() -> ModelConfig:
+    return ModelConfig(vocab_size=151936, hidden_size=896, num_layers=24,
+                       num_heads=14, num_kv_heads=2, head_dim=64,
+                       intermediate_size=4864, rope_theta=1000000.0,
+                       tie_word_embeddings=True, max_seq_len=32768)
+
+
+def mixtral_8x7b() -> ModelConfig:
+    return ModelConfig(vocab_size=32000, hidden_size=4096, num_layers=32,
+                       num_heads=32, num_kv_heads=8, head_dim=128,
+                       intermediate_size=14336, rope_theta=1000000.0,
+                       num_experts=8, experts_per_token=2,
+                       moe_intermediate_size=14336, max_seq_len=32768)
+
+
+def tiny_test(moe: bool = False) -> ModelConfig:
+    """Structurally-faithful small config for tests and dry runs."""
+    return ModelConfig(vocab_size=512, hidden_size=128, num_layers=4,
+                       num_heads=8, num_kv_heads=4, head_dim=16,
+                       intermediate_size=256, max_seq_len=256,
+                       rope_theta=10000.0,
+                       num_experts=8 if moe else 0,
+                       experts_per_token=2 if moe else 0,
+                       moe_intermediate_size=128 if moe else 0)

@@ -1,0 +1,290 @@
+"""Flagship Llama-family decoder in pure-functional JAX.
+
+TPU-first design choices (vs. the torch modules the reference's engines
+wrap): parameters are a pytree of stacked per-layer arrays scanned with
+`lax.scan` (one compiled layer body, natural fit for pipeline stages),
+bf16 weights with fp32 softmax/norm accumulation, static shapes
+everywhere, and attention dispatched through ome_tpu.ops so the Pallas
+flash kernel is used on TPU with an XLA fallback on the CPU test mesh.
+
+Covers dense Llama/Qwen2-class models and (via cfg.num_experts) the
+Mixtral-style MoE variant with top-k routing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import attention, make_causal_mask
+from .config import ModelConfig
+
+Params = Dict[str, Any]
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    """Fixed-capacity per-layer KV cache.
+
+    k, v: [L, B, S_max, K, Dh]; index: scalar int32 next-write slot
+    (the serving engine's paged cache builds on the same layout).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    index: jax.Array
+
+    @classmethod
+    def create(cls, cfg: ModelConfig, batch: int, max_seq: Optional[int] = None,
+               dtype=None) -> "KVCache":
+        S = max_seq or cfg.max_seq_len
+        dtype = dtype or cfg.dtype
+        shape = (cfg.num_layers, batch, S, cfg.num_kv_heads, cfg.head_dim)
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   index=jnp.zeros((), jnp.int32))
+
+
+# -- init ------------------------------------------------------------------
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Initialize parameters (normal init scaled like Llama pretraining)."""
+    L, D, H, K, Dh, F = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
+                         cfg.num_kv_heads, cfg.head_dim,
+                         cfg.intermediate_size)
+    keys = iter(jax.random.split(rng, 16))
+
+    def norm(shape, key, std=0.02):
+        return (jax.random.normal(key, shape, jnp.float32) * std).astype(cfg.dtype)
+
+    layers: Params = {
+        "attn_norm": jnp.ones((L, D), cfg.dtype),
+        "wq": norm((L, D, H, Dh), next(keys)),
+        "wk": norm((L, D, K, Dh), next(keys)),
+        "wv": norm((L, D, K, Dh), next(keys)),
+        "wo": norm((L, H, Dh, D), next(keys), std=0.02 / (2 * L) ** 0.5),
+        "mlp_norm": jnp.ones((L, D), cfg.dtype),
+    }
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, Dh), cfg.dtype)
+        layers["k_norm"] = jnp.ones((L, Dh), cfg.dtype)
+    if cfg.is_moe:
+        E, Fm = cfg.num_experts, cfg.moe_intermediate_size or F
+        layers.update({
+            "router": norm((L, D, E), next(keys)),
+            "we_gate": norm((L, E, D, Fm), next(keys)),
+            "we_up": norm((L, E, D, Fm), next(keys)),
+            "we_down": norm((L, E, Fm, D), next(keys), std=0.02 / (2 * L) ** 0.5),
+        })
+        if cfg.num_shared_experts > 0:
+            Fs = Fm * cfg.num_shared_experts
+            layers.update({
+                "ws_gate": norm((L, D, Fs), next(keys)),
+                "ws_up": norm((L, D, Fs), next(keys)),
+                "ws_down": norm((L, Fs, D), next(keys),
+                                std=0.02 / (2 * L) ** 0.5),
+            })
+    else:
+        layers.update({
+            "w_gate": norm((L, D, F), next(keys)),
+            "w_up": norm((L, D, F), next(keys)),
+            "w_down": norm((L, F, D), next(keys), std=0.02 / (2 * L) ** 0.5),
+        })
+    params: Params = {
+        "embed": norm((cfg.vocab_size, D), next(keys)),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), cfg.dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = norm((D, cfg.vocab_size), next(keys))
+    return params
+
+
+def param_count(params: Params) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))
+
+
+# -- building blocks -------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    y = x.astype(jnp.float32) * lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope_frequencies(cfg: ModelConfig) -> jax.Array:
+    half = cfg.head_dim // 2
+    freqs = 1.0 / cfg.rope_theta ** (jnp.arange(half, dtype=jnp.float32) / half)
+    sc = cfg.rope_scaling
+    if sc and sc.get("rope_type", sc.get("type")) == "llama3":
+        # Llama-3.1 NTK-by-parts frequency remapping
+        factor = sc.get("factor", 8.0)
+        lo = sc.get("low_freq_factor", 1.0)
+        hi = sc.get("high_freq_factor", 4.0)
+        orig = sc.get("original_max_position_embeddings", 8192)
+        wavelen = 2 * jnp.pi / freqs
+        ramp = (orig / wavelen - lo) / (hi - lo)
+        ramp = jnp.clip(ramp, 0.0, 1.0)
+        smoothed = freqs * (ramp + (1 - ramp) / factor)
+        freqs = jnp.where(wavelen < orig / hi, freqs,          # high freq: keep
+                          jnp.where(wavelen > orig / lo,
+                                    freqs / factor,            # low freq: scale
+                                    smoothed))                 # medium: blend
+    return freqs
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, freqs: jax.Array) -> jax.Array:
+    """Rotate-half RoPE (HF Llama convention). x: [B, S, N, Dh]."""
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def dense_mlp(x: jax.Array, p: Params) -> jax.Array:
+    gate = jnp.einsum("bsd,df->bsf", x, p["w_gate"])
+    up = jnp.einsum("bsd,df->bsf", x, p["w_up"])
+    return jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, p["w_down"])
+
+
+def moe_mlp(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
+    """Top-k MoE block (Mixtral-style).
+
+    Round-1 implementation computes every expert and mixes by router
+    weight — correct, fully static shapes, MXU-batched over experts; the
+    engine path swaps in a ragged-dispatch Pallas kernel later.
+    """
+    B, S, D = x.shape
+    router_logits = jnp.einsum("bsd,de->bse", x, p["router"]).astype(jnp.float32)
+    weights, idx = lax.top_k(router_logits, cfg.experts_per_token)
+    weights = jax.nn.softmax(weights, axis=-1)  # [B,S,k]
+    gate = jnp.einsum("bsd,edf->bsef", x, p["we_gate"])
+    up = jnp.einsum("bsd,edf->bsef", x, p["we_up"])
+    expert_out = jnp.einsum("bsef,efd->bsed", jax.nn.silu(gate) * up,
+                            p["we_down"])  # [B,S,E,D]
+    onehot = jax.nn.one_hot(idx, cfg.num_experts, dtype=weights.dtype)  # [B,S,k,E]
+    mix = jnp.einsum("bske,bsk->bse", onehot, weights)  # [B,S,E]
+    out = jnp.einsum("bsed,bse->bsd", expert_out, mix.astype(expert_out.dtype))
+    if cfg.num_shared_experts > 0:
+        # DeepSeek-MoE shared experts: always-active dense branch
+        shared = {"w_gate": p["ws_gate"], "w_up": p["ws_up"],
+                  "w_down": p["ws_down"]}
+        out = out + dense_mlp(x, shared)
+    return out
+
+
+# -- forward ---------------------------------------------------------------
+
+
+def build_attn_mask(cfg: ModelConfig, positions: jax.Array, kv_pos: jax.Array,
+                    kv_len: Optional[jax.Array] = None) -> jax.Array:
+    """Causal (+ sliding-window) mask — shared by the dense and pipeline
+    forward paths so both attend identically."""
+    mask = make_causal_mask(positions, kv_pos, kv_len)
+    if cfg.sliding_window is not None:
+        window_ok = (kv_pos[None, None, :]
+                     > positions[:, :, None] - cfg.sliding_window)
+        mask = mask & window_ok
+    return mask
+
+
+def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
+           positions: jax.Array, mask: Optional[jax.Array],
+           cache_kv: Optional[Tuple[jax.Array, jax.Array]],
+           cache_index: Optional[jax.Array]):
+    """One transformer block. cache_kv: ([B,Smax,K,Dh], [B,Smax,K,Dh])."""
+    h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    if cfg.qk_norm:
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+    q = apply_rope(q, positions, freqs)
+    k = apply_rope(k, positions, freqs)
+
+    if cache_kv is not None:
+        ck, cv = cache_kv
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
+        k_full, v_full = ck, cv
+        new_cache = (ck, cv)
+    else:
+        k_full, v_full = k, v
+        new_cache = None
+
+    attn = attention(q, k_full, v_full, mask=mask,
+                     logit_softcap=cfg.attn_logit_softcap)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    mlp_out = moe_mlp(h, lp, cfg) if cfg.is_moe else dense_mlp(h, lp)
+    return x + mlp_out, new_cache
+
+
+def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
+            positions: Optional[jax.Array] = None,
+            cache: Optional[KVCache] = None,
+            ) -> Tuple[jax.Array, Optional[KVCache]]:
+    """Run the decoder.
+
+    tokens: [B, S] int32. positions: [B, S] (defaults to arange).
+    With `cache`, K/V are written at cache.index and attention spans the
+    cache (serving decode/chunked prefill); without, plain causal prefill.
+    Returns (logits [B, S, vocab], updated cache or None).
+    """
+    B, S = tokens.shape
+    if positions is None:
+        base = jnp.arange(S, dtype=jnp.int32)[None, :]
+        positions = jnp.broadcast_to(base + (cache.index if cache is not None else 0),
+                                     (B, S))
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    freqs = _rope_frequencies(cfg)
+
+    if cache is not None:
+        kv_pos = jnp.arange(cache.k.shape[2], dtype=jnp.int32)
+        kv_len = jnp.broadcast_to(cache.index + S, (B,))
+        mask = build_attn_mask(cfg, positions, kv_pos, kv_len)
+    else:
+        kv_pos = jnp.arange(S, dtype=jnp.int32)
+        mask = build_attn_mask(cfg, positions, kv_pos)
+
+    def body(x, per_layer):
+        lp, layer_cache = per_layer
+        x, new_cache = _layer(x, lp, cfg, freqs, positions, mask,
+                              layer_cache, cache.index if cache is not None else None)
+        return x, new_cache
+
+    if cache is not None:
+        x, (nk, nv) = lax.scan(body, x, (params["layers"], (cache.k, cache.v)))
+        new_cache = KVCache(k=nk, v=nv, index=cache.index + S)
+    else:
+        x, _ = lax.scan(body, x, (params["layers"], None))
+        new_cache = None
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed"].T
+    logits = jnp.einsum("bsd,dv->bsv", x, head,
+                        preferred_element_type=jnp.float32)
+    return logits, new_cache
+
+
+def loss_fn(params: Params, cfg: ModelConfig, tokens: jax.Array,
+            targets: jax.Array, mask: Optional[jax.Array] = None) -> jax.Array:
+    """Next-token cross-entropy (fp32 logits), for the training step."""
+    logits, _ = forward(params, cfg, tokens)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return jnp.mean(nll)

@@ -1,0 +1,84 @@
+"""Attention ops with a pluggable backend.
+
+`attention()` is the single entry point the models call. On TPU it
+dispatches to the Pallas flash-attention kernel (ome_tpu/ops/flash.py);
+elsewhere (CPU test mesh) it uses an XLA reference implementation. Both
+compute GQA attention with fp32 softmax accumulation — the MXU-friendly
+layout keeps heads x head_dim contiguous in the last two dims.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -2.0e38
+
+
+def make_causal_mask(q_pos: jax.Array, kv_pos: jax.Array,
+                     kv_len: Optional[jax.Array] = None) -> jax.Array:
+    """Boolean mask [.., Sq, Skv]: True = attend.
+
+    q_pos: [B, Sq] absolute positions of queries
+    kv_pos: [Skv] absolute positions of kv slots
+    kv_len: optional [B] number of valid kv slots (for fixed-size caches)
+    """
+    m = kv_pos[None, None, :] <= q_pos[:, :, None]  # [B, Sq, Skv]
+    if kv_len is not None:
+        m = m & (kv_pos[None, None, :] < kv_len[:, None, None])
+    return m
+
+
+def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                  mask: Optional[jax.Array] = None,
+                  scale: Optional[float] = None,
+                  logit_softcap: Optional[float] = None) -> jax.Array:
+    """Reference GQA attention.
+
+    q: [B, Sq, H, D]; k, v: [B, Skv, K, D] with H % K == 0.
+    mask: [B, Sq, Skv] boolean (True = attend) or None for full causal-free.
+    Returns [B, Sq, H, D] in q.dtype.
+    """
+    B, Sq, H, D = q.shape
+    K = k.shape[2]
+    G = H // K
+    scale = scale if scale is not None else D ** -0.5
+    qg = q.reshape(B, Sq, K, G, D)
+    logits = jnp.einsum("bskgd,btkd->bkgst", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    if logit_softcap:
+        logits = jnp.tanh(logits / logit_softcap) * logit_softcap
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs.astype(v.dtype), v)
+    return out.reshape(B, Sq, H, D)
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array,
+              mask: Optional[jax.Array] = None,
+              scale: Optional[float] = None,
+              logit_softcap: Optional[float] = None,
+              backend: Optional[str] = None) -> jax.Array:
+    """Dispatching attention entry point used by all models."""
+    if backend is None:
+        backend = "pallas" if _on_tpu() else "xla"
+    if backend == "pallas":
+        from . import flash
+        out = flash.flash_attention(q, k, v, mask=mask, scale=scale,
+                                    logit_softcap=logit_softcap)
+        if out is not None:
+            return out
+    return xla_attention(q, k, v, mask=mask, scale=scale,
+                         logit_softcap=logit_softcap)
+
+
+@functools.cache
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover - no backend at all
+        return False

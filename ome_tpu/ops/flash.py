@@ -1,0 +1,16 @@
+"""Pallas TPU flash-attention kernel (filled in by ops task; returns None
+to fall back to XLA until the kernel supports the given shapes)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    mask: Optional[jax.Array] = None,
+                    scale: Optional[float] = None,
+                    logit_softcap: Optional[float] = None):
+    """Return attention output or None if unsupported (caller falls back)."""
+    return None

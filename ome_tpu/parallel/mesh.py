@@ -1,0 +1,69 @@
+"""Device mesh construction for TPU slices.
+
+Axis convention (the scaling-book recipe — pick a mesh, annotate
+shardings, let XLA insert collectives over ICI):
+
+  dp — data parallel (batch dim; DCN axis for multislice)
+  pp — pipeline parallel (layer stages; GSPMD collective-permute ring)
+  tp — tensor parallel (heads / mlp / vocab; also carries the
+       Megatron-style sequence-parallel activation sharding and the
+       expert-parallel axis for MoE blocks, as in Megatron/DeepSpeed-MoE)
+
+The reference operator only *orchestrates* engine parallelism via CLI
+args (SURVEY.md §2.9); here the mesh is first-class and engine flags
+(tp_size etc.) map directly onto these axes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "pp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    pp: int = 1
+    tp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.pp * self.tp
+
+    @classmethod
+    def auto(cls, n_devices: int, num_layers: int = 0,
+             want_pp: bool = True) -> "MeshConfig":
+        """Factor n_devices into (dp, pp, tp), preferring tp then pp.
+
+        tp gets the innermost (fastest ICI) axis; pp only if the layer
+        count divides; remaining devices go to dp.
+        """
+        n = n_devices
+        tp = 2 if n % 2 == 0 else 1
+        if n % 4 == 0 and n >= 16:
+            tp = 4  # bigger slices: widen tp on the innermost ICI axis
+        rem = n // tp
+        pp = 1
+        if want_pp and rem % 2 == 0 and (num_layers == 0 or num_layers % 2 == 0):
+            pp = 2
+        dp = rem // pp
+        return cls(dp=dp, pp=pp, tp=tp)
+
+
+def build_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = cfg.size
+    if len(devices) < n:
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(cfg.dp, cfg.pp, cfg.tp)
+    return Mesh(arr, AXES)
+
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))

@@ -1,0 +1,97 @@
+"""Parameter and activation sharding rules.
+
+Maps the llama param pytree onto the (dp, pp, tp) mesh:
+  * attention heads, MLP hidden, vocab         -> tp (Megatron layout)
+  * MoE expert dim                             -> tp (expert parallelism
+    over the same group, DeepSpeed-MoE style)
+  * stacked-layer leading dim (pipeline mode)  -> pp
+  * batch / optimizer state                    -> dp (ZeRO-1 style for
+    optimizer state; params stay replicated across dp)
+
+Rules are keyed by param name, not position, so every model family that
+follows the llama.py naming gets sharded consistently.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# spec for each stacked-layer leaf, WITHOUT the leading layer/stage dims.
+_LAYER_RULES: Dict[str, tuple] = {
+    "attn_norm": (None,),
+    "mlp_norm": (None,),
+    "q_norm": (None,),
+    "k_norm": (None,),
+    "wq": (None, "tp", None),      # [D, H, Dh]
+    "wk": (None, "tp", None),
+    "wv": (None, "tp", None),
+    "wo": ("tp", None, None),      # [H, Dh, D]
+    "w_gate": (None, "tp"),        # [D, F]
+    "w_up": (None, "tp"),
+    "w_down": ("tp", None),        # [F, D]
+    "router": (None, None),        # [D, E] replicated
+    "we_gate": ("tp", None, None),  # [E, D, F] — experts sharded (EP)
+    "we_up": ("tp", None, None),
+    "we_down": ("tp", None, None),
+    "ws_gate": (None, "tp"),        # shared experts: dense Megatron split
+    "ws_up": (None, "tp"),
+    "ws_down": ("tp", None),
+}
+
+_TOP_RULES: Dict[str, tuple] = {
+    "embed": ("tp", None),         # vocab-sharded
+    "final_norm": (None,),
+    "lm_head": (None, "tp"),
+}
+
+
+def param_specs(params: Dict[str, Any], pipeline: bool = False) -> Dict[str, Any]:
+    """PartitionSpec pytree matching `params`.
+
+    pipeline=True expects layer leaves reshaped to [pp, L/pp, ...] and
+    shards the stage dim on "pp"; otherwise layer leaves are [L, ...].
+    """
+    layer_prefix = ("pp", None) if pipeline else (None,)
+    out: Dict[str, Any] = {}
+    for name, leaf in params.items():
+        if name == "layers":
+            out["layers"] = {
+                k: P(*layer_prefix, *_LAYER_RULES[k]) for k in leaf
+            }
+        else:
+            out[name] = P(*_TOP_RULES[name])
+    return out
+
+
+def shard_params(params, mesh: Mesh, pipeline: bool = False):
+    specs = param_specs(params, pipeline)
+    return jax.device_put(
+        params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P)))
+
+
+def logical(x, mesh: Optional[Mesh], *spec):
+    """with_sharding_constraint if inside a mesh context, else identity."""
+    if mesh is None or mesh.empty:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+def stack_to_stages(params: Dict[str, Any], pp: int) -> Dict[str, Any]:
+    """Reshape stacked layer leaves [L, ...] -> [pp, L/pp, ...]."""
+    out = dict(params)
+    out["layers"] = jax.tree.map(
+        lambda x: x.reshape(pp, x.shape[0] // pp, *x.shape[1:]),
+        params["layers"])
+    return out
+
+
+def unstack_stages(params: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(params)
+    out["layers"] = jax.tree.map(
+        lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]),
+        params["layers"])
+    return out

@@ -2,19 +2,20 @@
 
 Forces JAX onto a virtual 8-device CPU mesh so multi-chip sharding tests
 (tp/pp/dp/sp/ep over jax.sharding.Mesh) run without TPU hardware — the
-same trick the driver uses for dryrun_multichip validation.
+same setup the driver uses for dryrun_multichip validation.
 
-Must run before any jax import, hence the env mutation at module scope of
-the earliest-loaded conftest.
+The image's sitecustomize pre-imports jax pinned to the axon TPU
+backend, so env vars alone don't switch platforms; reuse the
+config-level forcing from __graft_entry__.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # for subprocess children
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _force_cpu_devices  # noqa: E402
+
+_force_cpu_devices(8)

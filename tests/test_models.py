@@ -1,0 +1,164 @@
+"""Model correctness tests: causality, decode/prefill consistency,
+MoE routing, parameter accounting."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ome_tpu.models import config as cfgs
+from ome_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return cfgs.tiny_test().replace(dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def tiny_params(tiny):
+    return llama.init_params(jax.random.PRNGKey(0), tiny)
+
+
+class TestForward:
+    def test_shapes(self, tiny, tiny_params):
+        tokens = jnp.ones((2, 16), jnp.int32)
+        logits, cache = llama.forward(tiny_params, tiny, tokens)
+        assert logits.shape == (2, 16, tiny.vocab_size)
+        assert logits.dtype == jnp.float32
+        assert cache is None
+
+    def test_causality(self, tiny, tiny_params):
+        """Changing a future token must not affect earlier logits."""
+        rng = jax.random.PRNGKey(1)
+        tokens = jax.random.randint(rng, (1, 12), 0, tiny.vocab_size)
+        logits_a, _ = llama.forward(tiny_params, tiny, tokens)
+        tampered = tokens.at[0, 8].set((tokens[0, 8] + 7) % tiny.vocab_size)
+        logits_b, _ = llama.forward(tiny_params, tiny, tampered)
+        assert jnp.allclose(logits_a[0, :8], logits_b[0, :8], atol=1e-5)
+        assert not jnp.allclose(logits_a[0, 8:], logits_b[0, 8:], atol=1e-3)
+
+    def test_decode_matches_prefill(self, tiny, tiny_params):
+        """Cached chunked decode must reproduce uncached prefill logits."""
+        rng = jax.random.PRNGKey(2)
+        T = 10
+        tokens = jax.random.randint(rng, (2, T), 0, tiny.vocab_size)
+        full_logits, _ = llama.forward(tiny_params, tiny, tokens)
+
+        cache = llama.KVCache.create(tiny, batch=2, max_seq=32,
+                                     dtype=jnp.float32)
+        pre_logits, cache = llama.forward(tiny_params, tiny, tokens[:, :6],
+                                          cache=cache)
+        assert jnp.allclose(pre_logits, full_logits[:, :6], atol=1e-4)
+        # decode one token at a time
+        for t in range(6, T):
+            step_logits, cache = llama.forward(tiny_params, tiny,
+                                               tokens[:, t:t + 1], cache=cache)
+            assert jnp.allclose(step_logits[:, 0], full_logits[:, t],
+                                atol=1e-4), f"mismatch at {t}"
+        assert int(cache.index) == T
+
+    def test_jit_decode_compiles_once(self, tiny, tiny_params):
+        decode = jax.jit(lambda p, tok, c: llama.forward(p, tiny, tok, cache=c))
+        cache = llama.KVCache.create(tiny, batch=1, max_seq=32)
+        tok = jnp.zeros((1, 1), jnp.int32)
+        logits, cache = decode(tiny_params, tok, cache)
+        logits, cache = decode(tiny_params, tok + 1, cache)
+        assert int(cache.index) == 2
+
+    def test_tied_embeddings(self):
+        cfg = cfgs.tiny_test().replace(tie_word_embeddings=True,
+                                       dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        assert "lm_head" not in params
+        logits, _ = llama.forward(params, cfg, jnp.ones((1, 4), jnp.int32))
+        assert logits.shape == (1, 4, cfg.vocab_size)
+
+    def test_sliding_window(self, tiny, tiny_params):
+        cfg = tiny.replace(sliding_window=4)
+        tokens = jnp.ones((1, 12), jnp.int32)
+        logits, _ = llama.forward(tiny_params, cfg, tokens)
+        assert logits.shape == (1, 12, cfg.vocab_size)
+
+
+class TestRoPE:
+    def test_llama3_scaling_matches_reference_formula(self):
+        """Check all three bands against transformers'
+        _compute_llama3_parameters (modeling_rope_utils.py) in numpy."""
+        import numpy as np
+        cfg = cfgs.tiny_test().replace(
+            head_dim=128, rope_theta=500000.0,
+            rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                          "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                          "original_max_position_embeddings": 8192})
+        got = np.asarray(llama._rope_frequencies(cfg))
+
+        inv = 1.0 / cfg.rope_theta ** (np.arange(64) / 64)
+        lo_wave = 8192 / 1.0
+        hi_wave = 8192 / 4.0
+        want = []
+        for f in inv:
+            wl = 2 * np.pi / f
+            if wl < hi_wave:
+                want.append(f)
+            elif wl > lo_wave:
+                want.append(f / 8.0)
+            else:
+                smooth = (8192 / wl - 1.0) / (4.0 - 1.0)
+                want.append((1 - smooth) * f / 8.0 + smooth * f)
+        np.testing.assert_allclose(got, np.array(want, np.float32), rtol=1e-6)
+
+
+class TestMoE:
+    def test_shared_experts_contribute(self):
+        cfg = cfgs.tiny_test(moe=True).replace(dtype=jnp.float32,
+                                               num_shared_experts=2)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        assert "ws_gate" in params["layers"]
+        tokens = jnp.ones((1, 4), jnp.int32)
+        logits, _ = llama.forward(params, cfg, tokens)
+        # zeroing the shared expert weights must change the output
+        params2 = dict(params)
+        params2["layers"] = dict(params["layers"])
+        params2["layers"]["ws_down"] = jnp.zeros_like(
+            params["layers"]["ws_down"])
+        logits2, _ = llama.forward(params2, cfg, tokens)
+        assert not jnp.allclose(logits, logits2, atol=1e-5)
+
+    def test_moe_forward_and_grad(self):
+        cfg = cfgs.tiny_test(moe=True).replace(dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        assert "router" in params["layers"]
+        tokens = jnp.ones((2, 8), jnp.int32)
+        logits, _ = llama.forward(params, cfg, tokens)
+        assert logits.shape == (2, 8, cfg.vocab_size)
+        g = jax.grad(llama.loss_fn)(params, cfg, tokens, tokens)
+        assert jnp.isfinite(g["layers"]["router"]).all()
+
+
+class TestAccounting:
+    def test_llama3_8b_param_count(self):
+        cfg = cfgs.llama3_8b()
+        # analytic count (no materialization): embed + head + layers
+        L, D, H, K, Dh, F, V = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
+                                cfg.num_kv_heads, cfg.head_dim,
+                                cfg.intermediate_size, cfg.vocab_size)
+        n = V * D * 2 + D  # embed + lm_head + final norm
+        n += L * (2 * D + D * H * Dh + 2 * D * K * Dh + H * Dh * D + 3 * D * F)
+        assert n == pytest.approx(8.03e9, rel=0.01)
+
+    def test_loss_decreases_with_sgd(self):
+        cfg = cfgs.tiny_test().replace(dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(3), (4, 16), 0,
+                                    cfg.vocab_size)
+        targets = jnp.roll(tokens, -1, axis=1)
+
+        @jax.jit
+        def step(p):
+            l, g = jax.value_and_grad(llama.loss_fn)(p, cfg, tokens, targets)
+            return l, jax.tree.map(lambda w, gw: w - 0.05 * gw, p, g)
+
+        l0, params = step(params)
+        for _ in range(5):
+            l1, params = step(params)
+        assert l1 < l0
