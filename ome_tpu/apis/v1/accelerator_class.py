@@ -106,6 +106,8 @@ def parse_topology(name: str) -> Optional[TopologySpec]:
         dims = [int(d) for d in name.lower().split("x")]
     except (ValueError, AttributeError):
         return None
+    if not dims or any(d < 1 for d in dims):
+        return None
     chips = 1
     for d in dims:
         chips *= d

@@ -149,13 +149,18 @@ class InMemoryClient:
         self._garbage_collect(cur)
 
     def _garbage_collect(self, owner: Resource):
-        """Cascade-delete objects owned (controller=True) by `owner`."""
+        """k8s-style GC: drop the dead owner's references; an object is
+        cascade-deleted only once its last owner reference is gone."""
         doomed = []
         for key, obj in list(self._store.items()):
-            for ref in obj.metadata.owner_references:
-                if ref.uid == owner.metadata.uid:
-                    doomed.append((key, obj))
-                    break
+            refs = obj.metadata.owner_references
+            remaining = [r for r in refs if r.uid != owner.metadata.uid]
+            if len(remaining) == len(refs):
+                continue
+            if remaining:
+                obj.metadata.owner_references = remaining
+            else:
+                doomed.append((key, obj))
         for key, obj in doomed:
             obj.metadata.finalizers = []
             self._finish_delete(key, obj)

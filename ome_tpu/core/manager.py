@@ -68,10 +68,9 @@ class Manager:
             if rec.FOR is not None and kind == rec.FOR.KIND:
                 q.add((obj.metadata.namespace, obj.metadata.name))
             for ref in obj.metadata.owner_references:
-                for owned_parent in [rec.FOR] if rec.FOR else []:
-                    if ref.controller and ref.kind == owned_parent.KIND and any(
-                            type(obj) is k or type(obj).KIND == k.KIND for k in rec.owns()):
-                        q.add((obj.metadata.namespace, ref.name))
+                if (rec.FOR and ref.controller and ref.kind == rec.FOR.KIND
+                        and any(kind == k.KIND for k in rec.owns())):
+                    q.add((obj.metadata.namespace, ref.name))
             for watched_cls, mapper in rec.watches():
                 if kind == watched_cls.KIND:
                     for key in mapper(obj):
@@ -140,8 +139,10 @@ class Manager:
                 try:
                     res = rec.reconcile(ns, name) or Result()
                     q.forget(item)
-                    if res.requeue:
-                        q.add_rate_limited(item)
+                    # test mode: requeues retry immediately (bounded by
+                    # max_iters) instead of waiting out backoff delays
+                    if res.requeue or res.requeue_after > 0:
+                        q.add(item)
                 except Exception:
                     log.error("reconcile %s %s/%s failed:\n%s",
                               type(rec).__name__, ns, name, traceback.format_exc())

@@ -10,13 +10,10 @@ from __future__ import annotations
 
 import dataclasses
 import datetime
-import itertools
 from dataclasses import dataclass, field
 from typing import ClassVar, Dict, List, Optional
 
 from . import serde
-
-_now_counter = itertools.count()
 
 
 def now() -> str:
@@ -69,8 +66,11 @@ def set_condition(conditions: List[Condition], cond: Condition) -> List[Conditio
     replaced = False
     for c in conditions:
         if c.type == cond.type:
-            if c.status != cond.status or cond.last_transition_time is None:
-                cond.last_transition_time = now()
+            if cond.last_transition_time is None:
+                # preserve the transition time while status is stable
+                cond.last_transition_time = (c.last_transition_time
+                                             if c.status == cond.status
+                                             else now())
             out.append(cond)
             replaced = True
         else:

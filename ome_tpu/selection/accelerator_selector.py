@@ -1,0 +1,242 @@
+"""AcceleratorClass selection engine.
+
+Re-designs pkg/acceleratorclassselector (SURVEY.md §2.4) TPU-first:
+resolution order is explicit name > component override > policy
+(selector.go:46-105); candidates are filtered by runtime
+AcceleratorRequirements and isvc constraints (policy_helpers.go:60-177);
+policies:
+
+  BestFit      — smallest slice whose aggregate HBM fits the model's
+                 weights + KV-cache headroom (memory-fit scoring,
+                 policy_helpers.go:178-319, re-based on chips x HBM/chip)
+  Cheapest     — lowest $/chip-hour x chips needed (:320-364)
+  MostCapable  — normalized TFLOPS/HBM/bandwidth score (:366-509)
+  FirstAvailable — first candidate with matched ready nodes
+
+Unlike the GPU reference (nvidia.com/gpu counting), sizing reasons in
+chips / hosts / slice topologies, and returns the chosen TopologySpec so
+downstream reconcilers can stamp slice-shaped LWS groups.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from ..apis import v1
+from ..core.client import InMemoryClient
+from ..core.errors import APIError
+
+BYTES_PER_PARAM_BF16 = 2.0
+KV_HEADROOM = 1.35  # weights + runtime KV/cache/activation headroom
+
+
+class AcceleratorSelectionError(APIError):
+    pass
+
+
+@dataclass
+class AcceleratorChoice:
+    accelerator: v1.AcceleratorClass
+    topology: Optional[v1.TopologySpec] = None
+    chips: int = 0
+    reason: str = ""
+
+    @property
+    def name(self) -> str:
+        return self.accelerator.metadata.name
+
+
+def required_hbm_gb(model: Optional[v1.BaseModelSpec]) -> Optional[float]:
+    if model is None:
+        return None
+    size = v1.parse_parameter_size(model.model_parameter_size)
+    if size is None:
+        return None
+    bytes_per_param = BYTES_PER_PARAM_BF16
+    if model.quantization in (v1.ModelQuantization.FP8,
+                              v1.ModelQuantization.FBGEMM_FP8,
+                              v1.ModelQuantization.INT8):
+        bytes_per_param = 1.0
+    elif model.quantization == v1.ModelQuantization.INT4:
+        bytes_per_param = 0.5
+    return size * bytes_per_param * KV_HEADROOM / 1e9
+
+
+def chips_needed(model: Optional[v1.BaseModelSpec],
+                 ac: v1.AcceleratorClass) -> int:
+    need = required_hbm_gb(model)
+    per_chip = ac.spec.capabilities.memory_gb or 16.0
+    if need is None:
+        return 1
+    import math
+    return max(1, math.ceil(need / per_chip))
+
+
+def smallest_fitting_topology(ac: v1.AcceleratorClass, chips: int,
+                              ) -> Optional[v1.TopologySpec]:
+    topos = sorted(ac.spec.capabilities.topologies, key=lambda t: t.chips)
+    for t in topos:
+        if t.chips >= chips:
+            return t
+    return topos[-1] if topos else None
+
+
+class AcceleratorSelector:
+    def __init__(self, client: InMemoryClient):
+        self.client = client
+
+    # -- resolution (selector.go:46-105) --------------------------------
+
+    def resolve(self, isvc: v1.InferenceService,
+                runtime_spec: Optional[v1.ServingRuntimeSpec] = None,
+                model: Optional[v1.BaseModelSpec] = None,
+                component_override: Optional[str] = None) -> AcceleratorChoice:
+        sel = isvc.spec.accelerator_selector or v1.AcceleratorSelector()
+        # 1. component-level override wins
+        if component_override:
+            return self._by_name(component_override, sel, model)
+        # 2. explicit class on the isvc
+        if sel.accelerator_class:
+            return self._by_name(sel.accelerator_class, sel, model)
+        # 3. policy over filtered candidates
+        candidates = self._candidates(runtime_spec, model)
+        if not candidates:
+            raise AcceleratorSelectionError(
+                "no AcceleratorClass candidates match the runtime "
+                "requirements and model constraints")
+        policy = sel.policy or v1.AcceleratorSelectorPolicy.BEST_FIT
+        choice = self._apply_policy(policy, candidates, model)
+        if sel.topology:
+            topo = v1.parse_topology(sel.topology)
+            known = {t.name: t for t in
+                     choice.accelerator.spec.capabilities.topologies}
+            choice.topology = known.get(sel.topology, topo)
+            choice.chips = choice.topology.chips if choice.topology else 0
+        return choice
+
+    def _by_name(self, name: str, sel: v1.AcceleratorSelector,
+                 model: Optional[v1.BaseModelSpec]) -> AcceleratorChoice:
+        ac = self.client.try_get(v1.AcceleratorClass, name)
+        if ac is None:
+            raise AcceleratorSelectionError(
+                f"AcceleratorClass {name!r} not found")
+        chips = chips_needed(model, ac)
+        topo = None
+        if sel.topology:
+            topo = v1.parse_topology(sel.topology)
+        if topo is None:
+            topo = smallest_fitting_topology(ac, chips)
+        return AcceleratorChoice(ac, topo, topo.chips if topo else chips,
+                                 reason="explicit")
+
+    # -- candidate filtering (policy_helpers.go:60-177) ------------------
+
+    def _candidates(self, runtime_spec: Optional[v1.ServingRuntimeSpec],
+                    model: Optional[v1.BaseModelSpec],
+                    ) -> List[v1.AcceleratorClass]:
+        out = []
+        req = runtime_spec.accelerator_requirements if runtime_spec else None
+        for ac in self.client.list(v1.AcceleratorClass):
+            caps = ac.spec.capabilities
+            if req:
+                if req.accelerator_classes and \
+                        ac.metadata.name not in req.accelerator_classes:
+                    continue
+                if req.min_memory_gb and (caps.memory_gb or 0) < req.min_memory_gb:
+                    continue
+                if any(f not in caps.features for f in req.required_features):
+                    continue
+                if req.topologies:
+                    have = {t.name for t in caps.topologies}
+                    if not have.intersection(req.topologies):
+                        continue
+            # model must fit on the largest available slice
+            need = required_hbm_gb(model)
+            if need is not None and caps.topologies:
+                max_chips = max(t.chips for t in caps.topologies)
+                if (caps.memory_gb or 0) * max_chips < need:
+                    continue
+            out.append(ac)
+        return out
+
+    # -- policies --------------------------------------------------------
+
+    def _apply_policy(self, policy: v1.AcceleratorSelectorPolicy,
+                      candidates: List[v1.AcceleratorClass],
+                      model: Optional[v1.BaseModelSpec]) -> AcceleratorChoice:
+        if policy == v1.AcceleratorSelectorPolicy.BEST_FIT:
+            return self._best_fit(candidates, model)
+        if policy == v1.AcceleratorSelectorPolicy.CHEAPEST:
+            return self._cheapest(candidates, model)
+        if policy == v1.AcceleratorSelectorPolicy.MOST_CAPABLE:
+            return self._most_capable(candidates, model)
+        if policy == v1.AcceleratorSelectorPolicy.FIRST_AVAILABLE:
+            return self._first_available(candidates, model)
+        raise AcceleratorSelectionError(f"unknown policy {policy}")
+
+    def _best_fit(self, candidates, model) -> AcceleratorChoice:
+        """Least wasted HBM across the smallest fitting slice; TFLOPS as
+        tiebreak (policy_helpers.go:178-319 re-based on slices)."""
+        best: Optional[Tuple[float, float, AcceleratorChoice]] = None
+        need = required_hbm_gb(model)
+        for ac in candidates:
+            chips = chips_needed(model, ac)
+            topo = smallest_fitting_topology(ac, chips)
+            total_chips = topo.chips if topo else chips
+            total_hbm = (ac.spec.capabilities.memory_gb or 0) * total_chips
+            waste = total_hbm - (need or 0)
+            tflops = (ac.spec.capabilities.bf16_tflops or 0) * total_chips
+            choice = AcceleratorChoice(ac, topo, total_chips, reason="BestFit")
+            key = (waste, -tflops)
+            if best is None or key < best[:2] or \
+                    (key == best[:2] and choice.name < best[2].name):
+                best = (*key, choice)
+        return best[2]
+
+    def _cheapest(self, candidates, model) -> AcceleratorChoice:
+        best = None
+        for ac in candidates:
+            chips = chips_needed(model, ac)
+            topo = smallest_fitting_topology(ac, chips)
+            total = topo.chips if topo else chips
+            cost = (ac.spec.cost.per_chip_hour_usd
+                    if ac.spec.cost and ac.spec.cost.per_chip_hour_usd
+                    else float("inf")) * total
+            choice = AcceleratorChoice(ac, topo, total, reason="Cheapest")
+            if best is None or cost < best[0] or \
+                    (cost == best[0] and choice.name < best[1].name):
+                best = (cost, choice)
+        return best[1]
+
+    def _most_capable(self, candidates, model) -> AcceleratorChoice:
+        """Normalized per-chip tflops + hbm + bandwidth (':366-509')."""
+        max_tf = max((c.spec.capabilities.bf16_tflops or 1) for c in candidates)
+        max_mem = max((c.spec.capabilities.memory_gb or 1) for c in candidates)
+        max_bw = max((c.spec.capabilities.memory_bandwidth_gbps or 1)
+                     for c in candidates)
+        best = None
+        for ac in candidates:
+            caps = ac.spec.capabilities
+            score = ((caps.bf16_tflops or 0) / max_tf
+                     + (caps.memory_gb or 0) / max_mem
+                     + (caps.memory_bandwidth_gbps or 0) / max_bw)
+            chips = chips_needed(model, ac)
+            topo = smallest_fitting_topology(ac, chips)
+            choice = AcceleratorChoice(ac, topo, topo.chips if topo else chips,
+                                       reason="MostCapable")
+            if best is None or score > best[0] or \
+                    (score == best[0] and choice.name < best[1].name):
+                best = (score, choice)
+        return best[1]
+
+    def _first_available(self, candidates, model) -> AcceleratorChoice:
+        for ac in sorted(candidates, key=lambda a: a.metadata.name):
+            if ac.status.node_count > 0:
+                chips = chips_needed(model, ac)
+                topo = smallest_fitting_topology(ac, chips)
+                return AcceleratorChoice(ac, topo,
+                                         topo.chips if topo else chips,
+                                         reason="FirstAvailable")
+        raise AcceleratorSelectionError(
+            "no AcceleratorClass has matched nodes (FirstAvailable)")

@@ -1,0 +1,340 @@
+"""Runtime selection engine.
+
+Re-designs the reference's pkg/runtimeselector (fetcher.go / matcher.go /
+scorer.go / selector.go, SURVEY.md §2.4) for the TPU catalog: given a
+BaseModel, fetch namespace + cluster ServingRuntimes, evaluate detailed
+compatibility (format / framework / architecture / quantization / size
+range / protocol / accelerator requirements), score the matches
+(weight x priority with size-proximity and namespace tiebreaks) and pick
+deterministically.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple, Union
+
+from ..apis import v1
+from ..core.client import InMemoryClient
+from ..core.errors import APIError
+from ..utils.modelver import compare_lenient
+
+Runtime = Union[v1.ServingRuntime, v1.ClusterServingRuntime]
+
+# scoring weights (reference scorer.go:30-100)
+FORMAT_WEIGHT = 10
+FRAMEWORK_WEIGHT = 5
+ARCHITECTURE_WEIGHT = 8
+QUANTIZATION_WEIGHT = 3
+
+
+class SelectionError(APIError):
+    pass
+
+
+class NoRuntimeFoundError(SelectionError):
+    def __init__(self, model: str, reports: List["CompatibilityReport"]):
+        self.reports = reports
+        detail = "; ".join(
+            f"{r.runtime_name}: {r.first_failure()}" for r in reports[:5])
+        super().__init__(
+            f"no suitable runtime found for model {model!r}"
+            + (f" (candidates: {detail})" if detail else ""))
+
+
+class RuntimeNotFoundError(SelectionError):
+    pass
+
+
+class RuntimeIncompatibleError(SelectionError):
+    def __init__(self, runtime: str, model: str, report: "CompatibilityReport"):
+        self.report = report
+        super().__init__(
+            f"runtime {runtime!r} is incompatible with model {model!r}: "
+            f"{report.first_failure()}")
+
+
+class RuntimeDisabledError(SelectionError):
+    pass
+
+
+@dataclass
+class CheckResult:
+    name: str
+    passed: bool
+    reason: str = ""
+
+
+@dataclass
+class CompatibilityReport:
+    """Per-runtime detailed evaluation (matcher.go GetCompatibilityDetails)."""
+
+    runtime_name: str = ""
+    cluster_scoped: bool = False
+    checks: List[CheckResult] = field(default_factory=list)
+    matched_format: Optional[v1.SupportedModelFormat] = None
+
+    @property
+    def compatible(self) -> bool:
+        return all(c.passed for c in self.checks)
+
+    def first_failure(self) -> str:
+        for c in self.checks:
+            if not c.passed:
+                return f"{c.name}: {c.reason}"
+        return ""
+
+
+@dataclass
+class RuntimeMatch:
+    runtime: Runtime
+    report: CompatibilityReport
+    score: int = 0
+    size_distance: float = float("inf")
+
+    @property
+    def name(self) -> str:
+        return self.runtime.metadata.name
+
+
+# -- fetcher (fetcher.go:29-97) --------------------------------------------
+
+
+class Fetcher:
+    def __init__(self, client: InMemoryClient):
+        self.client = client
+
+    def fetch(self, namespace: str) -> List[Runtime]:
+        ns_runtimes: List[Runtime] = list(
+            self.client.list(v1.ServingRuntime, namespace=namespace))
+        cluster_runtimes: List[Runtime] = list(
+            self.client.list(v1.ClusterServingRuntime))
+        return sorted(ns_runtimes, key=lambda r: r.metadata.name) + \
+            sorted(cluster_runtimes, key=lambda r: r.metadata.name)
+
+
+# -- matcher (matcher.go:29-160) -------------------------------------------
+
+
+def _name_version_match(want_name: Optional[str], want_version: Optional[str],
+                        got: Optional[dict]) -> Tuple[bool, str]:
+    if not want_name:
+        return True, ""
+    got = got or {}
+    if got.get("name", "").lower() != want_name.lower():
+        return False, f"want {want_name}, runtime supports {got.get('name') or 'any'}"
+    if want_version and got.get("version"):
+        if compare_lenient(want_version, got["version"]) != 0:
+            return False, (f"version mismatch: model {want_version} "
+                           f"vs runtime {got['version']}")
+    return True, ""
+
+
+class Matcher:
+    def evaluate(self, runtime: Runtime, model: v1.BaseModelSpec,
+                 accelerator: Optional[v1.AcceleratorClass] = None,
+                 ) -> CompatibilityReport:
+        spec = runtime.spec
+        report = CompatibilityReport(
+            runtime_name=runtime.metadata.name,
+            cluster_scoped=isinstance(runtime, v1.ClusterServingRuntime))
+
+        report.checks.append(CheckResult(
+            "disabled", not spec.is_disabled(),
+            "runtime is disabled" if spec.is_disabled() else ""))
+
+        fmt_match, matched = self._match_formats(spec, model)
+        report.matched_format = matched
+        report.checks.append(CheckResult(
+            "modelFormat", fmt_match,
+            "" if fmt_match else
+            f"no supported format entry matches format="
+            f"{model.model_format.name!r} arch={model.model_architecture!r} "
+            f"quant={model.quantization.value if model.quantization else None!r}"))
+
+        size_ok, size_reason = self._check_size(spec, model)
+        report.checks.append(CheckResult("modelSizeRange", size_ok, size_reason))
+
+        acc_ok, acc_reason = self._check_accelerator(spec, accelerator)
+        report.checks.append(CheckResult("acceleratorRequirements", acc_ok,
+                                         acc_reason))
+        return report
+
+    def _match_formats(self, spec: v1.ServingRuntimeSpec,
+                       model: v1.BaseModelSpec,
+                       ) -> Tuple[bool, Optional[v1.SupportedModelFormat]]:
+        """A model matches if any supported entry passes every sub-check
+        the entry specifies (format, framework, architecture, quant)."""
+        best: Optional[v1.SupportedModelFormat] = None
+        for entry in spec.supported_model_formats:
+            if entry.auto_select is False:
+                continue
+            fmt = entry.model_format or (
+                {"name": entry.name, "version": entry.version}
+                if entry.name else None)
+            ok, _ = _name_version_match(
+                model.model_format.name, model.model_format.version, fmt)
+            if not ok:
+                continue
+            if entry.model_framework is not None:
+                want = model.model_framework
+                ok, _ = _name_version_match(
+                    entry.model_framework.get("name"),
+                    entry.model_framework.get("version"),
+                    {"name": want.name if want else "",
+                     "version": want.version if want else None})
+                if not ok:
+                    continue
+            if entry.model_architecture:
+                if (model.model_architecture or "").lower() != \
+                        entry.model_architecture.lower():
+                    continue
+            if entry.quantization:
+                got = model.quantization.value if model.quantization else ""
+                if got.lower() != entry.quantization.lower():
+                    continue
+            if best is None or (entry.priority or 0) > (best.priority or 0):
+                best = entry
+        return best is not None, best
+
+    def _check_size(self, spec: v1.ServingRuntimeSpec,
+                    model: v1.BaseModelSpec) -> Tuple[bool, str]:
+        rng = spec.model_size_range
+        if rng is None:
+            return True, ""
+        size = v1.parse_parameter_size(model.model_parameter_size)
+        if size is None:
+            return True, ""  # unknown size: don't exclude
+        lo = v1.parse_parameter_size(rng.min) or 0
+        hi = v1.parse_parameter_size(rng.max) or float("inf")
+        if lo <= size <= hi:
+            return True, ""
+        return False, (f"model size {model.model_parameter_size} outside "
+                       f"runtime range [{rng.min}, {rng.max}]")
+
+    def _check_accelerator(self, spec: v1.ServingRuntimeSpec,
+                           accelerator: Optional[v1.AcceleratorClass],
+                           ) -> Tuple[bool, str]:
+        req = spec.accelerator_requirements
+        if req is None or accelerator is None:
+            return True, ""
+        if req.accelerator_classes and \
+                accelerator.metadata.name not in req.accelerator_classes:
+            return False, (f"accelerator {accelerator.metadata.name} not in "
+                           f"{req.accelerator_classes}")
+        caps = accelerator.spec.capabilities
+        if req.min_memory_gb and (caps.memory_gb or 0) < req.min_memory_gb:
+            return False, (f"accelerator HBM {caps.memory_gb}GB < required "
+                           f"{req.min_memory_gb}GB")
+        missing = [f for f in req.required_features if f not in caps.features]
+        if missing:
+            return False, f"accelerator missing features {missing}"
+        if req.topologies:
+            have = {t.name for t in caps.topologies}
+            if not have.intersection(req.topologies):
+                return False, (f"no supported topology among {req.topologies} "
+                               f"(accelerator offers {sorted(have)})")
+        return True, ""
+
+
+# -- scorer (scorer.go:30-164) ---------------------------------------------
+
+
+class Scorer:
+    def score(self, match: RuntimeMatch, model: v1.BaseModelSpec) -> None:
+        entry = match.report.matched_format
+        score = 0
+        if entry is not None:
+            prio = entry.priority or 1
+            score += FORMAT_WEIGHT * prio * (model.model_format.weight or 1)
+            if entry.model_framework is not None and model.model_framework:
+                score += FRAMEWORK_WEIGHT * prio * \
+                    (model.model_framework.weight or 1)
+            if entry.model_architecture:
+                score += ARCHITECTURE_WEIGHT * prio
+            if entry.quantization:
+                score += QUANTIZATION_WEIGHT * prio
+        match.score = score
+        match.size_distance = self._size_distance(match.runtime.spec, model)
+
+    @staticmethod
+    def _size_distance(spec: v1.ServingRuntimeSpec,
+                       model: v1.BaseModelSpec) -> float:
+        size = v1.parse_parameter_size(model.model_parameter_size)
+        rng = spec.model_size_range
+        if size is None or rng is None:
+            return float("inf")
+        lo = v1.parse_parameter_size(rng.min) or 0
+        hi = v1.parse_parameter_size(rng.max) or size
+        return abs((lo + hi) / 2 - size)
+
+    @staticmethod
+    def compare(a: RuntimeMatch, b: RuntimeMatch) -> int:
+        """CompareRuntimes (scorer.go:67-100): score desc, size proximity
+        asc, namespace-scoped first, then name for determinism."""
+        if a.score != b.score:
+            return -1 if a.score > b.score else 1
+        if a.size_distance != b.size_distance:
+            return -1 if a.size_distance < b.size_distance else 1
+        if a.report.cluster_scoped != b.report.cluster_scoped:
+            return -1 if not a.report.cluster_scoped else 1
+        return -1 if a.name < b.name else (1 if a.name > b.name else 0)
+
+
+# -- selector facade (selector.go:39-150) ----------------------------------
+
+
+class RuntimeSelector:
+    def __init__(self, client: InMemoryClient):
+        self.client = client
+        self.fetcher = Fetcher(client)
+        self.matcher = Matcher()
+        self.scorer = Scorer()
+
+    def select(self, model: v1.BaseModelSpec, namespace: str,
+               accelerator: Optional[v1.AcceleratorClass] = None,
+               model_name: str = "") -> RuntimeMatch:
+        """SelectRuntime: best compatible runtime or NoRuntimeFoundError."""
+        import functools
+
+        runtimes = self.fetcher.fetch(namespace)
+        matches, failed = [], []
+        for rt in runtimes:
+            report = self.matcher.evaluate(rt, model, accelerator)
+            if report.compatible:
+                m = RuntimeMatch(runtime=rt, report=report)
+                self.scorer.score(m, model)
+                matches.append(m)
+            else:
+                failed.append(report)
+        if not matches:
+            raise NoRuntimeFoundError(model_name or model.model_format.name,
+                                      failed)
+        matches.sort(key=functools.cmp_to_key(self.scorer.compare))
+        return matches[0]
+
+    def get(self, name: str, namespace: str) -> Runtime:
+        """GetRuntime: namespace-scoped first, then cluster-scoped."""
+        rt = self.client.try_get(v1.ServingRuntime, name, namespace)
+        if rt is None:
+            rt = self.client.try_get(v1.ClusterServingRuntime, name)
+        if rt is None:
+            raise RuntimeNotFoundError(f"runtime {name!r} not found in "
+                                       f"namespace {namespace!r} or cluster scope")
+        return rt
+
+    def validate(self, name: str, model: v1.BaseModelSpec, namespace: str,
+                 accelerator: Optional[v1.AcceleratorClass] = None,
+                 model_name: str = "") -> RuntimeMatch:
+        """ValidateRuntime: explicit runtime must exist, be enabled and
+        compatible."""
+        rt = self.get(name, namespace)
+        if rt.spec.is_disabled():
+            raise RuntimeDisabledError(f"runtime {name!r} is disabled")
+        report = self.matcher.evaluate(rt, model, accelerator)
+        if not report.compatible:
+            raise RuntimeIncompatibleError(name, model_name, report)
+        m = RuntimeMatch(runtime=rt, report=report)
+        self.scorer.score(m, model)
+        return m

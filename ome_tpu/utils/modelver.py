@@ -1,0 +1,45 @@
+"""Lenient version comparison for model/framework versions.
+
+Equivalent of the reference's pkg/modelver (modelver/util.go:45): accepts
+loose version strings ("4.36", "v1.0.0", "2024.1-beta"), compares
+numerically component-wise, falls back to string comparison for
+non-numeric parts.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Tuple, Union
+
+_PART = re.compile(r"(\d+|[a-zA-Z]+)")
+
+
+def _tokens(v: str) -> List[Union[int, str]]:
+    v = v.strip().lstrip("vV")
+    out: List[Union[int, str]] = []
+    for tok in _PART.findall(v):
+        out.append(int(tok) if tok.isdigit() else tok.lower())
+    return out
+
+
+def compare_lenient(a: str, b: str) -> int:
+    """-1 / 0 / 1; numeric-aware, tolerant of different lengths
+    (trailing zeros are insignificant: 1.0 == 1.0.0)."""
+    ta, tb = _tokens(a), _tokens(b)
+    n = max(len(ta), len(tb))
+    for i in range(n):
+        x = ta[i] if i < len(ta) else 0
+        y = tb[i] if i < len(tb) else 0
+        if isinstance(x, int) and isinstance(y, int):
+            if x != y:
+                return -1 if x < y else 1
+        else:
+            xs, ys = str(x), str(y)
+            if xs != ys:
+                return -1 if xs < ys else 1
+    return 0
+
+
+def matches_major_minor(a: str, b: str) -> bool:
+    ta, tb = _tokens(a), _tokens(b)
+    return ta[:2] == tb[:2]

@@ -73,6 +73,8 @@ class TestSerde:
         assert t.chips == 8
         assert v1.parse_topology("1x1").chips == 1
         assert v1.parse_topology("junk") is None
+        assert v1.parse_topology("0x4") is None
+        assert v1.parse_topology("-2x4") is None
 
 
 class TestClient:
@@ -167,16 +169,42 @@ class TestClient:
         c.create(m)
         assert c.get(v1.ClusterBaseModel, "llama-3-70b").name == "llama-3-70b"
 
+    def test_gc_spares_multi_owner_objects(self):
+        c = InMemoryClient()
+        a = c.create(make_isvc("a"))
+        b = c.create(make_isvc("b"))
+        shared = ConfigMap(metadata=ObjectMeta(name="shared", namespace="default"))
+        set_controller_reference(a, shared)
+        shared.metadata.owner_references.append(
+            __import__("ome_tpu.core.meta", fromlist=["OwnerReference"])
+            .OwnerReference(kind="InferenceService", name="b",
+                            uid=b.metadata.uid))
+        c.create(shared)
+        c.delete(v1.InferenceService, "a", "default")
+        # still owned by b -> survives, with a's ref dropped
+        got = c.get(ConfigMap, "shared", "default")
+        assert [r.uid for r in got.metadata.owner_references] == [b.metadata.uid]
+        c.delete(v1.InferenceService, "b", "default")
+        with pytest.raises(NotFoundError):
+            c.get(ConfigMap, "shared", "default")
+
 
 class TestConditions:
     def test_set_and_transition(self):
         conds = []
         conds = set_condition(conds, Condition(type="Ready", status="False"))
         assert conds[0].last_transition_time
-        t0 = conds[0].last_transition_time
         conds = set_condition(conds, Condition(type="Ready", status="True"))
         assert len(conds) == 1
         assert conds[0].is_true()
+
+    def test_stable_status_preserves_transition_time(self):
+        conds = set_condition([], Condition(type="Ready", status="True"))
+        t0 = conds[0].last_transition_time
+        conds = set_condition(conds, Condition(type="Ready", status="True",
+                                               reason="StillFine"))
+        assert conds[0].last_transition_time == t0
+        assert conds[0].reason == "StillFine"
 
 
 class TestWorkQueue:

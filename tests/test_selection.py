@@ -1,0 +1,276 @@
+"""Runtime + accelerator selection tests (mirrors the reference's
+runtimeselector/selector_test.go and acceleratorclassselector
+policy_helpers_test.go table-driven style)."""
+
+import pytest
+
+from ome_tpu.apis import v1
+from ome_tpu.core.client import InMemoryClient
+from ome_tpu.core.meta import ObjectMeta
+from ome_tpu.selection.accelerator_selector import (
+    AcceleratorSelectionError,
+    AcceleratorSelector,
+    chips_needed,
+    required_hbm_gb,
+    smallest_fitting_topology,
+)
+from ome_tpu.selection.runtime_selector import (
+    NoRuntimeFoundError,
+    RuntimeDisabledError,
+    RuntimeIncompatibleError,
+    RuntimeNotFoundError,
+    RuntimeSelector,
+)
+
+
+def make_runtime(name, cluster=True, formats=None, size_range=None,
+                 disabled=None, accel_req=None):
+    cls = v1.ClusterServingRuntime if cluster else v1.ServingRuntime
+    spec = v1.ServingRuntimeSpec(
+        supported_model_formats=formats or [],
+        model_size_range=size_range,
+        disabled=disabled,
+        accelerator_requirements=accel_req)
+    return cls(metadata=ObjectMeta(name=name, namespace="" if cluster else "default"),
+               spec=spec)
+
+
+def safetensors_fmt(**kw):
+    return v1.SupportedModelFormat(
+        model_format={"name": "safetensors"}, auto_select=True, **kw)
+
+
+def llama_model(size="8B", arch="LlamaForCausalLM", quant=None):
+    return v1.BaseModelSpec(
+        model_format=v1.ModelFormat(name="safetensors"),
+        model_framework=v1.ModelFrameworkSpec(name="transformers"),
+        model_architecture=arch,
+        model_parameter_size=size,
+        quantization=quant)
+
+
+@pytest.fixture
+def client():
+    return InMemoryClient()
+
+
+class TestRuntimeSelector:
+    def test_select_by_format(self, client):
+        client.create(make_runtime("vllm-tpu", formats=[safetensors_fmt()]))
+        client.create(make_runtime("onnx-rt", formats=[
+            v1.SupportedModelFormat(model_format={"name": "onnx"},
+                                    auto_select=True)]))
+        sel = RuntimeSelector(client)
+        m = sel.select(llama_model(), "default")
+        assert m.name == "vllm-tpu"
+
+    def test_no_runtime_found_reports_reasons(self, client):
+        client.create(make_runtime("onnx-rt", formats=[
+            v1.SupportedModelFormat(model_format={"name": "onnx"},
+                                    auto_select=True)]))
+        sel = RuntimeSelector(client)
+        with pytest.raises(NoRuntimeFoundError) as exc:
+            sel.select(llama_model(), "default")
+        assert "onnx-rt" in str(exc.value)
+
+    def test_size_range_filters(self, client):
+        client.create(make_runtime(
+            "small-rt", formats=[safetensors_fmt()],
+            size_range=v1.ModelSizeRangeSpec(min="0.1B", max="20B")))
+        client.create(make_runtime(
+            "big-rt", formats=[safetensors_fmt()],
+            size_range=v1.ModelSizeRangeSpec(min="30B", max="700B")))
+        sel = RuntimeSelector(client)
+        assert sel.select(llama_model("8B"), "default").name == "small-rt"
+        assert sel.select(llama_model("70B"), "default").name == "big-rt"
+
+    def test_architecture_specific_beats_generic(self, client):
+        client.create(make_runtime("generic", formats=[safetensors_fmt()]))
+        client.create(make_runtime("llama-tuned", formats=[
+            safetensors_fmt(model_architecture="LlamaForCausalLM")]))
+        sel = RuntimeSelector(client)
+        assert sel.select(llama_model(), "default").name == "llama-tuned"
+
+    def test_priority_breaks_ties(self, client):
+        client.create(make_runtime("low", formats=[safetensors_fmt(priority=1)]))
+        client.create(make_runtime("high", formats=[safetensors_fmt(priority=2)]))
+        sel = RuntimeSelector(client)
+        assert sel.select(llama_model(), "default").name == "high"
+
+    def test_namespace_scoped_beats_cluster_scoped(self, client):
+        client.create(make_runtime("rt-cluster", cluster=True,
+                                   formats=[safetensors_fmt()]))
+        client.create(make_runtime("rt-ns", cluster=False,
+                                   formats=[safetensors_fmt()]))
+        sel = RuntimeSelector(client)
+        assert sel.select(llama_model(), "default").name == "rt-ns"
+
+    def test_name_determinism(self, client):
+        client.create(make_runtime("b-rt", formats=[safetensors_fmt()]))
+        client.create(make_runtime("a-rt", formats=[safetensors_fmt()]))
+        sel = RuntimeSelector(client)
+        assert sel.select(llama_model(), "default").name == "a-rt"
+
+    def test_auto_select_false_excluded(self, client):
+        client.create(make_runtime("manual-only", formats=[
+            v1.SupportedModelFormat(model_format={"name": "safetensors"},
+                                    auto_select=False)]))
+        sel = RuntimeSelector(client)
+        with pytest.raises(NoRuntimeFoundError):
+            sel.select(llama_model(), "default")
+
+    def test_disabled_runtime_excluded(self, client):
+        client.create(make_runtime("off", formats=[safetensors_fmt()],
+                                   disabled=True))
+        sel = RuntimeSelector(client)
+        with pytest.raises(NoRuntimeFoundError):
+            sel.select(llama_model(), "default")
+
+    def test_validate_explicit(self, client):
+        client.create(make_runtime("off", formats=[safetensors_fmt()],
+                                   disabled=True))
+        client.create(make_runtime("onnx-rt", formats=[
+            v1.SupportedModelFormat(model_format={"name": "onnx"})]))
+        sel = RuntimeSelector(client)
+        with pytest.raises(RuntimeNotFoundError):
+            sel.validate("missing", llama_model(), "default")
+        with pytest.raises(RuntimeDisabledError):
+            sel.validate("off", llama_model(), "default")
+        with pytest.raises(RuntimeIncompatibleError):
+            sel.validate("onnx-rt", llama_model(), "default")
+
+    def test_quantization_match(self, client):
+        client.create(make_runtime("fp8-rt", formats=[
+            safetensors_fmt(quantization="fp8")]))
+        sel = RuntimeSelector(client)
+        m = sel.select(llama_model(quant=v1.ModelQuantization.FP8), "default")
+        assert m.name == "fp8-rt"
+        with pytest.raises(NoRuntimeFoundError):
+            sel.select(llama_model(), "default")  # unquantized model
+
+    def test_accelerator_requirements_respected(self, client):
+        client.create(make_runtime(
+            "v5p-only", formats=[safetensors_fmt()],
+            accel_req=v1.AcceleratorRequirements(accelerator_classes=["tpu-v5p"])))
+        sel = RuntimeSelector(client)
+        v5e = make_accelerator("tpu-v5e")
+        with pytest.raises(NoRuntimeFoundError):
+            sel.select(llama_model(), "default", accelerator=v5e)
+
+
+def make_accelerator(name, model="v5e", hbm=16.0, tflops=197.0, bw=819.0,
+                     cost=1.2, topologies=("1x1", "2x2", "2x4", "4x4", "4x8"),
+                     node_count=0, features=()):
+    topos = [v1.parse_topology(t) for t in topologies]
+    return v1.AcceleratorClass(
+        metadata=ObjectMeta(name=name),
+        spec=v1.AcceleratorClassSpec(
+            vendor="google", family="tpu", model=model,
+            capabilities=v1.AcceleratorCapabilities(
+                memory_gb=hbm, bf16_tflops=tflops,
+                memory_bandwidth_gbps=bw, topologies=topos,
+                features=list(features)),
+            cost=v1.AcceleratorCost(per_chip_hour_usd=cost),
+            resources={v1.TPU_RESOURCE: "1"}),
+        status=v1.AcceleratorClassStatus(node_count=node_count))
+
+
+class TestSizing:
+    def test_required_hbm(self):
+        assert required_hbm_gb(llama_model("70B")) == pytest.approx(189, rel=0.01)
+        assert required_hbm_gb(llama_model("70B", quant=v1.ModelQuantization.INT4)) \
+            == pytest.approx(47.25, rel=0.01)
+
+    def test_chips_needed_and_topology(self):
+        ac = make_accelerator("tpu-v5e")
+        assert chips_needed(llama_model("8B"), ac) == 2
+        assert chips_needed(llama_model("70B"), ac) == 12
+        topo = smallest_fitting_topology(ac, 12)
+        assert topo.name == "4x4" and topo.hosts == 4
+
+
+class TestAcceleratorSelector:
+    def _isvc(self, policy=None, ac_class=None, topology=None):
+        return v1.InferenceService(
+            metadata=ObjectMeta(name="i", namespace="default"),
+            spec=v1.InferenceServiceSpec(
+                accelerator_selector=v1.AcceleratorSelector(
+                    accelerator_class=ac_class, policy=policy,
+                    topology=topology)))
+
+    def test_explicit_name(self, client):
+        client.create(make_accelerator("tpu-v5e"))
+        sel = AcceleratorSelector(client)
+        c = sel.resolve(self._isvc(ac_class="tpu-v5e"), model=llama_model("8B"))
+        assert c.name == "tpu-v5e" and c.topology.name == "2x2"
+
+    def test_best_fit_prefers_least_waste(self, client):
+        client.create(make_accelerator("tpu-v5e", hbm=16.0))
+        client.create(make_accelerator("tpu-v5p", model="v5p", hbm=95.0,
+                                       tflops=459.0, cost=4.2,
+                                       topologies=("2x2x1", "2x2x2", "2x2x4")))
+        sel = AcceleratorSelector(client)
+        c = sel.resolve(self._isvc(v1.AcceleratorSelectorPolicy.BEST_FIT),
+                        model=llama_model("8B"))
+        # 8B bf16 ~21.6GB: v5e 2x2 (64GB) wastes less than v5p 2x2x1 (380GB)
+        assert c.name == "tpu-v5e" and c.topology.name == "2x2"
+
+    def test_cheapest(self, client):
+        client.create(make_accelerator("tpu-v5e", cost=1.2))
+        client.create(make_accelerator("tpu-v6e", model="v6e", hbm=32,
+                                       tflops=918, cost=2.97))
+        sel = AcceleratorSelector(client)
+        c = sel.resolve(self._isvc(v1.AcceleratorSelectorPolicy.CHEAPEST),
+                        model=llama_model("8B"))
+        # v5e rounds up to a 2x2 slice: 4 x $1.2 = $4.8; v6e fits on one
+        # chip: 1 x $2.97 — slice-shape rounding makes v6e cheaper
+        assert c.name == "tpu-v6e" and c.chips == 1
+
+    def test_most_capable(self, client):
+        client.create(make_accelerator("tpu-v5e"))
+        client.create(make_accelerator("tpu-v6e", model="v6e", hbm=32,
+                                       tflops=918, bw=1638, cost=2.97))
+        sel = AcceleratorSelector(client)
+        c = sel.resolve(self._isvc(v1.AcceleratorSelectorPolicy.MOST_CAPABLE),
+                        model=llama_model("8B"))
+        assert c.name == "tpu-v6e"
+
+    def test_first_available_needs_nodes(self, client):
+        client.create(make_accelerator("tpu-v5e", node_count=0))
+        client.create(make_accelerator("tpu-v6e", model="v6e", node_count=3))
+        sel = AcceleratorSelector(client)
+        c = sel.resolve(self._isvc(v1.AcceleratorSelectorPolicy.FIRST_AVAILABLE),
+                        model=llama_model("8B"))
+        assert c.name == "tpu-v6e"
+
+    def test_topology_pin(self, client):
+        client.create(make_accelerator("tpu-v5e"))
+        sel = AcceleratorSelector(client)
+        c = sel.resolve(self._isvc(v1.AcceleratorSelectorPolicy.BEST_FIT,
+                                   topology="4x4"),
+                        model=llama_model("8B"))
+        assert c.topology.name == "4x4" and c.chips == 16
+
+    def test_runtime_requirements_filter(self, client):
+        client.create(make_accelerator("tpu-v5e"))
+        client.create(make_accelerator("tpu-v5p", model="v5p", hbm=95,
+                                       topologies=("2x2x1", "2x2x2")))
+        rt_spec = v1.ServingRuntimeSpec(
+            accelerator_requirements=v1.AcceleratorRequirements(
+                min_memory_gb=90))
+        sel = AcceleratorSelector(client)
+        c = sel.resolve(self._isvc(v1.AcceleratorSelectorPolicy.BEST_FIT),
+                        runtime_spec=rt_spec, model=llama_model("8B"))
+        assert c.name == "tpu-v5p"
+
+    def test_model_must_fit_largest_slice(self, client):
+        client.create(make_accelerator("tiny", hbm=16.0, topologies=("1x1",)))
+        sel = AcceleratorSelector(client)
+        with pytest.raises(AcceleratorSelectionError):
+            sel.resolve(self._isvc(v1.AcceleratorSelectorPolicy.BEST_FIT),
+                        model=llama_model("70B"))
+
+    def test_missing_explicit_class_errors(self, client):
+        sel = AcceleratorSelector(client)
+        with pytest.raises(AcceleratorSelectionError):
+            sel.resolve(self._isvc(ac_class="nope"), model=llama_model("8B"))
