@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Driver benchmark: sustained decode throughput of the flagship model.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference (bcfre/ome) publishes no hardware numbers (BASELINE.md) —
+its headline metric is BenchmarkJob *output tokens/sec* against a served
+InferenceService (SURVEY.md §6). This bench measures the same quantity
+at the layer we own end-to-end on one chip: batched autoregressive
+decode tokens/sec of the flagship Llama-class model with a KV cache.
+
+`vs_baseline` is the fraction of the chip's HBM-bandwidth roofline
+(decode is bandwidth-bound: every generated token must stream all
+weights + the KV cache once), so 1.0 == perfect memory-bound decode.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sync(x):
+    """Force completion. On the axon-tunneled TPU backend
+    jax.block_until_ready returns before execution finishes; only a
+    device->host fetch truly synchronizes, so time through a fetch."""
+    jax.block_until_ready(x)
+    return np.asarray(jax.device_get(x))
+
+# Per-chip HBM bandwidth (GB/s) by TPU generation; CPU fallback uses a
+# nominal DDR figure so the ratio stays defined in dev environments.
+HBM_GBPS = {"v5 lite": 819.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1640.0,
+            "v4": 1228.0, "cpu": 50.0}
+
+BATCH = 32
+PREFILL = 128
+DECODE_STEPS = 128
+CACHE_LEN = PREFILL + DECODE_STEPS
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def device_bandwidth() -> float:
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", d.platform).lower()
+    for key, bw in HBM_GBPS.items():
+        if key in kind:
+            return bw
+    return HBM_GBPS["cpu" if d.platform == "cpu" else "v5e"]
+
+
+def main() -> None:
+    from ome_tpu.models import config as cfgs
+    from ome_tpu.models import llama
+
+    # ~1.9B-parameter dense Llama-class config: big enough that decode is
+    # genuinely HBM-bound, small enough to fit one v5e chip (16G HBM)
+    # in bf16 with headroom for the KV cache.
+    cfg = cfgs.ModelConfig(
+        vocab_size=32768, hidden_size=2048, num_layers=24, num_heads=16,
+        num_kv_heads=8, head_dim=128, intermediate_size=8192,
+        rope_theta=500000.0, max_seq_len=CACHE_LEN)
+
+    log(f"bench: devices={jax.devices()}")
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    n_params = llama.param_count(params)
+    log(f"bench: params={n_params/1e9:.2f}B")
+
+    cache = llama.KVCache.create(cfg, BATCH, CACHE_LEN)
+
+    @jax.jit
+    def prefill(params, tokens, cache):
+        logits, cache = llama.forward(params, cfg, tokens, cache=cache)
+        return jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32), cache
+
+    @jax.jit
+    def decode(params, tokens, cache):
+        logits, cache = llama.forward(params, cfg, tokens, cache=cache)
+        return jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32), cache
+
+    tok = jax.random.randint(jax.random.PRNGKey(1), (BATCH, PREFILL), 0,
+                             cfg.vocab_size, dtype=jnp.int32)
+    t0 = time.perf_counter()
+    tok, cache = prefill(params, tok, cache)
+    sync(tok)
+    log(f"bench: prefill(batch={BATCH}, len={PREFILL}) + compile "
+        f"{time.perf_counter()-t0:.1f}s")
+
+    # warmup decode (compile + one synced step)
+    tok, cache = decode(params, tok, cache)
+    sync(tok)
+
+    t0 = time.perf_counter()
+    for _ in range(DECODE_STEPS - 1):
+        tok, cache = decode(params, tok, cache)
+    sync(tok)
+    dt = time.perf_counter() - t0
+    steps = DECODE_STEPS - 1
+    toks_per_s = BATCH * steps / dt
+
+    # Roofline: per decode step the chip must read all weights once
+    # (amortized across the batch) + each sequence's KV cache.
+    bw = device_bandwidth()
+    kv_bytes = (cfg.num_layers * CACHE_LEN * cfg.num_kv_heads * cfg.head_dim
+                * 2 * 2)  # k+v, bf16, per sequence
+    step_bytes = n_params * 2 + BATCH * kv_bytes
+    roofline_steps = bw * 1e9 / step_bytes
+    roofline_toks = roofline_steps * BATCH
+    vs = toks_per_s / roofline_toks
+
+    log(f"bench: decode {steps} steps x batch {BATCH} in {dt:.2f}s "
+        f"-> {toks_per_s:.1f} tok/s (roofline {roofline_toks:.0f}, "
+        f"{100*vs:.1f}%)")
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec_1.9B_bf16_batch32",
+        "value": round(toks_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -159,6 +159,8 @@ class InMemoryClient:
                 continue
             if remaining:
                 obj.metadata.owner_references = remaining
+                obj.metadata.resource_version = self._next_rv()
+                self._notify(Event("Modified", obj.deepcopy()))
             else:
                 doomed.append((key, obj))
         for key, obj in doomed:

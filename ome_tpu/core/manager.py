@@ -128,6 +128,7 @@ class Manager:
                 if rec.FOR is not None:
                     for obj in self.client.list(rec.FOR):
                         q.add((obj.metadata.namespace, obj.metadata.name))
+        requeues: Dict[Tuple[str, ReconcileKey], int] = {}
         for _ in range(max_iters):
             progressed = False
             for rec, q in self._controllers:
@@ -139,10 +140,14 @@ class Manager:
                 try:
                     res = rec.reconcile(ns, name) or Result()
                     q.forget(item)
-                    # test mode: requeues retry immediately (bounded by
-                    # max_iters) instead of waiting out backoff delays
+                    # test mode: requeues retry immediately (bounded per
+                    # item so a periodic-resync reconciler that always
+                    # returns requeue_after can't spin the drain loop)
                     if res.requeue or res.requeue_after > 0:
-                        q.add(item)
+                        seen = requeues.get((type(rec).__name__, item), 0)
+                        if seen < 5:
+                            requeues[(type(rec).__name__, item)] = seen + 1
+                            q.add(item)
                 except Exception:
                     log.error("reconcile %s %s/%s failed:\n%s",
                               type(rec).__name__, ns, name, traceback.format_exc())

@@ -75,11 +75,32 @@ def chips_needed(model: Optional[v1.BaseModelSpec],
 
 def smallest_fitting_topology(ac: v1.AcceleratorClass, chips: int,
                               ) -> Optional[v1.TopologySpec]:
+    """Smallest declared slice with >= chips; None when nothing fits (or
+    the class declares no topologies)."""
     topos = sorted(ac.spec.capabilities.topologies, key=lambda t: t.chips)
     for t in topos:
         if t.chips >= chips:
             return t
-    return topos[-1] if topos else None
+    return None
+
+
+def _resolve_pinned_topology(ac: v1.AcceleratorClass, pin: str,
+                             ) -> v1.TopologySpec:
+    """A topology pinned by the isvc must be one the accelerator offers
+    (or at least parse) — never fabricate an unsupported slice shape."""
+    for t in ac.spec.capabilities.topologies:
+        if t.name == pin:
+            return t
+    topo = v1.parse_topology(pin)
+    if topo is None:
+        raise AcceleratorSelectionError(
+            f"requested topology {pin!r} is not parseable")
+    if ac.spec.capabilities.topologies:
+        raise AcceleratorSelectionError(
+            f"AcceleratorClass {ac.metadata.name!r} does not offer "
+            f"topology {pin!r} (offers "
+            f"{[t.name for t in ac.spec.capabilities.topologies]})")
+    return topo
 
 
 class AcceleratorSelector:
@@ -108,11 +129,9 @@ class AcceleratorSelector:
         policy = sel.policy or v1.AcceleratorSelectorPolicy.BEST_FIT
         choice = self._apply_policy(policy, candidates, model)
         if sel.topology:
-            topo = v1.parse_topology(sel.topology)
-            known = {t.name: t for t in
-                     choice.accelerator.spec.capabilities.topologies}
-            choice.topology = known.get(sel.topology, topo)
-            choice.chips = choice.topology.chips if choice.topology else 0
+            choice.topology = _resolve_pinned_topology(
+                choice.accelerator, sel.topology)
+            choice.chips = choice.topology.chips
         return choice
 
     def _by_name(self, name: str, sel: v1.AcceleratorSelector,
@@ -122,11 +141,16 @@ class AcceleratorSelector:
             raise AcceleratorSelectionError(
                 f"AcceleratorClass {name!r} not found")
         chips = chips_needed(model, ac)
-        topo = None
         if sel.topology:
-            topo = v1.parse_topology(sel.topology)
-        if topo is None:
+            topo = _resolve_pinned_topology(ac, sel.topology)
+        else:
             topo = smallest_fitting_topology(ac, chips)
+            if topo is None and ac.spec.capabilities.topologies:
+                raise AcceleratorSelectionError(
+                    f"AcceleratorClass {name!r}: model needs {chips} chips "
+                    f"but the largest offered topology is "
+                    f"{max(t.chips for t in ac.spec.capabilities.topologies)}"
+                    f" chips")
         return AcceleratorChoice(ac, topo, topo.chips if topo else chips,
                                  reason="explicit")
 
@@ -135,22 +159,14 @@ class AcceleratorSelector:
     def _candidates(self, runtime_spec: Optional[v1.ServingRuntimeSpec],
                     model: Optional[v1.BaseModelSpec],
                     ) -> List[v1.AcceleratorClass]:
+        from .common import check_accelerator_requirements
         out = []
         req = runtime_spec.accelerator_requirements if runtime_spec else None
         for ac in self.client.list(v1.AcceleratorClass):
             caps = ac.spec.capabilities
-            if req:
-                if req.accelerator_classes and \
-                        ac.metadata.name not in req.accelerator_classes:
-                    continue
-                if req.min_memory_gb and (caps.memory_gb or 0) < req.min_memory_gb:
-                    continue
-                if any(f not in caps.features for f in req.required_features):
-                    continue
-                if req.topologies:
-                    have = {t.name for t in caps.topologies}
-                    if not have.intersection(req.topologies):
-                        continue
+            ok, _ = check_accelerator_requirements(req, ac)
+            if not ok:
+                continue
             # model must fit on the largest available slice
             need = required_hbm_gb(model)
             if need is not None and caps.topologies:

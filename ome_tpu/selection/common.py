@@ -1,0 +1,37 @@
+"""Checks shared by the runtime and accelerator selection engines."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..apis import v1
+
+
+def check_accelerator_requirements(
+        req: Optional[v1.AcceleratorRequirements],
+        ac: Optional[v1.AcceleratorClass]) -> Tuple[bool, str]:
+    """Does an AcceleratorClass satisfy a runtime's AcceleratorRequirements?
+
+    Single source of truth for the four requirement checks
+    (servingruntime_types.go:233-265) so the runtime matcher and the
+    accelerator candidate filter cannot drift apart.
+    """
+    if req is None or ac is None:
+        return True, ""
+    if req.accelerator_classes and \
+            ac.metadata.name not in req.accelerator_classes:
+        return False, (f"accelerator {ac.metadata.name} not in "
+                       f"{req.accelerator_classes}")
+    caps = ac.spec.capabilities
+    if req.min_memory_gb and (caps.memory_gb or 0) < req.min_memory_gb:
+        return False, (f"accelerator HBM {caps.memory_gb}GB < required "
+                       f"{req.min_memory_gb}GB")
+    missing = [f for f in req.required_features if f not in caps.features]
+    if missing:
+        return False, f"accelerator missing features {missing}"
+    if req.topologies:
+        have = {t.name for t in caps.topologies}
+        if not have.intersection(req.topologies):
+            return False, (f"no supported topology among {req.topologies} "
+                           f"(accelerator offers {sorted(have)})")
+    return True, ""

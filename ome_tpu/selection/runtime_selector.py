@@ -216,26 +216,9 @@ class Matcher:
     def _check_accelerator(self, spec: v1.ServingRuntimeSpec,
                            accelerator: Optional[v1.AcceleratorClass],
                            ) -> Tuple[bool, str]:
-        req = spec.accelerator_requirements
-        if req is None or accelerator is None:
-            return True, ""
-        if req.accelerator_classes and \
-                accelerator.metadata.name not in req.accelerator_classes:
-            return False, (f"accelerator {accelerator.metadata.name} not in "
-                           f"{req.accelerator_classes}")
-        caps = accelerator.spec.capabilities
-        if req.min_memory_gb and (caps.memory_gb or 0) < req.min_memory_gb:
-            return False, (f"accelerator HBM {caps.memory_gb}GB < required "
-                           f"{req.min_memory_gb}GB")
-        missing = [f for f in req.required_features if f not in caps.features]
-        if missing:
-            return False, f"accelerator missing features {missing}"
-        if req.topologies:
-            have = {t.name for t in caps.topologies}
-            if not have.intersection(req.topologies):
-                return False, (f"no supported topology among {req.topologies} "
-                               f"(accelerator offers {sorted(have)})")
-        return True, ""
+        from .common import check_accelerator_requirements
+        return check_accelerator_requirements(spec.accelerator_requirements,
+                                              accelerator)
 
 
 # -- scorer (scorer.go:30-164) ---------------------------------------------

@@ -38,8 +38,3 @@ def compare_lenient(a: str, b: str) -> int:
             if xs != ys:
                 return -1 if xs < ys else 1
     return 0
-
-
-def matches_major_minor(a: str, b: str) -> bool:
-    ta, tb = _tokens(a), _tokens(b)
-    return ta[:2] == tb[:2]
