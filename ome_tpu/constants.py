@@ -1,0 +1,105 @@
+"""Label / annotation / env contracts (pkg/constants/constants.go analog).
+
+Every string two components agree on lives here, TPU-first: the
+schedulable resource is google.com/tpu, rendezvous env is the GKE/libtpu
+contract (TPU_WORKER_ID / TPU_WORKER_HOSTNAMES / MEGASCALE_*), and node
+readiness labels mark staged models per node.
+"""
+
+GROUP = "ome.io"
+
+# -- labels -----------------------------------------------------------------
+
+ISVC_LABEL = f"serving.{GROUP}/inferenceservice"
+COMPONENT_LABEL = f"component.{GROUP}/name"  # engine | decoder | router
+RAW_DEPLOYMENT_LABEL = f"serving.{GROUP}/raw"
+BENCHMARK_LABEL = f"benchmark.{GROUP}/name"
+
+# model-agent writes these on nodes (constants.go:802-818 analog)
+def model_ready_label(kind: str, name: str) -> str:
+    """models.ome.io/clusterbasemodel.llama-3-8b = Ready|Updating|Failed."""
+    return f"models.{GROUP}/{kind.lower()}.{name}"
+
+
+MODEL_STATUS_READY = "Ready"
+MODEL_STATUS_UPDATING = "Updating"
+MODEL_STATUS_FAILED = "Failed"
+MODEL_STATUS_DELETED = "Deleted"
+
+# -- annotations ------------------------------------------------------------
+
+DEPLOYMENT_MODE_ANNOTATION = f"serving.{GROUP}/deployment-mode"
+MODEL_INIT_ANNOTATION = f"{GROUP}/inject-model-init"
+FINE_TUNED_ADAPTER_ANNOTATION = f"{GROUP}/inject-fine-tuned-adapter"
+SERVING_SIDECAR_ANNOTATION = f"{GROUP}/inject-serving-sidecar"
+TPU_INJECT_ANNOTATION = f"tpu.{GROUP}/auto-inject"       # rdma.ome.io analog
+TPU_PROFILE_ANNOTATION = f"tpu.{GROUP}/profile"          # podslice | multislice
+TPU_CONTAINER_ANNOTATION = f"tpu.{GROUP}/container-name"
+PROMETHEUS_SCRAPE_ANNOTATION = "prometheus.io/scrape"
+PROMETHEUS_PORT_ANNOTATION = "prometheus.io/port"
+
+# -- finalizers -------------------------------------------------------------
+
+ISVC_FINALIZER = f"inferenceservice.finalizers.{GROUP}"
+BENCHMARK_FINALIZER = f"benchmarkjob.finalizers.{GROUP}"
+
+# -- env contracts ----------------------------------------------------------
+
+MODEL_PATH_ENV = "MODEL_PATH"
+SERVED_MODEL_NAME_ENV = "SERVED_MODEL_NAME"
+PARALLELISM_SIZE_ENV = "PARALLELISM_SIZE"  # constants.go:272 analog (chips)
+FINE_TUNED_WEIGHT_INFO_ENV = "FINE_TUNED_WEIGHT_INFO"
+
+# libtpu / GKE podslice rendezvous contract (replaces NCCL_*/GLOO_* env)
+TPU_WORKER_ID_ENV = "TPU_WORKER_ID"
+TPU_WORKER_HOSTNAMES_ENV = "TPU_WORKER_HOSTNAMES"
+TPU_TOPOLOGY_ENV = "TPU_TOPOLOGY"
+TPU_CHIPS_PER_HOST_ENV = "TPU_CHIPS_PER_HOST_BOUNDS"
+TPU_ACCELERATOR_ENV = "TPU_ACCELERATOR_TYPE"
+# multislice (DCN) contract
+MEGASCALE_COORDINATOR_ENV = "MEGASCALE_COORDINATOR_ADDRESS"
+MEGASCALE_NUM_SLICES_ENV = "MEGASCALE_NUM_SLICES"
+MEGASCALE_SLICE_ID_ENV = "MEGASCALE_SLICE_ID"
+# JAX-level rendezvous for engines that use jax.distributed directly
+JAX_COORDINATOR_ENV = "JAX_COORDINATOR_ADDRESS"
+JAX_NUM_PROCESSES_ENV = "JAX_NUM_PROCESSES"
+JAX_PROCESS_ID_ENV = "JAX_PROCESS_ID"
+
+# LWS-injected env consumed by the leader/worker templates
+LWS_LEADER_ADDRESS_ENV = "LWS_LEADER_ADDRESS"
+LWS_GROUP_SIZE_ENV = "LWS_GROUP_SIZE"
+LWS_WORKER_INDEX_ENV = "LWS_WORKER_INDEX"
+
+# -- resources --------------------------------------------------------------
+
+TPU_RESOURCE = "google.com/tpu"
+
+# -- ports / names ----------------------------------------------------------
+
+ENGINE_PORT = 8080
+ROUTER_PORT = 8000
+METRICS_PORT = 9090
+MAIN_CONTAINER = "ome-container"  # the engine runner container name
+
+OPERATOR_NAMESPACE = "ome"
+ISVC_CONFIG_NAME = "inferenceservice-config"
+
+# container name for the model download init container
+MODEL_INIT_CONTAINER = "model-init"
+SERVING_SIDECAR_CONTAINER = "serving-sidecar"
+
+
+def engine_name(isvc_name: str) -> str:
+    return f"{isvc_name}-engine"
+
+
+def decoder_name(isvc_name: str) -> str:
+    return f"{isvc_name}-decoder"
+
+
+def router_name(isvc_name: str) -> str:
+    return f"{isvc_name}-router"
+
+
+def predictor_service_name(isvc_name: str) -> str:
+    return isvc_name

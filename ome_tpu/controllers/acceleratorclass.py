@@ -1,0 +1,105 @@
+"""AcceleratorClass controller — TPU node discovery.
+
+Re-designs pkg/controller/v1beta1/acceleratorclass/controller.go:43-137:
+match cluster nodes against each class's Discovery selector, count
+schedulable chips, write the matched set into status. The chip-count
+helper reads google.com/tpu capacity (replacing the reference's
+nvidia.com/gpu | mig | amd | intel matrix, controller.go:245-290) and
+falls back to the GKE topology label when the device plugin hasn't
+registered capacity yet.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..apis import v1
+from ..core.client import InMemoryClient
+from ..core.errors import ConflictError, NotFoundError
+from ..core.k8s import Node
+from ..core.manager import Reconciler, Result
+
+
+def node_matches(ac: v1.AcceleratorClass, node: Node) -> bool:
+    sel = ac.spec.discovery.node_selector
+    if sel and all(node.metadata.labels.get(k) == val
+                   for k, val in sel.items()):
+        return True
+    aff = ac.spec.discovery.node_affinity
+    if aff:
+        terms = aff.get("nodeSelectorTerms", [])
+        for term in terms:
+            exprs = term.get("matchExpressions", [])
+            ok = True
+            for e in exprs:
+                key, op = e.get("key"), e.get("operator", "In")
+                have = node.metadata.labels.get(key)
+                values = e.get("values", [])
+                if op == "In":
+                    ok = ok and have in values
+                elif op == "NotIn":
+                    ok = ok and have not in values
+                elif op == "Exists":
+                    ok = ok and have is not None
+                elif op == "DoesNotExist":
+                    ok = ok and have is None
+            if exprs and ok:
+                return True
+    return False
+
+
+def node_chip_capacity(node: Node) -> int:
+    """Chips this node contributes (controller.go:245-290 re-based)."""
+    for res in (v1.TPU_RESOURCE,):
+        raw = node.status.capacity.get(res) \
+            or node.status.allocatable.get(res)
+        if raw:
+            try:
+                return int(raw)
+            except ValueError:
+                pass
+    # device plugin not up yet: infer chips/host from the topology label
+    topo = node.metadata.labels.get(v1.GKE_TPU_TOPOLOGY_LABEL)
+    if topo:
+        t = v1.parse_topology(topo)
+        if t:
+            return t.chips_per_host
+    return 0
+
+
+def node_available_chips(node: Node) -> int:
+    raw = node.status.allocatable.get(v1.TPU_RESOURCE)
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    return node_chip_capacity(node)
+
+
+class AcceleratorClassReconciler(Reconciler):
+    FOR = v1.AcceleratorClass
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        ac = self.client.try_get(v1.AcceleratorClass, name)
+        if ac is None:
+            return Result()
+        matched = [n for n in self.client.list(Node)
+                   if node_matches(ac, n)]
+        ac.status.nodes = sorted(n.metadata.name for n in matched)
+        ac.status.node_count = len(matched)
+        ac.status.total_chips = sum(node_chip_capacity(n) for n in matched)
+        ac.status.available_chips = sum(node_available_chips(n)
+                                        for n in matched)
+        try:
+            self.client.update_status(ac)
+        except (ConflictError, NotFoundError):
+            return Result(requeue=True)
+        return Result()
+
+    def watches(self):
+        # any Node event re-reconciles every class (controller.go:43-137)
+        def node_to_all(obj):
+            return [("", ac.metadata.name)
+                    for ac in self.client.list(v1.AcceleratorClass)]
+        return [(Node, node_to_all)]

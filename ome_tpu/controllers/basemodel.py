@@ -1,0 +1,143 @@
+"""BaseModel / ClusterBaseModel lifecycle controller.
+
+Re-designs pkg/controller/v1beta1/basemodel/controller.go:53-560:
+aggregates the per-node status ConfigMaps written by the model-agent
+(ome_tpu/modelagent) plus node lifecycle into ModelStatusSpec — which
+nodes have the weights staged, which failed, and the overall state that
+gates InferenceService scheduling.
+
+Contract with the model-agent (configmap_reconciler.go analog): one
+ConfigMap per node in the operator namespace, named
+`model-status-<node>`, labeled MODEL_STATUS_CM_LABEL, whose data maps
+model keys (`basemodel.<ns>.<name>` / `clusterbasemodel..<name>`) to a
+JSON blob {"state": Ready|Updating|Failed, ...}.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple, Type
+
+from .. import constants
+from ..apis import v1
+from ..core.client import InMemoryClient
+from ..core.errors import ConflictError, NotFoundError
+from ..core.k8s import ConfigMap, Node
+from ..core.manager import Reconciler, Result
+
+MODEL_STATUS_CM_LABEL = f"models.{constants.GROUP}/status"
+MODEL_STATUS_CM_PREFIX = "model-status-"
+
+
+def node_status_cm_name(node: str) -> str:
+    return f"{MODEL_STATUS_CM_PREFIX}{node}"
+
+
+def model_key(kind: str, namespace: str, name: str) -> str:
+    return f"{kind.lower()}.{namespace}.{name}"
+
+
+def parse_model_key(key: str) -> Tuple[str, str, str]:
+    kind, namespace, name = key.split(".", 2)
+    return kind, namespace, name
+
+
+class _BaseModelReconcilerMixin:
+    """Shared aggregation for namespaced + cluster-scoped models."""
+
+    MODEL_CLS: Type = None
+
+    def _aggregate(self, namespace: str, name: str) -> Result:
+        obj = self.client.try_get(self.MODEL_CLS, name, namespace)
+        if obj is None:
+            return Result()
+
+        key = model_key(self.MODEL_CLS.KIND, namespace, name)
+        live_nodes = {n.metadata.name for n in self.client.list(Node)}
+        ready: List[str] = []
+        failed: List[str] = []
+        in_progress: List[str] = []
+        for cm in self.client.list(ConfigMap,
+                                   namespace=constants.OPERATOR_NAMESPACE,
+                                   label_selector={MODEL_STATUS_CM_LABEL:
+                                                   "true"}):
+            node = cm.metadata.name[len(MODEL_STATUS_CM_PREFIX):]
+            if live_nodes and node not in live_nodes:
+                continue  # node is gone; its entries are stale
+            raw = cm.data.get(key)
+            if not raw:
+                continue
+            try:
+                entry = json.loads(raw)
+            except ValueError:
+                continue
+            state = entry.get("state")
+            if state == constants.MODEL_STATUS_READY:
+                ready.append(node)
+            elif state == constants.MODEL_STATUS_FAILED:
+                failed.append(node)
+            elif state == constants.MODEL_STATUS_UPDATING:
+                in_progress.append(node)
+
+        st = obj.status
+        st.nodes_ready = sorted(ready)
+        st.nodes_failed = sorted(failed)
+        if ready:
+            st.state = v1.ModelState.READY
+            st.lifecycle = "Active"
+        elif failed and not in_progress:
+            st.state = v1.ModelState.FAILED
+            st.lifecycle = "Failed"
+        elif in_progress:
+            st.state = v1.ModelState.IN_TRANSIT
+            st.lifecycle = "Staging"
+        else:
+            st.state = v1.ModelState.CREATING
+            st.lifecycle = "Pending"
+        try:
+            self.client.update_status(obj)
+        except (ConflictError, NotFoundError):
+            return Result(requeue=True)
+        return Result()
+
+    def _watch_mappers(self):
+        def cm_to_models(obj):
+            if obj.metadata.labels.get(MODEL_STATUS_CM_LABEL) != "true":
+                return []
+            keys = []
+            for key in obj.data:
+                try:
+                    kind, ns, name = parse_model_key(key)
+                except ValueError:
+                    continue
+                if kind == self.MODEL_CLS.KIND.lower():
+                    keys.append((ns, name))
+            return keys
+
+        def node_to_models(obj):
+            return [(m.metadata.namespace, m.metadata.name)
+                    for m in self.client.list(self.MODEL_CLS)]
+
+        return [(ConfigMap, cm_to_models), (Node, node_to_models)]
+
+
+class BaseModelReconciler(_BaseModelReconcilerMixin, Reconciler):
+    FOR = v1.BaseModel
+    MODEL_CLS = v1.BaseModel
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        return self._aggregate(namespace, name)
+
+    def watches(self):
+        return self._watch_mappers()
+
+
+class ClusterBaseModelReconciler(_BaseModelReconcilerMixin, Reconciler):
+    FOR = v1.ClusterBaseModel
+    MODEL_CLS = v1.ClusterBaseModel
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        return self._aggregate("", name)
+
+    def watches(self):
+        return self._watch_mappers()

@@ -1,0 +1,158 @@
+"""BenchmarkJob controller.
+
+Re-designs pkg/controller/v1beta1/benchmark (controller.go:78-150,
+utils/utils.go:47-156, reconcilers/job/job.go): wait for the target
+InferenceService to be Ready, stamp a batch Job running the bench CLI
+(`ome-bench`, our genai-bench equivalent shipped in ome_tpu.benchmark)
+against its endpoint, mirror Job state into BenchmarkJob status.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .. import constants
+from ..apis import v1
+from ..core.client import InMemoryClient
+from ..core.errors import ConflictError, NotFoundError
+from ..core.k8s import (Container, Job, JobSpec, PodSpec, PodTemplateSpec,
+                        ResourceRequirements)
+from ..core.manager import Reconciler, Result
+from ..core.meta import ObjectMeta, now
+from .config import BenchmarkJobConfig, load_controller_config
+from .reconcilers.common import child_meta, upsert
+
+
+def benchmark_args(bj: v1.BenchmarkJob, endpoint_url: str,
+                   model_name: str) -> List[str]:
+    """CLI args (benchmark/utils/utils.go:47-123 behavior)."""
+    args = [
+        "benchmark",
+        "--api-base", endpoint_url,
+        "--api-model-name", model_name or "model",
+        "--task", bj.spec.task,
+    ]
+    for scenario in bj.spec.traffic_scenarios:
+        args += ["--traffic-scenario", scenario]
+    for c in bj.spec.num_concurrency:
+        args += ["--num-concurrency", str(c)]
+    if bj.spec.max_time_per_iteration is not None:
+        args += ["--max-time-per-run", str(bj.spec.max_time_per_iteration)]
+    if bj.spec.max_requests_per_iteration is not None:
+        args += ["--max-requests-per-run",
+                 str(bj.spec.max_requests_per_iteration)]
+    for k, val in sorted(bj.spec.additional_request_params.items()):
+        args += ["--additional-request-params", f"{k}={val}"]
+    out = bj.spec.output_location
+    if out is not None and out.storage_uri:
+        args += ["--upload-results", "--storage-uri", out.storage_uri]
+        if bj.spec.result_folder_name:
+            args += ["--result-folder", bj.spec.result_folder_name]
+    if bj.spec.dataset is not None and bj.spec.dataset.storage_uri:
+        args += ["--dataset-path", bj.spec.dataset.storage_uri]
+    return args
+
+
+def _resolve_endpoint(client: InMemoryClient, bj: v1.BenchmarkJob,
+                      ) -> Optional[tuple]:
+    ep = bj.spec.endpoint
+    if ep.url:
+        return ep.url, ep.model_name or "model"
+    if ep.inference_service is not None and ep.inference_service.name:
+        ns = ep.inference_service.namespace or bj.metadata.namespace
+        isvc = client.try_get(v1.InferenceService,
+                              ep.inference_service.name, ns)
+        if isvc is None or not isvc.status.is_ready():
+            return None
+        model = ep.model_name or (
+            isvc.spec.model.name if isvc.spec.model else "model")
+        return isvc.status.url, model
+    return None
+
+
+def build_benchmark_job(bj: v1.BenchmarkJob, cfg: BenchmarkJobConfig,
+                        endpoint_url: str, model_name: str) -> Job:
+    container = Container(
+        name="ome-bench", image=cfg.pod_image,
+        args=benchmark_args(bj, endpoint_url, model_name),
+        resources=ResourceRequirements(
+            requests={"cpu": cfg.cpu_request, "memory": cfg.memory_request}))
+    pod = PodSpec(containers=[container], restart_policy="Never",
+                  service_account_name=bj.spec.service_account_name)
+    if bj.spec.pod_override is not None:
+        from . import merging
+        merging.merge_pod_spec(pod, bj.spec.pod_override)
+    return Job(
+        metadata=child_meta(
+            bj, f"{bj.metadata.name}-bench",
+            {constants.BENCHMARK_LABEL: bj.metadata.name}),
+        spec=JobSpec(
+            template=PodTemplateSpec(
+                metadata=ObjectMeta(labels={constants.BENCHMARK_LABEL:
+                                            bj.metadata.name}),
+                spec=pod),
+            backoff_limit=3, ttl_seconds_after_finished=3600))
+
+
+class BenchmarkJobReconciler(Reconciler):
+    FOR = v1.BenchmarkJob
+
+    def owns(self):
+        return [Job]
+
+    def watches(self):
+        def isvc_to_jobs(obj):
+            keys = []
+            for bj in self.client.list(v1.BenchmarkJob):
+                ref = bj.spec.endpoint.inference_service
+                if ref is not None and ref.name == obj.metadata.name:
+                    keys.append((bj.metadata.namespace, bj.metadata.name))
+            return keys
+        return [(v1.InferenceService, isvc_to_jobs)]
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        bj = self.client.try_get(v1.BenchmarkJob, name, namespace)
+        if bj is None:
+            return Result()
+        if bj.metadata.deletion_timestamp:
+            if constants.BENCHMARK_FINALIZER in bj.metadata.finalizers:
+                bj.metadata.finalizers.remove(constants.BENCHMARK_FINALIZER)
+                self.client.update(bj)
+            return Result()
+        if constants.BENCHMARK_FINALIZER not in bj.metadata.finalizers:
+            bj.metadata.finalizers.append(constants.BENCHMARK_FINALIZER)
+            self.client.update(bj)
+            return Result(requeue=True)
+
+        endpoint = _resolve_endpoint(self.client, bj)
+        if endpoint is None:
+            bj.status.state = "Pending"
+            bj.status.last_reconcile_time = now()
+            self._update_status(bj)
+            return Result(requeue_after=60)  # controller.go:113-121
+
+        cfg = load_controller_config(self.client).benchmark
+        url, model_name = endpoint
+        job = upsert(self.client, bj,
+                     build_benchmark_job(bj, cfg, url, model_name))
+
+        if job.status.succeeded > 0:
+            bj.status.state = "Completed"
+            bj.status.completion_time = bj.status.completion_time or now()
+        elif job.status.failed > (job.spec.backoff_limit or 0):
+            bj.status.state = "Failed"
+            bj.status.failure_message = "benchmark Job exceeded backoff limit"
+        elif job.status.active > 0:
+            bj.status.state = "Running"
+            bj.status.start_time = bj.status.start_time or now()
+        else:
+            bj.status.state = "Pending"
+        bj.status.last_reconcile_time = now()
+        self._update_status(bj)
+        return Result()
+
+    def _update_status(self, bj: v1.BenchmarkJob):
+        try:
+            self.client.update_status(bj)
+        except (ConflictError, NotFoundError):
+            pass

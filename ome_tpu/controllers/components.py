@@ -1,0 +1,358 @@
+"""Component builders: Engine / Decoder / Router.
+
+Re-designs pkg/controller/v1beta1/inferenceservice/components/
+(engine.go:87-373, decoder.go, router.go, base.go, builder.go): each
+component merges the runtime recipe with the isvc overrides into a
+ComponentPlan — object meta, pod spec, worker pod spec, replica bounds —
+that the per-mode reconcilers (raw / multinode) stamp into Deployments
+or LeaderWorkerSets.
+
+TPU-first differences from the reference:
+  * PARALLELISM_SIZE = slice chips (hosts x chips/host from the chosen
+    TopologySpec) instead of nvidia.com/gpu-count x pods
+    (engine.go:350-373 re-based);
+  * pods are sized in chips via google.com/tpu resources and pinned to
+    slices via GKE TPU node labels;
+  * per-accelerator overrides rewrite ICI-mesh/tp flags (merging.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .. import constants
+from ..apis import v1
+from ..core.k8s import (Container, EnvVar, PodSpec, Volume, VolumeMount)
+from ..core.meta import ObjectMeta
+from ..selection.accelerator_selector import AcceleratorChoice
+from . import merging
+
+DEFAULT_MODELS_ROOT = "/mnt/models"
+
+
+@dataclass
+class ComponentPlan:
+    """Everything a mode reconciler needs to stamp child resources."""
+
+    component: str  # engine | decoder | router
+    name: str = ""
+    mode: str = v1.DeploymentMode.RAW.value
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    pod_spec: PodSpec = field(default_factory=PodSpec)
+    worker_pod_spec: Optional[PodSpec] = None
+    worker_size: int = 0  # worker pods per group (hosts - 1 in slice terms)
+    replicas: int = 1
+    min_replicas: Optional[int] = None
+    max_replicas: Optional[int] = None
+    extension: v1.ComponentExtensionSpec = field(
+        default_factory=v1.ComponentExtensionSpec)
+    port: int = constants.ENGINE_PORT
+    accelerator: Optional[AcceleratorChoice] = None
+
+
+def component_name(isvc_name: str, component: str) -> str:
+    return {
+        v1.ENGINE: constants.engine_name(isvc_name),
+        v1.DECODER: constants.decoder_name(isvc_name),
+        v1.ROUTER: constants.router_name(isvc_name),
+    }[component]
+
+
+def model_mount_path(model: Optional[v1.BaseModelSpec],
+                     model_name: str) -> str:
+    if model is not None and model.storage is not None and model.storage.path:
+        return model.storage.path
+    return f"{DEFAULT_MODELS_ROOT}/{model_name}"
+
+
+def _component_labels(isvc: v1.InferenceService, component: str,
+                      extra: Dict[str, str]) -> Dict[str, str]:
+    labels = dict(isvc.metadata.labels)
+    labels.update(extra)
+    labels[constants.ISVC_LABEL] = isvc.metadata.name
+    labels[constants.COMPONENT_LABEL] = component
+    return labels
+
+
+def _runner_container(runtime_cfg: Optional[v1.EngineConfig],
+                      runtime_spec: Optional[v1.ServingRuntimeSpec],
+                      ) -> Container:
+    """The engine container recipe: EngineConfig.runner first, else the
+    runtime's flattened containers list (simple runtimes)."""
+    if runtime_cfg is not None and runtime_cfg.runner is not None:
+        return _copy_container(runtime_cfg.runner.container)
+    if runtime_spec is not None and runtime_spec.containers:
+        return _copy_container(runtime_spec.containers[0])
+    return Container(name=constants.MAIN_CONTAINER)
+
+
+def _copy_container(c: Container) -> Container:
+    return dataclasses.replace(
+        c,
+        command=list(c.command), args=list(c.args),
+        env=[dataclasses.replace(e) for e in c.env],
+        ports=[dataclasses.replace(p) for p in c.ports],
+        resources=(dataclasses.replace(
+            c.resources, requests=dict(c.resources.requests),
+            limits=dict(c.resources.limits))
+            if c.resources else None),
+        volume_mounts=[dataclasses.replace(m) for m in c.volume_mounts])
+
+
+def _copy_pod_spec(p: Optional[PodSpec]) -> PodSpec:
+    if p is None:
+        return PodSpec()
+    return dataclasses.replace(
+        p,
+        containers=[_copy_container(c) for c in p.containers],
+        init_containers=[_copy_container(c) for c in p.init_containers],
+        volumes=[dataclasses.replace(v) for v in p.volumes],
+        node_selector=dict(p.node_selector),
+        tolerations=[dict(t) for t in p.tolerations],
+        image_pull_secrets=[dict(s) for s in p.image_pull_secrets])
+
+
+@dataclass
+class BuildContext:
+    """Inputs resolved by the InferenceService controller before
+    component building (SURVEY.md §3.2 steps 1-5)."""
+
+    isvc: v1.InferenceService
+    model: Optional[v1.BaseModelSpec] = None
+    model_name: str = ""
+    model_kind: str = "ClusterBaseModel"
+    runtime_spec: Optional[v1.ServingRuntimeSpec] = None
+    accelerator: Optional[AcceleratorChoice] = None
+    mode: str = v1.DeploymentMode.RAW.value
+
+
+def build_component(ctx: BuildContext, component: str,
+                    spec: Optional[v1.EngineSpec]) -> ComponentPlan:
+    """Assemble the full pod recipe for one component."""
+    isvc = ctx.isvc
+    # the router NEVER inherits the engine recipe — it has its own
+    # RouterConfig (a router built from engine args would serve as a
+    # second engine instead of routing)
+    runtime_cfg = None
+    if ctx.runtime_spec is not None and component != v1.ROUTER:
+        runtime_cfg = (ctx.runtime_spec.decoder_config
+                       if component == v1.DECODER
+                       else ctx.runtime_spec.engine_config)
+
+    plan = ComponentPlan(
+        component=component,
+        name=component_name(isvc.metadata.name, component),
+        mode=ctx.mode,
+        extension=spec or v1.ComponentExtensionSpec(),
+        accelerator=ctx.accelerator)
+
+    # ---- object meta (engine.go:181-266) -----------------------------
+    extra_labels = dict(runtime_cfg.labels) if runtime_cfg else {}
+    if spec is not None:
+        extra_labels.update(spec.labels)
+    plan.labels = _component_labels(isvc, component, extra_labels)
+    plan.annotations = {
+        k: val for k, val in isvc.metadata.annotations.items()
+        if not k.startswith("kubectl.kubernetes.io/")}
+    if runtime_cfg is not None:
+        plan.annotations.update(runtime_cfg.annotations)
+    if spec is not None:
+        plan.annotations.update(spec.annotations)
+
+    # ---- replicas ----------------------------------------------------
+    ext = plan.extension
+    if ext.min_replicas is not None:
+        plan.min_replicas = ext.min_replicas
+    elif runtime_cfg is not None and runtime_cfg.min_replicas is not None:
+        plan.min_replicas = runtime_cfg.min_replicas
+    plan.max_replicas = (ext.max_replicas
+                         if ext.max_replicas is not None
+                         else (runtime_cfg.max_replicas if runtime_cfg
+                               else None))
+    plan.replicas = max(plan.min_replicas or 1, 1)
+
+    # ---- base pod spec from runtime recipe ---------------------------
+    base_pod = _copy_pod_spec(runtime_cfg.pod if runtime_cfg else None)
+    if component != v1.ROUTER:
+        if not base_pod.containers and ctx.runtime_spec is not None \
+                and ctx.runtime_spec.containers:
+            base_pod.containers = [_copy_container(c)
+                                   for c in ctx.runtime_spec.containers]
+            base_pod.node_selector.update(ctx.runtime_spec.node_selector)
+        if not base_pod.containers:
+            base_pod.containers = [_runner_container(runtime_cfg,
+                                                     ctx.runtime_spec)]
+    elif not base_pod.containers:
+        rc = ctx.runtime_spec.router_config if ctx.runtime_spec else None
+        base_pod.containers = [
+            _copy_container(rc.runner.container)
+            if rc is not None and rc.runner is not None
+            else Container(name=constants.MAIN_CONTAINER)]
+    if runtime_cfg is not None and runtime_cfg.runner is not None:
+        main = base_pod.container(constants.MAIN_CONTAINER)
+        if main is None:
+            base_pod.containers.insert(
+                0, _copy_container(runtime_cfg.runner.container))
+        else:
+            merging.merge_container(main,
+                                    runtime_cfg.runner.container)
+    main = base_pod.container(constants.MAIN_CONTAINER)
+    if main is None:
+        main = base_pod.containers[0]
+        main.name = main.name or constants.MAIN_CONTAINER
+
+    # ---- isvc overrides ----------------------------------------------
+    if spec is not None and getattr(spec, "pod", None) is not None:
+        merging.merge_pod_spec(base_pod, spec.pod)
+    if spec is not None and getattr(spec, "runner", None) is not None:
+        merging.merge_container(main, spec.runner)
+
+    # ---- multi-node leader/worker ------------------------------------
+    worker_pod: Optional[PodSpec] = None
+    worker_size = 0
+    if ctx.mode == v1.DeploymentMode.MULTI_NODE.value \
+            and component in (v1.ENGINE, v1.DECODER):
+        worker_pod = _copy_pod_spec(
+            runtime_cfg.worker if runtime_cfg else None) \
+            if (runtime_cfg and runtime_cfg.worker) else _copy_pod_spec(base_pod)
+        if not worker_pod.containers:
+            worker_pod.containers = [_copy_container(main)]
+        if spec is not None and spec.worker is not None:
+            if spec.worker.pod is not None:
+                merging.merge_pod_spec(worker_pod, spec.worker.pod)
+            if spec.worker.runner is not None:
+                wmain = worker_pod.container(constants.MAIN_CONTAINER) \
+                        or worker_pod.containers[0]
+                merging.merge_container(wmain, spec.worker.runner)
+        if spec is not None and spec.leader is not None:
+            if spec.leader.pod is not None:
+                merging.merge_pod_spec(base_pod, spec.leader.pod)
+            if spec.leader.runner is not None:
+                merging.merge_container(main, spec.leader.runner)
+        # slice topology decides the group size: hosts = leader + workers
+        if spec is not None and spec.worker is not None \
+                and spec.worker.size is not None:
+            worker_size = spec.worker.size
+        elif runtime_cfg is not None and runtime_cfg.worker_size:
+            worker_size = runtime_cfg.worker_size
+        elif ctx.accelerator is not None and ctx.accelerator.topology:
+            worker_size = max(0, ctx.accelerator.topology.hosts - 1)
+
+    # ---- accelerator: overrides, resources, node selector ------------
+    chips_per_host = 0
+    ac = ctx.accelerator.accelerator if ctx.accelerator else None
+    topo = ctx.accelerator.topology if ctx.accelerator else None
+    if ctx.accelerator is not None:
+        if topo is not None:
+            chips_per_host = topo.chips_per_host
+        else:
+            chips_per_host = max(1, ctx.accelerator.chips)
+    if component != v1.ROUTER and ac is not None:
+        override = None
+        if ctx.runtime_spec is not None:
+            override = ctx.runtime_spec.accelerator_config_for(
+                ac.metadata.name)
+        for pod in filter(None, (base_pod, worker_pod)):
+            tgt = pod.container(constants.MAIN_CONTAINER) or pod.containers[0]
+            merging.apply_accelerator_override(tgt, pod, override)
+            merging.apply_accelerator_resources(tgt, ac, chips_per_host)
+            merging.merge_node_selector(pod, ac, topo)
+            tgt.set_env(constants.TPU_ACCELERATOR_ENV,
+                        ac.spec.discovery.node_selector.get(
+                            v1.GKE_TPU_ACCELERATOR_LABEL,
+                            ac.spec.model))
+            if topo is not None:
+                tgt.set_env(constants.TPU_TOPOLOGY_ENV, topo.name)
+
+    # ---- model env / volumes / node affinity -------------------------
+    if component != v1.ROUTER:
+        _apply_model(base_pod, ctx)
+        if worker_pod is not None:
+            _apply_model(worker_pod, ctx)
+        _set_parallelism_env(base_pod, worker_pod, ctx, worker_size,
+                             chips_per_host)
+
+    # ---- placeholder substitution ------------------------------------
+    subst = {
+        constants.MODEL_PATH_ENV: model_mount_path(ctx.model, ctx.model_name),
+        constants.SERVED_MODEL_NAME_ENV: ctx.model_name,
+    }
+    for pod in filter(None, (base_pod, worker_pod)):
+        for c in pod.containers:
+            env = {**subst, **{e.name: e.value or "" for e in c.env}}
+            c.args = merging.substitute_placeholders(c.args, env)
+
+    if component == v1.ROUTER:
+        plan.port = constants.ROUTER_PORT
+        _apply_router_config(base_pod, ctx)
+
+    plan.pod_spec = base_pod
+    plan.worker_pod_spec = worker_pod
+    plan.worker_size = worker_size
+    return plan
+
+
+def _apply_model(pod: PodSpec, ctx: BuildContext):
+    """MODEL_PATH env, hostPath model volume, model-ready node label
+    (base.go:132-257 behavior)."""
+    if ctx.model is None:
+        return
+    path = model_mount_path(ctx.model, ctx.model_name)
+    vol_name = "model-weights"
+    if not any(v.name == vol_name for v in pod.volumes):
+        pod.volumes.append(Volume(
+            name=vol_name, host_path={"path": path,
+                                      "type": "DirectoryOrCreate"}))
+    for c in pod.containers:
+        c.set_env(constants.MODEL_PATH_ENV, path)
+        c.set_env(constants.SERVED_MODEL_NAME_ENV, ctx.model_name)
+        if not any(m.name == vol_name for m in c.volume_mounts):
+            c.volume_mounts.append(VolumeMount(
+                name=vol_name, mount_path=path, read_only=True))
+    # schedule only onto nodes where the model-agent staged the weights
+    label = constants.model_ready_label(ctx.model_kind, ctx.model_name)
+    pod.node_selector.setdefault(label, constants.MODEL_STATUS_READY)
+
+
+def _set_parallelism_env(pod: PodSpec, worker_pod: Optional[PodSpec],
+                         ctx: BuildContext, worker_size: int,
+                         chips_per_host: int):
+    """PARALLELISM_SIZE = total chips across the slice group
+    (engine.go:350-373 re-based on topology, not gpu-count)."""
+    if ctx.accelerator is None:
+        return
+    topo = ctx.accelerator.topology
+    if topo is not None:
+        total = topo.chips
+    else:
+        total = max(1, chips_per_host) * (1 + worker_size)
+    for p in filter(None, (pod, worker_pod)):
+        for c in p.containers:
+            if c.get_env(constants.PARALLELISM_SIZE_ENV) is None:
+                c.set_env(constants.PARALLELISM_SIZE_ENV, str(total))
+
+
+def _apply_router_config(pod: PodSpec, ctx: BuildContext):
+    """Router service-discovery config (deepseek-rdma-pd-rt.yaml:490-515
+    pattern): selectors for engine/decoder pods arrive as env. The
+    router container itself was seeded from RouterConfig.runner and
+    merged with the isvc's RouterSpec.runner in build_component."""
+    spec = ctx.isvc.spec.router
+    cfg: Dict[str, str] = {}
+    if ctx.runtime_spec is not None and ctx.runtime_spec.router_config:
+        cfg.update(ctx.runtime_spec.router_config.config)
+    if spec is not None:
+        cfg.update(spec.config)
+    isvc_name = ctx.isvc.metadata.name
+    defaults = {
+        "ENGINE_SELECTOR": f"{constants.ISVC_LABEL}={isvc_name},"
+                           f"{constants.COMPONENT_LABEL}={v1.ENGINE}",
+        "DECODER_SELECTOR": f"{constants.ISVC_LABEL}={isvc_name},"
+                            f"{constants.COMPONENT_LABEL}={v1.DECODER}",
+    }
+    for c in pod.containers:
+        for k, val in {**defaults, **cfg}.items():
+            c.set_env(k, str(val))

@@ -1,0 +1,97 @@
+"""Operator configuration from the `ome/inferenceservice-config` ConfigMap.
+
+Mirrors pkg/controller/v1beta1/controllerconfig/configmap.go:28-210:
+typed config blocks parsed from JSON values in one ConfigMap, with
+defaults that work without the ConfigMap present.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .. import constants
+from ..core.client import InMemoryClient
+from ..core.k8s import ConfigMap
+
+
+@dataclass
+class DeployConfig:
+    default_deployment_mode: str = "RawDeployment"
+
+
+@dataclass
+class IngressConfig:
+    domain_template: str = "{name}.{namespace}.svc.cluster.local"
+    ingress_gateway: Optional[str] = None
+    ingress_class_name: Optional[str] = None
+    enable_gateway_api: bool = False
+    disable_ingress_creation: bool = False
+    disable_istio_virtual_host: bool = False
+    url_scheme: str = "http"
+
+
+@dataclass
+class MultiNodeProberConfig:
+    image: str = "ome/multinode-prober:latest"
+    startup_failure_threshold: int = 120
+    startup_period_seconds: int = 30
+    startup_timeout_seconds: int = 60
+    unavailable_threshold_seconds: int = 600
+
+
+@dataclass
+class BenchmarkJobConfig:
+    pod_image: str = "ome/genai-bench:latest"
+    cpu_request: str = "2"
+    memory_request: str = "4Gi"
+
+
+@dataclass
+class ModelInitConfig:
+    image: str = "ome/model-agent:latest"
+    cpu_request: str = "1"
+    memory_request: str = "1Gi"
+
+
+@dataclass
+class ControllerConfig:
+    deploy: DeployConfig = field(default_factory=DeployConfig)
+    ingress: IngressConfig = field(default_factory=IngressConfig)
+    prober: MultiNodeProberConfig = field(default_factory=MultiNodeProberConfig)
+    benchmark: BenchmarkJobConfig = field(default_factory=BenchmarkJobConfig)
+    model_init: ModelInitConfig = field(default_factory=ModelInitConfig)
+
+
+def _load(cls, data: dict, key: str):
+    raw = data.get(key)
+    if not raw:
+        return cls()
+    try:
+        parsed = json.loads(raw)
+    except (TypeError, ValueError):
+        return cls()
+    kwargs = {}
+    for f in cls.__dataclass_fields__:
+        camel = "".join(
+            w.capitalize() if i else w
+            for i, w in enumerate(f.split("_")))
+        if f in parsed:
+            kwargs[f] = parsed[f]
+        elif camel in parsed:
+            kwargs[f] = parsed[camel]
+    return cls(**kwargs)
+
+
+def load_controller_config(client: InMemoryClient) -> ControllerConfig:
+    cm = client.try_get(ConfigMap, constants.ISVC_CONFIG_NAME,
+                        constants.OPERATOR_NAMESPACE)
+    data = cm.data if cm is not None else {}
+    return ControllerConfig(
+        deploy=_load(DeployConfig, data, "deploy"),
+        ingress=_load(IngressConfig, data, "ingress"),
+        prober=_load(MultiNodeProberConfig, data, "multinodeProber"),
+        benchmark=_load(BenchmarkJobConfig, data, "benchmarkJob"),
+        model_init=_load(ModelInitConfig, data, "modelInit"),
+    )

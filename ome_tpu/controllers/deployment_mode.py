@@ -1,0 +1,118 @@
+"""Deployment-mode resolution + compatibility rules.
+
+Mirrors inferenceservice/utils/deployment.go:12-133: the mode comes from
+the isvc annotation, else is inferred from the merged spec shape
+(leader/worker present -> MultiNode; decoder present -> PDDisaggregated;
+minReplicas=0 -> Serverless), else falls back to the operator default.
+Incompatible combinations are rejected before any child resource is
+stamped.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from .. import constants
+from ..apis import v1
+from ..core.errors import APIError
+
+
+class DeploymentModeError(APIError):
+    pass
+
+
+@dataclass
+class ComponentModes:
+    engine: Optional[str] = None
+    decoder: Optional[str] = None
+    router: Optional[str] = None
+
+    def as_dict(self) -> Dict[str, Optional[str]]:
+        return {"engine": self.engine, "decoder": self.decoder,
+                "router": self.router}
+
+
+def _infer_component_mode(spec: Optional[v1.EngineSpec],
+                          default_mode: str) -> Optional[str]:
+    if spec is None:
+        return None
+    if spec.leader is not None or spec.worker is not None:
+        return v1.DeploymentMode.MULTI_NODE.value
+    if spec.min_replicas == 0:
+        return v1.DeploymentMode.SERVERLESS.value
+    return default_mode
+
+
+def resolve_modes(isvc: v1.InferenceService, default_mode: str,
+                  runtime_spec: Optional[v1.ServingRuntimeSpec] = None,
+                  ) -> ComponentModes:
+    """Per-component deployment mode (utils/deployment.go:38+)."""
+    annotated = isvc.metadata.annotations.get(
+        constants.DEPLOYMENT_MODE_ANNOTATION)
+    if annotated:
+        valid = {m.value for m in v1.DeploymentMode}
+        if annotated not in valid:
+            raise DeploymentModeError(
+                f"invalid deployment mode annotation {annotated!r}; "
+                f"valid: {sorted(valid)}")
+
+    engine_spec = isvc.spec.engine
+    decoder_spec = isvc.spec.decoder
+    # runtime worker recipe makes the engine multi-node even when the
+    # isvc doesn't spell out leader/worker
+    if (engine_spec is not None and runtime_spec is not None
+            and runtime_spec.engine_config is not None
+            and runtime_spec.engine_config.worker is not None
+            and engine_spec.leader is None and engine_spec.worker is None):
+        engine_spec = v1.EngineSpec(
+            leader=v1.LeaderSpec(),
+            worker=v1.WorkerSpec(size=runtime_spec.engine_config.worker_size))
+
+    # the annotation overrides the mode of components that exist; it
+    # never conjures a component the spec doesn't define
+    modes = ComponentModes(
+        engine=(annotated if annotated and engine_spec is not None
+                else _infer_component_mode(engine_spec, default_mode)),
+        decoder=(annotated if annotated and decoder_spec is not None
+                 else _infer_component_mode(decoder_spec, default_mode)),
+        router=(v1.DeploymentMode.RAW.value
+                if isvc.spec.router is not None else None),
+    )
+    validate_modes(isvc, modes)
+    return modes
+
+
+def adjust_for_topology(modes: ComponentModes,
+                        topology: Optional[v1.TopologySpec]):
+    """A pinned slice spanning multiple hosts cannot run as a single
+    RawDeployment pod — each pod only gets one host's chips. Upgrade to
+    MultiNode so the LWS group covers the slice."""
+    if topology is None or topology.hosts <= 1:
+        return
+    for comp in ("engine", "decoder"):
+        if getattr(modes, comp) == v1.DeploymentMode.RAW.value:
+            setattr(modes, comp, v1.DeploymentMode.MULTI_NODE.value)
+
+
+def validate_modes(isvc: v1.InferenceService, modes: ComponentModes):
+    """Compatibility matrix (deployment.go:76-133)."""
+    if isvc.spec.decoder is not None and isvc.spec.engine is None:
+        raise DeploymentModeError(
+            "decoder (PD disaggregation) requires an engine component")
+    if modes.decoder == v1.DeploymentMode.SERVERLESS.value:
+        raise DeploymentModeError(
+            "decoder does not support Serverless mode")
+    if (isvc.spec.decoder is not None
+            and modes.engine == v1.DeploymentMode.SERVERLESS.value):
+        raise DeploymentModeError(
+            "PD-disaggregated engine does not support Serverless mode")
+    if (modes.engine == v1.DeploymentMode.MULTI_NODE.value
+            and isvc.spec.engine is not None
+            and isvc.spec.engine.worker is not None
+            and (isvc.spec.engine.worker.size or 0) < 0):
+        raise DeploymentModeError("worker size must be >= 0")
+
+
+def is_pd_disaggregated(isvc: v1.InferenceService) -> bool:
+    return isvc.spec.engine is not None and isvc.spec.decoder is not None

@@ -1,0 +1,256 @@
+"""InferenceService controller — the heart of the control plane.
+
+Re-designs pkg/controller/v1beta1/inferenceservice/controller.go:117-503
+(reconcile steps documented in SURVEY.md §3.2): finalizers → deployment
+mode → model resolution → runtime selection/validation → spec merge →
+accelerator resolution → per-component reconcilers → ingress → status.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+from .. import constants
+from ..apis import v1
+from ..core.client import InMemoryClient
+from ..core.errors import ConflictError, NotFoundError
+from ..core.k8s import (ConfigMap, Deployment, HorizontalPodAutoscaler,
+                        Ingress, LeaderWorkerSet, PodDisruptionBudget,
+                        ScaledObject, Service)
+from ..core.manager import Reconciler, Result
+from ..core.meta import Condition, set_condition
+from ..selection.accelerator_selector import (AcceleratorChoice,
+                                              AcceleratorSelectionError,
+                                              AcceleratorSelector)
+from ..selection.runtime_selector import RuntimeSelector, SelectionError
+from . import components, deployment_mode, status as status_mod
+from .config import load_controller_config
+from .reconcilers import ingress as ingress_mod
+from .reconcilers import modelconfig as modelconfig_mod
+from .reconcilers.common import delete_if_exists
+from .reconcilers.multinode import reconcile_multinode
+from .reconcilers.raw import reconcile_raw
+
+
+class ModelNotFoundError(NotFoundError):
+    pass
+
+
+def resolve_base_model(client: InMemoryClient, ref: Optional[v1.ModelRef],
+                       namespace: str,
+                       ) -> Tuple[v1.BaseModelSpec, str, str, object]:
+    """BaseModel in the isvc namespace, else ClusterBaseModel
+    (utils/reconciliation.go:51 behavior)."""
+    if ref is None or not ref.name:
+        raise ModelNotFoundError("inference service has no model reference")
+    if ref.kind in (None, "", "BaseModel"):
+        bm = client.try_get(v1.BaseModel, ref.name, namespace)
+        if bm is not None:
+            return bm.spec, ref.name, "BaseModel", bm
+        if ref.kind == "BaseModel":
+            raise ModelNotFoundError(
+                f"BaseModel {namespace}/{ref.name} not found")
+    cbm = client.try_get(v1.ClusterBaseModel, ref.name)
+    if cbm is None:
+        raise ModelNotFoundError(
+            f"model {ref.name!r} not found as BaseModel in {namespace!r} "
+            f"or ClusterBaseModel")
+    return cbm.spec, ref.name, "ClusterBaseModel", cbm
+
+
+class InferenceServiceReconciler(Reconciler):
+    FOR = v1.InferenceService
+
+    def __init__(self, client: InMemoryClient):
+        super().__init__(client)
+        self.runtime_selector = RuntimeSelector(client)
+        self.accelerator_selector = AcceleratorSelector(client)
+
+    def owns(self):
+        return [Deployment, Service, ConfigMap, LeaderWorkerSet,
+                HorizontalPodAutoscaler, ScaledObject, PodDisruptionBudget,
+                Ingress]
+
+    def watches(self):
+        def models_to_isvcs(obj):
+            keys = []
+            for isvc in self.client.list(v1.InferenceService):
+                ref = isvc.spec.model
+                if ref is not None and ref.name == obj.metadata.name:
+                    keys.append((isvc.metadata.namespace,
+                                 isvc.metadata.name))
+            return keys
+        return [(v1.BaseModel, models_to_isvcs),
+                (v1.ClusterBaseModel, models_to_isvcs)]
+
+    # ------------------------------------------------------------------
+
+    def reconcile(self, namespace: str, name: str) -> Result:
+        isvc = self.client.try_get(v1.InferenceService, name, namespace)
+        if isvc is None:
+            return Result()
+
+        if isvc.metadata.deletion_timestamp:
+            return self._finalize(isvc)
+
+        if constants.ISVC_FINALIZER not in isvc.metadata.finalizers:
+            isvc.metadata.finalizers.append(constants.ISVC_FINALIZER)
+            self.client.update(isvc)
+            return Result(requeue=True)
+
+        cfg = load_controller_config(self.client)
+
+        # Step 1: model resolution
+        try:
+            model, model_name, model_kind, model_obj = resolve_base_model(
+                self.client, isvc.spec.model, namespace)
+        except ModelNotFoundError as e:
+            return self._fail(isvc, "ModelNotFound", str(e),
+                              requeue_after=30)
+        if model.disabled:
+            return self._fail(isvc, "ModelDisabled",
+                              f"model {model_name!r} is disabled")
+        isvc.status.model_status = v1.ModelStatus(
+            name=model_name,
+            state=(model_obj.status.state.value
+                   if model_obj.status.state else None))
+
+        modelconfig_mod.reconcile_modelconfig(self.client, isvc, model,
+                                              model_name)
+
+        # Step 2+5: accelerator then runtime (accelerator feeds the
+        # runtime compatibility check)
+        accelerator: Optional[AcceleratorChoice] = None
+        runtime_spec: Optional[v1.ServingRuntimeSpec] = None
+        try:
+            runtime_spec, accelerator = self._resolve_runtime_and_accelerator(
+                isvc, model, model_name, namespace)
+        except (SelectionError, AcceleratorSelectionError) as e:
+            return self._fail(isvc, "RuntimeSelectionFailed", str(e),
+                              requeue_after=60)
+
+        # Step 4: deployment modes
+        try:
+            modes = deployment_mode.resolve_modes(
+                isvc, cfg.deploy.default_deployment_mode, runtime_spec)
+        except deployment_mode.DeploymentModeError as e:
+            return self._fail(isvc, "InvalidDeploymentMode", str(e))
+        deployment_mode.adjust_for_topology(
+            modes, accelerator.topology if accelerator else None)
+
+        # Step 6: per-component build + stamp
+        built: Dict[str, components.ComponentPlan] = {}
+        for component, spec, mode in (
+                (v1.ENGINE, isvc.spec.engine, modes.engine),
+                (v1.DECODER, isvc.spec.decoder, modes.decoder),
+                (v1.ROUTER, isvc.spec.router, modes.router)):
+            if mode is None:
+                self._cleanup_component(isvc, component)
+                continue
+            ctx = components.BuildContext(
+                isvc=isvc, model=model, model_name=model_name,
+                model_kind=model_kind, runtime_spec=runtime_spec,
+                accelerator=(accelerator if component != v1.ROUTER
+                             else None),
+                mode=mode)
+            plan = components.build_component(ctx, component, spec)
+            if mode == v1.DeploymentMode.MULTI_NODE.value:
+                reconcile_multinode(self.client, isvc, plan)
+            else:
+                reconcile_raw(self.client, isvc, plan)
+            built[component] = plan
+
+        if not built:
+            return self._fail(isvc, "NoComponents",
+                              "inference service defines no components")
+
+        # Step 7: ingress + external service + URL
+        entry = built.get(v1.ROUTER) or built.get(v1.ENGINE)
+        url = ingress_mod.reconcile_ingress(
+            self.client, isvc, cfg.ingress,
+            modes.engine or v1.DeploymentMode.RAW.value, entry)
+
+        # Step 8: status
+        isvc.status.deployment_mode = modes.engine
+        status_mod.propagate_status(
+            self.client, isvc,
+            {c: m for c, m in modes.as_dict().items()}, url)
+        self._update_status(isvc)
+        return Result()
+
+    # ------------------------------------------------------------------
+
+    def _resolve_runtime_and_accelerator(
+            self, isvc: v1.InferenceService, model: v1.BaseModelSpec,
+            model_name: str, namespace: str,
+    ) -> Tuple[v1.ServingRuntimeSpec, Optional[AcceleratorChoice]]:
+        """Explicit runtime -> validate; else auto-select. Accelerator is
+        resolved first (when possible) so runtime matching can check
+        AcceleratorRequirements against the actual target hardware."""
+        sel = isvc.spec.accelerator_selector
+        accelerator: Optional[AcceleratorChoice] = None
+        if sel is not None and sel.accelerator_class:
+            accelerator = self.accelerator_selector.resolve(isvc, None, model)
+        ac_obj = accelerator.accelerator if accelerator else None
+
+        if isvc.spec.runtime is not None and isvc.spec.runtime.name:
+            match = self.runtime_selector.validate(
+                isvc.spec.runtime.name, model, namespace,
+                accelerator=ac_obj, model_name=model_name)
+        else:
+            match = self.runtime_selector.select(
+                model, namespace, accelerator=ac_obj, model_name=model_name)
+        runtime_spec = match.runtime.spec
+
+        if accelerator is None and self.client.list(v1.AcceleratorClass):
+            try:
+                accelerator = self.accelerator_selector.resolve(
+                    isvc, runtime_spec, model)
+            except AcceleratorSelectionError:
+                if runtime_spec.accelerator_requirements is not None:
+                    raise
+                accelerator = None  # CPU-only runtime is legitimate
+        return runtime_spec, accelerator
+
+    def _cleanup_component(self, isvc: v1.InferenceService, component: str):
+        name = components.component_name(isvc.metadata.name, component)
+        ns = isvc.metadata.namespace
+        for cls in (Deployment, LeaderWorkerSet, Service,
+                    HorizontalPodAutoscaler, ScaledObject,
+                    PodDisruptionBudget):
+            delete_if_exists(self.client, cls, name, ns)
+
+    def _finalize(self, isvc: v1.InferenceService) -> Result:
+        """Children are owner-referenced; GC cascades on delete."""
+        if constants.ISVC_FINALIZER in isvc.metadata.finalizers:
+            isvc.metadata.finalizers.remove(constants.ISVC_FINALIZER)
+            try:
+                self.client.update(isvc)
+            except (ConflictError, NotFoundError):
+                return Result(requeue=True)
+        return Result()
+
+    def _fail(self, isvc: v1.InferenceService, reason: str, message: str,
+              requeue_after: float = 0.0) -> Result:
+        isvc.status.conditions = set_condition(isvc.status.conditions, Condition(
+            type=v1.READY, status="False", reason=reason, message=message))
+        self.client.record_event(isvc, "Warning", reason, message)
+        self._update_status(isvc)
+        return Result(requeue_after=requeue_after)
+
+    def _update_status(self, isvc: v1.InferenceService):
+        try:
+            self.client.update_status(isvc)
+        except ConflictError:
+            fresh = self.client.try_get(v1.InferenceService,
+                                        isvc.metadata.name,
+                                        isvc.metadata.namespace)
+            if fresh is not None:
+                fresh.status = isvc.status
+                try:
+                    self.client.update_status(fresh)
+                except ConflictError:
+                    pass
+        except NotFoundError:
+            pass

@@ -1,0 +1,247 @@
+"""Spec-merging semantics: runtime ⊕ isvc ⊕ accelerator override.
+
+Re-designs pkg/controller/v1beta1/inferenceservice/utils/merging.go and
+components/base.go:258-307 (SURVEY.md §2.3 "Spec merging"): argument
+merges are key-aware (an override of `--tp-size` replaces the runtime's
+`--tp-size`, everything else appends), `$(NAME)`-style placeholders are
+substituted from a context map, node selectors fold in AcceleratorClass
+discovery labels, and parallelism overrides rewrite engine flags across
+alias groups — extended here with the MaxText/JetStream ICI-mesh flag
+family, which is how parallelism is actually expressed TPU-side.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence
+
+from ..apis import v1
+from ..core.k8s import Container, PodSpec, ResourceRequirements
+
+_PLACEHOLDER = re.compile(r"\$\(([A-Z0-9_]+)\)")
+
+# flag alias groups — any spelling identifies the same logical knob
+# (components/base.go:269-307 extended with TPU engine spellings)
+TP_ALIASES = ("--tp-size", "--tp", "--tensor-parallel-size",
+              "--ici_tensor_parallelism")
+PP_ALIASES = ("--pp-size", "--pp", "--pipeline-parallel-size",
+              "--ici_pipeline_parallelism")
+DP_ALIASES = ("--dp-size", "--dp", "--data-parallel-size",
+              "--ici_data_parallelism", "--dcn_data_parallelism")
+EP_ALIASES = ("--ep-size", "--ep", "--expert-parallel-size",
+              "--ici_expert_parallelism")
+SP_ALIASES = ("--sp-size", "--sp", "--sequence-parallel-size",
+              "--ici_sequence_parallelism", "--context-parallel-size")
+
+_ALIAS_GROUPS = (TP_ALIASES, PP_ALIASES, DP_ALIASES, EP_ALIASES, SP_ALIASES)
+
+
+def _flag_key(arg: str) -> Optional[str]:
+    """'--tp-size=4' / '--tp-size' -> '--tp-size'; bare values -> None."""
+    if not arg.startswith("-"):
+        return None
+    return arg.split("=", 1)[0]
+
+
+def _canonical_key(key: str) -> str:
+    for group in _ALIAS_GROUPS:
+        if key in group:
+            return group[0]
+    return key
+
+
+def parse_args(args: Sequence[str]) -> List[List[str]]:
+    """Group a flat argv into [flag, value...] units, keyed by flag."""
+    units: List[List[str]] = []
+    for a in args:
+        if _flag_key(a) is not None or not units:
+            units.append([a])
+        else:
+            units[-1].append(a)
+    return units
+
+
+def merge_args(base: Sequence[str], override: Sequence[str]) -> List[str]:
+    """Key-aware argv merge (merging.go:422-494 behavior): override units
+    replace base units with the same (alias-canonical) flag key in place;
+    new flags append in override order; bare leading values in override
+    replace the whole base argv."""
+    if override and _flag_key(override[0]) is None:
+        return list(override)
+    base_units = parse_args(base)
+    over_units = parse_args(override)
+    over_by_key = {}
+    for u in over_units:
+        k = _flag_key(u[0])
+        if k is not None:
+            over_by_key[_canonical_key(k)] = u
+    out: List[str] = []
+    used = set()
+    for u in base_units:
+        k = _flag_key(u[0])
+        ck = _canonical_key(k) if k else None
+        if ck is not None and ck in over_by_key:
+            out.extend(over_by_key[ck])
+            used.add(ck)
+        else:
+            out.extend(u)
+    for u in over_units:
+        k = _flag_key(u[0])
+        ck = _canonical_key(k) if k else None
+        if ck is None or ck not in used:
+            if ck is not None and ck in over_by_key and u is not over_by_key[ck]:
+                continue  # duplicate alias in override: first occurrence wins
+            out.extend(u)
+            if ck is not None:
+                used.add(ck)
+    return out
+
+
+def set_flag(args: Sequence[str], flag: str, value: str) -> List[str]:
+    """Set/replace one flag (respecting alias groups) in an argv."""
+    return merge_args(args, [flag, value])
+
+
+def substitute_placeholders(args: Sequence[str], ctx: Dict[str, str],
+                            ) -> List[str]:
+    """Replace $(NAME) from ctx (merging.go:167-181); unknown names are
+    left intact so LWS-injected env like $(LWS_LEADER_ADDRESS) survives
+    to the pod where the kubelet resolves it."""
+    def sub(a: str) -> str:
+        return _PLACEHOLDER.sub(
+            lambda m: ctx.get(m.group(1), m.group(0)), a)
+    return [sub(a) for a in args]
+
+
+def merge_env(base: Container, override_env: Dict[str, str]):
+    for k, val in override_env.items():
+        base.set_env(k, val)
+
+
+def merge_container(base: Container, override: Optional[Container],
+                    ) -> Container:
+    """Runtime runner ⊕ isvc runner: scalar fields replace when set, args
+    merge key-aware, env merges by name, resources replace per-key."""
+    if override is None:
+        return base
+    if override.image:
+        base.image = override.image
+    if override.command:
+        base.command = list(override.command)
+    if override.args:
+        base.args = merge_args(base.args, override.args)
+    for e in override.env:
+        base.set_env(e.name, e.value or "")
+    if override.resources:
+        if base.resources is None:
+            base.resources = ResourceRequirements()
+        base.resources.requests.update(override.resources.requests)
+        base.resources.limits.update(override.resources.limits)
+    if override.ports:
+        base.ports = list(override.ports)
+    for probe in ("liveness_probe", "readiness_probe", "startup_probe"):
+        if getattr(override, probe) is not None:
+            setattr(base, probe, getattr(override, probe))
+    if override.volume_mounts:
+        have = {m.name for m in base.volume_mounts}
+        base.volume_mounts.extend(
+            m for m in override.volume_mounts if m.name not in have)
+    return base
+
+
+def merge_pod_spec(base: PodSpec, override: Optional[PodSpec]) -> PodSpec:
+    """isvc pod fields layered over the runtime's pod recipe."""
+    if override is None:
+        return base
+    if override.node_selector:
+        base.node_selector.update(override.node_selector)
+    if override.affinity is not None:
+        base.affinity = override.affinity
+    if override.tolerations:
+        base.tolerations = base.tolerations + [
+            t for t in override.tolerations if t not in base.tolerations]
+    if override.service_account_name:
+        base.service_account_name = override.service_account_name
+    if override.scheduler_name:
+        base.scheduler_name = override.scheduler_name
+    if override.volumes:
+        have = {vol.name for vol in base.volumes}
+        base.volumes.extend(v for v in override.volumes if v.name not in have)
+    by_name = {c.name: c for c in base.containers}
+    for c in override.containers:
+        if c.name in by_name:
+            merge_container(by_name[c.name], c)
+        else:
+            base.containers.append(c)
+    init_by_name = {c.name: c for c in base.init_containers}
+    for c in override.init_containers:
+        if c.name in init_by_name:
+            merge_container(init_by_name[c.name], c)
+        else:
+            base.init_containers.append(c)
+    return base
+
+
+def apply_parallelism(container: Container,
+                      par: Optional[v1.ParallelismConfig]):
+    """Rewrite engine flags from a per-accelerator ParallelismConfig —
+    the AcceleratorModelConfig hook (servingruntime_types.go:88-101)."""
+    if par is None:
+        return
+    pairs = ((par.tensor_parallel_size, TP_ALIASES),
+             (par.pipeline_parallel_size, PP_ALIASES),
+             (par.data_parallel_size, DP_ALIASES),
+             (par.expert_parallel_size, EP_ALIASES),
+             (par.sequence_parallel_size, SP_ALIASES))
+    present_keys = {_flag_key(a) for a in container.args if _flag_key(a)}
+    for size, aliases in pairs:
+        if size is None:
+            continue
+        # keep the engine's own spelling when the flag already exists;
+        # otherwise append the group's canonical spelling
+        present = next((a for a in aliases if a in present_keys), None)
+        container.args = set_flag(container.args, present or aliases[0],
+                                  str(size))
+    if par.ici_mesh:
+        container.set_env("ICI_MESH_SHAPE", par.ici_mesh)
+    if par.dcn_mesh:
+        container.set_env("DCN_MESH_SHAPE", par.dcn_mesh)
+
+
+def apply_accelerator_override(container: Container, pod: PodSpec,
+                               cfg: Optional[v1.AcceleratorModelConfig]):
+    """Per-AcceleratorClass args/env/image override from the runtime."""
+    if cfg is None:
+        return
+    apply_parallelism(container, cfg.parallelism)
+    if cfg.args:
+        container.args = merge_args(container.args, cfg.args)
+    merge_env(container, cfg.env)
+    if cfg.runner_image:
+        container.image = cfg.runner_image
+
+
+def apply_accelerator_resources(container: Container,
+                                ac: Optional[v1.AcceleratorClass],
+                                chips_per_pod: int):
+    """Stamp the schedulable accelerator resource (merging.go:224-290
+    re-based: google.com/tpu chips, never nvidia.com/gpu)."""
+    if ac is None or chips_per_pod <= 0:
+        return
+    if container.resources is None:
+        container.resources = ResourceRequirements()
+    for res in ac.spec.resources or {v1.TPU_RESOURCE: "1"}:
+        amount = str(chips_per_pod)
+        container.resources.requests.setdefault(res, amount)
+        container.resources.limits.setdefault(res, amount)
+
+
+def merge_node_selector(pod: PodSpec, ac: Optional[v1.AcceleratorClass],
+                        topology: Optional[v1.TopologySpec] = None):
+    """Constrain scheduling to the accelerator's discovery labels plus
+    the requested slice topology (merging.go:183-222, TPU labels)."""
+    if ac is None:
+        return
+    pod.node_selector.update(ac.spec.discovery.node_selector)
+    if topology is not None and topology.name:
+        pod.node_selector.setdefault(v1.GKE_TPU_TOPOLOGY_LABEL, topology.name)

@@ -1,0 +1,56 @@
+"""Shared helpers for child-resource reconcilers."""
+
+from __future__ import annotations
+
+from typing import Optional, Type
+
+from ...core.client import InMemoryClient, set_controller_reference
+from ...core.errors import NotFoundError
+from ...core.meta import ObjectMeta, Resource
+from ...core.serde import to_dict
+
+
+def child_meta(owner: Resource, name: str, labels=None,
+               annotations=None) -> ObjectMeta:
+    return ObjectMeta(
+        name=name, namespace=owner.metadata.namespace,
+        labels=dict(labels or {}), annotations=dict(annotations or {}))
+
+
+def specs_equal(a: Resource, b: Resource) -> bool:
+    """Semantic equality over everything but metadata/status (ConfigMaps
+    carry `data`, Secrets `data`+`type`, workloads `spec`)."""
+    da, db = to_dict(a), to_dict(b)
+    for skip in ("metadata", "status"):
+        da.pop(skip, None)
+        db.pop(skip, None)
+    return da == db and \
+        a.metadata.labels == b.metadata.labels and \
+        a.metadata.annotations == b.metadata.annotations
+
+
+def upsert(client: InMemoryClient, owner: Resource, desired: Resource,
+           ) -> Resource:
+    """Create-or-update with semantic equality guard (the CreateOrUpdate
+    idiom used across the reference's reconcilers)."""
+    set_controller_reference(owner, desired)
+    existing = client.try_get(type(desired), desired.metadata.name,
+                              desired.metadata.namespace)
+    if existing is None:
+        return client.create(desired)
+    if specs_equal(existing, desired):
+        return existing
+    desired.metadata.resource_version = existing.metadata.resource_version
+    desired.metadata.uid = existing.metadata.uid
+    desired.metadata.owner_references = existing.metadata.owner_references
+    if hasattr(existing, "status"):
+        desired.status = existing.status  # children own their status
+    return client.update(desired)
+
+
+def delete_if_exists(client: InMemoryClient, cls: Type[Resource],
+                     name: str, namespace: str):
+    try:
+        client.delete(cls, name, namespace)
+    except NotFoundError:
+        pass

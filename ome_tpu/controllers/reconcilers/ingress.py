@@ -1,0 +1,122 @@
+"""Ingress reconciler — strategy per deployment mode.
+
+Re-designs reconcilers/ingress (ingress/README.md:36-60): Serverless →
+Istio VirtualService; Raw/MultiNode → networking/v1 Ingress, or a
+Gateway-API HTTPRoute when the operator config enables it. Also stamps
+the external Service + status URL (external_service reconciler).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ... import constants
+from ...apis import v1
+from ...core.client import InMemoryClient
+from ...core.k8s import HTTPRoute, Ingress, Service, ServicePort, ServiceSpec, VirtualService
+from ..components import ComponentPlan
+from ..config import IngressConfig
+from .common import child_meta, delete_if_exists, upsert
+
+
+def service_url(isvc: v1.InferenceService, cfg: IngressConfig) -> str:
+    host = cfg.domain_template.format(name=isvc.metadata.name,
+                                      namespace=isvc.metadata.namespace)
+    return f"{cfg.url_scheme}://{host}"
+
+
+def _target_component(isvc: v1.InferenceService) -> str:
+    """Traffic entry point: router if present, else engine."""
+    return v1.ROUTER if isvc.spec.router is not None else v1.ENGINE
+
+
+def build_ingress(isvc: v1.InferenceService, cfg: IngressConfig,
+                  target_service: str, port: int) -> Ingress:
+    host = cfg.domain_template.format(name=isvc.metadata.name,
+                                      namespace=isvc.metadata.namespace)
+    return Ingress(
+        metadata=child_meta(isvc, isvc.metadata.name,
+                            {constants.ISVC_LABEL: isvc.metadata.name}),
+        spec={
+            "ingressClassName": cfg.ingress_class_name,
+            "rules": [{
+                "host": host,
+                "http": {"paths": [{
+                    "path": "/", "pathType": "Prefix",
+                    "backend": {"service": {
+                        "name": target_service,
+                        "port": {"number": port}}}}]}}]})
+
+
+def build_httproute(isvc: v1.InferenceService, cfg: IngressConfig,
+                    target_service: str, port: int) -> HTTPRoute:
+    host = cfg.domain_template.format(name=isvc.metadata.name,
+                                      namespace=isvc.metadata.namespace)
+    return HTTPRoute(
+        metadata=child_meta(isvc, isvc.metadata.name,
+                            {constants.ISVC_LABEL: isvc.metadata.name}),
+        spec={
+            "parentRefs": [{"name": cfg.ingress_gateway or "ome-gateway"}],
+            "hostnames": [host],
+            "rules": [{
+                "matches": [{"path": {"type": "PathPrefix", "value": "/"}}],
+                "backendRefs": [{"name": target_service, "port": port}]}]})
+
+
+def build_virtual_service(isvc: v1.InferenceService, cfg: IngressConfig,
+                          target_service: str, port: int) -> VirtualService:
+    host = cfg.domain_template.format(name=isvc.metadata.name,
+                                      namespace=isvc.metadata.namespace)
+    return VirtualService(
+        metadata=child_meta(isvc, isvc.metadata.name,
+                            {constants.ISVC_LABEL: isvc.metadata.name}),
+        spec={
+            "hosts": [host],
+            "gateways": [cfg.ingress_gateway or "knative-serving/knative-ingress-gateway"],
+            "http": [{"route": [{"destination": {
+                "host": f"{target_service}.{isvc.metadata.namespace}"
+                        f".svc.cluster.local",
+                "port": {"number": port}}}]}]})
+
+
+def build_external_service(isvc: v1.InferenceService, target_service: str,
+                           port: int) -> Service:
+    """Stable per-isvc Service name fronting the entry component."""
+    sel_component = _target_component(isvc)
+    return Service(
+        metadata=child_meta(isvc, isvc.metadata.name,
+                            {constants.ISVC_LABEL: isvc.metadata.name}),
+        spec=ServiceSpec(
+            selector={constants.ISVC_LABEL: isvc.metadata.name,
+                      constants.COMPONENT_LABEL: sel_component},
+            ports=[ServicePort(name="http", port=80, target_port=port)]))
+
+
+def reconcile_ingress(client: InMemoryClient, isvc: v1.InferenceService,
+                      cfg: IngressConfig, mode: str,
+                      entry_plan: ComponentPlan) -> Optional[str]:
+    """Stamp ingress per strategy; returns the external URL."""
+    target = entry_plan.name
+    port = entry_plan.port
+    if isvc.metadata.name != target:  # avoid colliding with component svc
+        upsert(client, isvc, build_external_service(isvc, target, port))
+
+    if cfg.disable_ingress_creation:
+        return service_url(isvc, cfg)
+
+    ns = isvc.metadata.namespace
+    if mode == v1.DeploymentMode.SERVERLESS.value:
+        if not cfg.disable_istio_virtual_host:
+            upsert(client, isvc,
+                   build_virtual_service(isvc, cfg, target, port))
+        delete_if_exists(client, Ingress, isvc.metadata.name, ns)
+        delete_if_exists(client, HTTPRoute, isvc.metadata.name, ns)
+    elif cfg.enable_gateway_api:
+        upsert(client, isvc, build_httproute(isvc, cfg, target, port))
+        delete_if_exists(client, Ingress, isvc.metadata.name, ns)
+        delete_if_exists(client, VirtualService, isvc.metadata.name, ns)
+    else:
+        upsert(client, isvc, build_ingress(isvc, cfg, target, port))
+        delete_if_exists(client, HTTPRoute, isvc.metadata.name, ns)
+        delete_if_exists(client, VirtualService, isvc.metadata.name, ns)
+    return service_url(isvc, cfg)

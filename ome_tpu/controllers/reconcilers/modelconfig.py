@@ -1,0 +1,47 @@
+"""Per-isvc model ConfigMap (reconcilers/modelconfig, 337 LoC analog).
+
+Publishes the resolved model list (base model + fine-tuned weights) as a
+ConfigMap the serving sidecar watches for runtime adapter loading.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+from ...apis import v1
+from ...core.client import InMemoryClient
+from ...core.k8s import ConfigMap
+from .common import child_meta, upsert
+
+
+def modelconfig_name(isvc_name: str) -> str:
+    return f"modelconfig-{isvc_name}"
+
+
+def reconcile_modelconfig(client: InMemoryClient, isvc: v1.InferenceService,
+                          model: Optional[v1.BaseModelSpec],
+                          model_name: str) -> ConfigMap:
+    entries: List[dict] = []
+    if model is not None:
+        entries.append({
+            "modelName": model_name,
+            "modelPath": (model.storage.path
+                          if model.storage and model.storage.path
+                          else f"/mnt/models/{model_name}"),
+            "modelType": "base",
+        })
+    ref = isvc.spec.model
+    if ref is not None:
+        for ft_name in ref.fine_tuned_weights:
+            ftw = client.try_get(v1.FineTunedWeight, ft_name)
+            entry = {"modelName": ft_name, "modelType": "fine-tuned"}
+            if ftw is not None and ftw.spec.storage is not None:
+                entry["storageUri"] = ftw.spec.storage.storage_uri
+                if ftw.spec.storage.path:
+                    entry["modelPath"] = ftw.spec.storage.path
+            entries.append(entry)
+    cm = ConfigMap(
+        metadata=child_meta(isvc, modelconfig_name(isvc.metadata.name)),
+        data={"models.json": json.dumps(entries, sort_keys=True)})
+    return upsert(client, isvc, cm)

@@ -1,0 +1,113 @@
+"""MultiNode reconciler: LeaderWorkerSet per slice group.
+
+Re-designs reconcilers/multinode + reconcilers/lws (lws_reconciler.go:
+47-157): one LWS whose group = 1 leader + N workers = the hosts of a TPU
+pod slice, RecreateGroupOnPodRestart (a slice is all-or-nothing: losing
+one host breaks the ICI mesh), shared subdomain for deterministic host
+DNS, and a headless Service for rendezvous.
+
+Rendezvous env is the TPU contract, not NCCL: every host gets
+TPU_WORKER_ID (its LWS worker index), TPU_WORKER_HOSTNAMES (the
+deterministic group host DNS list) and a JAX coordinator address on the
+leader — the libtpu/JAX analog of the reference's
+`--dist-init-addr $(LWS_LEADER_ADDRESS)` pattern
+(deepseek-rdma-pd-rt.yaml:108-115).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ... import constants
+from ...apis import v1
+from ...core.client import InMemoryClient
+from ...core.k8s import (LeaderWorkerSet, LeaderWorkerSetSpec,
+                         LeaderWorkerTemplate, PodSpec, PodTemplateSpec,
+                         Service, ServicePort, ServiceSpec)
+from ...core.meta import ObjectMeta
+from ..components import ComponentPlan
+from .common import child_meta, upsert
+
+JAX_COORDINATOR_PORT = 8476
+
+
+def group_hostnames(lws_name: str, namespace: str, size: int) -> str:
+    """Deterministic DNS names of all hosts in group 0 of an LWS with a
+    shared subdomain — the TPU_WORKER_HOSTNAMES contract. (For replicas
+    > 1 each group substitutes its own group index via the
+    $(LWS_GROUP_INDEX) placeholder.)"""
+    subdomain = lws_name
+    names = []
+    for i in range(size):
+        names.append(f"{lws_name}-$(LWS_GROUP_INDEX)-{i}.{subdomain}"
+                     f".{namespace}.svc.cluster.local")
+    return ",".join(names)
+
+
+def _apply_rendezvous_env(pod: PodSpec, lws_name: str, namespace: str,
+                          size: int, is_leader: bool):
+    hostnames = group_hostnames(lws_name, namespace, size)
+    leader_host = (f"{lws_name}-$(LWS_GROUP_INDEX)-0.{lws_name}"
+                   f".{namespace}.svc.cluster.local")
+    for c in pod.containers:
+        c.set_env(constants.TPU_WORKER_ID_ENV, "$(LWS_WORKER_INDEX)")
+        c.set_env(constants.TPU_WORKER_HOSTNAMES_ENV, hostnames)
+        c.set_env(constants.JAX_COORDINATOR_ENV,
+                  f"{leader_host}:{JAX_COORDINATOR_PORT}")
+        c.set_env(constants.JAX_NUM_PROCESSES_ENV, str(size))
+        c.set_env(constants.JAX_PROCESS_ID_ENV, "$(LWS_WORKER_INDEX)")
+
+
+def build_lws(isvc: v1.InferenceService, plan: ComponentPlan,
+              ) -> LeaderWorkerSet:
+    size = plan.worker_size + 1  # hosts in the slice (lws size = leader+N)
+    namespace = isvc.metadata.namespace
+
+    leader_pod = plan.pod_spec
+    worker_pod = plan.worker_pod_spec or plan.pod_spec
+    leader_pod.subdomain = plan.name
+    worker_pod.subdomain = plan.name
+    _apply_rendezvous_env(leader_pod, plan.name, namespace, size, True)
+    _apply_rendezvous_env(worker_pod, plan.name, namespace, size, False)
+
+    return LeaderWorkerSet(
+        metadata=child_meta(isvc, plan.name, plan.labels, plan.annotations),
+        spec=LeaderWorkerSetSpec(
+            replicas=plan.replicas,
+            leader_worker_template=LeaderWorkerTemplate(
+                leader_template=PodTemplateSpec(
+                    metadata=ObjectMeta(labels=dict(plan.labels),
+                                        annotations=dict(plan.annotations)),
+                    spec=leader_pod),
+                worker_template=PodTemplateSpec(
+                    metadata=ObjectMeta(labels=dict(plan.labels),
+                                        annotations=dict(plan.annotations)),
+                    spec=worker_pod),
+                size=size,
+                restart_policy="RecreateGroupOnPodRestart"),
+            rollout_strategy={"type": "RollingUpdate",
+                              "rollingUpdateConfiguration":
+                                  {"maxSurge": 1, "maxUnavailable": 1}},
+            startup_policy="LeaderCreated",
+            network_config={"subdomainPolicy": "Shared"}))
+
+
+def build_headless_service(isvc: v1.InferenceService, plan: ComponentPlan,
+                           ) -> Service:
+    """Headless service over the leaders for request routing + the
+    shared-subdomain host DNS."""
+    sel = {constants.ISVC_LABEL: isvc.metadata.name,
+           constants.COMPONENT_LABEL: plan.component}
+    return Service(
+        metadata=child_meta(isvc, plan.name, plan.labels),
+        spec=ServiceSpec(
+            selector=sel, cluster_ip="None",
+            ports=[ServicePort(name="http", port=plan.port,
+                               target_port=plan.port)]))
+
+
+def reconcile_multinode(client: InMemoryClient, isvc: v1.InferenceService,
+                        plan: ComponentPlan) -> LeaderWorkerSet:
+    lws = upsert(client, isvc, build_lws(isvc, plan))
+    upsert(client, isvc, build_headless_service(isvc, plan))
+    return lws

@@ -1,0 +1,149 @@
+"""RawDeployment reconciler: Deployment + Service + autoscaler + PDB.
+
+Re-designs reconcilers/raw/raw_kube_reconciler.go:33-105 and its
+deployment/service/hpa/keda/pdb sub-reconcilers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ... import constants
+from ...apis import v1
+from ...core.client import InMemoryClient
+from ...core.k8s import (Deployment, DeploymentSpec, HorizontalPodAutoscaler,
+                         PodDisruptionBudget, PodTemplateSpec, ScaledObject,
+                         Service, ServicePort, ServiceSpec)
+from ...core.meta import ObjectMeta
+from ..components import ComponentPlan
+from .common import child_meta, delete_if_exists, upsert
+
+
+def selector_labels(plan: ComponentPlan, isvc_name: str) -> dict:
+    return {constants.ISVC_LABEL: isvc_name,
+            constants.COMPONENT_LABEL: plan.component}
+
+
+def build_deployment(isvc: v1.InferenceService, plan: ComponentPlan,
+                     ) -> Deployment:
+    sel = selector_labels(plan, isvc.metadata.name)
+    template = PodTemplateSpec(
+        metadata=ObjectMeta(labels=dict(plan.labels),
+                            annotations=dict(plan.annotations)),
+        spec=plan.pod_spec)
+    strategy = None
+    if plan.extension.deployment_strategy is not None:
+        strategy = {"type": plan.extension.deployment_strategy.type,
+                    "rollingUpdate":
+                        plan.extension.deployment_strategy.rolling_update}
+    return Deployment(
+        metadata=child_meta(isvc, plan.name, plan.labels, plan.annotations),
+        spec=DeploymentSpec(
+            replicas=plan.replicas,
+            selector={"matchLabels": sel},
+            template=template,
+            strategy=strategy))
+
+
+def build_service(isvc: v1.InferenceService, plan: ComponentPlan) -> Service:
+    sel = selector_labels(plan, isvc.metadata.name)
+    return Service(
+        metadata=child_meta(isvc, plan.name, plan.labels),
+        spec=ServiceSpec(
+            selector=sel,
+            ports=[ServicePort(name="http", port=plan.port,
+                               target_port=plan.port)]))
+
+
+def build_hpa(isvc: v1.InferenceService, plan: ComponentPlan,
+              ) -> Optional[HorizontalPodAutoscaler]:
+    ext = plan.extension
+    if ext.max_replicas is None or (ext.max_replicas or 0) <= \
+            (plan.min_replicas or 1):
+        return None
+    metric = (ext.scale_metric.value if ext.scale_metric
+              else v1.ScaleMetric.CPU.value)
+    target = ext.scale_target or 80
+    if metric in ("cpu", "memory"):
+        metrics = [{"type": "Resource",
+                    "resource": {"name": metric,
+                                 "target": {"type": "Utilization",
+                                            "averageUtilization": target}}}]
+    else:
+        metrics = [{"type": "Pods",
+                    "pods": {"metric": {"name": metric},
+                             "target": {"type": "AverageValue",
+                                        "averageValue": str(target)}}}]
+    return HorizontalPodAutoscaler(
+        metadata=child_meta(isvc, plan.name, plan.labels),
+        spec={"scaleTargetRef": {"apiVersion": "apps/v1",
+                                 "kind": "Deployment", "name": plan.name},
+              "minReplicas": plan.min_replicas or 1,
+              "maxReplicas": ext.max_replicas,
+              "metrics": metrics})
+
+
+def build_keda(isvc: v1.InferenceService, plan: ComponentPlan,
+               ) -> Optional[ScaledObject]:
+    keda = plan.extension.keda_config or isvc.spec.keda_config
+    if keda is None or not keda.enable_keda:
+        return None
+    trigger = {
+        "type": "prometheus",
+        "metadata": {
+            "serverAddress": keda.prom_server_address
+            or "http://prometheus.monitoring:9090",
+            "query": keda.custom_prom_query or "",
+            "threshold": keda.scaling_threshold or "10",
+        }}
+    return ScaledObject(
+        metadata=child_meta(isvc, plan.name, plan.labels),
+        spec={"scaleTargetRef": {"name": plan.name},
+              "minReplicaCount": plan.min_replicas or 1,
+              "maxReplicaCount": plan.extension.max_replicas
+              or (plan.min_replicas or 1),
+              "pollingInterval": keda.polling_interval or 30,
+              "cooldownPeriod": keda.cooldown_period or 300,
+              "triggers": [trigger]})
+
+
+def build_pdb(isvc: v1.InferenceService, plan: ComponentPlan,
+              ) -> Optional[PodDisruptionBudget]:
+    if (plan.min_replicas or 1) < 2:
+        return None
+    return PodDisruptionBudget(
+        metadata=child_meta(isvc, plan.name, plan.labels),
+        spec={"minAvailable": 1,
+              "selector": {"matchLabels":
+                           selector_labels(plan, isvc.metadata.name)}})
+
+
+def reconcile_raw(client: InMemoryClient, isvc: v1.InferenceService,
+                  plan: ComponentPlan) -> Deployment:
+    """Stamp the full raw-mode child set; returns the Deployment."""
+    dep = upsert(client, isvc, build_deployment(isvc, plan))
+    upsert(client, isvc, build_service(isvc, plan))
+
+    keda = build_keda(isvc, plan)
+    hpa = None if keda is not None else build_hpa(isvc, plan)
+    if keda is not None:
+        upsert(client, isvc, keda)
+        delete_if_exists(client, HorizontalPodAutoscaler, plan.name,
+                         isvc.metadata.namespace)
+    elif hpa is not None:
+        upsert(client, isvc, hpa)
+        delete_if_exists(client, ScaledObject, plan.name,
+                         isvc.metadata.namespace)
+    else:
+        delete_if_exists(client, HorizontalPodAutoscaler, plan.name,
+                         isvc.metadata.namespace)
+        delete_if_exists(client, ScaledObject, plan.name,
+                         isvc.metadata.namespace)
+
+    pdb = build_pdb(isvc, plan)
+    if pdb is not None:
+        upsert(client, isvc, pdb)
+    else:
+        delete_if_exists(client, PodDisruptionBudget, plan.name,
+                         isvc.metadata.namespace)
+    return dep

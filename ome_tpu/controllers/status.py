@@ -1,0 +1,76 @@
+"""InferenceService status propagation.
+
+Re-designs status/status_reconciler.go:31-260: per-component readiness
+comes from the stamped child resource (Deployment availability, LWS
+ready groups), feeds Knative-style conditions, and the top-level Ready
+condition is the AND of component conditions + ingress.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .. import constants
+from ..apis import v1
+from ..core.client import InMemoryClient
+from ..core.k8s import Deployment, LeaderWorkerSet
+from ..core.meta import Condition, set_condition
+
+_COMPONENT_CONDITION = {
+    v1.ENGINE: v1.ENGINE_READY,
+    v1.DECODER: v1.DECODER_READY,
+    v1.ROUTER: v1.ROUTER_READY,
+}
+
+
+def component_ready(client: InMemoryClient, isvc: v1.InferenceService,
+                    component: str, name: str, mode: str) -> (bool, str):
+    ns = isvc.metadata.namespace
+    if mode == v1.DeploymentMode.MULTI_NODE.value:
+        lws = client.try_get(LeaderWorkerSet, name, ns)
+        if lws is None:
+            return False, "LeaderWorkerSet not found"
+        if lws.status.ready_replicas >= max(1, lws.spec.replicas):
+            return True, ""
+        return False, (f"{lws.status.ready_replicas}/{lws.spec.replicas} "
+                       f"slice groups ready")
+    dep = client.try_get(Deployment, name, ns)
+    if dep is None:
+        return False, "Deployment not found"
+    if dep.status.ready_replicas >= max(1, dep.spec.replicas):
+        return True, ""
+    return False, (f"{dep.status.ready_replicas}/{dep.spec.replicas} "
+                   f"replicas ready")
+
+
+def propagate_status(client: InMemoryClient, isvc: v1.InferenceService,
+                     modes: Dict[str, Optional[str]], url: Optional[str]):
+    """Mutates isvc.status in place from observed child state."""
+    st = isvc.status
+    all_ready = True
+    for component, mode in modes.items():
+        ctype = _COMPONENT_CONDITION[component]
+        if mode is None:
+            st.conditions = [c for c in st.conditions if c.type != ctype]
+            st.components.pop(component, None)
+            continue
+        from .components import component_name
+        name = component_name(isvc.metadata.name, component)
+        ready, reason = component_ready(client, isvc, component, name, mode)
+        all_ready = all_ready and ready
+        st.conditions = set_condition(st.conditions, Condition(
+            type=ctype, status="True" if ready else "False",
+            reason="" if ready else "ComponentNotReady", message=reason))
+        entry = st.components.get(component) or v1.ComponentStatusSpec()
+        entry.url = (f"http://{name}.{isvc.metadata.namespace}"
+                     f".svc.cluster.local")
+        st.components[component] = entry
+
+    ingress_ready = url is not None
+    st.conditions = set_condition(st.conditions, Condition(
+        type=v1.INGRESS_READY, status="True" if ingress_ready else "False"))
+    st.conditions = set_condition(st.conditions, Condition(
+        type=v1.READY,
+        status="True" if (all_ready and ingress_ready) else "False"))
+    st.url = url
+    st.observed_generation = isvc.metadata.generation

@@ -1,0 +1,160 @@
+"""Admission chain: defaulting + validation webhooks.
+
+Re-designs pkg/webhook (SURVEY.md §2.5): the isvc defaulter fills model
+kind and selector defaults, the isvc validator dry-runs runtime
+selection so a broken isvc is rejected at admission instead of failing
+asynchronously in the controller, and the ServingRuntime validator
+enforces priority uniqueness within a model format
+(servingruntime_webhook.go:48-330).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .. import constants
+from ..apis import v1
+from ..core.client import InMemoryClient
+from ..core.errors import APIError
+from ..selection.runtime_selector import RuntimeSelector, SelectionError
+
+
+class AdmissionError(APIError):
+    """Webhook denial — carries all violation messages."""
+
+    def __init__(self, messages: List[str]):
+        self.messages = messages
+        super().__init__("; ".join(messages))
+
+
+# -- InferenceService defaulter (isvc/inference_service_defaults.go) -------
+
+
+def default_inference_service(client: InMemoryClient,
+                              isvc: v1.InferenceService) -> v1.InferenceService:
+    if isvc.spec.model is not None and not isvc.spec.model.kind:
+        # prefer namespaced BaseModel when it exists, else cluster-scoped
+        if client.try_get(v1.BaseModel, isvc.spec.model.name,
+                          isvc.metadata.namespace) is not None:
+            isvc.spec.model.kind = "BaseModel"
+        else:
+            isvc.spec.model.kind = "ClusterBaseModel"
+    if isvc.spec.runtime is not None and not isvc.spec.runtime.kind:
+        if client.try_get(v1.ServingRuntime, isvc.spec.runtime.name,
+                          isvc.metadata.namespace) is not None:
+            isvc.spec.runtime.kind = "ServingRuntime"
+        else:
+            isvc.spec.runtime.kind = "ClusterServingRuntime"
+    if isvc.spec.engine is None and isvc.spec.decoder is None \
+            and isvc.spec.model is not None:
+        isvc.spec.engine = v1.EngineSpec()  # minimal single-engine default
+    return isvc
+
+
+# -- InferenceService validator (isvc/inference_service_validation.go) -----
+
+
+def validate_inference_service(client: InMemoryClient,
+                               isvc: v1.InferenceService):
+    errs: List[str] = []
+    if isvc.spec.model is None or not isvc.spec.model.name:
+        errs.append("spec.model.name is required")
+    if isvc.spec.decoder is not None and isvc.spec.engine is None:
+        errs.append("spec.decoder requires spec.engine (PD disaggregation)")
+    for field_name, comp in (("engine", isvc.spec.engine),
+                             ("decoder", isvc.spec.decoder)):
+        if comp is None:
+            continue
+        if comp.min_replicas is not None and comp.min_replicas < 0:
+            errs.append(f"spec.{field_name}.minReplicas must be >= 0")
+        if comp.max_replicas is not None and comp.min_replicas is not None \
+                and comp.max_replicas < comp.min_replicas:
+            errs.append(f"spec.{field_name}.maxReplicas must be >= "
+                        f"minReplicas")
+        if comp.worker is not None and comp.worker.size is not None \
+                and comp.worker.size < 0:
+            errs.append(f"spec.{field_name}.worker.size must be >= 0")
+
+    # dry-run runtime validation when both model + explicit runtime resolve
+    if isvc.spec.model is not None and isvc.spec.model.name \
+            and isvc.spec.runtime is not None and isvc.spec.runtime.name:
+        model = client.try_get(v1.BaseModel, isvc.spec.model.name,
+                               isvc.metadata.namespace) \
+            or client.try_get(v1.ClusterBaseModel, isvc.spec.model.name)
+        if model is not None:
+            try:
+                RuntimeSelector(client).validate(
+                    isvc.spec.runtime.name, model.spec,
+                    isvc.metadata.namespace,
+                    model_name=isvc.spec.model.name)
+            except SelectionError as e:
+                errs.append(str(e))
+    if errs:
+        raise AdmissionError(errs)
+
+
+# -- ServingRuntime validator ----------------------------------------------
+
+
+def validate_serving_runtime(client: InMemoryClient, runtime,
+                             cluster_scoped: bool):
+    """Priority must be unique among enabled runtimes supporting the same
+    model format+version (servingruntime_webhook.go behavior)."""
+    errs: List[str] = []
+    spec: v1.ServingRuntimeSpec = runtime.spec
+    if not spec.supported_model_formats and not spec.containers \
+            and spec.engine_config is None:
+        errs.append("runtime must define supportedModelFormats or a pod spec")
+
+    def entries(s: v1.ServingRuntimeSpec):
+        for f in s.supported_model_formats:
+            if f.auto_select is not False:
+                yield (f.name, f.version, f.model_architecture,
+                       f.quantization), f.priority
+
+    mine = dict(entries(spec))
+    peers = list(client.list(v1.ClusterServingRuntime)) if cluster_scoped \
+        else list(client.list(v1.ServingRuntime,
+                              namespace=runtime.metadata.namespace))
+    for peer in peers:
+        if peer.metadata.name == runtime.metadata.name:
+            continue
+        if peer.spec.is_disabled():
+            continue
+        for key, prio in entries(peer.spec):
+            if key in mine and prio is not None and mine[key] is not None \
+                    and prio == mine[key]:
+                errs.append(
+                    f"priority {prio} for model format {key[0]!r} conflicts "
+                    f"with runtime {peer.metadata.name!r}")
+    # per-accelerator override sanity
+    for cfg in spec.accelerator_configs:
+        if not cfg.accelerator_class:
+            errs.append("acceleratorConfigs[].acceleratorClass is required")
+        elif client.try_get(v1.AcceleratorClass,
+                            cfg.accelerator_class) is None:
+            errs.append(f"acceleratorConfigs references unknown "
+                        f"AcceleratorClass {cfg.accelerator_class!r}")
+    if errs:
+        raise AdmissionError(errs)
+
+
+# -- BenchmarkJob validator ------------------------------------------------
+
+
+def validate_benchmark_job(client: InMemoryClient, bj: v1.BenchmarkJob):
+    errs: List[str] = []
+    ep = bj.spec.endpoint
+    if not ep.url and (ep.inference_service is None
+                       or not ep.inference_service.name):
+        errs.append("spec.endpoint must set url or inferenceService.name")
+    if ep.url and ep.inference_service is not None \
+            and ep.inference_service.name:
+        errs.append("spec.endpoint.url and inferenceService are exclusive")
+    if not bj.spec.num_concurrency:
+        pass  # defaulted by CLI
+    for c in bj.spec.num_concurrency:
+        if c < 1:
+            errs.append("spec.numConcurrency entries must be >= 1")
+    if errs:
+        raise AdmissionError(errs)

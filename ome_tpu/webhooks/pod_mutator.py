@@ -1,0 +1,153 @@
+"""Pod mutation webhook — the TPU analog of pod/mutator.go.
+
+Injection chain (mutator.go:75-117 order preserved): metrics-aggregator
+env → model-init init container → fine-tuned-adapter init container →
+serving sidecar → **tpu-env injector**. The last one replaces the
+reference's RDMA/NCCL injector (rdma_injector.go:25-120): instead of
+`NCCL_IB_HCA` + /dev/infiniband + privileged, TPU slices need only the
+libtpu rendezvous env (worker ids/hostnames ride the LWS contract) and
+a dshm mount for the TPU runtime — no privileged containers, no host
+network.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import constants
+from ..apis import v1
+from ..core.client import InMemoryClient
+from ..core.k8s import Container, EnvVar, Pod, Volume, VolumeMount
+from ..controllers.config import load_controller_config
+
+# annotation-selected TPU profiles (rdma profile analog)
+TPU_PROFILE_PODSLICE = "podslice"      # single slice over ICI
+TPU_PROFILE_MULTISLICE = "multislice"  # slices over DCN (MEGASCALE_*)
+
+
+def needs_mutation(pod: Pod) -> bool:
+    return constants.ISVC_LABEL in pod.metadata.labels \
+        or constants.BENCHMARK_LABEL in pod.metadata.labels
+
+
+def mutate_pod(client: InMemoryClient, pod: Pod) -> Pod:
+    """Apply the full chain in order; each step is idempotent."""
+    if not needs_mutation(pod):
+        return pod
+    cfg = load_controller_config(client)
+    inject_metrics_env(pod)
+    inject_model_init(client, pod, cfg.model_init.image)
+    inject_serving_sidecar(pod, cfg.model_init.image)
+    inject_tpu_env(pod)
+    return pod
+
+
+# -- metrics aggregator env (qpext analog) ---------------------------------
+
+
+def inject_metrics_env(pod: Pod):
+    for c in pod.spec.containers:
+        if c.name == constants.MAIN_CONTAINER:
+            if c.get_env("METRICS_PORT") is None:
+                c.set_env("METRICS_PORT", str(constants.METRICS_PORT))
+            if constants.PROMETHEUS_SCRAPE_ANNOTATION not in \
+                    pod.metadata.annotations:
+                pod.metadata.annotations[
+                    constants.PROMETHEUS_SCRAPE_ANNOTATION] = "true"
+                pod.metadata.annotations[
+                    constants.PROMETHEUS_PORT_ANNOTATION] = str(
+                    constants.METRICS_PORT)
+
+
+# -- model-init injector (model_init_injector.go:47-60) --------------------
+
+
+def inject_model_init(client: InMemoryClient, pod: Pod, image: str):
+    uri = pod.metadata.annotations.get(constants.MODEL_INIT_ANNOTATION)
+    if not uri:
+        return
+    if any(c.name == constants.MODEL_INIT_CONTAINER
+           for c in pod.spec.init_containers):
+        return
+    main = pod.spec.container(constants.MAIN_CONTAINER)
+    target = (main.get_env(constants.MODEL_PATH_ENV)
+              if main else None) or "/mnt/models/model"
+    init = Container(
+        name=constants.MODEL_INIT_CONTAINER, image=image,
+        args=["download", "--source", uri, "--target", target],
+        volume_mounts=[VolumeMount(name="model-weights",
+                                   mount_path=target)])
+    if not any(v.name == "model-weights" for v in pod.spec.volumes):
+        pod.spec.volumes.append(Volume(name="model-weights",
+                                       empty_dir={}))
+    # model-init must run first (mutator.go:104-114 ordering)
+    pod.spec.init_containers.insert(0, init)
+
+
+# -- serving sidecar (fine-tuned weight watcher) ---------------------------
+
+
+def inject_serving_sidecar(pod: Pod, image: str):
+    if pod.metadata.annotations.get(
+            constants.SERVING_SIDECAR_ANNOTATION) != "true":
+        return
+    if any(c.name == constants.SERVING_SIDECAR_CONTAINER
+           for c in pod.spec.containers):
+        return
+    pod.spec.containers.append(Container(
+        name=constants.SERVING_SIDECAR_CONTAINER,
+        image=image,
+        args=["serving-agent"],
+        env=[EnvVar(name=constants.FINE_TUNED_WEIGHT_INFO_ENV,
+                    value="/mnt/ft-config/models.json")],
+        volume_mounts=[VolumeMount(name="ft-config",
+                                   mount_path="/mnt/ft-config")]))
+    if not any(v.name == "ft-config" for v in pod.spec.volumes):
+        pod.spec.volumes.append(Volume(
+            name="ft-config",
+            config_map={"name": f"modelconfig-"
+                        f"{pod.metadata.labels.get(constants.ISVC_LABEL)}"}))
+
+
+# -- TPU env injector (rdma_injector.go analog) ----------------------------
+
+
+def inject_tpu_env(pod: Pod):
+    if pod.metadata.annotations.get(
+            constants.TPU_INJECT_ANNOTATION, "true") != "true":
+        return
+    profile = pod.metadata.annotations.get(
+        constants.TPU_PROFILE_ANNOTATION, TPU_PROFILE_PODSLICE)
+    target_name = pod.metadata.annotations.get(
+        constants.TPU_CONTAINER_ANNOTATION, constants.MAIN_CONTAINER)
+    target = pod.spec.container(target_name)
+    if target is None:
+        return
+    uses_tpu = any(
+        constants.TPU_RESOURCE in (c.resources.requests if c.resources
+                                   else {})
+        or constants.TPU_RESOURCE in (c.resources.limits if c.resources
+                                      else {})
+        for c in pod.spec.containers)
+    if not uses_tpu:
+        return
+    # libtpu wants a large shm segment for its runtime ring buffers
+    if not any(v.name == "dshm" for v in pod.spec.volumes):
+        pod.spec.volumes.append(Volume(
+            name="dshm", empty_dir={"medium": "Memory"}))
+    if not any(m.name == "dshm" for m in target.volume_mounts):
+        target.volume_mounts.append(VolumeMount(name="dshm",
+                                                mount_path="/dev/shm"))
+    if target.get_env("TPU_MIN_LOG_LEVEL") is None:
+        target.set_env("TPU_MIN_LOG_LEVEL", "0")
+    if profile == TPU_PROFILE_MULTISLICE:
+        # slices rendezvous over DCN via the megascale coordinator; the
+        # coordinator is slice 0's leader (LWS group 0 leader DNS)
+        if target.get_env(constants.MEGASCALE_COORDINATOR_ENV) is None:
+            target.set_env(constants.MEGASCALE_COORDINATOR_ENV,
+                           "$(LWS_LEADER_ADDRESS)")
+        if target.get_env(constants.MEGASCALE_NUM_SLICES_ENV) is None:
+            target.set_env(constants.MEGASCALE_NUM_SLICES_ENV, "1")
+        if target.get_env(constants.MEGASCALE_SLICE_ID_ENV) is None:
+            target.set_env(constants.MEGASCALE_SLICE_ID_ENV,
+                           "$(LWS_GROUP_INDEX)")

@@ -1,0 +1,274 @@
+"""BaseModel / AcceleratorClass / BenchmarkJob controllers + webhooks."""
+
+import json
+
+import pytest
+
+from ome_tpu import constants
+from ome_tpu.apis import v1
+from ome_tpu.controllers.acceleratorclass import AcceleratorClassReconciler
+from ome_tpu.controllers.basemodel import (ClusterBaseModelReconciler,
+                                           BaseModelReconciler,
+                                           MODEL_STATUS_CM_LABEL,
+                                           model_key, node_status_cm_name)
+from ome_tpu.controllers.benchmark import BenchmarkJobReconciler
+from ome_tpu.controllers.inferenceservice import InferenceServiceReconciler
+from ome_tpu.core.client import InMemoryClient
+from ome_tpu.core.k8s import (ConfigMap, Container, Deployment, Job, Node,
+                              NodeStatus, Pod, PodSpec,
+                              ResourceRequirements)
+from ome_tpu.core.manager import Manager
+from ome_tpu.core.meta import ObjectMeta
+from ome_tpu.webhooks import admission
+from ome_tpu.webhooks.pod_mutator import mutate_pod
+
+from test_controllers import (llama8b_model, make_isvc, tpu_v5e_class,
+                              vllm_tpu_runtime)
+
+
+def tpu_node(name: str, topology="4x4", chips="4") -> Node:
+    n = Node(metadata=ObjectMeta(
+        name=name,
+        labels={v1.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice",
+                v1.GKE_TPU_TOPOLOGY_LABEL: topology}))
+    n.status = NodeStatus(capacity={v1.TPU_RESOURCE: chips},
+                          allocatable={v1.TPU_RESOURCE: chips})
+    return n
+
+
+def status_cm(node: str, entries: dict) -> ConfigMap:
+    return ConfigMap(
+        metadata=ObjectMeta(
+            name=node_status_cm_name(node),
+            namespace=constants.OPERATOR_NAMESPACE,
+            labels={MODEL_STATUS_CM_LABEL: "true"}),
+        data={k: json.dumps(v) for k, v in entries.items()})
+
+
+class TestAcceleratorClassController:
+    def test_matches_nodes_and_counts_chips(self):
+        client = InMemoryClient()
+        client.create(tpu_v5e_class())
+        client.create(tpu_node("n1"))
+        client.create(tpu_node("n2"))
+        other = Node(metadata=ObjectMeta(name="gpu-node",
+                                         labels={"gpu": "a100"}))
+        client.create(other)
+        mgr = Manager(client)
+        mgr.register(AcceleratorClassReconciler(client))
+        mgr.reconcile_once()
+        ac = client.get(v1.AcceleratorClass, "tpu-v5e")
+        assert ac.status.nodes == ["n1", "n2"]
+        assert ac.status.node_count == 2
+        assert ac.status.total_chips == 8
+
+    def test_topology_label_fallback(self):
+        client = InMemoryClient()
+        client.create(tpu_v5e_class())
+        n = tpu_node("n1")
+        n.status = NodeStatus()  # device plugin not registered yet
+        client.create(n)
+        mgr = Manager(client)
+        mgr.register(AcceleratorClassReconciler(client))
+        mgr.reconcile_once()
+        ac = client.get(v1.AcceleratorClass, "tpu-v5e")
+        assert ac.status.total_chips == 4  # 4 chips/host from 4x4 label
+
+
+class TestBaseModelController:
+    def test_aggregates_node_configmaps(self):
+        client = InMemoryClient()
+        client.create(llama8b_model())
+        client.create(tpu_node("n1"))
+        client.create(tpu_node("n2"))
+        key = model_key("ClusterBaseModel", "", "llama-3-8b")
+        client.create(status_cm("n1", {key: {"state": "Ready"}}))
+        client.create(status_cm("n2", {key: {"state": "Failed"}}))
+        mgr = Manager(client)
+        mgr.register(ClusterBaseModelReconciler(client))
+        mgr.reconcile_once()
+        m = client.get(v1.ClusterBaseModel, "llama-3-8b")
+        assert m.status.nodes_ready == ["n1"]
+        assert m.status.nodes_failed == ["n2"]
+        assert m.status.state == v1.ModelState.READY
+
+    def test_no_nodes_yet_creating(self):
+        client = InMemoryClient()
+        client.create(llama8b_model())
+        mgr = Manager(client)
+        mgr.register(ClusterBaseModelReconciler(client))
+        mgr.reconcile_once()
+        m = client.get(v1.ClusterBaseModel, "llama-3-8b")
+        assert m.status.state == v1.ModelState.CREATING
+
+    def test_deleted_node_entries_ignored(self):
+        client = InMemoryClient()
+        client.create(llama8b_model())
+        client.create(tpu_node("n1"))
+        key = model_key("ClusterBaseModel", "", "llama-3-8b")
+        client.create(status_cm("n1", {key: {"state": "Ready"}}))
+        client.create(status_cm("gone", {key: {"state": "Failed"}}))
+        mgr = Manager(client)
+        mgr.register(ClusterBaseModelReconciler(client))
+        mgr.reconcile_once()
+        m = client.get(v1.ClusterBaseModel, "llama-3-8b")
+        assert m.status.nodes_failed == []
+
+
+class TestBenchmarkJobController:
+    def _world(self):
+        client = InMemoryClient()
+        client.create(tpu_v5e_class())
+        client.create(llama8b_model())
+        client.create(vllm_tpu_runtime())
+        mgr = Manager(client)
+        mgr.register(InferenceServiceReconciler(client))
+        mgr.register(BenchmarkJobReconciler(client))
+        return client, mgr
+
+    def _bench(self, name="bench"):
+        bj = v1.BenchmarkJob(metadata=ObjectMeta(name=name,
+                                                 namespace="default"))
+        bj.spec.endpoint.inference_service = v1.InferenceServiceRef(
+            name="svc")
+        bj.spec.traffic_scenarios = ["D(100,100)"]
+        bj.spec.num_concurrency = [1, 4]
+        bj.spec.max_time_per_iteration = 5
+        return bj
+
+    def test_pending_until_isvc_ready_then_job(self):
+        client, mgr = self._world()
+        client.create(make_isvc())
+        client.create(self._bench())
+        mgr.reconcile_once()
+        bj = client.get(v1.BenchmarkJob, "bench", "default")
+        assert bj.status.state == "Pending"
+        assert client.try_get(Job, "bench-bench", "default") is None
+
+        dep = client.get(Deployment, "svc-engine", "default")
+        dep.status.ready_replicas = dep.spec.replicas
+        client.update_status(dep)
+        mgr.reconcile_once()
+
+        job = client.get(Job, "bench-bench", "default")
+        args = job.spec.template.spec.containers[0].args
+        assert "--api-base" in args
+        assert args[args.index("--api-base") + 1] == \
+            "http://svc.default.svc.cluster.local"
+        assert "--traffic-scenario" in args
+        assert args.count("--num-concurrency") == 2
+
+    def test_job_completion_propagates(self):
+        client, mgr = self._world()
+        client.create(make_isvc())
+        dep_bj = self._bench()
+        client.create(dep_bj)
+        mgr.reconcile_once()
+        dep = client.get(Deployment, "svc-engine", "default")
+        dep.status.ready_replicas = dep.spec.replicas
+        client.update_status(dep)
+        mgr.reconcile_once()
+        job = client.get(Job, "bench-bench", "default")
+        job.status.succeeded = 1
+        client.update_status(job)
+        mgr.reconcile_once()
+        bj = client.get(v1.BenchmarkJob, "bench", "default")
+        assert bj.status.state == "Completed"
+        assert bj.status.completion_time
+
+
+class TestAdmission:
+    def test_defaulter_fills_model_kind(self):
+        client = InMemoryClient()
+        client.create(llama8b_model())
+        isvc = make_isvc()
+        admission.default_inference_service(client, isvc)
+        assert isvc.spec.model.kind == "ClusterBaseModel"
+        assert isvc.spec.engine is not None
+
+    def test_validator_rejects_missing_model(self):
+        client = InMemoryClient()
+        isvc = v1.InferenceService(metadata=ObjectMeta(name="x"))
+        with pytest.raises(admission.AdmissionError) as ei:
+            admission.validate_inference_service(client, isvc)
+        assert "spec.model.name" in str(ei.value)
+
+    def test_validator_rejects_incompatible_runtime(self):
+        client = InMemoryClient()
+        client.create(llama8b_model())
+        rt = vllm_tpu_runtime()
+        rt.spec.model_size_range = v1.ModelSizeRangeSpec(min="30B",
+                                                         max="100B")
+        client.create(rt)
+        isvc = make_isvc()
+        isvc.spec.runtime = v1.RuntimeRef(name="vllm-tpu")
+        with pytest.raises(admission.AdmissionError):
+            admission.validate_inference_service(client, isvc)
+
+    def test_runtime_priority_conflict(self):
+        client = InMemoryClient()
+        client.create(tpu_v5e_class())
+        client.create(vllm_tpu_runtime("rt-a"))
+        rt_b = vllm_tpu_runtime("rt-b")
+        with pytest.raises(admission.AdmissionError) as ei:
+            admission.validate_serving_runtime(client, rt_b, True)
+        assert "priority" in str(ei.value)
+
+    def test_runtime_unknown_accelerator_rejected(self):
+        client = InMemoryClient()
+        rt = vllm_tpu_runtime()
+        with pytest.raises(admission.AdmissionError) as ei:
+            admission.validate_serving_runtime(client, rt, True)
+        assert "AcceleratorClass" in str(ei.value)
+
+
+class TestPodMutator:
+    def _pod(self, annotations=None) -> Pod:
+        c = Container(
+            name=constants.MAIN_CONTAINER, image="x",
+            resources=ResourceRequirements(
+                requests={constants.TPU_RESOURCE: "4"},
+                limits={constants.TPU_RESOURCE: "4"}))
+        return Pod(
+            metadata=ObjectMeta(
+                name="p", namespace="default",
+                labels={constants.ISVC_LABEL: "svc"},
+                annotations=dict(annotations or {})),
+            spec=PodSpec(containers=[c]))
+
+    def test_tpu_env_injected(self):
+        client = InMemoryClient()
+        pod = mutate_pod(client, self._pod())
+        main = pod.spec.container(constants.MAIN_CONTAINER)
+        assert any(v.name == "dshm" for v in pod.spec.volumes)
+        assert any(m.mount_path == "/dev/shm" for m in main.volume_mounts)
+        # no privileged, no host networking — TPU needs neither
+        assert pod.spec.host_network is None
+        assert main.security_context is None
+
+    def test_multislice_profile(self):
+        client = InMemoryClient()
+        pod = mutate_pod(client, self._pod(
+            {constants.TPU_PROFILE_ANNOTATION: "multislice"}))
+        main = pod.spec.container(constants.MAIN_CONTAINER)
+        assert main.get_env(constants.MEGASCALE_COORDINATOR_ENV)
+        assert main.get_env(constants.MEGASCALE_SLICE_ID_ENV) == \
+            "$(LWS_GROUP_INDEX)"
+
+    def test_model_init_injected(self):
+        client = InMemoryClient()
+        pod = self._pod({constants.MODEL_INIT_ANNOTATION:
+                         "hf://meta-llama/llama-3-8b"})
+        pod = mutate_pod(client, pod)
+        assert pod.spec.init_containers[0].name == \
+            constants.MODEL_INIT_CONTAINER
+        args = pod.spec.init_containers[0].args
+        assert "hf://meta-llama/llama-3-8b" in args
+
+    def test_non_isvc_pod_untouched(self):
+        client = InMemoryClient()
+        pod = Pod(metadata=ObjectMeta(name="p"),
+                  spec=PodSpec(containers=[Container(name="c")]))
+        out = mutate_pod(client, pod)
+        assert out.spec.volumes == []
+        assert out.metadata.annotations == {}

@@ -1,0 +1,362 @@
+"""InferenceService controller suite.
+
+The analog of the reference's fake-client ginkgo suites
+(inferenceservice/controller_test.go, SURVEY.md §4): seed the in-memory
+API with models/runtimes/accelerators, reconcile, and assert the stamped
+child resources — for TPU: Deployments/LWS carrying google.com/tpu
+resources, GKE TPU node selectors and rendezvous env, zero
+nvidia.com/gpu anywhere.
+"""
+
+import pytest
+
+from ome_tpu import constants
+from ome_tpu.apis import v1
+from ome_tpu.controllers import merging
+from ome_tpu.controllers.deployment_mode import (DeploymentModeError,
+                                                 resolve_modes)
+from ome_tpu.controllers.inferenceservice import InferenceServiceReconciler
+from ome_tpu.core.client import InMemoryClient
+from ome_tpu.core.k8s import (Container, Deployment, EnvVar,
+                              HorizontalPodAutoscaler, Ingress,
+                              LeaderWorkerSet, PodSpec, ResourceRequirements,
+                              Service)
+from ome_tpu.core.manager import Manager
+from ome_tpu.core.meta import ObjectMeta, get_condition
+
+
+# -- fixtures ---------------------------------------------------------------
+
+
+def tpu_v5e_class() -> v1.AcceleratorClass:
+    ac = v1.AcceleratorClass(metadata=ObjectMeta(name="tpu-v5e"))
+    ac.spec.vendor, ac.spec.family, ac.spec.model = "google", "tpu", "v5e"
+    ac.spec.discovery.node_selector = {
+        v1.GKE_TPU_ACCELERATOR_LABEL: "tpu-v5-lite-podslice"}
+    ac.spec.capabilities.memory_gb = 16
+    ac.spec.capabilities.bf16_tflops = 197
+    ac.spec.capabilities.topologies = [
+        v1.parse_topology(t) for t in ("1x1", "2x2", "2x4", "4x4")]
+    ac.spec.resources = {constants.TPU_RESOURCE: "1"}
+    ac.status.node_count = 4
+    return ac
+
+
+def llama8b_model(name="llama-3-8b") -> v1.ClusterBaseModel:
+    m = v1.ClusterBaseModel(metadata=ObjectMeta(name=name))
+    m.spec.model_format = v1.ModelFormat(name="safetensors")
+    m.spec.model_architecture = "LlamaForCausalLM"
+    m.spec.model_parameter_size = "8.03B"
+    m.spec.storage = v1.StorageSpec(storage_uri=f"hf://meta-llama/{name}")
+    m.status.state = v1.ModelState.READY
+    return m
+
+
+def vllm_tpu_runtime(name="vllm-tpu") -> v1.ClusterServingRuntime:
+    rt = v1.ClusterServingRuntime(metadata=ObjectMeta(name=name))
+    rt.spec.supported_model_formats = [v1.SupportedModelFormat(
+        name="safetensors", model_architecture="LlamaForCausalLM",
+        auto_select=True, priority=1)]
+    rt.spec.model_size_range = v1.ModelSizeRangeSpec(min="1B", max="15B")
+    runner = Container(
+        name=constants.MAIN_CONTAINER, image="vllm/vllm-tpu:latest",
+        args=["--model", "$(MODEL_PATH)", "--tensor-parallel-size", "1",
+              "--port", "8080"])
+    rt.spec.engine_config = v1.EngineConfig(
+        runner=v1.RunnerSpec(container=runner))
+    rt.spec.accelerator_configs = [v1.AcceleratorModelConfig(
+        accelerator_class="tpu-v5e",
+        parallelism=v1.ParallelismConfig(tensor_parallel_size=4))]
+    return rt
+
+
+def make_isvc(name="svc", namespace="default", model="llama-3-8b",
+              **engine_kw) -> v1.InferenceService:
+    isvc = v1.InferenceService(
+        metadata=ObjectMeta(name=name, namespace=namespace))
+    isvc.spec.model = v1.ModelRef(name=model)
+    isvc.spec.engine = v1.EngineSpec(**engine_kw)
+    return isvc
+
+
+@pytest.fixture()
+def world():
+    client = InMemoryClient()
+    client.create(tpu_v5e_class())
+    client.create(llama8b_model())
+    client.create(vllm_tpu_runtime())
+    mgr = Manager(client)
+    rec = InferenceServiceReconciler(client)
+    mgr.register(rec)
+    return client, mgr
+
+
+def reconcile(client, mgr):
+    mgr.reconcile_once()
+
+
+# -- merging unit tests -----------------------------------------------------
+
+
+class TestMerging:
+    def test_merge_args_replaces_by_key(self):
+        out = merging.merge_args(
+            ["--model", "/m", "--tp-size", "1", "--port", "8080"],
+            ["--tp-size", "8"])
+        assert out == ["--model", "/m", "--tp-size", "8", "--port", "8080"]
+
+    def test_merge_args_alias_groups(self):
+        out = merging.merge_args(
+            ["--tensor-parallel-size", "1"], ["--tp-size", "4"])
+        assert out == ["--tp-size", "4"]
+
+    def test_merge_args_appends_new(self):
+        out = merging.merge_args(["--a", "1"], ["--b", "2"])
+        assert out == ["--a", "1", "--b", "2"]
+
+    def test_merge_args_equals_syntax(self):
+        out = merging.merge_args(["--tp-size=1"], ["--tp-size=4"])
+        assert out == ["--tp-size=4"]
+
+    def test_bare_override_replaces(self):
+        assert merging.merge_args(["--a", "1"], ["serve"]) == ["serve"]
+
+    def test_placeholders(self):
+        out = merging.substitute_placeholders(
+            ["--model", "$(MODEL_PATH)", "--addr",
+             "$(LWS_LEADER_ADDRESS):5757"],
+            {"MODEL_PATH": "/mnt/models/llama"})
+        assert out[1] == "/mnt/models/llama"
+        assert out[3] == "$(LWS_LEADER_ADDRESS):5757"  # left for kubelet
+
+    def test_apply_parallelism_keeps_engine_spelling(self):
+        c = Container(args=["--tensor-parallel-size", "1"])
+        merging.apply_parallelism(
+            c, v1.ParallelismConfig(tensor_parallel_size=4))
+        assert c.args == ["--tensor-parallel-size", "4"]
+
+    def test_apply_parallelism_appends_ici_mesh(self):
+        c = Container(args=[])
+        merging.apply_parallelism(
+            c, v1.ParallelismConfig(tensor_parallel_size=4, ici_mesh="4,4"))
+        assert "--tp-size" in c.args
+        assert c.get_env("ICI_MESH_SHAPE") == "4,4"
+
+
+# -- deployment mode --------------------------------------------------------
+
+
+class TestDeploymentMode:
+    def test_raw_default(self):
+        isvc = make_isvc()
+        modes = resolve_modes(isvc, "RawDeployment")
+        assert modes.engine == "RawDeployment"
+        assert modes.decoder is None
+
+    def test_leader_worker_implies_multinode(self):
+        isvc = make_isvc(leader=v1.LeaderSpec(),
+                         worker=v1.WorkerSpec(size=3))
+        assert resolve_modes(isvc, "RawDeployment").engine == "MultiNode"
+
+    def test_min_replicas_zero_implies_serverless(self):
+        isvc = make_isvc(min_replicas=0)
+        assert resolve_modes(isvc, "RawDeployment").engine == "Serverless"
+
+    def test_annotation_wins(self):
+        isvc = make_isvc()
+        isvc.metadata.annotations[
+            constants.DEPLOYMENT_MODE_ANNOTATION] = "MultiNode"
+        assert resolve_modes(isvc, "RawDeployment").engine == "MultiNode"
+
+    def test_invalid_annotation_rejected(self):
+        isvc = make_isvc()
+        isvc.metadata.annotations[
+            constants.DEPLOYMENT_MODE_ANNOTATION] = "Bogus"
+        with pytest.raises(DeploymentModeError):
+            resolve_modes(isvc, "RawDeployment")
+
+    def test_annotation_does_not_conjure_decoder(self):
+        isvc = make_isvc()  # engine only
+        isvc.metadata.annotations[
+            constants.DEPLOYMENT_MODE_ANNOTATION] = "RawDeployment"
+        modes = resolve_modes(isvc, "RawDeployment")
+        assert modes.decoder is None
+
+    def test_multihost_topology_upgrades_raw_to_multinode(self, world):
+        client, mgr = world
+        isvc = make_isvc()  # no leader/worker spelled out
+        isvc.spec.accelerator_selector = v1.AcceleratorSelector(
+            accelerator_class="tpu-v5e", topology="4x4")
+        client.create(isvc)
+        reconcile(client, mgr)
+        lws = client.get(LeaderWorkerSet, "svc-engine", "default")
+        assert lws.spec.leader_worker_template.size == 4
+        leader = lws.spec.leader_worker_template.leader_template.spec
+        main = leader.container(constants.MAIN_CONTAINER)
+        assert main.get_env(constants.PARALLELISM_SIZE_ENV) == "16"
+        assert main.resources.requests[constants.TPU_RESOURCE] == "4"
+        assert client.try_get(Deployment, "svc-engine", "default") is None
+
+    def test_decoder_requires_engine(self):
+        isvc = v1.InferenceService(metadata=ObjectMeta(name="x"))
+        isvc.spec.decoder = v1.EngineSpec()
+        with pytest.raises(DeploymentModeError):
+            resolve_modes(isvc, "RawDeployment")
+
+
+# -- full reconcile ---------------------------------------------------------
+
+
+class TestRawReconcile:
+    def test_stamps_deployment_service_and_status(self, world):
+        client, mgr = world
+        client.create(make_isvc())
+        reconcile(client, mgr)
+
+        dep = client.get(Deployment, "svc-engine", "default")
+        pod = dep.spec.template.spec
+        main = pod.container(constants.MAIN_CONTAINER)
+        # TPU parallelism override rewrote the vLLM flag
+        assert "--tensor-parallel-size" in main.args
+        idx = main.args.index("--tensor-parallel-size")
+        assert main.args[idx + 1] == "4"
+        # model path substituted + env set
+        assert "/mnt/models/llama-3-8b" in main.args
+        assert main.get_env(constants.MODEL_PATH_ENV) == \
+            "/mnt/models/llama-3-8b"
+        # chips stamped as google.com/tpu, no nvidia anywhere
+        assert main.resources.requests[constants.TPU_RESOURCE] == "4"
+        assert not any("nvidia" in k for k in main.resources.requests)
+        # scheduling constraints: TPU accelerator + topology + model-ready
+        assert pod.node_selector[v1.GKE_TPU_ACCELERATOR_LABEL] == \
+            "tpu-v5-lite-podslice"
+        assert pod.node_selector[v1.GKE_TPU_TOPOLOGY_LABEL] == "2x2"
+        assert pod.node_selector[
+            constants.model_ready_label("clusterbasemodel", "llama-3-8b")] \
+            == "Ready"
+
+        svc = client.get(Service, "svc-engine", "default")
+        assert svc.spec.selector[constants.COMPONENT_LABEL] == "engine"
+
+        isvc = client.get(v1.InferenceService, "svc", "default")
+        cond = get_condition(isvc.status.conditions, v1.ENGINE_READY)
+        assert cond is not None and not cond.is_true()  # no ready replicas
+
+    def test_becomes_ready_when_deployment_ready(self, world):
+        client, mgr = world
+        client.create(make_isvc())
+        reconcile(client, mgr)
+        dep = client.get(Deployment, "svc-engine", "default")
+        dep.status.ready_replicas = dep.spec.replicas
+        client.update_status(dep)
+        reconcile(client, mgr)
+        isvc = client.get(v1.InferenceService, "svc", "default")
+        assert isvc.status.is_ready()
+        assert isvc.status.url == \
+            "http://svc.default.svc.cluster.local"
+
+    def test_hpa_when_max_replicas(self, world):
+        client, mgr = world
+        client.create(make_isvc(min_replicas=2, max_replicas=5,
+                                scale_metric=v1.ScaleMetric.CPU,
+                                scale_target=60))
+        reconcile(client, mgr)
+        hpa = client.get(HorizontalPodAutoscaler, "svc-engine", "default")
+        assert hpa.spec["maxReplicas"] == 5
+        assert hpa.spec["minReplicas"] == 2
+
+    def test_model_not_found_sets_condition(self, world):
+        client, mgr = world
+        client.create(make_isvc(model="missing-model"))
+        reconcile(client, mgr)
+        isvc = client.get(v1.InferenceService, "svc", "default")
+        cond = get_condition(isvc.status.conditions, v1.READY)
+        assert cond.status == "False"
+        assert cond.reason == "ModelNotFound"
+
+    def test_ingress_stamped(self, world):
+        client, mgr = world
+        client.create(make_isvc())
+        reconcile(client, mgr)
+        ing = client.get(Ingress, "svc", "default")
+        assert ing.spec["rules"][0]["host"] == \
+            "svc.default.svc.cluster.local"
+
+    def test_finalizer_added_and_cascade_delete(self, world):
+        client, mgr = world
+        client.create(make_isvc())
+        reconcile(client, mgr)
+        isvc = client.get(v1.InferenceService, "svc", "default")
+        assert constants.ISVC_FINALIZER in isvc.metadata.finalizers
+        client.delete(v1.InferenceService, "svc", "default")
+        reconcile(client, mgr)
+        assert client.try_get(v1.InferenceService, "svc", "default") is None
+        assert client.try_get(Deployment, "svc-engine", "default") is None
+
+
+class TestMultiNodeReconcile:
+    def test_lws_with_tpu_rendezvous(self, world):
+        client, mgr = world
+        isvc = make_isvc(leader=v1.LeaderSpec(), worker=v1.WorkerSpec())
+        isvc.spec.accelerator_selector = v1.AcceleratorSelector(
+            accelerator_class="tpu-v5e", topology="4x4")
+        client.create(isvc)
+        reconcile(client, mgr)
+
+        lws = client.get(LeaderWorkerSet, "svc-engine", "default")
+        tmpl = lws.spec.leader_worker_template
+        # 4x4 slice = 16 chips = 4 hosts -> 1 leader + 3 workers
+        assert tmpl.size == 4
+        assert tmpl.restart_policy == "RecreateGroupOnPodRestart"
+        leader = tmpl.leader_template.spec.containers[0]
+        assert leader.get_env(constants.TPU_WORKER_ID_ENV) == \
+            "$(LWS_WORKER_INDEX)"
+        hostnames = leader.get_env(constants.TPU_WORKER_HOSTNAMES_ENV)
+        assert hostnames.count(",") == 3
+        assert leader.get_env(constants.JAX_NUM_PROCESSES_ENV) == "4"
+        assert leader.get_env(constants.PARALLELISM_SIZE_ENV) == "16"
+        # per-host chip count rides google.com/tpu
+        assert leader.resources.requests[constants.TPU_RESOURCE] == "4"
+        worker = tmpl.worker_template.spec.containers[0]
+        assert worker.get_env(constants.TPU_WORKER_HOSTNAMES_ENV) == hostnames
+
+    def test_lws_ready_propagates(self, world):
+        client, mgr = world
+        isvc = make_isvc(leader=v1.LeaderSpec(), worker=v1.WorkerSpec())
+        isvc.spec.accelerator_selector = v1.AcceleratorSelector(
+            accelerator_class="tpu-v5e", topology="2x4")
+        client.create(isvc)
+        reconcile(client, mgr)
+        lws = client.get(LeaderWorkerSet, "svc-engine", "default")
+        lws.status.ready_replicas = 1
+        client.update_status(lws)
+        reconcile(client, mgr)
+        isvc = client.get(v1.InferenceService, "svc", "default")
+        assert isvc.status.is_ready()
+
+
+class TestPDDisaggregated:
+    def test_engine_and_decoder_with_router(self, world):
+        client, mgr = world
+        isvc = make_isvc()
+        isvc.spec.decoder = v1.EngineSpec()
+        isvc.spec.router = v1.RouterSpec(
+            runner=Container(name=constants.MAIN_CONTAINER,
+                             image="ome/router:latest"))
+        client.create(isvc)
+        reconcile(client, mgr)
+        assert client.get(Deployment, "svc-engine", "default")
+        assert client.get(Deployment, "svc-decoder", "default")
+        router = client.get(Deployment, "svc-router", "default")
+        rc = router.spec.template.spec.containers[0]
+        assert "component.ome.io/name=engine" in rc.get_env("ENGINE_SELECTOR")
+        assert "component.ome.io/name=decoder" in \
+            rc.get_env("DECODER_SELECTOR")
+        # router fronts the external service
+        ext = client.get(Service, "svc", "default")
+        assert ext.spec.selector[constants.COMPONENT_LABEL] == "router"
+        # the router must NOT inherit the engine recipe (args/TPU pinning)
+        assert rc.image == "ome/router:latest"
+        assert "--tensor-parallel-size" not in rc.args
+        assert v1.GKE_TPU_ACCELERATOR_LABEL not in \
+            router.spec.template.spec.node_selector
