@@ -1,0 +1,77 @@
+// Content-defined chunking + hashing for the model-weight dedup store.
+//
+// The TPU-native equivalent of the reference's Rust xet-core binding
+// (pkg/xet/src/*.rs, SURVEY.md §2.7): FastCDC-style gear-hash chunking
+// so identical weight regions across model revisions / fine-tunes map
+// to identical chunks, plus a fast 64-bit content hash for addressing.
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in image).
+//
+// Build: make -C native   ->  native/libomechunk.so
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+// splitmix64 — also implemented in ome_tpu/storage/xet.py so the pure-
+// Python fallback produces byte-identical gear tables and boundaries.
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+static uint64_t GEAR[256];
+static bool gear_init_done = false;
+
+static void gear_init() {
+  if (gear_init_done) return;
+  for (int i = 0; i < 256; i++) GEAR[i] = splitmix64((uint64_t)i);
+  gear_init_done = true;
+}
+
+// FNV-1a 64-bit content hash (chunk addressing).
+uint64_t ome_hash64(const uint8_t* data, size_t len) {
+  uint64_t h = 0xCBF29CE484222325ULL;
+  for (size_t i = 0; i < len; i++) {
+    h ^= data[i];
+    h *= 0x100000001B3ULL;
+  }
+  return h;
+}
+
+// FastCDC boundary scan: writes chunk END offsets into out (ascending,
+// last == len), returns the number of chunks. avg_size must be a power
+// of two; normalized cut-point masks harden/soften around it.
+size_t ome_cdc_boundaries(const uint8_t* data, size_t len,
+                          size_t min_size, size_t avg_size,
+                          size_t max_size, size_t* out, size_t out_cap) {
+  gear_init();
+  if (len == 0 || out_cap == 0) return 0;
+  const uint64_t mask_hard = (avg_size << 2) - 1;  // stricter before avg
+  const uint64_t mask_easy = (avg_size >> 2) - 1;  // looser after avg
+  size_t n = 0, start = 0;
+  while (start < len && n < out_cap) {
+    size_t end = len;
+    uint64_t fp = 0;
+    size_t limit = start + max_size < len ? start + max_size : len;
+    size_t avg_at = start + avg_size < limit ? start + avg_size : limit;
+    size_t i = start + min_size < limit ? start + min_size : limit;
+    for (; i < avg_at; i++) {
+      fp = (fp << 1) + GEAR[data[i]];
+      if (!(fp & mask_hard)) { end = i + 1; goto cut; }
+    }
+    for (; i < limit; i++) {
+      fp = (fp << 1) + GEAR[data[i]];
+      if (!(fp & mask_easy)) { end = i + 1; goto cut; }
+    }
+    end = limit;
+  cut:
+    out[n++] = end;
+    start = end;
+  }
+  return n;
+}
+
+}  // extern "C"

@@ -1,0 +1,4 @@
+"""HuggingFace config.json parsers (pkg/hfutil/modelconfig analog)."""
+
+from .parser import (ConfigParseError, FamilyHandler, ParsedModelConfig,
+                     parse_config, parse_model_dir, supported_model_types)

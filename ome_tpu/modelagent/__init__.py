@@ -1,0 +1,6 @@
+"""Node data plane: model staging agent (pkg/modelagent analog)."""
+
+from .gopher import Gopher, GopherTask, TaskType
+from .metrics import METRICS, Metrics
+from .reconcilers import ConfigMapReconciler, NodeLabelReconciler
+from .scout import Scout, node_matches_storage

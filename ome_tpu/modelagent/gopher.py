@@ -1,0 +1,251 @@
+"""Gopher — the model download worker pool.
+
+Re-designs pkg/modelagent/gopher.go:240-1442: a queue of tasks
+(Download / Delete) drained by worker threads; per-storage-type
+download paths (HF hub with chunk-dedup via the native CDC store,
+object stores, PVC/local), post-download verification, config.json
+parsing written back to the model CR, then node label + per-node
+ConfigMap status updates.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import os
+import queue
+import shutil
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from .. import constants
+from ..apis import v1
+from ..core.client import InMemoryClient
+from ..core.errors import ConflictError
+from ..hfconfig import ConfigParseError, parse_model_dir
+from ..storage.base import verify_tree
+from ..storage.hub import HubClient
+from ..storage.providers import open_storage
+from ..storage.uri import StorageType, parse_storage_uri
+from ..storage.xet import ChunkStore, DedupStats
+from .metrics import METRICS
+from .reconcilers import ConfigMapReconciler, NodeLabelReconciler
+
+log = logging.getLogger("ome.modelagent.gopher")
+
+
+class TaskType(str, enum.Enum):
+    DOWNLOAD = "Download"
+    DELETE = "Delete"
+
+
+@dataclass
+class GopherTask:
+    type: TaskType
+    model_kind: str  # BaseModel | ClusterBaseModel
+    model_namespace: str
+    model_name: str
+    spec: Optional[v1.BaseModelSpec] = None
+
+
+@dataclass
+class Gopher:
+    client: InMemoryClient
+    node_name: str
+    models_root: str = "/mnt/models"
+    hub: Optional[HubClient] = None
+    chunk_store: Optional[ChunkStore] = None
+    download_retries: int = 3
+    num_workers: int = 2
+    endpoints: Dict[str, str] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.tasks: "queue.Queue[Optional[GopherTask]]" = queue.Queue()
+        self.labels = NodeLabelReconciler(self.client, self.node_name)
+        self.status_cm = ConfigMapReconciler(self.client, self.node_name)
+        self._threads = []
+        self._stop = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self):
+        for i in range(self.num_workers):
+            t = threading.Thread(target=self._worker,
+                                 name=f"gopher-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+        for _ in self._threads:
+            self.tasks.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def enqueue(self, task: GopherTask):
+        self.tasks.put(task)
+
+    def drain(self):
+        """Synchronously process queued tasks (test/deterministic mode)."""
+        while True:
+            try:
+                task = self.tasks.get_nowait()
+            except queue.Empty:
+                return
+            if task is not None:
+                self.process(task)
+            self.tasks.task_done()
+
+    def _worker(self):
+        while not self._stop.is_set():
+            task = self.tasks.get()
+            if task is None:
+                return
+            try:
+                self.process(task)
+            except Exception:
+                log.exception("task %s %s failed unexpectedly",
+                              task.type, task.model_name)
+            finally:
+                self.tasks.task_done()
+
+    # -- task processing (gopher.go:240+) ------------------------------
+
+    def model_dir(self, task: GopherTask) -> str:
+        if task.spec is not None and task.spec.storage is not None \
+                and task.spec.storage.path:
+            return task.spec.storage.path
+        return os.path.join(self.models_root, task.model_name)
+
+    def process(self, task: GopherTask):
+        if task.type == TaskType.DELETE:
+            self._delete(task)
+            return
+        self._set_state(task, constants.MODEL_STATUS_UPDATING)
+        try:
+            target = self._download(task)
+            self._parse_and_update_cr(task, target)
+        except Exception as e:  # noqa: BLE001 — any failure marks the node
+            log.warning("download %s failed: %s", task.model_name, e)
+            METRICS.inc("downloads_failed_total")
+            self._set_state(task, constants.MODEL_STATUS_FAILED,
+                            {"error": str(e)[:500]})
+            return
+        METRICS.inc("downloads_success_total")
+        self._set_state(task, constants.MODEL_STATUS_READY)
+
+    def _set_state(self, task: GopherTask, state: str,
+                   extra: Optional[Dict] = None):
+        self.labels.reconcile(task.model_kind, task.model_name, state)
+        self.status_cm.set_status(task.model_kind, task.model_namespace,
+                                  task.model_name, state, extra)
+
+    def _delete(self, task: GopherTask):
+        target = self.model_dir(task)
+        if os.path.isdir(target):
+            shutil.rmtree(target, ignore_errors=True)
+        self.labels.reconcile(task.model_kind, task.model_name, None)
+        self.status_cm.remove(task.model_kind, task.model_namespace,
+                              task.model_name)
+
+    # -- download paths ------------------------------------------------
+
+    def _download(self, task: GopherTask) -> str:
+        spec = task.spec
+        if spec is None or spec.storage is None \
+                or not spec.storage.storage_uri:
+            raise ValueError(f"model {task.model_name} has no storage uri")
+        target = self.model_dir(task)
+        if spec.storage.download_policy == v1.DownloadPolicy.REUSE \
+                and os.path.isdir(target) and os.listdir(target):
+            return target  # ReuseIfExists (model.go:150-156)
+
+        comps = parse_storage_uri(spec.storage.storage_uri)
+        last: Optional[Exception] = None
+        for attempt in range(self.download_retries):
+            try:
+                if comps.type == StorageType.HUGGINGFACE:
+                    self._download_hf(comps, target)
+                else:
+                    # local/pvc roots are baked into the provider; only
+                    # object stores carry a key prefix
+                    storage = open_storage(comps, self.endpoints)
+                    prefix = comps.prefix
+                    expected = storage.list(prefix)
+                    if not expected:
+                        raise IOError(
+                            f"{spec.storage.storage_uri}: no objects found")
+                    storage.download(target, prefix, objects=expected)
+                    bad = verify_tree(target, [
+                        type(o)(o.name[len(prefix):].lstrip("/")
+                                if prefix else o.name, o.size)
+                        for o in expected])
+                    if bad:
+                        raise IOError(
+                            f"verification failed: {bad[:3]}")
+                METRICS.inc("verifications_total")
+                return target
+            except Exception as e:  # noqa: BLE001
+                last = e
+                log.warning("attempt %d/%d for %s failed: %s",
+                            attempt + 1, self.download_retries,
+                            task.model_name, e)
+        raise last  # type: ignore[misc]
+
+    def _download_hf(self, comps, target: str):
+        hub = self.hub or HubClient()
+        files = hub.snapshot_download(comps.repo_id, target,
+                                      revision=comps.revision)
+        expected = hub.expected_objects(comps.repo_id, comps.revision)
+        bad = verify_tree(target, [o for o in expected if o.size])
+        if bad:
+            raise IOError(f"verification failed: {bad[:3]}")
+        # feed the dedup store so future revisions reuse local chunks
+        if self.chunk_store is not None:
+            stats = DedupStats()
+            for f in files:
+                rel = os.path.relpath(f, target)
+                key = f"{comps.repo_id}@{comps.revision}/{rel}"
+                manifest = self.chunk_store.ingest(f, stats)
+                self.chunk_store.save_manifest(key, manifest)
+            METRICS.observe("dedup_ratio", stats.dedup_ratio)
+
+    # -- config parse-back (gopher.go:207, config_parser.go:51) --------
+
+    def _parse_and_update_cr(self, task: GopherTask, target: str):
+        try:
+            parsed = parse_model_dir(target)
+        except ConfigParseError as e:
+            log.info("no parseable config for %s: %s", task.model_name, e)
+            return
+        cls = (v1.BaseModel if task.model_kind == "BaseModel"
+               else v1.ClusterBaseModel)
+        for _ in range(4):
+            obj = self.client.try_get(cls, task.model_name,
+                                      task.model_namespace)
+            if obj is None:
+                return
+            spec = obj.spec
+            before = repr(spec)
+            if parsed.architecture:
+                spec.model_architecture = parsed.architecture
+            if parsed.parameter_count:
+                spec.model_parameter_size = parsed.parameter_size
+            if parsed.context_length:
+                spec.max_tokens = parsed.context_length
+            if parsed.capabilities and not spec.model_capabilities:
+                spec.model_capabilities = list(parsed.capabilities)
+            if parsed.quantization and spec.quantization is None:
+                try:
+                    spec.quantization = v1.ModelQuantization(
+                        parsed.quantization)
+                except ValueError:
+                    pass
+            if repr(spec) == before:
+                return  # nothing new parsed — avoid a no-op update event
+            try:
+                self.client.update(obj)
+                return
+            except ConflictError:
+                continue

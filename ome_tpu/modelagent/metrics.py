@@ -1,0 +1,48 @@
+"""Model-agent metrics (modelagent/metrics.go:50-160 analog): Prometheus
+text-format counters/gauges without a client-library dependency."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+PREFIX = "model_agent"
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+
+    def inc(self, name: str, amount: float = 1.0):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + amount
+
+    def observe(self, name: str, value: float):
+        with self._lock:
+            self._gauges[name] = value
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, self._gauges.get(name, 0.0))
+
+    def render(self) -> str:
+        """Prometheus exposition format."""
+        with self._lock:
+            lines = []
+            for k, v in sorted(self._counters.items()):
+                lines.append(f"# TYPE {PREFIX}_{k} counter")
+                lines.append(f"{PREFIX}_{k} {v}")
+            for k, v in sorted(self._gauges.items()):
+                lines.append(f"# TYPE {PREFIX}_{k} gauge")
+                lines.append(f"{PREFIX}_{k} {v}")
+            return "\n".join(lines) + "\n"
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+
+
+METRICS = Metrics()

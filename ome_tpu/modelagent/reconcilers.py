@@ -1,0 +1,110 @@
+"""Node-side label + status-ConfigMap reconcilers.
+
+Re-designs pkg/modelagent/node_label_reconciler.go (idempotent
+models.ome.io/<kind>.<name>=<state> node labels consumed by the
+controller's model-ready scheduling constraint) and
+configmap_reconciler.go:90-560 (per-node ConfigMap in the operator
+namespace feeding the BaseModel controller's aggregation).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Optional
+
+from .. import constants
+from ..controllers.basemodel import (MODEL_STATUS_CM_LABEL, model_key,
+                                     node_status_cm_name)
+from ..core.client import InMemoryClient
+from ..core.errors import ConflictError, NotFoundError
+from ..core.k8s import ConfigMap, Node
+from ..core.meta import ObjectMeta
+
+
+class NodeLabelReconciler:
+    def __init__(self, client: InMemoryClient, node_name: str):
+        self.client = client
+        self.node_name = node_name
+
+    def reconcile(self, model_kind: str, model_name: str,
+                  state: Optional[str]) -> None:
+        """Set (or clear, state=None) the model label on this node."""
+        label = constants.model_ready_label(model_kind, model_name)
+        for _ in range(4):  # retry on rv conflict
+            node = self.client.try_get(Node, self.node_name)
+            if node is None:
+                return
+            current = node.metadata.labels.get(label)
+            if state is None:
+                if current is None:
+                    return
+                node.metadata.labels.pop(label, None)
+            else:
+                if current == state:
+                    return
+                node.metadata.labels[label] = state
+            try:
+                self.client.update(node, bump_generation=False)
+                return
+            except ConflictError:
+                continue
+
+
+class ConfigMapReconciler:
+    """Per-node model status ConfigMap with a write-through cache that
+    survives agent restarts by re-reading the live object."""
+
+    def __init__(self, client: InMemoryClient, node_name: str,
+                 namespace: str = constants.OPERATOR_NAMESPACE):
+        self.client = client
+        self.node_name = node_name
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._cache: Optional[Dict[str, str]] = None
+
+    @property
+    def cm_name(self) -> str:
+        return node_status_cm_name(self.node_name)
+
+    def _load(self) -> Dict[str, str]:
+        cm = self.client.try_get(ConfigMap, self.cm_name, self.namespace)
+        return dict(cm.data) if cm is not None else {}
+
+    def _flush(self, data: Dict[str, str]) -> None:
+        for _ in range(4):
+            cm = self.client.try_get(ConfigMap, self.cm_name,
+                                     self.namespace)
+            if cm is None:
+                self.client.create(ConfigMap(
+                    metadata=ObjectMeta(
+                        name=self.cm_name, namespace=self.namespace,
+                        labels={MODEL_STATUS_CM_LABEL: "true"}),
+                    data=dict(data)))
+                return
+            cm.data = dict(data)
+            try:
+                self.client.update(cm)
+                return
+            except ConflictError:
+                continue
+
+    def set_status(self, model_kind: str, model_namespace: str,
+                   model_name: str, state: str,
+                   extra: Optional[Dict] = None) -> None:
+        key = model_key(model_kind, model_namespace, model_name)
+        entry = {"state": state, **(extra or {})}
+        with self._lock:
+            if self._cache is None:
+                self._cache = self._load()
+            self._cache[key] = json.dumps(entry, sort_keys=True)
+            self._flush(self._cache)
+
+    def remove(self, model_kind: str, model_namespace: str,
+               model_name: str) -> None:
+        key = model_key(model_kind, model_namespace, model_name)
+        with self._lock:
+            if self._cache is None:
+                self._cache = self._load()
+            if self._cache.pop(key, None) is not None:
+                self._flush(self._cache)

@@ -1,0 +1,112 @@
+"""Scout — model watcher deciding what this node stages.
+
+Re-designs pkg/modelagent/scout.go:49-745: handles (Cluster)BaseModel
+add/update/delete events, checks the model's StorageSpec node
+constraints (nodeSelector / nodeAffinity) against this node's labels
+(scout.go:499-652 shouldDownloadModel), and enqueues Gopher tasks.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from ..apis import v1
+from ..core.client import Event, InMemoryClient
+from ..core.k8s import Node
+from ..core.serde import to_dict
+from .gopher import Gopher, GopherTask, TaskType
+
+log = logging.getLogger("ome.modelagent.scout")
+
+
+def node_matches_storage(storage: Optional[v1.StorageSpec],
+                         node: Node) -> bool:
+    """shouldDownloadModel: empty constraints mean every node stages."""
+    if storage is None:
+        return True
+    if storage.node_selector:
+        if not all(node.metadata.labels.get(k) == val
+                   for k, val in storage.node_selector.items()):
+            return False
+    aff = storage.node_affinity
+    if aff:
+        terms = (aff.get("required", aff) or {}).get(
+            "nodeSelectorTerms", [])
+        if terms:
+            for term in terms:
+                ok = True
+                for e in term.get("matchExpressions", []):
+                    key = e.get("key")
+                    op = e.get("operator", "In")
+                    have = node.metadata.labels.get(key)
+                    values = e.get("values", [])
+                    if op == "In":
+                        ok = ok and have in values
+                    elif op == "NotIn":
+                        ok = ok and have not in values
+                    elif op == "Exists":
+                        ok = ok and have is not None
+                    elif op == "DoesNotExist":
+                        ok = ok and have is None
+                if ok:
+                    return True
+            return False
+    return True
+
+
+class Scout:
+    def __init__(self, client: InMemoryClient, gopher: Gopher,
+                 node_name: str):
+        self.client = client
+        self.gopher = gopher
+        self.node_name = node_name
+        self._cancel: Optional[Callable[[], None]] = None
+        # last download-relevant spec per model, so self-inflicted CR
+        # updates (config parse-back) don't re-trigger downloads — the
+        # reference's UpdateFunc diffs old/new specs the same way
+        # (scout.go:170-230)
+        self._seen: dict = {}
+
+    def start(self):
+        # seed: existing models reconcile on boot (informer initial list)
+        for cls in (v1.BaseModel, v1.ClusterBaseModel):
+            for m in self.client.list(cls):
+                self._handle(m, deleted=False)
+        self._cancel = self.client.watch(self._on_event)
+
+    def stop(self):
+        if self._cancel:
+            self._cancel()
+
+    def _on_event(self, ev: Event):
+        if not isinstance(ev.obj, (v1.BaseModel, v1.ClusterBaseModel)):
+            return
+        self._handle(ev.obj, deleted=(ev.type == "Deleted"))
+
+    def _handle(self, model, deleted: bool):
+        node = self.client.try_get(Node, self.node_name)
+        if node is None:
+            return
+        kind = type(model).KIND
+        task_kw = dict(model_kind=kind,
+                       model_namespace=model.metadata.namespace,
+                       model_name=model.metadata.name)
+        key = (kind, model.metadata.namespace, model.metadata.name)
+        if deleted or model.metadata.deletion_timestamp \
+                or model.spec.disabled:
+            self._seen.pop(key, None)
+            # spec rides along so _delete removes a custom storage.path
+            self.gopher.enqueue(GopherTask(type=TaskType.DELETE,
+                                           spec=model.spec, **task_kw))
+            return
+        sig = repr(to_dict(model.spec.storage))
+        if self._seen.get(key) == sig:
+            return  # spec unchanged (e.g. our own config parse-back)
+        self._seen[key] = sig
+        if not node_matches_storage(model.spec.storage, node):
+            log.debug("%s/%s: node constraints exclude %s",
+                      kind, model.metadata.name, self.node_name)
+            return
+        self.gopher.enqueue(GopherTask(type=TaskType.DOWNLOAD,
+                                       spec=model.spec, **task_kw))

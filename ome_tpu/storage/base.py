@@ -1,0 +1,120 @@
+"""Storage provider interface.
+
+Re-designs pkg/storage/interfaces.go:25-150 (Storage / MultipartCapable
+/ BulkStorage): a uniform surface over object stores, the HF hub, PVCs
+and local paths, consumed by the model-agent's download workers and the
+replica tooling.
+"""
+
+from __future__ import annotations
+
+import abc
+import hashlib
+import os
+from dataclasses import dataclass
+from typing import Callable, Iterable, List, Optional
+
+ProgressFn = Callable[[str, int, int], None]  # (object_name, done, total)
+
+
+@dataclass
+class ObjectInfo:
+    name: str
+    size: int = 0
+    etag: str = ""
+
+
+class Storage(abc.ABC):
+    """download/upload move whole object trees; get/put move bytes."""
+
+    @abc.abstractmethod
+    def list(self, prefix: str = "") -> List[ObjectInfo]:
+        ...
+
+    @abc.abstractmethod
+    def get(self, name: str) -> bytes:
+        ...
+
+    @abc.abstractmethod
+    def put(self, name: str, data: bytes) -> None:
+        ...
+
+    @abc.abstractmethod
+    def exists(self, name: str) -> bool:
+        ...
+
+    def download(self, target_dir: str, prefix: str = "",
+                 progress: Optional[ProgressFn] = None,
+                 workers: int = 4,
+                 objects: Optional[List[ObjectInfo]] = None) -> List[str]:
+        """Mirror the remote tree under target_dir; resumable by
+        default (existing files with matching size are skipped).
+        Pass `objects` to reuse an already-fetched listing — avoids a
+        second paginated list sweep (and listing skew) per attempt."""
+        import concurrent.futures as cf
+
+        objs = self.list(prefix) if objects is None else objects
+        os.makedirs(target_dir, exist_ok=True)
+
+        def fetch(o: ObjectInfo) -> str:
+            rel = o.name[len(prefix):].lstrip("/") if prefix else o.name
+            dst = os.path.join(target_dir, rel)
+            os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+            if os.path.exists(dst) and os.path.getsize(dst) == o.size:
+                if progress:
+                    progress(o.name, o.size, o.size)
+                return dst
+            data = self.get(o.name)
+            tmp = dst + ".part"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, dst)  # tmp-and-move (hub/download.go:274)
+            if progress:
+                progress(o.name, len(data), o.size)
+            return dst
+
+        with cf.ThreadPoolExecutor(max_workers=workers) as ex:
+            return list(ex.map(fetch, objs))
+
+    def upload(self, source_dir: str, prefix: str = "",
+               workers: int = 4) -> List[str]:
+        import concurrent.futures as cf
+
+        paths = []
+        for root, _, files in os.walk(source_dir):
+            for fn in files:
+                paths.append(os.path.join(root, fn))
+
+        def push(p: str) -> str:
+            rel = os.path.relpath(p, source_dir)
+            name = f"{prefix.rstrip('/')}/{rel}" if prefix else rel
+            with open(p, "rb") as f:
+                self.put(name, f.read())
+            return name
+
+        with cf.ThreadPoolExecutor(max_workers=workers) as ex:
+            return list(ex.map(push, paths))
+
+
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def verify_tree(target_dir: str, expected: Iterable[ObjectInfo]) -> List[str]:
+    """Downloaded-file verification (gopher.go:876 behavior): every
+    expected object exists with the expected size; returns failures."""
+    bad = []
+    for o in expected:
+        p = os.path.join(target_dir, o.name)
+        if not os.path.exists(p):
+            bad.append(f"{o.name}: missing")
+        elif o.size and os.path.getsize(p) != o.size:
+            bad.append(f"{o.name}: size {os.path.getsize(p)} != {o.size}")
+    return bad

@@ -1,0 +1,451 @@
+"""Storage layer + hfconfig parsers + model-agent tests.
+
+Mirrors the reference's test strategy (SURVEY.md §4): HTTP test servers
+for hub download paths, fixture-driven config parser tests, and
+fake-client agent flows asserting node labels + status ConfigMaps.
+"""
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from ome_tpu import constants
+from ome_tpu.apis import v1
+from ome_tpu.core.client import InMemoryClient
+from ome_tpu.core.k8s import ConfigMap, Node
+from ome_tpu.core.meta import ObjectMeta
+from ome_tpu.hfconfig import parse_config, parse_model_dir
+from ome_tpu.modelagent import Gopher, GopherTask, Scout, TaskType
+from ome_tpu.modelagent.scout import node_matches_storage
+from ome_tpu.controllers.basemodel import model_key, node_status_cm_name
+from ome_tpu.storage import (ChunkStore, DedupStats, HubClient,
+                             LocalStorage, StorageType, cdc_boundaries,
+                             parse_storage_uri)
+
+
+# -- uri parsing ------------------------------------------------------------
+
+
+class TestStorageURI:
+    @pytest.mark.parametrize("uri,stype,check", [
+        ("hf://meta-llama/Llama-3-8B", StorageType.HUGGINGFACE,
+         lambda c: c.repo_id == "meta-llama/Llama-3-8B"
+         and c.revision == "main"),
+        ("hf://org/repo@v2", StorageType.HUGGINGFACE,
+         lambda c: c.revision == "v2"),
+        ("gcs://bucket/models/llama", StorageType.GCS,
+         lambda c: c.bucket == "bucket" and c.prefix == "models/llama"),
+        ("s3://b/p", StorageType.S3, lambda c: c.bucket == "b"),
+        ("oci://n/myns/b/mybucket/o/models", StorageType.OCI,
+         lambda c: c.namespace == "myns" and c.bucket == "mybucket"
+         and c.prefix == "models"),
+        ("pvc://claim/sub/dir", StorageType.PVC,
+         lambda c: c.pvc_name == "claim" and c.path == "sub/dir"),
+        ("local:///mnt/models/x", StorageType.LOCAL,
+         lambda c: c.path == "/mnt/models/x"),
+    ])
+    def test_parse(self, uri, stype, check):
+        c = parse_storage_uri(uri)
+        assert c.type == stype
+        assert check(c)
+
+    def test_invalid(self):
+        from ome_tpu.storage import StorageURIError
+        with pytest.raises(StorageURIError):
+            parse_storage_uri("ftp://nope/x")
+        with pytest.raises(StorageURIError):
+            parse_storage_uri("not-a-uri")
+
+
+# -- chunk store ------------------------------------------------------------
+
+
+class TestChunkStore:
+    def test_dedup_across_revisions(self, tmp_path):
+        import random
+        random.seed(7)
+        base = bytes(random.randrange(256) for _ in range(300_000))
+        v1_file = tmp_path / "m1.bin"
+        v1_file.write_bytes(base)
+        # revision 2 = same weights with a small edit in the middle
+        v2_file = tmp_path / "m2.bin"
+        v2_file.write_bytes(base[:150_000] + b"xx" + base[150_000:])
+
+        store = ChunkStore(str(tmp_path / "store"))
+        s1 = DedupStats()
+        m1 = store.ingest(str(v1_file), s1)
+        assert s1.new_bytes == s1.total_bytes  # first ingest: all new
+        s2 = DedupStats()
+        m2 = store.ingest(str(v2_file), s2)
+        assert s2.dedup_ratio > 0.5  # CDC keeps most chunks identical
+
+        out = tmp_path / "rebuilt.bin"
+        store.materialize(m2, str(out))
+        assert out.read_bytes() == v2_file.read_bytes()
+        assert store.can_materialize(m1)
+
+    def test_boundaries_deterministic(self):
+        data = os.urandom(200_000)
+        assert cdc_boundaries(data) == cdc_boundaries(data)
+        assert cdc_boundaries(data)[-1] == len(data)
+
+
+# -- hub client over a local HTTP server ------------------------------------
+
+
+FILES = {
+    "config.json": json.dumps({
+        "model_type": "llama", "architectures": ["LlamaForCausalLM"],
+        "vocab_size": 512, "hidden_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "intermediate_size": 128,
+        "max_position_embeddings": 2048}).encode(),
+    "model.safetensors": os.urandom(100_000),
+    "tokenizer.json": b"{}",
+}
+
+
+class HubHandler(BaseHTTPRequestHandler):
+    fail_after = {}  # path -> bytes to serve before dropping (resume test)
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        if self.path.startswith("/api/models/"):
+            body = json.dumps({"siblings": [
+                {"rfilename": k, "size": len(v)}
+                for k, v in FILES.items()]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        name = self.path.split("/resolve/main/")[-1]
+        data = FILES.get(name)
+        if data is None:
+            self.send_error(404)
+            return
+        rng = self.headers.get("Range")
+        if rng:
+            start = int(rng.split("=")[1].split("-")[0])
+            body = data[start:]
+            self.send_response(206)
+            self.send_header("Content-Range",
+                             f"bytes {start}-{len(data)-1}/{len(data)}")
+        else:
+            body = data
+            self.send_response(200)
+        cut = HubHandler.fail_after.pop(name, None)
+        if cut is not None:
+            body = body[:cut]  # simulate a dropped connection
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def hub_server():
+    srv = HTTPServer(("127.0.0.1", 0), HubHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+class TestHubClient:
+    def test_snapshot_download_and_verify(self, hub_server, tmp_path):
+        hub = HubClient(endpoint=hub_server, retries=2, backoff=0.01)
+        out = hub.snapshot_download("org/model", str(tmp_path))
+        assert sorted(os.path.basename(p) for p in out) == \
+            sorted(FILES)
+        assert (tmp_path / "model.safetensors").read_bytes() == \
+            FILES["model.safetensors"]
+
+    def test_resume_from_partial(self, hub_server, tmp_path):
+        hub = HubClient(endpoint=hub_server, retries=2, backoff=0.01)
+        # pre-existing truncated .part: client must Range-resume
+        part = tmp_path / "model.safetensors.part"
+        part.write_bytes(FILES["model.safetensors"][:40_000])
+        hub.download_file("org/model", "model.safetensors",
+                          str(tmp_path),
+                          expected_size=len(FILES["model.safetensors"]))
+        assert (tmp_path / "model.safetensors").read_bytes() == \
+            FILES["model.safetensors"]
+
+    def test_short_read_fails_verification(self, hub_server, tmp_path):
+        hub = HubClient(endpoint=hub_server, retries=1, backoff=0.01)
+        HubHandler.fail_after["model.safetensors"] = 10_000
+        from ome_tpu.storage import HubError
+        with pytest.raises(HubError):
+            hub.download_file(
+                "org/model", "model.safetensors", str(tmp_path),
+                expected_size=len(FILES["model.safetensors"]))
+
+
+# -- hfconfig ---------------------------------------------------------------
+
+
+class TestHFConfig:
+    def test_llama8b_estimate(self):
+        p = parse_config({
+            "model_type": "llama", "architectures": ["LlamaForCausalLM"],
+            "vocab_size": 128256, "hidden_size": 4096,
+            "num_hidden_layers": 32, "num_attention_heads": 32,
+            "num_key_value_heads": 8, "intermediate_size": 14336,
+            "max_position_embeddings": 8192})
+        assert abs(p.parameter_count - 8.03e9) / 8.03e9 < 0.01
+        assert p.context_length == 8192
+        assert "TEXT_GENERATION" in p.capabilities
+
+    def test_mixtral_moe(self):
+        p = parse_config({
+            "model_type": "mixtral", "vocab_size": 32000,
+            "hidden_size": 4096, "num_hidden_layers": 32,
+            "num_attention_heads": 32, "num_key_value_heads": 8,
+            "intermediate_size": 14336, "num_local_experts": 8,
+            "max_position_embeddings": 32768})
+        assert abs(p.parameter_count - 46.7e9) / 46.7e9 < 0.01
+        assert p.is_moe
+
+    def test_deepseek_v3(self):
+        p = parse_config({
+            "model_type": "deepseek_v3", "vocab_size": 129280,
+            "hidden_size": 7168, "num_hidden_layers": 61,
+            "num_attention_heads": 128, "q_lora_rank": 1536,
+            "kv_lora_rank": 512, "qk_nope_head_dim": 128,
+            "qk_rope_head_dim": 64, "v_head_dim": 128,
+            "intermediate_size": 18432, "moe_intermediate_size": 2048,
+            "n_routed_experts": 256, "n_shared_experts": 1,
+            "first_k_dense_replace": 3,
+            "max_position_embeddings": 163840})
+        assert abs(p.parameter_count - 671e9) / 671e9 < 0.01
+
+    def test_bert_embeddings(self):
+        p = parse_config({"model_type": "bert", "vocab_size": 30522,
+                          "hidden_size": 768, "num_hidden_layers": 12,
+                          "num_attention_heads": 12,
+                          "intermediate_size": 3072})
+        assert p.capabilities == ["TEXT_EMBEDDINGS"]
+
+    def test_vlm_nested_text_config(self):
+        p = parse_config({
+            "model_type": "gemma3",
+            "architectures": ["Gemma3ForConditionalGeneration"],
+            "text_config": {"vocab_size": 262144, "hidden_size": 2560,
+                            "num_hidden_layers": 34,
+                            "num_attention_heads": 8,
+                            "num_key_value_heads": 4,
+                            "intermediate_size": 10240,
+                            "max_position_embeddings": 131072}})
+        assert p.vision
+        assert p.parameter_count > 1e9
+        assert p.context_length == 131072
+
+    def test_quantization_detection(self):
+        p = parse_config({"model_type": "llama",
+                          "quantization_config": {
+                              "quant_method": "fp8"}})
+        assert p.quantization == "fp8"
+        p = parse_config({"model_type": "llama",
+                          "quantization_config": {
+                              "quant_method": "gptq", "bits": 4}})
+        assert p.quantization == "int4"
+
+    def test_diffusion_model_index(self, tmp_path):
+        (tmp_path / "model_index.json").write_text(json.dumps({
+            "_class_name": "StableDiffusionXLPipeline",
+            "_diffusers_version": "0.19.0"}))
+        p = parse_model_dir(str(tmp_path))
+        assert p.capabilities == ["IMAGE_GENERATION"]
+
+    def test_safetensors_index_exact_count(self, tmp_path):
+        (tmp_path / "config.json").write_text(json.dumps(
+            {"model_type": "llama", "torch_dtype": "bfloat16"}))
+        (tmp_path / "model.safetensors.index.json").write_text(
+            json.dumps({"metadata": {"total_size": 2 * 8_030_000_000}}))
+        p = parse_model_dir(str(tmp_path))
+        assert p.parameter_count == 8_030_000_000
+
+
+# -- model agent ------------------------------------------------------------
+
+
+def agent_world(tmp_path, node_labels=None):
+    client = InMemoryClient()
+    client.create(Node(metadata=ObjectMeta(
+        name="node-1", labels=dict(node_labels or {}))))
+    gopher = Gopher(client=client, node_name="node-1",
+                    models_root=str(tmp_path / "models"),
+                    download_retries=1)
+    scout = Scout(client, gopher, "node-1")
+    return client, gopher, scout
+
+
+def local_model(tmp_path, name="m1", kind=v1.ClusterBaseModel):
+    src = tmp_path / "src" / name
+    src.mkdir(parents=True)
+    (src / "config.json").write_text(json.dumps({
+        "model_type": "llama", "architectures": ["LlamaForCausalLM"],
+        "vocab_size": 512, "hidden_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "intermediate_size": 128, "max_position_embeddings": 2048}))
+    (src / "model.safetensors").write_bytes(os.urandom(5000))
+    m = kind(metadata=ObjectMeta(name=name))
+    m.spec.model_format = v1.ModelFormat(name="safetensors")
+    m.spec.storage = v1.StorageSpec(storage_uri=f"local://{src}")
+    return m
+
+
+class TestModelAgent:
+    def test_download_labels_and_cr_writeback(self, tmp_path):
+        client, gopher, scout = agent_world(tmp_path)
+        client.create(local_model(tmp_path))
+        scout.start()
+        gopher.drain()
+        scout.stop()
+
+        node = client.get(Node, "node-1")
+        label = constants.model_ready_label("ClusterBaseModel", "m1")
+        assert node.metadata.labels[label] == "Ready"
+        cm = client.get(ConfigMap, node_status_cm_name("node-1"),
+                        constants.OPERATOR_NAMESPACE)
+        entry = json.loads(cm.data[model_key("ClusterBaseModel", "", "m1")])
+        assert entry["state"] == "Ready"
+        # parsed config written back into the CR spec
+        m = client.get(v1.ClusterBaseModel, "m1")
+        assert m.spec.model_architecture == "LlamaForCausalLM"
+        assert m.spec.max_tokens == 2048
+        assert m.spec.model_parameter_size
+        # weights staged on disk
+        assert os.path.exists(
+            tmp_path / "models" / "m1" / "model.safetensors")
+
+    def test_node_selector_excludes(self, tmp_path):
+        client, gopher, scout = agent_world(
+            tmp_path, {"pool": "cpu"})
+        m = local_model(tmp_path)
+        m.spec.storage.node_selector = {"pool": "tpu"}
+        client.create(m)
+        scout.start()
+        gopher.drain()
+        scout.stop()
+        node = client.get(Node, "node-1")
+        assert constants.model_ready_label("ClusterBaseModel", "m1") \
+            not in node.metadata.labels
+
+    def test_failed_download_marks_failed(self, tmp_path):
+        client, gopher, scout = agent_world(tmp_path)
+        m = v1.ClusterBaseModel(metadata=ObjectMeta(name="broken"))
+        m.spec.storage = v1.StorageSpec(
+            storage_uri="local:///nonexistent/path")
+        client.create(m)
+        scout.start()
+        gopher.drain()
+        scout.stop()
+        node = client.get(Node, "node-1")
+        label = constants.model_ready_label("ClusterBaseModel", "broken")
+        assert node.metadata.labels[label] == "Failed"
+
+    def test_delete_cleans_up(self, tmp_path):
+        client, gopher, scout = agent_world(tmp_path)
+        client.create(local_model(tmp_path))
+        scout.start()
+        gopher.drain()
+        client.delete(v1.ClusterBaseModel, "m1")
+        gopher.drain()
+        scout.stop()
+        node = client.get(Node, "node-1")
+        assert constants.model_ready_label("ClusterBaseModel", "m1") \
+            not in node.metadata.labels
+        assert not os.path.exists(tmp_path / "models" / "m1")
+
+    def test_hub_download_via_gopher(self, tmp_path, hub_server):
+        client, gopher, scout = agent_world(tmp_path)
+        gopher.hub = HubClient(endpoint=hub_server, retries=2,
+                               backoff=0.01)
+        gopher.chunk_store = ChunkStore(str(tmp_path / "xet"))
+        m = v1.ClusterBaseModel(metadata=ObjectMeta(name="hfmodel"))
+        m.spec.storage = v1.StorageSpec(storage_uri="hf://org/model")
+        client.create(m)
+        scout.start()
+        gopher.drain()
+        scout.stop()
+        node = client.get(Node, "node-1")
+        label = constants.model_ready_label("ClusterBaseModel", "hfmodel")
+        assert node.metadata.labels[label] == "Ready"
+        # chunk store was fed for future dedup
+        assert gopher.chunk_store.load_manifest(
+            "org/model@main/model.safetensors")
+
+    def test_node_matches_storage_affinity(self):
+        node = Node(metadata=ObjectMeta(name="n",
+                                        labels={"tpu": "v5e"}))
+        st = v1.StorageSpec(node_affinity={
+            "required": {"nodeSelectorTerms": [
+                {"matchExpressions": [
+                    {"key": "tpu", "operator": "In",
+                     "values": ["v5e", "v6e"]}]}]}})
+        assert node_matches_storage(st, node)
+        st2 = v1.StorageSpec(node_affinity={
+            "required": {"nodeSelectorTerms": [
+                {"matchExpressions": [
+                    {"key": "tpu", "operator": "NotIn",
+                     "values": ["v5e"]}]}]}})
+        assert not node_matches_storage(st2, node)
+
+
+# -- regression tests for review findings -----------------------------------
+
+
+class TestReviewFindings:
+    def test_local_storage_sibling_prefix_escape(self, tmp_path):
+        from ome_tpu.storage import StorageURIError
+        (tmp_path / "claim").mkdir()
+        (tmp_path / "claim2").mkdir()
+        (tmp_path / "claim2" / "secret").write_bytes(b"x")
+        st = LocalStorage(str(tmp_path / "claim"))
+        with pytest.raises(StorageURIError):
+            st.get("../claim2/secret")
+
+    def test_oci_uri_requires_namespace(self):
+        from ome_tpu.storage import StorageURIError
+        with pytest.raises(StorageURIError):
+            parse_storage_uri("oci://mybucket/models/x")
+
+    def test_file_url_quotes_filename(self):
+        hub = HubClient(endpoint="http://h")
+        url = hub.file_url("org/repo", "data/file#1?.bin")
+        assert "#" not in url and "?" not in url
+
+    def test_streaming_ingest_matches_whole_file(self, tmp_path):
+        import random
+        random.seed(11)
+        data = bytes(random.randrange(256) for _ in range(3_000_000))
+        f = tmp_path / "big.bin"
+        f.write_bytes(data)
+        whole = ChunkStore(str(tmp_path / "s1")).ingest(str(f))
+        streamed = ChunkStore(str(tmp_path / "s2")).ingest(
+            str(f), window=1 << 20)
+        assert streamed == whole
+        out = tmp_path / "re.bin"
+        s2 = ChunkStore(str(tmp_path / "s2"))
+        s2.materialize(streamed, str(out))
+        assert out.read_bytes() == data
+
+    def test_delete_honors_custom_storage_path(self, tmp_path):
+        client, gopher, scout = agent_world(tmp_path)
+        custom = tmp_path / "custom-target"
+        m = local_model(tmp_path)
+        m.spec.storage.path = str(custom)
+        client.create(m)
+        scout.start()
+        gopher.drain()
+        assert (custom / "model.safetensors").exists()
+        client.delete(v1.ClusterBaseModel, "m1")
+        gopher.drain()
+        scout.stop()
+        assert not custom.exists()
