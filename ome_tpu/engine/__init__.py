@@ -1,0 +1,16 @@
+"""TPU-native serving engine (JetStream-style continuous batching).
+
+What the reference treats as external L0 engines (SGLang/vLLM inside
+runtime containers) is in-repo here: compiled prefill/insert/decode over
+the JAX data plane, a continuous-batching scheduler, and an
+OpenAI-compatible HTTP front-end.
+"""
+
+from .core import DecodeState, InferenceEngine
+from .sampling import sample
+from .scheduler import Request, Scheduler
+from .server import EngineServer
+from .tokenizer import ByteTokenizer, load_tokenizer
+
+__all__ = ["DecodeState", "InferenceEngine", "Request", "Scheduler",
+           "EngineServer", "ByteTokenizer", "load_tokenizer", "sample"]

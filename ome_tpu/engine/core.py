@@ -1,0 +1,170 @@
+"""Inference engine core: slot-based continuous batching primitives.
+
+TPU-first re-design of what the reference delegates to SGLang/vLLM
+(SURVEY.md L0 — external engines, out of its repo): here the engine is
+in-repo and JAX-native, structured like JetStream for XLA's compilation
+model:
+
+  * fixed decode batch of `max_slots` slots, one sequence each — every
+    decode step is ONE compiled program with static shapes, whatever
+    mix of requests is in flight;
+  * prefill runs per-request at bucketed lengths (few compilations),
+    producing a KV prefix that is *inserted* into a slot;
+  * per-slot cache write positions (KVCache.index as a [B] vector) let
+    every slot sit at a different sequence length;
+  * sampling params are [B] vectors so one program serves all requests.
+
+The three jitted programs (prefill / insert / decode) donate their
+state buffers, so cache updates are in-place in HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models import llama
+from ..models.config import ModelConfig
+from .sampling import sample
+
+Params = llama.Params
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DecodeState:
+    """Device-resident state of the decode batch."""
+
+    k: jax.Array        # [L, B, Smax, K, Dh]
+    v: jax.Array        # [L, B, Smax, K, Dh]
+    lengths: jax.Array  # [B] int32 — valid kv rows / next write index
+    tokens: jax.Array   # [B] int32 — last sampled token per slot
+
+
+def _bucketize(n: int, buckets: List[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class InferenceEngine:
+    """Compiled prefill/insert/decode over one model + one mesh."""
+
+    def __init__(self, params: Params, cfg: ModelConfig,
+                 max_slots: int = 8, max_seq: Optional[int] = None,
+                 prefill_buckets: Optional[List[int]] = None):
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.max_seq = max_seq or cfg.max_seq_len
+        if prefill_buckets is None:
+            prefill_buckets, b = [], 64
+            while b < self.max_seq:
+                prefill_buckets.append(b)
+                b *= 2
+            prefill_buckets.append(self.max_seq)
+        self.prefill_buckets = prefill_buckets
+
+        cfg_ = cfg
+
+        @functools.partial(jax.jit, static_argnames=("bucket",))
+        def _prefill(params, padded: jax.Array, true_len: jax.Array,
+                     temperature, top_k, top_p, key, bucket: int):
+            cache = llama.KVCache(
+                k=jnp.zeros((cfg_.num_layers, 1, bucket, cfg_.num_kv_heads,
+                             cfg_.head_dim), cfg_.dtype),
+                v=jnp.zeros((cfg_.num_layers, 1, bucket, cfg_.num_kv_heads,
+                             cfg_.head_dim), cfg_.dtype),
+                index=jnp.zeros((), jnp.int32))
+            logits, new_cache = llama.forward(params, cfg_, padded,
+                                              cache=cache)
+            # last REAL token's logits (right padding occupies the tail)
+            last = jnp.take_along_axis(
+                logits, (true_len - 1)[:, None, None], axis=1)[:, 0]
+            tok = sample(last, key, temperature, top_k, top_p)
+            return tok[0], new_cache.k, new_cache.v
+
+        @functools.partial(jax.jit, donate_argnums=(0,),
+                           static_argnames=("bucket",))
+        def _insert(state: DecodeState, kv_k, kv_v, slot: jax.Array,
+                    true_len: jax.Array, token: jax.Array, bucket: int):
+            keep = min(bucket, self.max_seq)
+            k = lax.dynamic_update_slice(
+                state.k, kv_k[:, :, :keep], (0, slot, 0, 0, 0))
+            v = lax.dynamic_update_slice(
+                state.v, kv_v[:, :, :keep], (0, slot, 0, 0, 0))
+            return DecodeState(
+                k=k, v=v,
+                lengths=state.lengths.at[slot].set(true_len),
+                tokens=state.tokens.at[slot].set(token))
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _decode(params, state: DecodeState, temperature, top_k, top_p,
+                    key) -> Tuple[DecodeState, jax.Array]:
+            cache = llama.KVCache(k=state.k, v=state.v, index=state.lengths)
+            logits, new_cache = llama.forward(
+                params, cfg_, state.tokens[:, None], cache=cache)
+            toks = sample(logits[:, -1], key, temperature, top_k, top_p)
+            return DecodeState(k=new_cache.k, v=new_cache.v,
+                               lengths=new_cache.index,
+                               tokens=toks), toks
+
+        self._prefill_fn = _prefill
+        self._insert_fn = _insert
+        self._decode_fn = _decode
+        self._step = 0
+        self._root_key = jax.random.PRNGKey(0)
+
+    # -- state ---------------------------------------------------------
+
+    def new_state(self) -> DecodeState:
+        L, B, S = self.cfg.num_layers, self.max_slots, self.max_seq
+        shape = (L, B, S, self.cfg.num_kv_heads, self.cfg.head_dim)
+        return DecodeState(
+            k=jnp.zeros(shape, self.cfg.dtype),
+            v=jnp.zeros(shape, self.cfg.dtype),
+            lengths=jnp.zeros((B,), jnp.int32),
+            tokens=jnp.zeros((B,), jnp.int32))
+
+    # -- ops -----------------------------------------------------------
+
+    def prefill(self, prompt_ids: List[int], temperature: float = 0.0,
+                top_k: int = 0, top_p: float = 1.0):
+        """Returns (first_token:int, kv pair, true_len, bucket)."""
+        # leave room for one generated token; cap at the largest bucket
+        max_prompt = min(self.max_seq - 1, self.prefill_buckets[-1])
+        ids = prompt_ids[-max_prompt:]
+        bucket = _bucketize(len(ids), self.prefill_buckets)
+        padded = jnp.asarray(
+            [ids + [0] * (bucket - len(ids))], jnp.int32)
+        self._step += 1
+        key = jax.random.fold_in(self._root_key, self._step)
+        tok, k, v = self._prefill_fn(
+            self.params, padded, jnp.asarray([len(ids)], jnp.int32),
+            jnp.asarray([temperature], jnp.float32),
+            jnp.asarray([top_k], jnp.int32),
+            jnp.asarray([top_p], jnp.float32), key, bucket=bucket)
+        return int(tok), (k, v), len(ids), bucket
+
+    def insert(self, state: DecodeState, kv, slot: int, true_len: int,
+               token: int, bucket: int) -> DecodeState:
+        return self._insert_fn(
+            state, kv[0], kv[1], jnp.asarray(slot, jnp.int32),
+            jnp.asarray(true_len, jnp.int32),
+            jnp.asarray(token, jnp.int32), bucket=bucket)
+
+    def decode(self, state: DecodeState, temperature, top_k, top_p,
+               ) -> Tuple[DecodeState, jax.Array]:
+        """One decode step for ALL slots. Sampling params: [B] arrays."""
+        self._step += 1
+        key = jax.random.fold_in(self._root_key, self._step)
+        return self._decode_fn(self.params, state,
+                               jnp.asarray(temperature, jnp.float32),
+                               jnp.asarray(top_k, jnp.int32),
+                               jnp.asarray(top_p, jnp.float32), key)

@@ -1,0 +1,57 @@
+"""Token sampling — per-slot parameters, fully vectorized.
+
+Each decode step samples one token per batch slot. Because slots in the
+continuous-batching engine belong to different requests, temperature /
+top-k / top-p are [B] vectors rather than scalars, and everything is
+computed with static shapes (sort + mask, no data-dependent gathers) so
+the whole step stays inside one compiled XLA program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1.0e30
+
+
+def sample(logits: jax.Array, key: jax.Array, temperature: jax.Array,
+           top_k: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Sample next tokens.
+
+    logits: [B, V] float; temperature/top_k/top_p: [B]
+    (temperature<=0 means greedy; top_k<=0 disables top-k;
+    top_p>=1 disables nucleus filtering).
+    Returns [B] int32.
+    """
+    logits = logits.astype(jnp.float32)
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # scale by temperature (guard the greedy rows against div-by-zero)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
+    scaled = logits / safe_t
+
+    # one descending ordering; both filters are rank-based prefix masks
+    # scattered back by rank — never probability-threshold comparisons,
+    # which are brittle to softmax rounding across recomputations
+    order = jnp.argsort(scaled, axis=-1)[:, ::-1]  # [B, V] desc indices
+    sorted_logits = jnp.take_along_axis(scaled, order, axis=-1)
+
+    ranks = jnp.arange(V)[None, :]
+    # top-k: keep the first k ranks (top_k<=0 disables)
+    keep_k = jnp.where(top_k[:, None] > 0, ranks < top_k[:, None], True)
+
+    # top-p (nucleus): smallest prefix of the sorted distribution whose
+    # mass reaches top_p — a rank is kept if the mass before it is < top_p
+    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+    cumulative = jnp.cumsum(probs_sorted, axis=-1)
+    keep_p = (cumulative - probs_sorted) < top_p[:, None]
+
+    keep_sorted = keep_k & keep_p  # rank 0 always survives both
+    keep = jax.vmap(
+        lambda o, m: jnp.zeros((V,), bool).at[o].set(m))(order, keep_sorted)
+    scaled = jnp.where(keep, scaled, NEG_INF)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, sampled, greedy)

@@ -1,0 +1,211 @@
+"""Continuous-batching scheduler.
+
+Host-side orchestration around InferenceEngine's three compiled
+programs: admit pending requests into free slots (prefill + insert),
+then run decode steps for the whole batch, streaming tokens out to
+per-request queues. One scheduler thread drives the device; request
+threads (HTTP handlers) only touch queues.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core import DecodeState, InferenceEngine
+
+_ids = itertools.count()
+
+
+@dataclass
+class Request:
+    prompt_ids: List[int]
+    max_new_tokens: int = 64
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    stop_ids: Sequence[int] = ()
+    id: int = field(default_factory=lambda: next(_ids))
+    created: float = field(default_factory=time.monotonic)
+    # results
+    output_ids: List[int] = field(default_factory=list)
+    finish_reason: Optional[str] = None
+    first_token_at: Optional[float] = None
+    done: threading.Event = field(default_factory=threading.Event)
+    stream: "queue.Queue[Optional[int]]" = field(
+        default_factory=queue.Queue)  # token ids; None = EOS sentinel
+
+    def emit(self, token: int):
+        if self.first_token_at is None:
+            self.first_token_at = time.monotonic()
+        self.output_ids.append(token)
+        self.stream.put(token)
+
+    def finish(self, reason: str):
+        self.finish_reason = reason
+        self.stream.put(None)
+        self.done.set()
+
+    def wait_output(self, timeout: Optional[float] = None) -> List[int]:
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"request {self.id} timed out")
+        return self.output_ids
+
+
+class Scheduler:
+    """Drives one InferenceEngine; thread-safe submit()."""
+
+    def __init__(self, engine: InferenceEngine, max_pending: int = 512):
+        self.engine = engine
+        self.state: DecodeState = engine.new_state()
+        self.pending: "queue.Queue[Request]" = queue.Queue(max_pending)
+        self.slots: List[Optional[Request]] = [None] * engine.max_slots
+        B = engine.max_slots
+        self._temp = np.zeros(B, np.float32)
+        self._top_k = np.zeros(B, np.int32)
+        self._top_p = np.ones(B, np.float32)
+        self._true_len = np.zeros(B, np.int32)  # admitted prompt len/slot
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()  # guards submit-vs-stop + stats
+        self.healthy = True
+        self.stats: Dict[str, float] = {
+            "requests_total": 0, "tokens_generated_total": 0,
+            "prefill_total": 0, "decode_steps_total": 0,
+            "queue_depth": 0, "active_slots": 0,
+        }
+
+    def _inc(self, key: str, by: float = 1):
+        with self._lock:
+            self.stats[key] += by
+
+    # -- public --------------------------------------------------------
+
+    def submit(self, req: Request) -> Request:
+        # the lock makes submit-vs-stop atomic: a request either gets
+        # queued before the shutdown drain, or is rejected here
+        with self._lock:
+            if self._stop.is_set() or not self.healthy:
+                raise RuntimeError("scheduler unavailable")
+            self.stats["requests_total"] += 1
+            self.pending.put_nowait(req)  # Full propagates -> HTTP 503
+        return req
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run,
+                                        name="ome-scheduler", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        with self._lock:
+            self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        self._fail_all("shutdown")
+
+    def _fail_all(self, reason: str):
+        with self._lock:
+            while True:
+                try:
+                    self.pending.get_nowait().finish(reason)
+                except queue.Empty:
+                    break
+            for slot, r in enumerate(self.slots):
+                if r is not None:
+                    self.slots[slot] = None
+                    r.finish(reason)
+
+    # -- core loop -----------------------------------------------------
+
+    def step(self) -> bool:
+        """One admission + decode round; returns True if work was done."""
+        admitted = self._admit()
+        decoded = self._decode()
+        with self._lock:
+            self.stats["queue_depth"] = self.pending.qsize()
+            self.stats["active_slots"] = sum(
+                r is not None for r in self.slots)
+        return admitted or decoded
+
+    def _admit(self) -> bool:
+        did = False
+        for slot, occupant in enumerate(self.slots):
+            if occupant is not None:
+                continue
+            try:
+                req = self.pending.get_nowait()
+            except queue.Empty:
+                break
+            try:
+                tok, kv, true_len, bucket = self.engine.prefill(
+                    req.prompt_ids, req.temperature, req.top_k, req.top_p)
+                self.state = self.engine.insert(
+                    self.state, kv, slot, true_len, tok, bucket)
+            except Exception:
+                # req is out of the queue but not yet slotted — _fail_all
+                # cannot see it, so fail it here before propagating
+                req.finish("error")
+                raise
+            self.slots[slot] = req
+            self._temp[slot] = req.temperature
+            self._top_k[slot] = req.top_k
+            self._top_p[slot] = req.top_p
+            self._true_len[slot] = true_len
+            self._inc("prefill_total")
+            req.emit(tok)
+            self._maybe_finish(slot, tok)
+            did = True
+        return did
+
+    def _decode(self) -> bool:
+        if not any(r is not None for r in self.slots):
+            return False
+        self.state, toks = self.engine.decode(
+            self.state, self._temp, self._top_k, self._top_p)
+        self._inc("decode_steps_total")
+        host_toks = np.asarray(toks)
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            tok = int(host_toks[slot])
+            req.emit(tok)
+            self._inc("tokens_generated_total")
+            self._maybe_finish(slot, tok)
+        return True
+
+    def _maybe_finish(self, slot: int, tok: int):
+        req = self.slots[slot]
+        if tok in req.stop_ids:
+            reason = "stop"
+        elif len(req.output_ids) >= req.max_new_tokens:
+            reason = "length"
+        elif (int(self._true_len[slot]) + len(req.output_ids)
+              >= self.engine.max_seq):
+            # cache capacity: the slot was admitted with the (possibly
+            # truncated) true_len rows, +1 row per generated token
+            reason = "length"
+        else:
+            return
+        self.slots[slot] = None
+        self._temp[slot] = 0.0
+        req.finish(reason)
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                if not self.step():
+                    time.sleep(0.001)
+            except Exception:  # noqa: BLE001 — a dead loop must not
+                # leave waiters hanging or /health lying
+                import logging
+                logging.getLogger("ome.engine").exception(
+                    "scheduler step failed; failing in-flight requests")
+                self.healthy = False
+                self._fail_all("error")
+                return

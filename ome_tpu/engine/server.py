@@ -1,0 +1,207 @@
+"""OpenAI-compatible HTTP serving front-end.
+
+The surface the reference's runtimes expose from their engine containers
+(SGLang/vLLM serve /v1/completions, /v1/chat/completions, /health,
+/metrics — probed by multinode-prober and scraped for KEDA autoscaling);
+here it fronts the in-repo JAX engine. stdlib http.server keeps the
+dependency footprint zero; a threading server is plenty because request
+handlers only enqueue work and read token queues — the device is driven
+by the single scheduler thread.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .scheduler import Request, Scheduler
+from .tokenizer import load_tokenizer
+
+
+class EngineServer:
+    def __init__(self, scheduler: Scheduler, tokenizer=None,
+                 model_name: str = "ome-model", host: str = "127.0.0.1",
+                 port: int = 0):
+        self.scheduler = scheduler
+        self.tokenizer = tokenizer or load_tokenizer()
+        self.model_name = model_name
+        self.started_at = time.time()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            # -- helpers ----------------------------------------------
+            def _json(self, code: int, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            # -- GET --------------------------------------------------
+            def do_GET(self):
+                if self.path in ("/health", "/healthz", "/ready"):
+                    healthy = outer.scheduler.healthy
+                    self._json(200 if healthy else 503, {
+                        "status": "ok" if healthy else "unhealthy",
+                        "uptime_s": round(
+                            time.time() - outer.started_at, 1)})
+                elif self.path == "/v1/models":
+                    self._json(200, {"object": "list", "data": [{
+                        "id": outer.model_name, "object": "model",
+                        "owned_by": "ome-tpu"}]})
+                elif self.path == "/metrics":
+                    lines = []
+                    for k, v in outer.scheduler.stats.items():
+                        name = f"ome_engine_{k}"
+                        lines.append(f"# TYPE {name} gauge")
+                        lines.append(f"{name} {v}")
+                    body = ("\n".join(lines) + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._json(404, {"error": "not found"})
+
+            # -- POST -------------------------------------------------
+            def do_POST(self):
+                try:
+                    payload = self._body()
+                except Exception as e:
+                    return self._json(400, {"error": str(e)})
+                if self.path == "/v1/completions":
+                    return self._complete(payload, chat=False)
+                if self.path == "/v1/chat/completions":
+                    return self._complete(payload, chat=True)
+                self._json(404, {"error": "not found"})
+
+            def _complete(self, payload, chat: bool):
+                tok = outer.tokenizer
+                if chat:
+                    prompt = tok.apply_chat_template(
+                        payload.get("messages", []))
+                else:
+                    prompt = payload.get("prompt", "")
+                    if isinstance(prompt, list):
+                        prompt = "".join(prompt)
+                req = Request(
+                    prompt_ids=tok.encode(prompt),
+                    max_new_tokens=int(payload.get("max_tokens", 64)),
+                    temperature=float(payload.get("temperature", 0.0)),
+                    top_k=int(payload.get("top_k", 0)),
+                    top_p=float(payload.get("top_p", 1.0)),
+                    stop_ids=[tok.eos_id] if tok.eos_id is not None else [])
+                try:
+                    outer.scheduler.submit(req)
+                except Exception as e:
+                    return self._json(503, {"error": str(e)})
+                if payload.get("stream"):
+                    return self._stream(req, chat)
+                req.done.wait()
+                text = tok.decode(req.output_ids)
+                usage = {"prompt_tokens": len(req.prompt_ids),
+                         "completion_tokens": len(req.output_ids),
+                         "total_tokens": len(req.prompt_ids)
+                         + len(req.output_ids)}
+                if chat:
+                    choice = {"index": 0, "message": {
+                        "role": "assistant", "content": text},
+                        "finish_reason": req.finish_reason}
+                    obj = "chat.completion"
+                else:
+                    choice = {"index": 0, "text": text,
+                              "finish_reason": req.finish_reason}
+                    obj = "text_completion"
+                self._json(200, {
+                    "id": f"cmpl-{req.id}", "object": obj,
+                    "created": int(time.time()),
+                    "model": outer.model_name,
+                    "choices": [choice], "usage": usage})
+
+            def _stream(self, req: Request, chat: bool):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(data: bytes):
+                    self.wfile.write(f"{len(data):x}\r\n".encode()
+                                     + data + b"\r\n")
+
+                tok = outer.tokenizer
+
+                def send_delta(delta: str):
+                    if chat:
+                        d = {"delta": {"content": delta}, "index": 0,
+                             "finish_reason": None}
+                    else:
+                        d = {"text": delta, "index": 0,
+                             "finish_reason": None}
+                    ev = {"id": f"cmpl-{req.id}",
+                          "object": "chat.completion.chunk" if chat
+                          else "text_completion",
+                          "model": outer.model_name, "choices": [d]}
+                    chunk(f"data: {json.dumps(ev)}\n\n".encode())
+
+                emitted = 0
+                sent_text = ""
+                while True:
+                    t = req.stream.get()
+                    last = t is None
+                    if not last:
+                        emitted += 1
+                    full = tok.decode(req.output_ids[:emitted])
+                    if last:
+                        stable = full  # flush everything at EOS
+                    else:
+                        # hold back trailing replacement chars — they are
+                        # usually a multi-byte char split across tokens
+                        # that the next token will complete
+                        stable = full.rstrip("�")
+                    if not stable.startswith(sent_text):
+                        sent_text = ""  # re-sync (should not happen)
+                    delta, sent_text = stable[len(sent_text):], stable
+                    if delta:
+                        send_delta(delta)
+                    if last:
+                        break
+                done = {"id": f"cmpl-{req.id}", "choices": [{
+                    "index": 0,
+                    "delta" if chat else "text": {} if chat else "",
+                    "finish_reason": req.finish_reason}]}
+                chunk(f"data: {json.dumps(done)}\n\n".encode())
+                chunk(b"data: [DONE]\n\n")
+                chunk(b"")  # terminal chunk
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self.scheduler.start()
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="ome-http", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.scheduler.stop()
+        if self._thread:
+            self._thread.join(timeout=5)

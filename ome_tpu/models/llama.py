@@ -31,8 +31,11 @@ Params = Dict[str, Any]
 class KVCache:
     """Fixed-capacity per-layer KV cache.
 
-    k, v: [L, B, S_max, K, Dh]; index: scalar int32 next-write slot
-    (the serving engine's paged cache builds on the same layout).
+    k, v: [L, B, S_max, K, Dh]; index: next-write position — scalar
+    int32 (shared by the whole batch: training-style chunked prefill)
+    or [B] int32 (per-slot write positions: the serving engine's
+    continuous-batching decode, where every slot is at a different
+    sequence length).
     """
 
     k: jax.Array
@@ -213,8 +216,19 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
 
     if cache_kv is not None:
         ck, cv = cache_kv
-        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
+        if cache_index.ndim == 1:
+            # per-slot write positions (continuous batching): vmap the
+            # update over the batch so each slot writes at its own length
+            upd = jax.vmap(
+                lambda c, u, i: lax.dynamic_update_slice(
+                    c, u.astype(c.dtype), (i, 0, 0)))
+            ck = upd(ck, k, cache_index)
+            cv = upd(cv, v, cache_index)
+        else:
+            ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                          (0, cache_index, 0, 0))
+            cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                          (0, cache_index, 0, 0))
         k_full, v_full = ck, cv
         new_cache = (ck, cv)
     else:
@@ -244,8 +258,10 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     B, S = tokens.shape
     if positions is None:
         base = jnp.arange(S, dtype=jnp.int32)[None, :]
-        positions = jnp.broadcast_to(base + (cache.index if cache is not None else 0),
-                                     (B, S))
+        if cache is not None:
+            idx = cache.index
+            base = base + (idx[:, None] if idx.ndim == 1 else idx)
+        positions = jnp.broadcast_to(base, (B, S))
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
     freqs = _rope_frequencies(cfg)
 
