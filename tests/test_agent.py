@@ -1,0 +1,240 @@
+"""ome-agent subsystem tests: enigma round-trips + tamper detection,
+replication matrix over local stores, serving-agent adapter lifecycle,
+metadata extraction, and binary-level CLI behavior (the reference's
+integration suite builds and drives the real ome-agent binary —
+tests/agent_integration_test.go)."""
+
+import json
+import os
+import subprocess
+import sys
+import zipfile
+
+import pytest
+
+from ome_tpu.agent import (AdapterInfo, EnigmaError, LocalKMS, Replicator,
+                           ServingAgent, decrypt_dir, encrypt_dir,
+                           extract_metadata)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_model_dir(d, payload=b""):
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "config.json"), "w") as f:
+        json.dump({"model_type": "llama",
+                   "architectures": ["LlamaForCausalLM"],
+                   "vocab_size": 512, "hidden_size": 64,
+                   "num_hidden_layers": 2, "num_attention_heads": 4,
+                   "num_key_value_heads": 2, "intermediate_size": 128,
+                   "max_position_embeddings": 2048}, f)
+    with open(os.path.join(d, "model.safetensors"), "wb") as f:
+        f.write(payload or os.urandom(300_000))
+
+
+class TestEnigma:
+    def test_roundtrip(self, tmp_path):
+        src = tmp_path / "model"
+        make_model_dir(src)
+        kms = LocalKMS(str(tmp_path / "master.key"), create=True)
+        n = encrypt_dir(str(src), str(tmp_path / "enc"), kms)
+        assert n == 2
+        # ciphertext differs from plaintext
+        enc = (tmp_path / "enc" / "model.safetensors.enc").read_bytes()
+        plain = (src / "model.safetensors").read_bytes()
+        assert plain not in enc
+        m = decrypt_dir(str(tmp_path / "enc"), str(tmp_path / "dec"), kms)
+        assert m == 2
+        assert (tmp_path / "dec" / "model.safetensors").read_bytes() \
+            == plain
+
+    def test_tamper_detected(self, tmp_path):
+        src = tmp_path / "model"
+        make_model_dir(src)
+        kms = LocalKMS(str(tmp_path / "master.key"), create=True)
+        encrypt_dir(str(src), str(tmp_path / "enc"), kms)
+        p = tmp_path / "enc" / "model.safetensors.enc"
+        raw = bytearray(p.read_bytes())
+        raw[-10] ^= 0xFF  # flip a ciphertext byte
+        p.write_bytes(bytes(raw))
+        with pytest.raises(EnigmaError):
+            decrypt_dir(str(tmp_path / "enc"), str(tmp_path / "dec"), kms)
+
+    def test_header_tamper_detected(self, tmp_path):
+        """Frames bind the header via AAD: patching orig_size (e.g. to
+        hide a truncated-weights attack) must fail authentication."""
+        import struct
+        src = tmp_path / "model"
+        make_model_dir(src)
+        kms = LocalKMS(str(tmp_path / "master.key"), create=True)
+        encrypt_dir(str(src), str(tmp_path / "enc"), kms)
+        p = tmp_path / "enc" / "model.safetensors.enc"
+        raw = p.read_bytes()
+        magic = b"OMEENC1\n"
+        (hlen,) = struct.unpack("<I", raw[len(magic):len(magic) + 4])
+        hstart = len(magic) + 4
+        header = json.loads(raw[hstart:hstart + hlen])
+        header["orig_size"] = 1  # attacker-patched header
+        new_header = json.dumps(header).encode().ljust(hlen)[:hlen]
+        p.write_bytes(raw[:hstart] + new_header + raw[hstart + hlen:])
+        with pytest.raises(EnigmaError):
+            decrypt_dir(str(tmp_path / "enc"), str(tmp_path / "dec"), kms)
+
+    def test_wrong_key_rejected(self, tmp_path):
+        src = tmp_path / "model"
+        make_model_dir(src)
+        kms1 = LocalKMS(str(tmp_path / "k1.key"), create=True)
+        kms2 = LocalKMS(str(tmp_path / "k2.key"), create=True)
+        encrypt_dir(str(src), str(tmp_path / "enc"), kms1)
+        with pytest.raises(EnigmaError):
+            decrypt_dir(str(tmp_path / "enc"), str(tmp_path / "dec"),
+                        kms2)
+
+
+class TestReplica:
+    def test_local_to_local(self, tmp_path):
+        src = tmp_path / "src"
+        make_model_dir(src)
+        rep = Replicator()
+        res = rep.replicate(f"local://{src}",
+                            f"local://{tmp_path / 'dst'}")
+        assert res.files == 2
+        assert (tmp_path / "dst" / "model.safetensors").exists()
+
+    def test_pvc_to_pvc(self, tmp_path):
+        pvc_root = tmp_path / "pvc"
+        src = pvc_root / "claim-a" / "models" / "m"
+        make_model_dir(src)
+        rep = Replicator(pvc_mount_root=str(pvc_root))
+        res = rep.replicate("pvc://claim-a/models/m",
+                            "pvc://claim-b/models/m")
+        assert res.files == 2
+        assert (pvc_root / "claim-b" / "models" / "m"
+                / "config.json").exists()
+
+    def test_hf_not_a_target(self, tmp_path):
+        src = tmp_path / "src"
+        make_model_dir(src)
+        with pytest.raises(ValueError):
+            Replicator().replicate(f"local://{src}", "hf://org/repo")
+
+
+class TestServingAgent:
+    def _info(self, path, entries):
+        with open(path, "w") as f:
+            json.dump(entries, f)
+
+    def test_adapter_load_update_unload(self, tmp_path):
+        adapter_src = tmp_path / "adapter-src"
+        os.makedirs(adapter_src)
+        (adapter_src / "adapter_model.bin").write_bytes(b"weights-v1")
+        info = tmp_path / "info.json"
+        self._info(info, [{"name": "ft1",
+                           "storageUri": f"local://{adapter_src}"}])
+        agent = ServingAgent(str(info), str(tmp_path / "adapters"))
+        assert agent.sync()
+        assert (tmp_path / "adapters" / "ft1"
+                / "adapter_model.bin").read_bytes() == b"weights-v1"
+        # same spec -> no-op
+        assert not agent.sync()
+        # removal -> unload
+        self._info(info, [])
+        assert agent.sync()
+        assert not (tmp_path / "adapters" / "ft1").exists()
+
+    def test_zip_adapter_extracted(self, tmp_path):
+        zsrc = tmp_path / "zip-src"
+        os.makedirs(zsrc)
+        with zipfile.ZipFile(zsrc / "adapter.zip", "w") as z:
+            z.writestr("adapter_config.json", "{}")
+            z.writestr("weights/adapter.bin", "wv2")
+        info = tmp_path / "info.json"
+        self._info(info, [{"name": "ftz",
+                           "storageUri": f"local://{zsrc}"}])
+        agent = ServingAgent(str(info), str(tmp_path / "adapters"))
+        agent.sync()
+        assert (tmp_path / "adapters" / "ftz"
+                / "weights" / "adapter.bin").read_text() == "wv2"
+
+    def test_zip_slip_blocked(self, tmp_path):
+        zsrc = tmp_path / "evil-src"
+        os.makedirs(zsrc)
+        with zipfile.ZipFile(zsrc / "adapter.zip", "w") as z:
+            z.writestr("../../evil.txt", "pwned")
+        info = tmp_path / "info.json"
+        self._info(info, [{"name": "evil",
+                           "storageUri": f"local://{zsrc}"}])
+        agent = ServingAgent(str(info), str(tmp_path / "adapters"))
+        with pytest.raises(ValueError):
+            agent._load(AdapterInfo(name="evil",
+                                    storage_uri=f"local://{zsrc}"))
+        assert not (tmp_path / "evil.txt").exists()
+
+
+class TestMetadata:
+    def test_extract(self, tmp_path):
+        make_model_dir(tmp_path / "m")
+        meta = extract_metadata(str(tmp_path / "m"))
+        assert meta["architecture"] == "LlamaForCausalLM"
+        assert meta["parameter_size"]
+
+
+class TestCLI:
+    """Binary-level integration (reference: tests/ drives the built
+    ome-agent binary; here the binary is `python -m ome_tpu.agent`)."""
+
+    def run_cli(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "ome_tpu.agent", *args],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=120)
+
+    def test_enigma_roundtrip_cli(self, tmp_path):
+        make_model_dir(tmp_path / "m")
+        key = str(tmp_path / "k.key")
+        r = self.run_cli("enigma", "encrypt", "--input",
+                         str(tmp_path / "m"), "--output",
+                         str(tmp_path / "enc"), "--keyfile", key)
+        assert r.returncode == 0, r.stderr
+        assert json.loads(r.stdout)["files"] == 2
+        r = self.run_cli("enigma", "decrypt", "--input",
+                         str(tmp_path / "enc"), "--output",
+                         str(tmp_path / "dec"), "--keyfile", key)
+        assert r.returncode == 0, r.stderr
+        assert (tmp_path / "dec" / "model.safetensors").read_bytes() == \
+            (tmp_path / "m" / "model.safetensors").read_bytes()
+
+    def test_replica_cli(self, tmp_path):
+        make_model_dir(tmp_path / "src")
+        r = self.run_cli("replica", "--source",
+                         f"local://{tmp_path / 'src'}",
+                         "--target", f"local://{tmp_path / 'dst'}")
+        assert r.returncode == 0, r.stderr
+        assert json.loads(r.stdout)["files"] == 2
+
+    def test_model_metadata_cli(self, tmp_path):
+        make_model_dir(tmp_path / "m")
+        out = str(tmp_path / "meta.json")
+        r = self.run_cli("model-metadata", "--model-dir",
+                         str(tmp_path / "m"), "--out-file", out)
+        assert r.returncode == 0, r.stderr
+        assert json.load(open(out))["architecture"] == "LlamaForCausalLM"
+
+    def test_serving_agent_once_cli(self, tmp_path):
+        asrc = tmp_path / "a"
+        os.makedirs(asrc)
+        (asrc / "w.bin").write_bytes(b"x")
+        info = tmp_path / "info.json"
+        info.write_text(json.dumps(
+            [{"name": "f", "storageUri": f"local://{asrc}"}]))
+        r = self.run_cli("serving-agent", "--info-file", str(info),
+                         "--adapters-dir", str(tmp_path / "out"),
+                         "--once")
+        assert r.returncode == 0, r.stderr
+        assert (tmp_path / "out" / "f" / "w.bin").exists()
+
+    def test_bad_args_exit_nonzero(self):
+        r = self.run_cli("replica", "--source", "notauri",
+                         "--target", "alsonot")
+        assert r.returncode == 1
+        assert "error" in r.stderr
