@@ -35,6 +35,7 @@ SERVING_SIDECAR_ANNOTATION = f"{GROUP}/inject-serving-sidecar"
 TPU_INJECT_ANNOTATION = f"tpu.{GROUP}/auto-inject"       # rdma.ome.io analog
 TPU_PROFILE_ANNOTATION = f"tpu.{GROUP}/profile"          # podslice | multislice
 TPU_CONTAINER_ANNOTATION = f"tpu.{GROUP}/container-name"
+METRICS_AGGREGATION_ANNOTATION = f"{GROUP}/enable-metric-aggregation"
 PROMETHEUS_SCRAPE_ANNOTATION = "prometheus.io/scrape"
 PROMETHEUS_PORT_ANNOTATION = "prometheus.io/port"
 

@@ -16,8 +16,9 @@ from ..apis import v1
 from ..core.client import InMemoryClient
 from ..core.errors import ConflictError, NotFoundError
 from ..core.k8s import (ConfigMap, Deployment, HorizontalPodAutoscaler,
-                        Ingress, LeaderWorkerSet, PodDisruptionBudget,
-                        ScaledObject, Service)
+                        Ingress, KnativeService, LeaderWorkerSet,
+                        PodDisruptionBudget, Role, RoleBinding,
+                        ScaledObject, Service, ServiceAccount)
 from ..core.manager import Reconciler, Result
 from ..core.meta import Condition, set_condition
 from ..selection.accelerator_selector import (AcceleratorChoice,
@@ -31,6 +32,8 @@ from .reconcilers import modelconfig as modelconfig_mod
 from .reconcilers.common import delete_if_exists
 from .reconcilers.multinode import reconcile_multinode
 from .reconcilers.raw import reconcile_raw
+from .reconcilers.rbac import rbac_name, reconcile_rbac
+from .reconcilers.serverless import reconcile_serverless
 
 
 class ModelNotFoundError(NotFoundError):
@@ -70,7 +73,7 @@ class InferenceServiceReconciler(Reconciler):
     def owns(self):
         return [Deployment, Service, ConfigMap, LeaderWorkerSet,
                 HorizontalPodAutoscaler, ScaledObject, PodDisruptionBudget,
-                Ingress]
+                Ingress, KnativeService, ServiceAccount, Role, RoleBinding]
 
     def watches(self):
         def models_to_isvcs(obj):
@@ -155,10 +158,17 @@ class InferenceServiceReconciler(Reconciler):
                              else None),
                 mode=mode)
             plan = components.build_component(ctx, component, spec)
+            if component == v1.ROUTER:
+                # router discovers PD backends via the API server
+                plan.pod_spec.service_account_name = reconcile_rbac(
+                    self.client, isvc, plan)
             if mode == v1.DeploymentMode.MULTI_NODE.value:
                 reconcile_multinode(self.client, isvc, plan)
+            elif mode == v1.DeploymentMode.SERVERLESS.value:
+                reconcile_serverless(self.client, isvc, plan)
             else:
                 reconcile_raw(self.client, isvc, plan)
+            self._cleanup_other_modes(isvc, plan.name, mode)
             built[component] = plan
 
         if not built:
@@ -213,13 +223,35 @@ class InferenceServiceReconciler(Reconciler):
                 accelerator = None  # CPU-only runtime is legitimate
         return runtime_spec, accelerator
 
+    def _cleanup_other_modes(self, isvc: v1.InferenceService, name: str,
+                             mode: str):
+        """A component that changed deployment mode must not leave the
+        previous mode's workload running (mirrors the ingress
+        reconciler's delete-other-strategies pass)."""
+        ns = isvc.metadata.namespace
+        if mode != v1.DeploymentMode.MULTI_NODE.value:
+            delete_if_exists(self.client, LeaderWorkerSet, name, ns)
+        if mode != v1.DeploymentMode.SERVERLESS.value:
+            delete_if_exists(self.client, KnativeService, name, ns)
+        if mode in (v1.DeploymentMode.MULTI_NODE.value,
+                    v1.DeploymentMode.SERVERLESS.value):
+            # raw-mode children (multinode keeps its own Service)
+            delete_if_exists(self.client, Deployment, name, ns)
+            for cls in (HorizontalPodAutoscaler, ScaledObject,
+                        PodDisruptionBudget):
+                delete_if_exists(self.client, cls, name, ns)
+            if mode == v1.DeploymentMode.SERVERLESS.value:
+                delete_if_exists(self.client, Service, name, ns)
+
     def _cleanup_component(self, isvc: v1.InferenceService, component: str):
         name = components.component_name(isvc.metadata.name, component)
         ns = isvc.metadata.namespace
         for cls in (Deployment, LeaderWorkerSet, Service,
                     HorizontalPodAutoscaler, ScaledObject,
-                    PodDisruptionBudget):
+                    PodDisruptionBudget, KnativeService):
             delete_if_exists(self.client, cls, name, ns)
+        for cls in (ServiceAccount, Role, RoleBinding):
+            delete_if_exists(self.client, cls, rbac_name(name), ns)
 
     def _finalize(self, isvc: v1.InferenceService) -> Result:
         """Children are owner-referenced; GC cascades on delete."""

@@ -1,0 +1,45 @@
+"""RBAC reconciler for the router component.
+
+Re-designs reconcilers/rbac: the router (PD request dispatcher) finds
+its engine/decoder backends through the Kubernetes API (endpoint
+discovery by component labels — deepseek-rdma-pd-rt.yaml:490-515), so
+it needs a ServiceAccount bound to a namespaced Role that can read
+pods/services/endpoints. Engine/decoder pods get no API access.
+"""
+
+from __future__ import annotations
+
+from ...apis import v1
+from ...core.client import InMemoryClient
+from ...core.k8s import Role, RoleBinding, ServiceAccount
+from ..components import ComponentPlan
+from .common import child_meta, upsert
+
+DISCOVERY_RULES = [{
+    "apiGroups": [""],
+    "resources": ["pods", "services", "endpoints"],
+    "verbs": ["get", "list", "watch"],
+}]
+
+
+def rbac_name(component_name: str) -> str:
+    return f"{component_name}-discovery"
+
+
+def reconcile_rbac(client: InMemoryClient, isvc: v1.InferenceService,
+                   plan: ComponentPlan) -> str:
+    """Stamp SA + Role + RoleBinding; returns the SA name (set on the
+    router pod spec by the caller)."""
+    name = rbac_name(plan.name)
+    upsert(client, isvc, ServiceAccount(
+        metadata=child_meta(isvc, name, plan.labels)))
+    upsert(client, isvc, Role(
+        metadata=child_meta(isvc, name, plan.labels),
+        rules=list(DISCOVERY_RULES)))
+    upsert(client, isvc, RoleBinding(
+        metadata=child_meta(isvc, name, plan.labels),
+        role_ref={"apiGroup": "rbac.authorization.k8s.io",
+                  "kind": "Role", "name": name},
+        subjects=[{"kind": "ServiceAccount", "name": name,
+                   "namespace": isvc.metadata.namespace}]))
+    return name

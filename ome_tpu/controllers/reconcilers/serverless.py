@@ -1,0 +1,97 @@
+"""Serverless reconciler: Knative Service per component.
+
+Re-designs reconcilers/knative (KsvcReconciler): a Serverless-mode
+component becomes a serving.knative.dev/v1 Service whose revision
+template carries the component pod spec plus autoscaling annotations —
+scale bounds from min/max replicas, the scale metric mapped onto the
+KPA/HPA autoscaling classes (concurrency/rps ride Knative's KPA;
+cpu/memory fall back to the HPA class), and the metrics-aggregation
+annotation the qpext sidecar keys on (cmd/qpext: queue-proxy + engine
+metrics on one port for Serverless autoscaling).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ... import constants
+from ...apis import v1
+from ...core.client import InMemoryClient
+from ...core.k8s import KnativeService
+from ...core.serde import to_dict
+from ..components import ComponentPlan
+from .common import child_meta, upsert
+
+AUTOSCALING = "autoscaling.knative.dev"
+
+
+def autoscaling_annotations(plan: ComponentPlan) -> dict:
+    ext = plan.extension
+    # scale-to-zero only when the user explicitly set min_replicas=0 —
+    # unset means 1, like every other mode (raw.py)
+    min_scale = plan.min_replicas if plan.min_replicas is not None else 1
+    ann = {f"{AUTOSCALING}/min-scale": str(min_scale)}
+    if ext.max_replicas:
+        ann[f"{AUTOSCALING}/max-scale"] = str(ext.max_replicas)
+    metric = ext.scale_metric.value if ext.scale_metric else \
+        v1.ScaleMetric.CONCURRENCY.value
+    kpa = metric in (v1.ScaleMetric.CONCURRENCY.value,
+                     v1.ScaleMetric.RPS.value)
+    # concurrency/rps ride Knative's KPA; cpu/memory fall back to HPA
+    ann[f"{AUTOSCALING}/class"] = (
+        "kpa.autoscaling.knative.dev" if kpa
+        else "hpa.autoscaling.knative.dev")
+    ann[f"{AUTOSCALING}/metric"] = metric
+    ann[f"{AUTOSCALING}/target"] = str(ext.scale_target or 100)
+    return ann
+
+
+def build_ksvc(isvc: v1.InferenceService, plan: ComponentPlan,
+               stable_revision: Optional[str] = None) -> KnativeService:
+    ann = dict(plan.annotations)
+    ann.update(autoscaling_annotations(plan))
+    # qpext metrics aggregation contract (cmd/qpext/main.go:26-34)
+    ann[constants.METRICS_AGGREGATION_ANNOTATION] = "true"
+    labels = dict(plan.labels)
+    template = {
+        "metadata": {"labels": labels, "annotations": ann},
+        "spec": {
+            "containerConcurrency": (
+                plan.extension.container_concurrency
+                if getattr(plan.extension, "container_concurrency", None)
+                else 0),
+            **to_dict(plan.pod_spec, keep_empty=False),
+        },
+    }
+    canary = plan.extension.canary_traffic_percent
+    stable = stable_revision or ""
+    if canary and stable:
+        # canary rollout: the LATEST revision takes the canary slice,
+        # the last ready revision (pinned by name — Knative rejects a
+        # nameless latestRevision:false target) keeps the rest
+        traffic = [{"latestRevision": True, "percent": canary},
+                   {"revisionName": stable, "percent": 100 - canary}]
+    else:
+        traffic = [{"latestRevision": True, "percent": 100}]
+    return KnativeService(
+        metadata=child_meta(isvc, plan.name, plan.labels, plan.annotations),
+        spec={"template": template, "traffic": traffic})
+
+
+def ksvc_ready(ksvc: KnativeService) -> bool:
+    conds = (ksvc.status or {}).get("conditions", [])
+    return any(c.get("type") == "Ready" and c.get("status") == "True"
+               for c in conds)
+
+
+def ksvc_url(ksvc: KnativeService) -> Optional[str]:
+    return (ksvc.status or {}).get("url")
+
+
+def reconcile_serverless(client: InMemoryClient, isvc: v1.InferenceService,
+                         plan: ComponentPlan) -> KnativeService:
+    existing = client.try_get(KnativeService, plan.name,
+                              isvc.metadata.namespace)
+    stable = ((existing.status or {}).get("latestReadyRevisionName")
+              if existing is not None else None)
+    return upsert(client, isvc, build_ksvc(isvc, plan, stable))

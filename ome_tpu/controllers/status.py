@@ -13,7 +13,7 @@ from typing import Dict, Optional
 from .. import constants
 from ..apis import v1
 from ..core.client import InMemoryClient
-from ..core.k8s import Deployment, LeaderWorkerSet
+from ..core.k8s import Deployment, KnativeService, LeaderWorkerSet
 from ..core.meta import Condition, set_condition
 
 _COMPONENT_CONDITION = {
@@ -34,6 +34,14 @@ def component_ready(client: InMemoryClient, isvc: v1.InferenceService,
             return True, ""
         return False, (f"{lws.status.ready_replicas}/{lws.spec.replicas} "
                        f"slice groups ready")
+    if mode == v1.DeploymentMode.SERVERLESS.value:
+        from .reconcilers.serverless import ksvc_ready
+        ksvc = client.try_get(KnativeService, name, ns)
+        if ksvc is None:
+            return False, "Knative Service not found"
+        if ksvc_ready(ksvc):
+            return True, ""
+        return False, "Knative Service revision not ready"
     dep = client.try_get(Deployment, name, ns)
     if dep is None:
         return False, "Deployment not found"
@@ -62,8 +70,16 @@ def propagate_status(client: InMemoryClient, isvc: v1.InferenceService,
             type=ctype, status="True" if ready else "False",
             reason="" if ready else "ComponentNotReady", message=reason))
         entry = st.components.get(component) or v1.ComponentStatusSpec()
-        entry.url = (f"http://{name}.{isvc.metadata.namespace}"
-                     f".svc.cluster.local")
+        if mode == v1.DeploymentMode.SERVERLESS.value:
+            # Knative owns the route URL for serverless components
+            from .reconcilers.serverless import ksvc_url
+            ksvc = client.try_get(KnativeService, name,
+                                  isvc.metadata.namespace)
+            entry.url = (ksvc_url(ksvc) if ksvc is not None else None) \
+                or entry.url
+        else:
+            entry.url = (f"http://{name}.{isvc.metadata.namespace}"
+                         f".svc.cluster.local")
         st.components[component] = entry
 
     ingress_ready = url is not None

@@ -354,3 +354,28 @@ class KnativeService(Resource):
     API_VERSION: ClassVar[str] = "serving.knative.dev/v1"
     spec: dict = field(default_factory=dict)
     status: dict = field(default_factory=dict)
+
+
+# --------------------------------------------------------------------------
+# RBAC
+
+
+@dataclass
+class ServiceAccount(Resource):
+    KIND: ClassVar[str] = "ServiceAccount"
+    API_VERSION: ClassVar[str] = "v1"
+
+
+@dataclass
+class Role(Resource):
+    KIND: ClassVar[str] = "Role"
+    API_VERSION: ClassVar[str] = "rbac.authorization.k8s.io/v1"
+    rules: list = field(default_factory=list)
+
+
+@dataclass
+class RoleBinding(Resource):
+    KIND: ClassVar[str] = "RoleBinding"
+    API_VERSION: ClassVar[str] = "rbac.authorization.k8s.io/v1"
+    role_ref: dict = field(default_factory=dict)
+    subjects: list = field(default_factory=list)

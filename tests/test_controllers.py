@@ -360,3 +360,117 @@ class TestPDDisaggregated:
         assert "--tensor-parallel-size" not in rc.args
         assert v1.GKE_TPU_ACCELERATOR_LABEL not in \
             router.spec.template.spec.node_selector
+
+
+# -- serverless + rbac reconcilers ------------------------------------------
+
+
+class TestServerlessReconcile:
+    def test_min_replicas_zero_stamps_knative_service(self, world):
+        from ome_tpu.core.k8s import Deployment, KnativeService
+        client, mgr = world
+        client.create(make_isvc(name="sls", min_replicas=0))
+        reconcile(client, mgr)
+        ksvc = client.get(KnativeService, "sls-engine", "default")
+        ann = ksvc.spec["template"]["metadata"]["annotations"]
+        assert ann["autoscaling.knative.dev/min-scale"] == "0"
+        assert ann[constants.METRICS_AGGREGATION_ANNOTATION] == "true"
+        # no Deployment stamped for a serverless component
+        assert client.try_get(Deployment, "sls-engine", "default") is None
+
+    def test_serverless_ready_via_knative_condition(self, world):
+        from ome_tpu.core.k8s import KnativeService
+        client, mgr = world
+        client.create(make_isvc(name="sls", min_replicas=0))
+        reconcile(client, mgr)
+        isvc = client.get(v1.InferenceService, "sls", "default")
+        assert not isvc.status.is_ready()
+        ksvc = client.get(KnativeService, "sls-engine", "default")
+        ksvc.status = {"conditions": [{"type": "Ready", "status": "True"}],
+                       "url": "http://sls.default.example.com"}
+        client.update_status(ksvc)
+        reconcile(client, mgr)
+        isvc = client.get(v1.InferenceService, "sls", "default")
+        ready = [c for c in isvc.status.conditions
+                 if c.type == v1.ENGINE_READY]
+        assert ready and ready[0].status == "True"
+
+    def test_serverless_autoscaling_metric_classes(self, world):
+        from ome_tpu.core.k8s import KnativeService
+        client, mgr = world
+        isvc = make_isvc(name="sls", min_replicas=0, max_replicas=5)
+        isvc.spec.engine.scale_metric = v1.ScaleMetric.RPS
+        isvc.spec.engine.scale_target = 50
+        client.create(isvc)
+        reconcile(client, mgr)
+        ann = client.get(KnativeService, "sls-engine", "default") \
+            .spec["template"]["metadata"]["annotations"]
+        assert ann["autoscaling.knative.dev/class"] == \
+            "kpa.autoscaling.knative.dev"
+        assert ann["autoscaling.knative.dev/metric"] == "rps"
+        assert ann["autoscaling.knative.dev/max-scale"] == "5"
+
+
+class TestRouterRBAC:
+    def test_router_gets_discovery_rbac(self, world):
+        from ome_tpu.core.k8s import (Deployment, Role, RoleBinding,
+                                      ServiceAccount)
+        client, mgr = world
+        isvc = make_isvc(name="pd")
+        isvc.spec.decoder = v1.EngineSpec()
+        isvc.spec.router = v1.RouterSpec()
+        client.create(isvc)
+        reconcile(client, mgr)
+        sa = client.get(ServiceAccount, "pd-router-discovery", "default")
+        role = client.get(Role, "pd-router-discovery", "default")
+        assert any("endpoints" in r["resources"] for r in role.rules)
+        rb = client.get(RoleBinding, "pd-router-discovery", "default")
+        assert rb.subjects[0]["name"] == sa.metadata.name
+        dep = client.get(Deployment, "pd-router", "default")
+        assert dep.spec.template.spec.service_account_name == \
+            "pd-router-discovery"
+
+    def test_engine_gets_no_rbac(self, world):
+        from ome_tpu.core.k8s import ServiceAccount
+        client, mgr = world
+        client.create(make_isvc(name="plain"))
+        reconcile(client, mgr)
+        assert client.try_get(ServiceAccount, "plain-engine-discovery",
+                              "default") is None
+
+    def test_mode_flip_cleans_up_previous_workload(self, world):
+        from ome_tpu.core.k8s import Deployment, KnativeService, Service
+        client, mgr = world
+        client.create(make_isvc(name="flip", min_replicas=1))
+        reconcile(client, mgr)
+        assert client.try_get(Deployment, "flip-engine", "default")
+        # flip raw -> serverless
+        isvc = client.get(v1.InferenceService, "flip", "default")
+        isvc.spec.engine.min_replicas = 0
+        client.update(isvc)
+        reconcile(client, mgr)
+        assert client.try_get(Deployment, "flip-engine", "default") is None
+        assert client.try_get(Service, "flip-engine", "default") is None
+        assert client.try_get(KnativeService, "flip-engine", "default")
+        # flip back serverless -> raw
+        isvc = client.get(v1.InferenceService, "flip", "default")
+        isvc.spec.engine.min_replicas = 1
+        client.update(isvc)
+        reconcile(client, mgr)
+        assert client.try_get(KnativeService, "flip-engine",
+                              "default") is None
+        assert client.try_get(Deployment, "flip-engine", "default")
+
+    def test_serverless_url_from_knative_route(self, world):
+        from ome_tpu.core.k8s import KnativeService
+        client, mgr = world
+        client.create(make_isvc(name="sls", min_replicas=0))
+        reconcile(client, mgr)
+        ksvc = client.get(KnativeService, "sls-engine", "default")
+        ksvc.status = {"conditions": [{"type": "Ready", "status": "True"}],
+                       "url": "http://sls-engine.default.example.com"}
+        client.update_status(ksvc)
+        reconcile(client, mgr)
+        isvc = client.get(v1.InferenceService, "sls", "default")
+        assert isvc.status.components["engine"].url == \
+            "http://sls-engine.default.example.com"
