@@ -1,0 +1,2 @@
+"""CLI binaries (cmd/ analog): manager, model-agent, multinode-prober,
+qpext. Each runs as `python -m ome_tpu.cmd.<name>`."""

@@ -1,0 +1,72 @@
+"""YAML manifest loading for the standalone control plane.
+
+The reference consumes CRs through the kube-apiserver; the standalone
+manager instead seeds its in-memory API store from YAML manifests (the
+same shapes `config/models` / `config/runtimes` carry) — one document
+per resource, kind-dispatched into the typed object model.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Type
+
+import yaml
+
+from ..apis import v1
+from ..core import k8s
+from ..core.meta import Resource
+from ..core.serde import from_dict
+
+KIND_REGISTRY: Dict[str, Type[Resource]] = {
+    cls.KIND: cls for cls in (
+        v1.InferenceService, v1.BaseModel, v1.ClusterBaseModel,
+        v1.FineTunedWeight, v1.ServingRuntime, v1.ClusterServingRuntime,
+        v1.AcceleratorClass, v1.BenchmarkJob,
+        k8s.Node, k8s.ConfigMap, k8s.Secret, k8s.Pod,
+    )
+}
+
+
+class ManifestError(ValueError):
+    pass
+
+
+def parse_manifest(doc: dict) -> Resource:
+    if not isinstance(doc, dict) or "kind" not in doc:
+        raise ManifestError(f"manifest missing kind: {doc!r:.100}")
+    kind = doc["kind"]
+    cls = KIND_REGISTRY.get(kind)
+    if cls is None:
+        raise ManifestError(
+            f"unsupported kind {kind!r} (known: {sorted(KIND_REGISTRY)})")
+    body = {k: v for k, v in doc.items()
+            if k not in ("apiVersion", "kind")}
+    return from_dict(cls, body)
+
+
+def load_file(path: str) -> List[Resource]:
+    with open(path) as f:
+        docs = list(yaml.safe_load_all(f))
+    return [parse_manifest(d) for d in docs if d]
+
+
+def load_path(path: str) -> List[Resource]:
+    """File or directory (recursive, *.yaml|*.yml, sorted)."""
+    if not os.path.exists(path):
+        raise ManifestError(f"manifest path does not exist: {path!r}")
+    if os.path.isfile(path):
+        return load_file(path)
+    out: List[Resource] = []
+    for root, _, files in sorted(os.walk(path)):
+        for fn in sorted(files):
+            if fn.endswith((".yaml", ".yml")):
+                out.extend(load_file(os.path.join(root, fn)))
+    return out
+
+
+def load_all(paths: Iterable[str]) -> List[Resource]:
+    out: List[Resource] = []
+    for p in paths:
+        out.extend(load_path(p))
+    return out

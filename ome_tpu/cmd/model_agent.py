@@ -1,0 +1,99 @@
+"""model-agent binary: node-side model staging daemon.
+
+Re-designs cmd/model-agent/main.go:33-80 (cobra+viper flags
+--models-root-dir / --num-download-worker / --download-retry): builds
+the Scout + Gopher pair against the API store, stages models whose
+node constraints match this node, and keeps node labels + the per-node
+status ConfigMap current. Standalone mode seeds the store from YAML
+manifests; `--once` drains and prints the staging report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+
+from .. import constants
+from ..core.client import InMemoryClient
+from ..core.k8s import Node
+from ..core.meta import ObjectMeta
+from ..modelagent import Gopher, Scout
+from ..modelagent.metrics import METRICS
+from ..storage.hub import HubClient
+from ..storage.xet import ChunkStore
+from .manifests import load_all
+
+log = logging.getLogger("ome.model-agent")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="model-agent")
+    p.add_argument("--node-name", required=True)
+    p.add_argument("--models-root-dir", default="/mnt/models")
+    p.add_argument("--num-download-worker", type=int, default=2)
+    p.add_argument("--download-retry", type=int, default=3)
+    p.add_argument("--manifests", action="append", default=[],
+                   help="YAML file/dir of (Cluster)BaseModels + Nodes")
+    p.add_argument("--chunk-store", default="",
+                   help="dir for the CDC dedup store (empty = disabled)")
+    p.add_argument("--hf-endpoint", default="")
+    p.add_argument("--once", action="store_true",
+                   help="stage everything once, print report, exit")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    client = InMemoryClient()
+    for obj in load_all(args.manifests):
+        client.create(obj)
+    if client.try_get(Node, args.node_name) is None:
+        client.create(Node(metadata=ObjectMeta(name=args.node_name)))
+
+    hub = HubClient(endpoint=args.hf_endpoint) if args.hf_endpoint \
+        else HubClient()
+    gopher = Gopher(
+        client, args.node_name, models_root=args.models_root_dir,
+        hub=hub,
+        chunk_store=(ChunkStore(args.chunk_store)
+                     if args.chunk_store else None),
+        download_retries=args.download_retry,
+        num_workers=args.num_download_worker)
+    scout = Scout(client, gopher, args.node_name)
+
+    if args.once:
+        scout.start()
+        gopher.drain()
+        scout.stop()
+        node = client.get(Node, args.node_name)
+        print(json.dumps({
+            "node": args.node_name,
+            "labels": node.metadata.labels,
+            "metrics": METRICS.snapshot(),
+        }, indent=2))
+        model_label_prefix = f"models.{constants.GROUP}/"
+        failed = [k for k, s in node.metadata.labels.items()
+                  if k.startswith(model_label_prefix)
+                  and s == constants.MODEL_STATUS_FAILED]
+        return 1 if failed else 0
+
+    gopher.start()
+    scout.start()
+    log.info("model-agent up on node %s (workers=%d)", args.node_name,
+             args.num_download_worker)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *a: stop.set())
+    stop.wait()
+    scout.stop()
+    gopher.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,131 @@
+"""multinode-prober: health-probe sidecar for multi-host serving.
+
+Re-designs cmd/multinode-prober (multinode_prober.go:129-230): kubelet
+probes hit this sidecar, which proxies liveness/readiness to the
+engine's /health and — for the startup probe — additionally sends one
+REAL chat completion so a slice group is only marked started once it
+can actually serve tokens (compilation done, collectives up).
+Prometheus counters on /metrics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+from ..utils.httpserver import BackgroundHTTPServer, QuietHandler
+
+log = logging.getLogger("ome.prober")
+
+
+class Prober:
+    def __init__(self, engine_url: str, model: str = "default",
+                 probe_timeout: float = 5.0,
+                 startup_timeout: float = 120.0):
+        self.engine_url = engine_url.rstrip("/")
+        self.model = model
+        self.probe_timeout = probe_timeout
+        self.startup_timeout = startup_timeout
+        self._startup_done = threading.Event()
+        self._lock = threading.Lock()
+        self.counters = {"probe_success_total": 0, "probe_failure_total": 0,
+                         "startup_inference_success_total": 0,
+                         "startup_inference_failure_total": 0}
+
+    def _inc(self, key: str):
+        with self._lock:
+            self.counters[key] += 1
+
+    def check_health(self) -> bool:
+        try:
+            with urllib.request.urlopen(f"{self.engine_url}/health",
+                                        timeout=self.probe_timeout) as r:
+                ok = r.getcode() == 200
+        except (urllib.error.URLError, OSError):
+            ok = False
+        self._inc("probe_success_total" if ok else "probe_failure_total")
+        return ok
+
+    def check_startup(self) -> bool:
+        """Health + one real completion (cached once it succeeds —
+        multinode_prober.go sends the real request only until started)."""
+        if self._startup_done.is_set():
+            return True
+        if not self.check_health():
+            return False
+        payload = json.dumps({
+            "model": self.model, "max_tokens": 2,
+            "messages": [{"role": "user", "content": "ping"}],
+        }).encode()
+        req = urllib.request.Request(
+            f"{self.engine_url}/v1/chat/completions", data=payload,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.startup_timeout) as r:
+                body = json.loads(r.read())
+            ok = bool(body.get("choices"))
+        except (urllib.error.URLError, OSError, ValueError):
+            ok = False
+        if ok:
+            self._startup_done.set()
+            self._inc("startup_inference_success_total")
+        else:
+            self._inc("startup_inference_failure_total")
+        return ok
+
+    def metrics(self) -> str:
+        with self._lock:
+            return "".join(f"ome_prober_{k} {v}\n"
+                           for k, v in self.counters.items())
+
+
+def ProberServer(prober: Prober, host: str = "127.0.0.1",
+                 port: int = 0) -> BackgroundHTTPServer:
+    class Handler(QuietHandler):
+        def do_GET(self):
+            if self.path in ("/healthz", "/livez", "/readyz"):
+                ok = prober.check_health()
+            elif self.path == "/startupz":
+                ok = prober.check_startup()
+            elif self.path == "/metrics":
+                return self.reply_metrics(prober.metrics())
+            else:
+                return self.reply_json(404, {"error": "not found"})
+            self.reply_json(200 if ok else 503, {"healthy": ok})
+
+    return BackgroundHTTPServer(Handler, host, port)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="multinode-prober")
+    p.add_argument("--engine-url", required=True,
+                   help="engine base url, e.g. http://127.0.0.1:8080")
+    p.add_argument("--model", default="default")
+    p.add_argument("--port", type=int, default=8089)
+    p.add_argument("--bind", default="0.0.0.0")
+    p.add_argument("--probe-timeout", type=float, default=5.0)
+    p.add_argument("--startup-timeout", type=float, default=120.0)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    srv = ProberServer(Prober(args.engine_url, args.model,
+                              args.probe_timeout, args.startup_timeout),
+                       args.bind, args.port)
+    srv.start()
+    log.info("prober on :%d -> %s", srv.port, args.engine_url)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *a: stop.set())
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

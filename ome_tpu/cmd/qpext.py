@@ -1,0 +1,109 @@
+"""qpext: metrics aggregator.
+
+Re-designs cmd/qpext (main.go:26-34): Knative's autoscaler scrapes ONE
+port per pod, but a serving pod exposes queue-proxy metrics AND engine
+metrics. This sidecar fetches every source and serves the concatenation
+(with source labels) on a single port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+import urllib.error
+import urllib.request
+from typing import List
+
+from ..utils.httpserver import BackgroundHTTPServer, QuietHandler
+
+log = logging.getLogger("ome.qpext")
+
+
+def scrape(url: str, timeout: float = 5.0) -> str:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode("utf-8", errors="replace")
+    except (urllib.error.URLError, OSError) as e:
+        return f'# scrape failed source="{url}" error="{e}"\n'
+
+
+def relabel(text: str, source: str) -> str:
+    """Append a source label to each sample line (comments untouched).
+
+    Splits at the LAST '}' (label values may contain spaces and braces)
+    rather than the first space.
+    """
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            out.append(line)
+            continue
+        if "{" in line:
+            idx = line.rfind("}")
+            if idx == -1:  # malformed — pass through untouched
+                out.append(line)
+                continue
+            name_labels, rest = line[:idx], line[idx + 1:].lstrip()
+            out.append(f'{name_labels},source="{source}"}} {rest}')
+        else:
+            name, _, rest = line.partition(" ")
+            out.append(f'{name}{{source="{source}"}} {rest}')
+    return "\n".join(out) + "\n"
+
+
+class Aggregator:
+    def __init__(self, sources: List[str], timeout: float = 5.0):
+        # "name=url" pairs; bare urls get an indexed source name
+        self.sources = []
+        for i, s in enumerate(sources):
+            if "=" in s.split("://")[0]:
+                name, _, url = s.partition("=")
+            else:
+                name, url = f"source{i}", s
+            self.sources.append((name, url))
+        self.timeout = timeout
+
+    def collect(self) -> str:
+        parts = [relabel(scrape(url, self.timeout), name)
+                 for name, url in self.sources]
+        return "".join(parts)
+
+
+def QpextServer(agg: Aggregator, host: str = "127.0.0.1",
+                port: int = 0) -> BackgroundHTTPServer:
+    class Handler(QuietHandler):
+        def do_GET(self):
+            if self.path != "/metrics":
+                return self.reply_json(404, {"error": "not found"})
+            self.reply_metrics(agg.collect())
+
+    return BackgroundHTTPServer(Handler, host, port)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="qpext")
+    p.add_argument("--source", action="append", required=True,
+                   help="name=url metrics source (repeatable)")
+    p.add_argument("--port", type=int, default=9088)
+    p.add_argument("--bind", default="0.0.0.0")
+    p.add_argument("--timeout", type=float, default=5.0)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    srv = QpextServer(Aggregator(args.source, args.timeout),
+                      args.bind, args.port)
+    srv.start()
+    log.info("qpext aggregating %d sources on :%d",
+             len(args.source), srv.port)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *a: stop.set())
+    stop.wait()
+    srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,10 @@ class Metrics:
                 lines.append(f"{PREFIX}_{k} {v}")
             return "\n".join(lines) + "\n"
 
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {**self._counters, **self._gauges}
+
     def reset(self):
         with self._lock:
             self._counters.clear()

@@ -1,0 +1,55 @@
+"""Shared background HTTP server scaffolding.
+
+Every sidecar/binary exposes a small HTTP surface (health, metrics,
+aggregation) — one helper owns the ThreadingHTTPServer + daemon-thread
+start/stop/join pattern instead of each binary re-implementing it.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Type
+
+
+class QuietHandler(BaseHTTPRequestHandler):
+    """Base handler: silent access log + reply helpers."""
+
+    def log_message(self, *a):  # noqa: D102 — quiet by design
+        pass
+
+    def reply(self, code: int, body: bytes,
+              ctype: str = "application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def reply_json(self, code: int, obj):
+        self.reply(code, json.dumps(obj).encode())
+
+    def reply_metrics(self, text: str):
+        self.reply(200, text.encode(), "text/plain; version=0.0.4")
+
+
+class BackgroundHTTPServer:
+    """ThreadingHTTPServer on a daemon thread with clean shutdown."""
+
+    def __init__(self, handler_cls: Type[BaseHTTPRequestHandler],
+                 host: str = "127.0.0.1", port: int = 0):
+        self.httpd = ThreadingHTTPServer((host, port), handler_cls)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,254 @@
+"""CLI binary tests (cmd/ analog): manifest loading, standalone manager
+convergence, model-agent staging run, prober semantics against a live
+engine-shaped server, qpext aggregation."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+import yaml
+
+from ome_tpu.cmd.manifests import ManifestError, load_path, parse_manifest
+from ome_tpu.cmd.prober import Prober, ProberServer
+from ome_tpu.cmd.qpext import Aggregator, relabel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+MODEL_YAML = """
+apiVersion: ome.io/v1
+kind: ClusterBaseModel
+metadata:
+  name: llama-3-8b
+spec:
+  modelFormat: {name: safetensors}
+  modelArchitecture: LlamaForCausalLM
+  modelParameterSize: 8B
+  storage:
+    storageUri: hf://meta-llama/Llama-3-8B
+    path: /mnt/models/llama
+"""
+
+RUNTIME_YAML = """
+apiVersion: ome.io/v1
+kind: ClusterServingRuntime
+metadata:
+  name: vllm-tpu
+spec:
+  supportedModelFormats:
+    - name: safetensors
+      modelArchitecture: LlamaForCausalLM
+      autoSelect: true
+      priority: 1
+  engineConfig:
+    runner:
+      name: ome-container
+      image: vllm-tpu:latest
+      args: ["--model", "$(MODEL_PATH)", "--port", "8080"]
+"""
+
+ISVC_YAML = """
+apiVersion: ome.io/v1
+kind: InferenceService
+metadata:
+  name: demo
+  namespace: default
+spec:
+  model: {name: llama-3-8b}
+  engine: {minReplicas: 1}
+"""
+
+
+class TestManifests:
+    def test_parse_known_kinds(self, tmp_path):
+        f = tmp_path / "all.yaml"
+        f.write_text(MODEL_YAML + "---" + RUNTIME_YAML + "---" + ISVC_YAML)
+        objs = load_path(str(f))
+        kinds = [type(o).KIND for o in objs]
+        assert kinds == ["ClusterBaseModel", "ClusterServingRuntime",
+                         "InferenceService"]
+        assert objs[0].spec.storage.storage_uri == \
+            "hf://meta-llama/Llama-3-8B"
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ManifestError):
+            parse_manifest({"kind": "Gateway", "metadata": {"name": "x"}})
+
+    def test_directory_recursive(self, tmp_path):
+        (tmp_path / "sub").mkdir()
+        (tmp_path / "a.yaml").write_text(MODEL_YAML)
+        (tmp_path / "sub" / "b.yml").write_text(RUNTIME_YAML)
+        (tmp_path / "ignored.txt").write_text("not yaml")
+        assert len(load_path(str(tmp_path))) == 2
+
+
+class TestManagerBinary:
+    def test_once_converges_and_reports(self, tmp_path):
+        d = tmp_path / "manifests"
+        d.mkdir()
+        (d / "model.yaml").write_text(MODEL_YAML)
+        (d / "runtime.yaml").write_text(RUNTIME_YAML)
+        (d / "isvc.yaml").write_text(ISVC_YAML)
+        r = subprocess.run(
+            [sys.executable, "-m", "ome_tpu.cmd.manager",
+             "--manifests", str(d), "--once"],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stderr
+        report = json.loads(r.stdout)
+        assert report[0]["inferenceService"] == "default/demo"
+        assert report[0]["deploymentMode"] == "RawDeployment"
+        # not ready: nothing marks the fake Deployment available
+        assert report[0]["ready"] is False
+
+    def test_invalid_manifest_rejected_at_admission(self, tmp_path):
+        d = tmp_path / "manifests"
+        d.mkdir()
+        bad = yaml.safe_load(ISVC_YAML)
+        bad["spec"].pop("model")
+        (d / "isvc.yaml").write_text(yaml.safe_dump(bad))
+        r = subprocess.run(
+            [sys.executable, "-m", "ome_tpu.cmd.manager",
+             "--manifests", str(d), "--once"],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 1
+        assert "rejected" in r.stderr
+
+
+class TestModelAgentBinary:
+    def test_once_stages_local_model(self, tmp_path):
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "config.json").write_text(json.dumps({
+            "model_type": "llama", "architectures": ["LlamaForCausalLM"],
+            "vocab_size": 512, "hidden_size": 64, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "num_key_value_heads": 2,
+            "intermediate_size": 128, "max_position_embeddings": 2048}))
+        (src / "model.safetensors").write_bytes(os.urandom(10_000))
+        man = tmp_path / "m.yaml"
+        man.write_text(yaml.safe_dump({
+            "apiVersion": "ome.io/v1", "kind": "ClusterBaseModel",
+            "metadata": {"name": "m1"},
+            "spec": {"storage": {"storageUri": f"local://{src}"}}}))
+        r = subprocess.run(
+            [sys.executable, "-m", "ome_tpu.cmd.model_agent",
+             "--node-name", "node-1", "--models-root-dir",
+             str(tmp_path / "models"), "--manifests", str(man), "--once"],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert r.returncode == 0, r.stderr
+        report = json.loads(r.stdout)
+        label = [v for k, v in report["labels"].items()
+                 if "clusterbasemodel.m1" in k]
+        assert label == ["Ready"]
+        assert (tmp_path / "models" / "m1" / "model.safetensors").exists()
+
+
+class FakeEngineHandler(BaseHTTPRequestHandler):
+    healthy = True
+    serve_tokens = True
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/health":
+            self._reply(200 if type(self).healthy else 503,
+                        {"status": "ok"})
+        elif self.path == "/metrics":
+            body = b"engine_tokens_total 42\n"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._reply(404, {})
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        self.rfile.read(n)
+        if type(self).serve_tokens:
+            self._reply(200, {"choices": [{"message": {
+                "role": "assistant", "content": "pong"}}]})
+        else:
+            self._reply(500, {"error": "not compiled yet"})
+
+
+@pytest.fixture()
+def fake_engine():
+    FakeEngineHandler.healthy = True
+    FakeEngineHandler.serve_tokens = True
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), FakeEngineHandler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    httpd.server_close()
+
+
+class TestProber:
+    def test_health_proxied(self, fake_engine):
+        srv = ProberServer(Prober(fake_engine))
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            assert r.getcode() == 200
+        FakeEngineHandler.healthy = False
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/readyz", timeout=10)
+        assert e.value.code == 503
+        srv.stop()
+
+    def test_startup_requires_real_inference(self, fake_engine):
+        prober = Prober(fake_engine)
+        srv = ProberServer(prober)
+        srv.start()
+        base = f"http://127.0.0.1:{srv.port}"
+        FakeEngineHandler.serve_tokens = False
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/startupz", timeout=10)
+        assert e.value.code == 503
+        FakeEngineHandler.serve_tokens = True
+        with urllib.request.urlopen(f"{base}/startupz", timeout=10) as r:
+            assert r.getcode() == 200
+        # cached after first success even if the engine degrades
+        FakeEngineHandler.serve_tokens = False
+        with urllib.request.urlopen(f"{base}/startupz", timeout=10) as r:
+            assert r.getcode() == 200
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "ome_prober_startup_inference_success_total 1" in text
+        srv.stop()
+
+
+class TestQpext:
+    def test_relabel(self):
+        out = relabel('a_total 1\nb{x="y"} 2\n# HELP c\n', "engine")
+        assert 'a_total{source="engine"} 1' in out
+        assert 'b{x="y",source="engine"} 2' in out
+        assert "# HELP c" in out
+
+    def test_relabel_label_value_with_spaces_and_braces(self):
+        out = relabel('err{msg="connection refused {peer}"} 3\n', "e")
+        assert out == ('err{msg="connection refused {peer}"'
+                       ',source="e"} 3\n')
+
+    def test_aggregates_sources(self, fake_engine):
+        agg = Aggregator([f"engine={fake_engine}/metrics",
+                          "qp=http://127.0.0.1:1/metrics"])  # one dead
+        text = agg.collect()
+        assert 'engine_tokens_total{source="engine"} 42' in text
+        assert 'scrape failed' in text
