@@ -81,10 +81,11 @@ class AcceleratorRequirements:
 
 
 @dataclass
-class RunnerSpec:
-    """Main engine container override (Container + extras)."""
-
-    container: Container = field(default_factory=Container)
+class RunnerSpec(Container):
+    """Main engine container recipe. Inherits Container so the YAML
+    embeds container fields inline (`runner: {name, image, args, ...}`)
+    exactly like the reference's RunnerSpec, which inlines
+    corev1.Container (servingruntime_types.go)."""
 
 
 @dataclass

@@ -91,7 +91,7 @@ def main(argv=None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
     client = InMemoryClient()
-    for obj in load_all(args.manifests):
+    for obj in load_all(args.manifests, skip_unknown=True):
         try:
             admit(client, obj)
             client.create(obj)

@@ -45,28 +45,40 @@ def parse_manifest(doc: dict) -> Resource:
     return from_dict(cls, body)
 
 
-def load_file(path: str) -> List[Resource]:
+def load_file(path: str, skip_unknown: bool = False) -> List[Resource]:
     with open(path) as f:
         docs = list(yaml.safe_load_all(f))
-    return [parse_manifest(d) for d in docs if d]
+    out: List[Resource] = []
+    for d in docs:
+        if not d:
+            continue
+        if skip_unknown and isinstance(d, dict) \
+                and d.get("kind") not in KIND_REGISTRY:
+            # cluster-install artifacts (CRDs, namespaces, charts) are
+            # not API-store resources — skip them when asked
+            continue
+        out.append(parse_manifest(d))
+    return out
 
 
-def load_path(path: str) -> List[Resource]:
+def load_path(path: str, skip_unknown: bool = False) -> List[Resource]:
     """File or directory (recursive, *.yaml|*.yml, sorted)."""
     if not os.path.exists(path):
         raise ManifestError(f"manifest path does not exist: {path!r}")
     if os.path.isfile(path):
-        return load_file(path)
+        return load_file(path, skip_unknown)
     out: List[Resource] = []
     for root, _, files in sorted(os.walk(path)):
         for fn in sorted(files):
             if fn.endswith((".yaml", ".yml")):
-                out.extend(load_file(os.path.join(root, fn)))
+                out.extend(load_file(os.path.join(root, fn),
+                                     skip_unknown))
     return out
 
 
-def load_all(paths: Iterable[str]) -> List[Resource]:
+def load_all(paths: Iterable[str],
+             skip_unknown: bool = False) -> List[Resource]:
     out: List[Resource] = []
     for p in paths:
-        out.extend(load_path(p))
+        out.extend(load_path(p, skip_unknown))
     return out

@@ -83,7 +83,7 @@ def _runner_container(runtime_cfg: Optional[v1.EngineConfig],
     """The engine container recipe: EngineConfig.runner first, else the
     runtime's flattened containers list (simple runtimes)."""
     if runtime_cfg is not None and runtime_cfg.runner is not None:
-        return _copy_container(runtime_cfg.runner.container)
+        return _copy_container(runtime_cfg.runner)
     if runtime_spec is not None and runtime_spec.containers:
         return _copy_container(runtime_spec.containers[0])
     return Container(name=constants.MAIN_CONTAINER)
@@ -188,17 +188,17 @@ def build_component(ctx: BuildContext, component: str,
     elif not base_pod.containers:
         rc = ctx.runtime_spec.router_config if ctx.runtime_spec else None
         base_pod.containers = [
-            _copy_container(rc.runner.container)
+            _copy_container(rc.runner)
             if rc is not None and rc.runner is not None
             else Container(name=constants.MAIN_CONTAINER)]
     if runtime_cfg is not None and runtime_cfg.runner is not None:
         main = base_pod.container(constants.MAIN_CONTAINER)
         if main is None:
             base_pod.containers.insert(
-                0, _copy_container(runtime_cfg.runner.container))
+                0, _copy_container(runtime_cfg.runner))
         else:
             merging.merge_container(main,
-                                    runtime_cfg.runner.container)
+                                    runtime_cfg.runner)
     main = base_pod.container(constants.MAIN_CONTAINER)
     if main is None:
         main = base_pod.containers[0]

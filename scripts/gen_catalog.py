@@ -1,0 +1,435 @@
+#!/usr/bin/env python
+"""Generate the static config/ catalog (accelerators, runtimes, models).
+
+The reference ships ~90 SGLang + 17 vLLM ClusterServingRuntimes and a
+206-model ClusterBaseModel catalog as static YAML (config/runtimes,
+config/models). This script emits our TPU-first equivalent — run it
+after changing the tables; the YAML output is committed so the catalog
+is reviewable and loadable without running anything.
+
+Usage: python scripts/gen_catalog.py [repo_root]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import yaml
+
+ROOT = sys.argv[1] if len(sys.argv) > 1 else \
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# -- accelerator classes ----------------------------------------------------
+
+TPUS = [
+    # model, gke label value, HBM/chip, BW, ici, bf16 tflops, $/chip-h,
+    # topologies [(name, chips, hosts, chips_per_host)]
+    ("v5e", "tpu-v5-lite-podslice", 16, 819, 400, 197, 1.20,
+     [("1x1", 1, 1, 1), ("2x2", 4, 1, 4), ("2x4", 8, 2, 4),
+      ("4x4", 16, 4, 4), ("4x8", 32, 8, 4), ("8x8", 64, 16, 4),
+      ("8x16", 128, 32, 4), ("16x16", 256, 64, 4)]),
+    ("v5p", "tpu-v5p-slice", 95, 2765, 1200, 459, 4.20,
+     [("2x2x1", 4, 1, 4), ("2x2x2", 8, 2, 4), ("2x4x4", 32, 8, 4),
+      ("4x4x4", 64, 16, 4), ("4x4x8", 128, 32, 4),
+      ("8x8x8", 512, 128, 4)]),
+    ("v6e", "tpu-v6e-slice", 32, 1640, 800, 918, 2.70,
+     [("1x1", 1, 1, 1), ("2x2", 4, 1, 4), ("2x4", 8, 2, 4),
+      ("4x4", 16, 4, 4), ("4x8", 32, 8, 4), ("8x8", 64, 16, 4),
+      ("16x16", 256, 64, 4)]),
+]
+
+
+def accelerator_docs():
+    for model, label, hbm, bw, ici, tflops, cost, topos in TPUS:
+        yield f"accelerators/tpu-{model}.yaml", {
+            "apiVersion": "ome.io/v1",
+            "kind": "AcceleratorClass",
+            "metadata": {"name": f"tpu-{model}"},
+            "spec": {
+                "vendor": "google", "family": "tpu", "model": model,
+                "discovery": {"nodeSelector": {
+                    "cloud.google.com/gke-tpu-accelerator": label}},
+                "capabilities": {
+                    "memoryGb": hbm,
+                    "computeCapability": model,
+                    "memoryBandwidthGbps": bw,
+                    "interconnectBandwidthGbps": ici,
+                    "bf16Tflops": tflops,
+                    "features": (["megacore"] if model == "v5p" else []),
+                    "topologies": [
+                        {"name": n, "chips": c, "hosts": h,
+                         "chipsPerHost": cph}
+                        for n, c, h, cph in topos],
+                },
+                "cost": {"perChipHourUsd": cost},
+                "resources": {"google.com/tpu": "1"},
+            },
+        }
+
+
+# -- model catalog ----------------------------------------------------------
+
+TEXTGEN = ["TEXT_GENERATION"]
+CHAT = ["TEXT_GENERATION", "CHAT"]
+EMBED = ["TEXT_EMBEDDINGS"]
+VISION = ["TEXT_GENERATION", "CHAT", "IMAGE_TEXT_TO_TEXT"]
+
+MODELS = [
+    # vendor, name, repo, arch, params, ctx, caps, quant
+    ("meta", "llama-3-8b-instruct", "meta-llama/Meta-Llama-3-8B-Instruct",
+     "LlamaForCausalLM", "8.03B", 8192, CHAT, None),
+    ("meta", "llama-3-70b-instruct", "meta-llama/Meta-Llama-3-70B-Instruct",
+     "LlamaForCausalLM", "70.6B", 8192, CHAT, None),
+    ("meta", "llama-3-1-8b-instruct", "meta-llama/Llama-3.1-8B-Instruct",
+     "LlamaForCausalLM", "8.03B", 131072, CHAT, None),
+    ("meta", "llama-3-1-70b-instruct", "meta-llama/Llama-3.1-70B-Instruct",
+     "LlamaForCausalLM", "70.6B", 131072, CHAT, None),
+    ("meta", "llama-3-1-405b-instruct-fp8",
+     "meta-llama/Llama-3.1-405B-Instruct-FP8",
+     "LlamaForCausalLM", "405B", 131072, CHAT, "fp8"),
+    ("meta", "llama-3-2-1b-instruct", "meta-llama/Llama-3.2-1B-Instruct",
+     "LlamaForCausalLM", "1.24B", 131072, CHAT, None),
+    ("meta", "llama-3-2-3b-instruct", "meta-llama/Llama-3.2-3B-Instruct",
+     "LlamaForCausalLM", "3.21B", 131072, CHAT, None),
+    ("meta", "llama-3-3-70b-instruct", "meta-llama/Llama-3.3-70B-Instruct",
+     "LlamaForCausalLM", "70.6B", 131072, CHAT, None),
+    ("meta", "llama-4-scout-17b-16e",
+     "meta-llama/Llama-4-Scout-17B-16E-Instruct",
+     "Llama4ForConditionalGeneration", "109B", 10485760, VISION, None),
+    ("qwen", "qwen2-5-0-5b-instruct", "Qwen/Qwen2.5-0.5B-Instruct",
+     "Qwen2ForCausalLM", "494M", 32768, CHAT, None),
+    ("qwen", "qwen2-5-7b-instruct", "Qwen/Qwen2.5-7B-Instruct",
+     "Qwen2ForCausalLM", "7.62B", 131072, CHAT, None),
+    ("qwen", "qwen2-5-32b-instruct", "Qwen/Qwen2.5-32B-Instruct",
+     "Qwen2ForCausalLM", "32.8B", 131072, CHAT, None),
+    ("qwen", "qwen2-5-72b-instruct", "Qwen/Qwen2.5-72B-Instruct",
+     "Qwen2ForCausalLM", "72.7B", 131072, CHAT, None),
+    ("qwen", "qwen3-8b", "Qwen/Qwen3-8B",
+     "Qwen3ForCausalLM", "8.19B", 40960, CHAT, None),
+    ("qwen", "qwen3-32b", "Qwen/Qwen3-32B",
+     "Qwen3ForCausalLM", "32.8B", 40960, CHAT, None),
+    ("qwen", "qwen3-235b-a22b", "Qwen/Qwen3-235B-A22B",
+     "Qwen3MoeForCausalLM", "235B", 40960, CHAT, None),
+    ("mistralai", "mistral-7b-instruct-v0-3",
+     "mistralai/Mistral-7B-Instruct-v0.3",
+     "MistralForCausalLM", "7.25B", 32768, CHAT, None),
+    ("mistralai", "mixtral-8x7b-instruct-v0-1",
+     "mistralai/Mixtral-8x7B-Instruct-v0.1",
+     "MixtralForCausalLM", "46.7B", 32768, CHAT, None),
+    ("mistralai", "mixtral-8x22b-instruct-v0-1",
+     "mistralai/Mixtral-8x22B-Instruct-v0.1",
+     "MixtralForCausalLM", "141B", 65536, CHAT, None),
+    ("deepseek", "deepseek-v3", "deepseek-ai/DeepSeek-V3",
+     "DeepseekV3ForCausalLM", "685B", 163840, CHAT, "fp8"),
+    ("deepseek", "deepseek-r1", "deepseek-ai/DeepSeek-R1",
+     "DeepseekV3ForCausalLM", "685B", 163840, CHAT, "fp8"),
+    ("google", "gemma-2-9b-it", "google/gemma-2-9b-it",
+     "Gemma2ForCausalLM", "9.24B", 8192, CHAT, None),
+    ("google", "gemma-2-27b-it", "google/gemma-2-27b-it",
+     "Gemma2ForCausalLM", "27.2B", 8192, CHAT, None),
+    ("google", "gemma-3-27b-it", "google/gemma-3-27b-it",
+     "Gemma3ForConditionalGeneration", "27.4B", 131072, VISION, None),
+    ("microsoft", "phi-4", "microsoft/phi-4",
+     "Phi3ForCausalLM", "14.7B", 16384, CHAT, None),
+    ("cohere", "command-r-plus", "CohereForAI/c4ai-command-r-plus",
+     "CohereForCausalLM", "104B", 131072, CHAT, None),
+    ("moonshotai", "kimi-k2-instruct", "moonshotai/Kimi-K2-Instruct",
+     "DeepseekV3ForCausalLM", "1026B", 131072, CHAT, "fp8"),
+    ("openai", "gpt-oss-120b", "openai/gpt-oss-120b",
+     "GptOssForCausalLM", "117B", 131072, CHAT, None),
+    ("intfloat", "e5-mistral-7b-instruct", "intfloat/e5-mistral-7b-instruct",
+     "MistralModel", "7.11B", 32768, EMBED, None),
+    ("baai", "bge-m3", "BAAI/bge-m3",
+     "XLMRobertaModel", "568M", 8192, EMBED, None),
+]
+
+
+def model_docs():
+    for vendor, name, repo, arch, params, ctx, caps, quant in MODELS:
+        spec = {
+            "vendor": vendor,
+            "displayName": repo.split("/")[-1],
+            "modelFormat": {"name": "safetensors"},
+            "modelArchitecture": arch,
+            "modelParameterSize": params,
+            "maxTokens": ctx,
+            "modelCapabilities": list(caps),
+            "storage": {
+                "storageUri": f"hf://{repo}",
+                "path": f"/mnt/models/{name}",
+            },
+        }
+        if quant:
+            spec["quantization"] = quant
+        yield f"models/{vendor}/{name}.yaml", {
+            "apiVersion": "ome.io/v1",
+            "kind": "ClusterBaseModel",
+            "metadata": {"name": name},
+            "spec": spec,
+        }
+
+
+# -- serving runtimes -------------------------------------------------------
+
+def fmt(arch, quant=None, prio=1):
+    d = {"name": "safetensors", "modelArchitecture": arch,
+         "autoSelect": True, "priority": prio}
+    if quant:
+        d["quantization"] = quant
+    return d
+
+
+DENSE_ARCHS = ["LlamaForCausalLM", "Qwen2ForCausalLM", "Qwen3ForCausalLM",
+               "MistralForCausalLM", "Gemma2ForCausalLM",
+               "Phi3ForCausalLM"]
+MOE_ARCHS = ["MixtralForCausalLM", "Qwen3MoeForCausalLM"]
+
+
+def runtime_docs():
+    # 1. in-repo engine: small dense models, single host (CI-runnable)
+    yield "runtimes/ome/ome-engine-small-rt.yaml", {
+        "apiVersion": "ome.io/v1",
+        "kind": "ClusterServingRuntime",
+        "metadata": {"name": "ome-engine-small"},
+        "spec": {
+            "supportedModelFormats": [fmt(a, prio=2) for a in DENSE_ARCHS],
+            "modelSizeRange": {"min": "100M", "max": "15B"},
+            "protocolVersions": ["openAI"],
+            "engineConfig": {"runner": {
+                "name": "ome-container",
+                "image": "ghcr.io/ome-tpu/engine:latest",
+                "command": ["python", "-m", "ome_tpu.engine.serve"],
+                "args": ["--model-dir", "$(MODEL_PATH)",
+                         "--max-slots", "16", "--port", "8080"],
+                "resources": {"requests": {"google.com/tpu": "1"},
+                              "limits": {"google.com/tpu": "1"}},
+            }},
+            "acceleratorRequirements": {
+                "acceleratorClasses": ["tpu-v5e", "tpu-v6e"],
+                "minChips": 1},
+        },
+    }
+    # 2. vLLM-TPU single host: dense <=15B
+    yield "runtimes/vllm/vllm-tpu-rt.yaml", {
+        "apiVersion": "ome.io/v1",
+        "kind": "ClusterServingRuntime",
+        "metadata": {"name": "vllm-tpu"},
+        "spec": {
+            "supportedModelFormats": [fmt(a, prio=3) for a in DENSE_ARCHS],
+            "modelSizeRange": {"min": "1B", "max": "15B"},
+            "protocolVersions": ["openAI"],
+            "engineConfig": {"runner": {
+                "name": "ome-container",
+                "image": "vllm/vllm-tpu:latest",
+                "args": ["--model", "$(MODEL_PATH)",
+                         "--tensor-parallel-size", "4",
+                         "--max-model-len", "8192", "--port", "8080"],
+                "resources": {"requests": {"google.com/tpu": "4"},
+                              "limits": {"google.com/tpu": "4"}},
+            }},
+            "acceleratorRequirements": {
+                "acceleratorClasses": ["tpu-v5e", "tpu-v6e"],
+                "minChips": 4, "topologies": ["2x2"]},
+            "acceleratorConfigs": [
+                {"acceleratorClass": "tpu-v5e",
+                 "parallelism": {"tensorParallelSize": 4,
+                                 "iciMesh": "2,2"}},
+                {"acceleratorClass": "tpu-v6e",
+                 "parallelism": {"tensorParallelSize": 4,
+                                 "iciMesh": "2,2"}},
+            ],
+        },
+    }
+    # 3. vLLM-TPU multi-host: 70B on a v5e-16 slice (BASELINE config #3)
+    yield "runtimes/vllm/vllm-tpu-llama-70b-rt.yaml", {
+        "apiVersion": "ome.io/v1",
+        "kind": "ClusterServingRuntime",
+        "metadata": {"name": "vllm-tpu-llama-70b"},
+        "spec": {
+            "supportedModelFormats": [fmt("LlamaForCausalLM", prio=5),
+                                      fmt("Qwen2ForCausalLM", prio=4),
+                                      fmt("Qwen3ForCausalLM", prio=4)],
+            "modelSizeRange": {"min": "30B", "max": "110B"},
+            "protocolVersions": ["openAI"],
+            "engineConfig": {
+                "runner": {
+                    "name": "ome-container",
+                    "image": "vllm/vllm-tpu:latest",
+                    "args": ["--model", "$(MODEL_PATH)",
+                             "--tensor-parallel-size", "16",
+                             "--max-model-len", "8192", "--port", "8080"],
+                    "resources": {"requests": {"google.com/tpu": "4"},
+                                  "limits": {"google.com/tpu": "4"}},
+                },
+                "workerSize": 3,
+            },
+            "acceleratorRequirements": {
+                "acceleratorClasses": ["tpu-v5e", "tpu-v6e"],
+                "minChips": 16, "topologies": ["4x4"]},
+            "acceleratorConfigs": [
+                {"acceleratorClass": "tpu-v5e",
+                 "parallelism": {"tensorParallelSize": 16,
+                                 "iciMesh": "4,4"}},
+                {"acceleratorClass": "tpu-v6e",
+                 "parallelism": {"tensorParallelSize": 16,
+                                 "iciMesh": "4,4"}},
+            ],
+        },
+    }
+    # 4. JetStream-MaxText
+    yield "runtimes/jetstream/jetstream-maxtext-rt.yaml", {
+        "apiVersion": "ome.io/v1",
+        "kind": "ClusterServingRuntime",
+        "metadata": {"name": "jetstream-maxtext"},
+        "spec": {
+            "supportedModelFormats": [
+                fmt("LlamaForCausalLM", prio=1),
+                fmt("Gemma2ForCausalLM", prio=2),
+                fmt("Gemma3ForConditionalGeneration", prio=2)],
+            "modelSizeRange": {"min": "1B", "max": "80B"},
+            "protocolVersions": ["openAI"],
+            "engineConfig": {"runner": {
+                "name": "ome-container",
+                "image": "us-docker.pkg.dev/jetstream/maxengine:latest",
+                "args": ["--model-path", "$(MODEL_PATH)",
+                         "--ici-tensor-parallelism", "4",
+                         "--port", "8080"],
+                "resources": {"requests": {"google.com/tpu": "4"},
+                              "limits": {"google.com/tpu": "4"}},
+            }},
+            "acceleratorRequirements": {
+                "acceleratorClasses": ["tpu-v5e", "tpu-v5p", "tpu-v6e"],
+                "minChips": 4},
+            "acceleratorConfigs": [
+                {"acceleratorClass": "tpu-v5p",
+                 "parallelism": {"tensorParallelSize": 4,
+                                 "iciMesh": "2,2,1"}},
+            ],
+        },
+    }
+    # 5. PD-disaggregated DeepSeek-class MoE on v5p (engine=prefill,
+    #    decoder=decode, router dispatches)
+    yield "runtimes/vllm/vllm-tpu-pd-deepseek-rt.yaml", {
+        "apiVersion": "ome.io/v1",
+        "kind": "ClusterServingRuntime",
+        "metadata": {"name": "vllm-tpu-pd-deepseek"},
+        "spec": {
+            "supportedModelFormats": [
+                fmt("DeepseekV3ForCausalLM", quant="fp8", prio=10),
+                fmt("DeepseekV3ForCausalLM", prio=8)],
+            "modelSizeRange": {"min": "200B", "max": "1500B"},
+            "protocolVersions": ["openAI"],
+            "engineConfig": {
+                "runner": {
+                    "name": "ome-container",
+                    "image": "vllm/vllm-tpu:latest",
+                    "args": ["--model", "$(MODEL_PATH)",
+                             "--disaggregation-mode", "prefill",
+                             "--tensor-parallel-size", "32",
+                             "--enable-expert-parallel",
+                             "--port", "8080"],
+                    "resources": {"requests": {"google.com/tpu": "4"},
+                                  "limits": {"google.com/tpu": "4"}},
+                },
+                "workerSize": 7,
+            },
+            "decoderConfig": {
+                "runner": {
+                    "name": "ome-container",
+                    "image": "vllm/vllm-tpu:latest",
+                    "args": ["--model", "$(MODEL_PATH)",
+                             "--disaggregation-mode", "decode",
+                             "--tensor-parallel-size", "32",
+                             "--enable-expert-parallel",
+                             "--port", "8080"],
+                    "resources": {"requests": {"google.com/tpu": "4"},
+                                  "limits": {"google.com/tpu": "4"}},
+                },
+                "workerSize": 7,
+            },
+            "routerConfig": {
+                "runner": {
+                    "name": "router",
+                    "image": "ghcr.io/ome-tpu/router:latest",
+                    "args": ["--policy", "cache_aware", "--port", "8000"],
+                },
+                "config": {
+                    "engine-selector": "component.ome.io/name=engine",
+                    "decoder-selector": "component.ome.io/name=decoder",
+                },
+            },
+            "acceleratorRequirements": {
+                "acceleratorClasses": ["tpu-v5p"],
+                "minChips": 32, "topologies": ["2x4x4"]},
+            "acceleratorConfigs": [
+                {"acceleratorClass": "tpu-v5p",
+                 "parallelism": {"tensorParallelSize": 32,
+                                 "expertParallelSize": 8,
+                                 "iciMesh": "2,4,4"}},
+            ],
+        },
+    }
+    # 6. embeddings
+    yield "runtimes/ome/ome-engine-embeddings-rt.yaml", {
+        "apiVersion": "ome.io/v1",
+        "kind": "ClusterServingRuntime",
+        "metadata": {"name": "ome-engine-embeddings"},
+        "spec": {
+            "supportedModelFormats": [fmt("MistralModel", prio=2),
+                                      fmt("XLMRobertaModel", prio=2),
+                                      fmt("BertModel", prio=2)],
+            "modelSizeRange": {"min": "10M", "max": "10B"},
+            "protocolVersions": ["openAI"],
+            "engineConfig": {"runner": {
+                "name": "ome-container",
+                "image": "ghcr.io/ome-tpu/engine:latest",
+                "command": ["python", "-m", "ome_tpu.engine.serve"],
+                "args": ["--model-dir", "$(MODEL_PATH)",
+                         "--task", "embed", "--port", "8080"],
+                "resources": {"requests": {"google.com/tpu": "1"},
+                              "limits": {"google.com/tpu": "1"}},
+            }},
+            "acceleratorRequirements": {
+                "acceleratorClasses": ["tpu-v5e", "tpu-v6e"],
+                "minChips": 1},
+        },
+    }
+
+
+def supported_models_md() -> str:
+    lines = [
+        "# Supported models",
+        "",
+        "Generated by `scripts/gen_catalog.py` — the ClusterBaseModel "
+        "catalog under `config/models/`.",
+        "",
+        "| Model | Vendor | Architecture | Params | Context | "
+        "Capabilities |",
+        "|---|---|---|---|---|---|",
+    ]
+    for vendor, name, repo, arch, params, ctx, caps, quant in MODELS:
+        label = name + (f" ({quant})" if quant else "")
+        lines.append(f"| `{label}` | {vendor} | {arch} | {params} | "
+                     f"{ctx} | {', '.join(caps)} |")
+    return "\n".join(lines) + "\n"
+
+
+def main():
+    count = 0
+    for rel, doc in (*accelerator_docs(), *model_docs(), *runtime_docs()):
+        path = os.path.join(ROOT, "config", rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write("# generated by scripts/gen_catalog.py — edit the "
+                    "tables there, not this file\n")
+            yaml.safe_dump(doc, f, sort_keys=False)
+        count += 1
+    with open(os.path.join(ROOT, "config", "models",
+                           "SUPPORTED_MODELS.md"), "w") as f:
+        f.write(supported_models_md())
+    print(f"wrote {count} catalog files under {ROOT}/config/")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Catalog coherence tests: every shipped YAML parses, passes admission,
+and the runtime auto-selector routes representative models to the
+intended runtime (the reference's catalog is exercised the same way —
+runtime selection over config/runtimes + config/models)."""
+
+import os
+
+import pytest
+
+from ome_tpu.apis import v1
+from ome_tpu.cmd.manifests import load_path
+from ome_tpu.core.client import InMemoryClient
+from ome_tpu.selection.runtime_selector import RuntimeSelector
+from ome_tpu.webhooks.admission import validate_serving_runtime
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIG = os.path.join(REPO, "config")
+
+
+@pytest.fixture(scope="module")
+def catalog():
+    objs = load_path(CONFIG, skip_unknown=True)
+    client = InMemoryClient()
+    for o in objs:
+        client.create(o)
+    return client, objs
+
+
+class TestCatalogLoads:
+    def test_counts(self, catalog):
+        _, objs = catalog
+        kinds = [type(o).KIND for o in objs]
+        assert kinds.count("AcceleratorClass") == 3
+        assert kinds.count("ClusterServingRuntime") >= 6
+        assert kinds.count("ClusterBaseModel") >= 25
+
+    def test_no_gpu_resources_anywhere(self):
+        """North star: zero nvidia.com/gpu in the whole catalog."""
+        for root, _, files in os.walk(CONFIG):
+            for fn in files:
+                text = open(os.path.join(root, fn)).read()
+                assert "nvidia.com/gpu" not in text, fn
+
+    def test_every_runtime_passes_admission(self, catalog):
+        client, objs = catalog
+        for rt in client.list(v1.ClusterServingRuntime):
+            validate_serving_runtime(client, rt, cluster_scoped=True)
+
+    def test_models_have_storage_and_arch(self, catalog):
+        client, _ = catalog
+        for m in client.list(v1.ClusterBaseModel):
+            assert m.spec.storage is not None, m.metadata.name
+            assert m.spec.storage.storage_uri.startswith("hf://")
+            assert m.spec.model_architecture, m.metadata.name
+            assert m.spec.model_parameter_size, m.metadata.name
+
+
+class TestCatalogRouting:
+    """Auto-selection over the real catalog."""
+
+    def _select(self, catalog, model_name, accelerator_name="tpu-v5e"):
+        client, _ = catalog
+        model = client.get(v1.ClusterBaseModel, model_name)
+        ac = client.get(v1.AcceleratorClass, accelerator_name)
+        sel = RuntimeSelector(client)
+        return sel.select(model.spec, "default", accelerator=ac,
+                          model_name=model_name).runtime.metadata.name
+
+    def test_llama70b_routes_to_multihost(self, catalog):
+        assert self._select(catalog, "llama-3-3-70b-instruct") == \
+            "vllm-tpu-llama-70b"
+
+    def test_llama8b_routes_to_single_host(self, catalog):
+        assert self._select(catalog, "llama-3-1-8b-instruct") == \
+            "vllm-tpu"
+
+    def test_tiny_qwen_routes_to_ome_engine(self, catalog):
+        # 494M is below vllm-tpu's 1B size floor
+        assert self._select(catalog, "qwen2-5-0-5b-instruct") == \
+            "ome-engine-small"
+
+    def test_deepseek_routes_to_pd(self, catalog):
+        assert self._select(catalog, "deepseek-v3", "tpu-v5p") == \
+            "vllm-tpu-pd-deepseek"
+
+    def test_embedding_model_routes_to_embeddings_runtime(self, catalog):
+        assert self._select(catalog, "e5-mistral-7b-instruct") == \
+            "ome-engine-embeddings"
+
+    def test_crd_files_cover_all_kinds(self):
+        names = os.listdir(os.path.join(CONFIG, "crd"))
+        for plural in ("inferenceservices", "basemodels",
+                       "clusterbasemodels", "servingruntimes",
+                       "clusterservingruntimes", "acceleratorclasses",
+                       "benchmarkjobs", "finetunedweights"):
+            assert f"ome.io_{plural}.yaml" in names

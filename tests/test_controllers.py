@@ -58,12 +58,10 @@ def vllm_tpu_runtime(name="vllm-tpu") -> v1.ClusterServingRuntime:
         name="safetensors", model_architecture="LlamaForCausalLM",
         auto_select=True, priority=1)]
     rt.spec.model_size_range = v1.ModelSizeRangeSpec(min="1B", max="15B")
-    runner = Container(
+    rt.spec.engine_config = v1.EngineConfig(runner=v1.RunnerSpec(
         name=constants.MAIN_CONTAINER, image="vllm/vllm-tpu:latest",
         args=["--model", "$(MODEL_PATH)", "--tensor-parallel-size", "1",
-              "--port", "8080"])
-    rt.spec.engine_config = v1.EngineConfig(
-        runner=v1.RunnerSpec(container=runner))
+              "--port", "8080"]))
     rt.spec.accelerator_configs = [v1.AcceleratorModelConfig(
         accelerator_class="tpu-v5e",
         parallelism=v1.ParallelismConfig(tensor_parallel_size=4))]
