@@ -24,6 +24,56 @@ class ObjectInfo:
     etag: str = ""
 
 
+class UnsafeObjectName(ValueError):
+    """A server-supplied name tried to escape the download root."""
+
+
+def safe_join(root: str, rel: str) -> str:
+    """Join a server-supplied relative name under root, rejecting
+    absolute paths and '..' escapes (hfutil/hub/download.go:129-130
+    applies the same rule to hub-listed rfilenames). A malicious
+    listing must not be able to write outside the model directory of
+    the node daemon."""
+    if rel.startswith("/"):
+        raise UnsafeObjectName(f"absolute object name: {rel!r}")
+    if os.name == "nt" and (rel.startswith("\\")
+                            or (len(rel) > 1 and rel[1] == ":")):
+        # drive-letter / UNC escapes only mean something on Windows;
+        # on POSIX 'a:b' and '\\notes' are legal filenames
+        raise UnsafeObjectName(f"absolute object name: {rel!r}")
+    # compare absolute forms so a relative root like '.' works too
+    root_a = os.path.abspath(root)
+    p_a = os.path.abspath(os.path.join(root, rel))
+    if p_a == root_a or os.path.commonpath([p_a, root_a]) != root_a:
+        raise UnsafeObjectName(f"object name escapes target dir: {rel!r}")
+    return os.path.normpath(os.path.join(root, rel))
+
+
+class ShortDownload(IOError):
+    """Bytes on disk after a download don't match the expected size."""
+
+
+def drain_response_to_file(resp, path: str, offset: int,
+                           name: str = "", total: int = 0,
+                           chunk_size: int = 1 << 20,
+                           progress: Optional[ProgressFn] = None) -> int:
+    """Shared streaming read loop: copy an HTTP response body to `path`
+    (appending at `offset` when resuming a 206), reporting progress.
+    Returns bytes now on disk. Used by both the hub client and the
+    S3-compat provider so the resume/verify behavior cannot diverge."""
+    done = offset
+    with open(path, "ab" if offset else "wb") as f:
+        while True:
+            buf = resp.read(chunk_size)
+            if not buf:
+                break
+            f.write(buf)
+            done += len(buf)
+            if progress:
+                progress(name, done, total or done)
+    return done
+
+
 class Storage(abc.ABC):
     """download/upload move whole object trees; get/put move bytes."""
 
@@ -43,6 +93,21 @@ class Storage(abc.ABC):
     def exists(self, name: str) -> bool:
         ...
 
+    def get_to_file(self, name: str, path: str,
+                    progress: Optional[ProgressFn] = None,
+                    total: int = 0, etag: str = "") -> int:
+        """Fetch one object to a local path. The base implementation
+        buffers via get(); providers that can stream (HTTP ranged
+        reads) override this so multi-GB shards never sit in memory
+        (pkg/ociobjectstore streams to disk the same way). `etag`
+        lets streaming providers version-validate a resumed partial."""
+        data = self.get(name)
+        with open(path, "wb") as f:
+            f.write(data)
+        if progress:
+            progress(name, len(data), total or len(data))
+        return len(data)
+
     def download(self, target_dir: str, prefix: str = "",
                  progress: Optional[ProgressFn] = None,
                  workers: int = 4,
@@ -58,19 +123,16 @@ class Storage(abc.ABC):
 
         def fetch(o: ObjectInfo) -> str:
             rel = o.name[len(prefix):].lstrip("/") if prefix else o.name
-            dst = os.path.join(target_dir, rel)
+            dst = safe_join(target_dir, rel)
             os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
             if os.path.exists(dst) and os.path.getsize(dst) == o.size:
                 if progress:
                     progress(o.name, o.size, o.size)
                 return dst
-            data = self.get(o.name)
             tmp = dst + ".part"
-            with open(tmp, "wb") as f:
-                f.write(data)
+            self.get_to_file(o.name, tmp, progress=progress, total=o.size,
+                             etag=o.etag)
             os.replace(tmp, dst)  # tmp-and-move (hub/download.go:274)
-            if progress:
-                progress(o.name, len(data), o.size)
             return dst
 
         with cf.ThreadPoolExecutor(max_workers=workers) as ex:

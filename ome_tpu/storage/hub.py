@@ -21,9 +21,36 @@ import urllib.request
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from .base import ObjectInfo, ProgressFn
+from .base import ObjectInfo, ProgressFn, drain_response_to_file, safe_join
 
 DEFAULT_ENDPOINT = "https://huggingface.co"
+
+
+class _AuthStrippingRedirectHandler(urllib.request.HTTPRedirectHandler):
+    """Drop Authorization when a redirect crosses hosts.
+
+    Hub /resolve URLs redirect to a CDN/S3 presigned URL; forwarding
+    the Bearer token there both leaks it and breaks presigned auth
+    ('only one auth mechanism allowed'). Go's net/http strips
+    sensitive headers on cross-domain redirects — urllib does not, so
+    we do it here.
+    """
+
+    def redirect_request(self, req, fp, code, msg, headers, newurl):
+        new = super().redirect_request(req, fp, code, msg, headers, newurl)
+        if new is not None and new.has_header("Authorization"):
+            def origin(url):
+                u = urllib.parse.urlsplit(url)
+                port = u.port or {"http": 80, "https": 443}.get(u.scheme)
+                return (u.scheme, u.hostname, port)
+            # strip on any origin change INCLUDING scheme downgrade
+            # (https→http would put the token on the wire in cleartext)
+            if origin(req.full_url) != origin(new.full_url):
+                new.remove_header("Authorization")
+        return new
+
+
+_OPENER = urllib.request.build_opener(_AuthStrippingRedirectHandler())
 
 
 class HubError(Exception):
@@ -58,7 +85,7 @@ class HubClient:
         for attempt in range(self.retries):
             req = urllib.request.Request(url, headers=self._headers(extra))
             try:
-                return urllib.request.urlopen(req, timeout=60)
+                return _OPENER.open(req, timeout=60)
             except urllib.error.HTTPError as e:
                 if e.code in (408, 429, 500, 502, 503, 504):
                     last = e
@@ -97,7 +124,7 @@ class HubClient:
     def download_file(self, repo_id: str, filename: str, target_dir: str,
                       revision: str = "main", expected_size: int = 0,
                       progress: Optional[ProgressFn] = None) -> str:
-        dst = os.path.join(target_dir, filename)
+        dst = safe_join(target_dir, filename)
         os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
         if os.path.exists(dst) and expected_size \
                 and os.path.getsize(dst) == expected_size:
@@ -118,20 +145,13 @@ class HubClient:
             os.remove(part)
             offset, resp = 0, self._open(url)
         with resp:
-            status = resp.getcode()
-            mode = "ab" if (offset and status == 206) else "wb"
+            if offset and resp.getcode() != 206:
+                offset = 0  # server ignored Range: overwrite from scratch
             total = expected_size or (
                 offset + int(resp.headers.get("Content-Length") or 0))
-            done = offset if mode == "ab" else 0
-            with open(part, mode) as f:
-                while True:
-                    buf = resp.read(self.chunk_size)
-                    if not buf:
-                        break
-                    f.write(buf)
-                    done += len(buf)
-                    if progress:
-                        progress(filename, done, total)
+            drain_response_to_file(resp, part, offset, name=filename,
+                                   total=total, chunk_size=self.chunk_size,
+                                   progress=progress)
         if expected_size and os.path.getsize(part) != expected_size:
             raise HubError(
                 f"{filename}: downloaded {os.path.getsize(part)} bytes, "

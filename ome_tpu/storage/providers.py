@@ -11,6 +11,7 @@ of three SDKs.
 
 from __future__ import annotations
 
+import http.client
 import os
 import shutil
 import time
@@ -20,7 +21,8 @@ import urllib.request
 import xml.etree.ElementTree as ET
 from typing import Dict, List, Optional
 
-from .base import ObjectInfo, Storage
+from .base import (ObjectInfo, ShortDownload, Storage, UnsafeObjectName,
+                   drain_response_to_file, safe_join)
 from .uri import StorageComponents, StorageType, StorageURIError
 
 
@@ -31,11 +33,14 @@ class LocalStorage(Storage):
         self.root = root
 
     def _p(self, name: str) -> str:
-        root = os.path.normpath(self.root)
-        p = os.path.normpath(os.path.join(root, name.lstrip("/")))
-        if p != root and os.path.commonpath([p, root]) != root:
-            raise StorageURIError(f"path escape: {name!r}")
-        return p
+        rel = name.lstrip("/")
+        if not rel or os.path.normpath(
+                os.path.join(self.root, rel)) == os.path.normpath(self.root):
+            return os.path.normpath(self.root)  # the root itself is fine
+        try:
+            return safe_join(self.root, rel)
+        except UnsafeObjectName as e:
+            raise StorageURIError(str(e)) from e
 
     def list(self, prefix: str = "") -> List[ObjectInfo]:
         base = self._p(prefix) if prefix else self.root
@@ -73,7 +78,7 @@ class LocalStorage(Storage):
         out = []
         for o in objs:
             rel = o.name[len(prefix):].lstrip("/") if prefix else o.name
-            dst = os.path.join(target_dir, rel)
+            dst = safe_join(target_dir, rel)
             os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
             src = self._p(o.name)
             if not (os.path.exists(dst)
@@ -162,6 +167,74 @@ class S3CompatStorage(Storage):
 
     def get(self, name: str) -> bytes:
         return self._request(self._url(name))
+
+    def get_to_file(self, name: str, path: str, progress=None,
+                    total: int = 0, etag: str = "",
+                    chunk_size: int = 1 << 20) -> int:
+        """Stream an object directly to disk with ranged-GET resume:
+        a retry continues from the bytes already on disk instead of
+        re-buffering the whole object in memory. The final byte count
+        is verified against the expected size (`total` from the
+        listing, else Content-Length/Content-Range) so a truncated
+        body can never be installed as a complete object. When the
+        listing supplied an ETag it rides If-Range, so a resume against
+        a re-uploaded object gets the full new body (200) instead of
+        splicing old-version and new-version bytes."""
+        url = self._url(name)
+        last: Optional[Exception] = None
+        for attempt in range(self.retries):
+            offset = os.path.getsize(path) if os.path.exists(path) else 0
+            if total and offset == total and not etag:
+                return offset  # crashed after the drain: already complete
+            extra = {}
+            if offset:
+                extra["Range"] = f"bytes={offset}-"
+                if etag:
+                    extra["If-Range"] = f'"{etag}"'
+            req = urllib.request.Request(
+                url, headers={**self.headers, **extra})
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    if offset and resp.getcode() != 206:
+                        offset = 0  # server ignored Range: restart clean
+                    length = int(resp.headers.get("Content-Length") or 0)
+                    # Content-Range total is authoritative on a 206
+                    crange = resp.headers.get("Content-Range") or ""
+                    cr_total = int(crange.rsplit("/", 1)[-1]) \
+                        if "/" in crange and crange.rsplit("/", 1)[-1].isdigit() \
+                        else 0
+                    full = total or cr_total or offset + length
+                    done = drain_response_to_file(
+                        resp, path, offset, name=name, total=full,
+                        chunk_size=chunk_size, progress=progress)
+                if full and done != full:
+                    # .part keeps the bytes; next attempt Range-resumes
+                    last = ShortDownload(
+                        f"{name}: got {done} bytes, expected {full}")
+                else:
+                    return done
+            except urllib.error.HTTPError as e:
+                if e.code == 416 and offset:
+                    if total and offset == total:
+                        # complete .part whose version the If-Range etag
+                        # just validated (a changed object returns 200)
+                        return offset
+                    # stale/oversized partial (e.g. from an older object
+                    # version): never trust it — restart clean
+                    os.remove(path)
+                    last = e
+                elif e.code not in (429, 500, 502, 503, 504):
+                    raise
+                else:
+                    last = e
+            except (urllib.error.URLError, http.client.HTTPException,
+                    OSError) as e:
+                # URLError covers connect failures; HTTPException
+                # (IncompleteRead) and OSError (reset, timeout) cover
+                # mid-body failures — all resume from the .part
+                last = e
+            time.sleep(self.backoff * (2 ** attempt))
+        raise last  # type: ignore[misc]
 
     def get_range(self, name: str, start: int, end: Optional[int] = None,
                   ) -> bytes:

@@ -96,10 +96,29 @@ def validate_inference_service(client: InMemoryClient,
 # -- ServingRuntime validator ----------------------------------------------
 
 
+def _size_ranges_overlap(a: v1.ServingRuntimeSpec,
+                         b: v1.ServingRuntimeSpec) -> bool:
+    """Two runtimes only compete for auto-selection when their
+    modelSizeRange intervals intersect; a missing range is unbounded
+    (servingruntime_webhook.go:48-330 scopes priority uniqueness the
+    same way so e.g. a <15B runtime and a 30B+ runtime may share a
+    priority for the same format)."""
+    ra, rb = a.model_size_range, b.model_size_range
+    lo_a = v1.parse_parameter_size(ra.min) or 0 if ra else 0
+    hi_a = (v1.parse_parameter_size(ra.max) or float("inf")) if ra \
+        else float("inf")
+    lo_b = v1.parse_parameter_size(rb.min) or 0 if rb else 0
+    hi_b = (v1.parse_parameter_size(rb.max) or float("inf")) if rb \
+        else float("inf")
+    return lo_a <= hi_b and lo_b <= hi_a
+
+
 def validate_serving_runtime(client: InMemoryClient, runtime,
                              cluster_scoped: bool):
-    """Priority must be unique among enabled runtimes supporting the same
-    model format+version (servingruntime_webhook.go behavior)."""
+    """Priority must be unique among enabled, auto-selectable runtimes
+    supporting the same (format, version, architecture, quantization)
+    whose model size ranges overlap (servingruntime_webhook.go behavior:
+    runtimes serving disjoint size classes never compete)."""
     errs: List[str] = []
     spec: v1.ServingRuntimeSpec = runtime.spec
     if not spec.supported_model_formats and not spec.containers \
@@ -121,12 +140,16 @@ def validate_serving_runtime(client: InMemoryClient, runtime,
             continue
         if peer.spec.is_disabled():
             continue
+        if not _size_ranges_overlap(spec, peer.spec):
+            continue
         for key, prio in entries(peer.spec):
             if key in mine and prio is not None and mine[key] is not None \
                     and prio == mine[key]:
                 errs.append(
-                    f"priority {prio} for model format {key[0]!r} conflicts "
-                    f"with runtime {peer.metadata.name!r}")
+                    f"priority {prio} for model format {key[0]!r} "
+                    f"(architecture {key[2]!r}) conflicts with runtime "
+                    f"{peer.metadata.name!r} over an overlapping model "
+                    f"size range")
     # per-accelerator override sanity
     for cfg in spec.accelerator_configs:
         if not cfg.accelerator_class:

@@ -285,7 +285,11 @@ def runtime_docs():
         "spec": {
             "supportedModelFormats": [
                 fmt("LlamaForCausalLM", prio=1),
-                fmt("Gemma2ForCausalLM", prio=2),
+                # prio 1: avoids the webhook collision with
+                # ome-engine-small (2) / vllm-tpu (3), which both overlap
+                # 1B-15B for Gemma2, without flipping auto-selection away
+                # from vllm-tpu for in-range Gemma2 models
+                fmt("Gemma2ForCausalLM", prio=1),
                 fmt("Gemma3ForConditionalGeneration", prio=2)],
             "modelSizeRange": {"min": "1B", "max": "80B"},
             "protocolVersions": ["openAI"],

@@ -449,3 +449,203 @@ class TestReviewFindings:
         gopher.drain()
         scout.stop()
         assert not custom.exists()
+
+
+# -- round-2 security hardening (ADVICE.md) ---------------------------------
+
+
+class TestPathTraversal:
+    def test_hub_rejects_dotdot_rfilename(self, hub_server, tmp_path):
+        from ome_tpu.storage.base import UnsafeObjectName
+        hub = HubClient(endpoint=hub_server, retries=1, backoff=0.01)
+        target = tmp_path / "model"
+        target.mkdir()
+        with pytest.raises(UnsafeObjectName):
+            hub.download_file("org/model", "../evil.txt", str(target))
+        assert not (tmp_path / "evil.txt").exists()
+
+    def test_hub_rejects_absolute_rfilename(self, hub_server, tmp_path):
+        from ome_tpu.storage.base import UnsafeObjectName
+        hub = HubClient(endpoint=hub_server, retries=1, backoff=0.01)
+        with pytest.raises(UnsafeObjectName):
+            hub.download_file("org/model", "/etc/cron.d/evil", str(tmp_path))
+
+    def test_storage_download_rejects_traversal_keys(self, tmp_path):
+        from ome_tpu.storage.base import (ObjectInfo, Storage,
+                                          UnsafeObjectName)
+
+        class Fake(Storage):
+            def list(self, prefix=""):
+                return [ObjectInfo("../../escape.bin", 1)]
+
+            def get(self, name):
+                return b"x"
+
+            def put(self, name, data):
+                pass
+
+            def exists(self, name):
+                return True
+
+        with pytest.raises(UnsafeObjectName):
+            Fake().download(str(tmp_path / "root"))
+
+
+class TestRedirectAuthStrip:
+    """hub.py:61 fix: Authorization must not follow cross-host redirects."""
+
+    def _servers(self):
+        seen = {}
+
+        class CDN(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                seen["auth"] = self.headers.get("Authorization")
+                body = b"weights"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        cdn = HTTPServer(("127.0.0.1", 0), CDN)
+
+        class Hub(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                # cross-host redirect: localhost vs 127.0.0.1 differ as
+                # hostnames but both reach loopback
+                self.send_response(302)
+                self.send_header(
+                    "Location",
+                    f"http://localhost:{cdn.server_port}{self.path}")
+                self.end_headers()
+
+        hub = HTTPServer(("127.0.0.1", 0), Hub)
+        for srv in (cdn, hub):
+            threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return hub, cdn, seen
+
+    def test_token_dropped_on_cross_host_redirect(self, tmp_path):
+        hub_srv, cdn, seen = self._servers()
+        try:
+            hub = HubClient(endpoint=f"http://127.0.0.1:{hub_srv.server_port}",
+                            token="sekrit", retries=1, backoff=0.01)
+            hub.download_file("org/model", "w.bin", str(tmp_path))
+            assert (tmp_path / "w.bin").read_bytes() == b"weights"
+            assert seen["auth"] is None
+        finally:
+            hub_srv.shutdown()
+            cdn.shutdown()
+
+
+S3_OBJECTS = {
+    "models/m/config.json": b'{"a": 1}',
+    "models/m/model.safetensors": os.urandom(3_000_000),
+}
+
+
+class S3Handler(BaseHTTPRequestHandler):
+    fail_after = {}  # key -> bytes to serve before dropping (resume test)
+
+    def log_message(self, *a):
+        pass
+
+    def _key(self):
+        # path is /<bucket>/<key>
+        return self.path.lstrip("/").split("/", 1)[1].split("?")[0]
+
+    def do_GET(self):
+        if "list-type=2" in self.path:
+            items = "".join(
+                f"<Contents><Key>{k}</Key><Size>{len(v)}</Size>"
+                f"<ETag>&quot;x&quot;</ETag></Contents>"
+                for k, v in S3_OBJECTS.items())
+            body = (f"<ListBucketResult>{items}</ListBucketResult>"
+                    ).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        import urllib.parse
+        key = urllib.parse.unquote(self._key())
+        data = S3_OBJECTS.get(key)
+        if data is None:
+            self.send_error(404)
+            return
+        rng = self.headers.get("Range")
+        if rng:
+            start = int(rng.split("=")[1].split("-")[0])
+            if start >= len(data):  # real S3: 416 Range Not Satisfiable
+                self.send_error(416)
+                return
+            body = data[start:]
+            self.send_response(206)
+            self.send_header("Content-Range",
+                             f"bytes {start}-{len(data)-1}/{len(data)}")
+        else:
+            body = data
+            self.send_response(200)
+        cut = S3Handler.fail_after.pop(key, None)
+        if cut is not None:
+            body = body[:cut]
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except BrokenPipeError:
+            pass
+
+
+@pytest.fixture()
+def s3_server():
+    srv = HTTPServer(("127.0.0.1", 0), S3Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+class TestS3CompatStreaming:
+    def test_download_tree_streams_to_disk(self, s3_server, tmp_path):
+        from ome_tpu.storage.providers import S3CompatStorage
+        st = S3CompatStorage(s3_server, "bkt", retries=2, backoff=0.01)
+        st.download(str(tmp_path), prefix="models/m")
+        assert (tmp_path / "model.safetensors").read_bytes() == \
+            S3_OBJECTS["models/m/model.safetensors"]
+        assert (tmp_path / "config.json").read_bytes() == \
+            S3_OBJECTS["models/m/config.json"]
+
+    def test_get_to_file_resumes_partial(self, s3_server, tmp_path):
+        from ome_tpu.storage.providers import S3CompatStorage
+        st = S3CompatStorage(s3_server, "bkt", retries=3, backoff=0.01)
+        key = "models/m/model.safetensors"
+        dst = tmp_path / "out.bin"
+        dst.write_bytes(S3_OBJECTS[key][:1_000_000])  # partial on disk
+        n = st.get_to_file(key, str(dst))
+        assert n == len(S3_OBJECTS[key])
+        assert dst.read_bytes() == S3_OBJECTS[key]
+
+    def test_truncated_body_not_installed(self, s3_server, tmp_path):
+        """A short body must retry (resume) — never return success with
+        fewer bytes than the listing promised."""
+        from ome_tpu.storage.providers import S3CompatStorage
+        st = S3CompatStorage(s3_server, "bkt", retries=3, backoff=0.01)
+        key = "models/m/model.safetensors"
+        S3Handler.fail_after[key] = 100_000  # first attempt truncated
+        st.download(str(tmp_path), prefix="models/m")
+        assert (tmp_path / "model.safetensors").read_bytes() == \
+            S3_OBJECTS[key]
+
+    def test_oversized_stale_part_restarts_clean(self, s3_server, tmp_path):
+        from ome_tpu.storage.providers import S3CompatStorage
+        st = S3CompatStorage(s3_server, "bkt", retries=3, backoff=0.01)
+        key = "models/m/config.json"
+        dst = tmp_path / "cfg.part"
+        dst.write_bytes(b"z" * (len(S3_OBJECTS[key]) + 50))  # stale, too big
+        st.get_to_file(key, str(dst))
+        assert dst.read_bytes() == S3_OBJECTS[key]
