@@ -56,6 +56,25 @@ def device_bandwidth() -> float:
     return HBM_GBPS["cpu" if d.platform == "cpu" else "v5e"]
 
 
+def measured_bandwidth() -> float:
+    """STREAM-style achievable read bandwidth (GB/s) on this device.
+
+    Roofline analysis conventionally uses *measured* bandwidth; on the
+    tunneled chips the achievable figure sits well below the part spec
+    (e.g. ~310 GB/s vs 819 on v5e), so the spec-based ratio would
+    understate kernel quality by ~2.5x. Both ratios are logged."""
+    gb = 2.0
+    x = jnp.ones((int(gb * 1e9 / 2),), jnp.bfloat16)
+    f = jax.jit(lambda x: jnp.sum(x.astype(jnp.float32)))
+    sync(f(x))
+    iters = 8
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = f(x)
+    sync(r)
+    return gb * iters / (time.perf_counter() - t0)
+
+
 def main() -> None:
     from ome_tpu.models import config as cfgs
     from ome_tpu.models import llama
@@ -75,6 +94,10 @@ def main() -> None:
 
     cache = llama.KVCache.create(cfg, BATCH, CACHE_LEN)
 
+    # NOTE: measured on the axon-tunneled chip, buffer donation and
+    # multi-step lax.scan/unrolled decode are all SLOWER than a plain
+    # python dispatch loop (donation ~-20%, scan ~-60%); keep the
+    # simple form the backend executes best.
     @jax.jit
     def prefill(params, tokens, cache):
         logits, cache = llama.forward(params, cfg, tokens, cache=cache)
@@ -92,6 +115,18 @@ def main() -> None:
     sync(tok)
     log(f"bench: prefill(batch={BATCH}, len={PREFILL}) + compile "
         f"{time.perf_counter()-t0:.1f}s")
+    # steady-state prefill (TTFT proxy at this batch/length): same
+    # [BATCH, PREFILL] shape as the compiled program, fresh cache
+    prompt2 = jax.random.randint(jax.random.PRNGKey(2), (BATCH, PREFILL),
+                                 0, cfg.vocab_size, dtype=jnp.int32)
+    cache2 = llama.KVCache.create(cfg, BATCH, CACHE_LEN)
+    t0 = time.perf_counter()
+    _tok2, cache2 = prefill(params, prompt2, cache2)
+    sync(_tok2)
+    ttft = time.perf_counter() - t0
+    log(f"bench: steady prefill {ttft*1000:.0f} ms "
+        f"({BATCH*PREFILL/ttft:.0f} prefill tok/s)")
+    del _tok2, cache2, prompt2
 
     # warmup decode (compile + one synced step)
     tok, cache = decode(params, tok, cache)
@@ -107,17 +142,27 @@ def main() -> None:
 
     # Roofline: per decode step the chip must read all weights once
     # (amortized across the batch) + each sequence's KV cache.
-    bw = device_bandwidth()
+    bw_spec = device_bandwidth()
+    bw_meas = measured_bandwidth()
     kv_bytes = (cfg.num_layers * CACHE_LEN * cfg.num_kv_heads * cfg.head_dim
                 * 2 * 2)  # k+v, bf16, per sequence
     step_bytes = n_params * 2 + BATCH * kv_bytes
-    roofline_steps = bw * 1e9 / step_bytes
-    roofline_toks = roofline_steps * BATCH
-    vs = toks_per_s / roofline_toks
+    roof_spec = bw_spec * 1e9 / step_bytes * BATCH
+    roof_meas = bw_meas * 1e9 / step_bytes * BATCH
+    # vs_baseline uses the SPEC roofline: deterministic and comparable
+    # across rounds. The measured figure (STREAM-style, highly variable
+    # on the shared/tunneled chip: 70-310 GB/s observed) is logged for
+    # context — decode's own effective bandwidth (step_bytes/step time)
+    # routinely EXCEEDS the microbenchmark, i.e. the model is at this
+    # environment's practical memory-bandwidth ceiling.
+    vs = toks_per_s / roof_spec
+    eff_gbps = step_bytes * steps / dt / 1e9
 
     log(f"bench: decode {steps} steps x batch {BATCH} in {dt:.2f}s "
-        f"-> {toks_per_s:.1f} tok/s (roofline {roofline_toks:.0f}, "
-        f"{100*vs:.1f}%)")
+        f"-> {toks_per_s:.1f} tok/s (effective {eff_gbps:.0f} GB/s)")
+    log(f"bench: roofline vs spec bw ({bw_spec:.0f} GB/s): "
+        f"{roof_spec:.0f} tok/s -> {100*vs:.1f}% | STREAM-measured bw "
+        f"{bw_meas:.0f} GB/s -> {roof_meas:.0f} tok/s")
     print(json.dumps({
         "metric": "decode_tokens_per_sec_1.9B_bf16_batch32",
         "value": round(toks_per_s, 1),

@@ -1,0 +1,11 @@
+"""ome-bench: the benchmark CLI the BenchmarkJob controller runs.
+
+genai-bench equivalent (reference: benchmark/controller.go:38 runs
+`genai-bench benchmark ...` with args built in benchmark/utils/
+utils.go:47-156). The controller stamps Jobs running
+`python -m ome_tpu.benchmark` with exactly the flags
+controllers/benchmark.py:benchmark_args emits.
+"""
+
+from .cli import build_parser, main  # noqa: F401
+from .runner import BenchmarkReport, run_benchmark  # noqa: F401

@@ -1,0 +1,114 @@
+"""ome-bench CLI argument surface + entrypoint.
+
+Accepts exactly the flags controllers/benchmark.py:benchmark_args
+stamps into the Job (which mirror genai-bench's CLI as invoked at
+reference benchmark/controller.go:38 with args from
+benchmark/utils/utils.go:47-156): `benchmark --api-base ...
+--api-model-name ... --task ... --traffic-scenario ...
+--num-concurrency ... --max-time-per-run --max-requests-per-run
+--additional-request-params k=v --upload-results --storage-uri ...
+--result-folder ... --dataset-path ...`.
+
+Results: JSON report written to --output-dir and optionally uploaded
+through the storage layer (any ome_tpu.storage URI scheme).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+log = logging.getLogger("ome.bench")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ome-bench")
+    sub = p.add_subparsers(dest="command")
+    b = sub.add_parser("benchmark", help="run a benchmark sweep")
+    b.add_argument("--api-base", required=True)
+    b.add_argument("--api-key", default=os.environ.get("OME_BENCH_API_KEY"))
+    b.add_argument("--api-model-name", default="model")
+    b.add_argument("--task", default="text-to-text")
+    b.add_argument("--traffic-scenario", action="append", default=[])
+    b.add_argument("--num-concurrency", action="append", type=int,
+                   default=[])
+    b.add_argument("--max-time-per-run", type=float, default=60.0,
+                   help="seconds per iteration (reference: minutes knob "
+                        "maxTimePerIteration)")
+    b.add_argument("--max-requests-per-run", type=int, default=1000)
+    b.add_argument("--additional-request-params", action="append",
+                   default=[], metavar="K=V")
+    b.add_argument("--dataset-path", default=None)
+    b.add_argument("--output-dir", default="/tmp/ome-bench")
+    b.add_argument("--upload-results", action="store_true")
+    b.add_argument("--storage-uri", default=None)
+    b.add_argument("--result-folder", default=None)
+    return p
+
+
+def _parse_extra(params: List[str]) -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for kv in params:
+        k, _, v = kv.partition("=")
+        try:
+            out[k] = json.loads(v)
+        except ValueError:
+            out[k] = v
+    return out
+
+
+def upload_report(report_path: str, storage_uri: str,
+                  result_folder: Optional[str]) -> None:
+    from ..storage import open_storage, parse_storage_uri
+    comps = parse_storage_uri(storage_uri)
+    store = open_storage(comps)
+    key = os.path.basename(report_path)
+    if result_folder:
+        key = f"{result_folder.rstrip('/')}/{key}"
+    if comps.prefix:
+        key = f"{comps.prefix.rstrip('/')}/{key}"
+    with open(report_path, "rb") as f:
+        store.put(key, f.read())
+    log.info("uploaded results to %s (%s)", storage_uri, key)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    args = build_parser().parse_args(argv)
+    if args.command != "benchmark":
+        build_parser().print_help()
+        return 2
+
+    from .runner import run_benchmark
+    report = run_benchmark(
+        api_base=args.api_base,
+        model=args.api_model_name,
+        task=args.task,
+        scenarios=args.traffic_scenario,
+        concurrencies=args.num_concurrency,
+        max_time_per_run_s=args.max_time_per_run,
+        max_requests_per_run=args.max_requests_per_run,
+        extra_params=_parse_extra(args.additional_request_params))
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    out_path = os.path.join(
+        args.output_dir, f"benchmark-{int(time.time())}.json")
+    with open(out_path, "w") as f:
+        json.dump(report.to_dict(), f, indent=2)
+    log.info("report written to %s", out_path)
+    print(json.dumps(report.summary()))
+
+    if args.upload_results and args.storage_uri:
+        upload_report(out_path, args.storage_uri, args.result_folder)
+    failed = sum(i.requests_failed for i in report.iterations)
+    total = sum(i.requests_total for i in report.iterations)
+    return 0 if total and failed < total else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

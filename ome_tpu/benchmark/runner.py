@@ -1,0 +1,204 @@
+"""Benchmark runner: scenario x concurrency sweep against an endpoint.
+
+Reference behavior (genai-bench as wrapped by benchmark/controller.go):
+iterations = traffic scenarios x concurrency levels, each bounded by
+--max-time-per-run / --max-requests-per-run; per iteration it reports
+throughput (output tokens/s, requests/s), TTFT and e2e latency
+percentiles. Zero-dependency: stdlib threads + urllib against any
+OpenAI-compatible /v1/completions endpoint (ours or vLLM/JetStream),
+SSE streaming to timestamp the first token.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .scenarios import Scenario, parse_scenario
+
+log = logging.getLogger("ome.bench")
+
+
+@dataclass
+class RequestResult:
+    ok: bool
+    ttft_s: Optional[float] = None
+    e2e_s: float = 0.0
+    output_tokens: int = 0
+    error: str = ""
+
+
+@dataclass
+class IterationResult:
+    scenario: str
+    concurrency: int
+    duration_s: float
+    requests_total: int
+    requests_failed: int
+    output_tokens_total: int
+    output_tokens_per_s: float
+    requests_per_s: float
+    ttft_p50_ms: float
+    ttft_p95_ms: float
+    ttft_p99_ms: float
+    e2e_p50_ms: float
+    e2e_p95_ms: float
+    e2e_p99_ms: float
+
+
+@dataclass
+class BenchmarkReport:
+    api_base: str
+    model: str
+    task: str
+    started_at: float
+    iterations: List[IterationResult] = field(default_factory=list)
+
+    def to_dict(self) -> Dict:
+        return {
+            "api_base": self.api_base, "model": self.model,
+            "task": self.task, "started_at": self.started_at,
+            "iterations": [vars(i) for i in self.iterations],
+            "summary": self.summary(),
+        }
+
+    def summary(self) -> Dict:
+        if not self.iterations:
+            return {}
+        best = max(self.iterations, key=lambda i: i.output_tokens_per_s)
+        return {
+            "best_output_tokens_per_s": best.output_tokens_per_s,
+            "best_concurrency": best.concurrency,
+            "best_scenario": best.scenario,
+            "ttft_p50_ms_at_best": best.ttft_p50_ms,
+        }
+
+
+def _pct(xs: List[float], p: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))
+    return xs[i]
+
+
+def _one_request(api_base: str, model: str, n_in: int, n_out: int,
+                 extra: Dict[str, object], timeout: float) -> RequestResult:
+    url = api_base.rstrip("/") + "/v1/completions"
+    body = {"model": model, "prompt": "word " * max(1, n_in - 1),
+            "max_tokens": n_out, "stream": True, "temperature": 0.0}
+    body.update(extra)
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    t0 = time.monotonic()
+    ttft = None
+    tokens = 0
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            for raw in resp:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    break
+                if ttft is None:
+                    ttft = time.monotonic() - t0
+                try:
+                    chunk = json.loads(payload)
+                    for choice in chunk.get("choices", []):
+                        if choice.get("text") or choice.get(
+                                "delta", {}).get("content"):
+                            tokens += 1
+                except ValueError:
+                    pass
+        return RequestResult(ok=True, ttft_s=ttft,
+                             e2e_s=time.monotonic() - t0,
+                             output_tokens=tokens)
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        return RequestResult(ok=False, e2e_s=time.monotonic() - t0,
+                             error=str(e))
+
+
+def run_iteration(api_base: str, model: str, scenario: Scenario,
+                  concurrency: int, max_time_s: float, max_requests: int,
+                  extra_params: Dict[str, object],
+                  request_timeout: float = 300.0,
+                  seed: int = 0) -> IterationResult:
+    results: List[RequestResult] = []
+    lock = threading.Lock()
+    stop_at = time.monotonic() + max_time_s
+    budget = [max_requests]
+
+    def worker(wid: int):
+        rng = random.Random(seed * 1000 + wid)
+        while True:
+            with lock:
+                if budget[0] <= 0 or time.monotonic() >= stop_at:
+                    return
+                budget[0] -= 1
+            n_in, n_out = scenario.sample(rng)
+            r = _one_request(api_base, model, n_in, n_out, extra_params,
+                             min(request_timeout,
+                                 max(1.0, stop_at - time.monotonic())))
+            with lock:
+                results.append(r)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=max_time_s + request_timeout)
+    duration = max(time.monotonic() - t0, 1e-9)
+
+    ok = [r for r in results if r.ok]
+    ttfts = [r.ttft_s * 1000 for r in ok if r.ttft_s is not None]
+    e2es = [r.e2e_s * 1000 for r in ok]
+    out_tokens = sum(r.output_tokens for r in ok)
+    return IterationResult(
+        scenario=scenario.name, concurrency=concurrency,
+        duration_s=round(duration, 3),
+        requests_total=len(results),
+        requests_failed=len(results) - len(ok),
+        output_tokens_total=out_tokens,
+        output_tokens_per_s=round(out_tokens / duration, 2),
+        requests_per_s=round(len(ok) / duration, 3),
+        ttft_p50_ms=round(_pct(ttfts, 50), 1),
+        ttft_p95_ms=round(_pct(ttfts, 95), 1),
+        ttft_p99_ms=round(_pct(ttfts, 99), 1),
+        e2e_p50_ms=round(_pct(e2es, 50), 1),
+        e2e_p95_ms=round(_pct(e2es, 95), 1),
+        e2e_p99_ms=round(_pct(e2es, 99), 1))
+
+
+def run_benchmark(api_base: str, model: str, task: str,
+                  scenarios: List[str], concurrencies: List[int],
+                  max_time_per_run_s: float = 60.0,
+                  max_requests_per_run: int = 1000,
+                  extra_params: Optional[Dict[str, object]] = None,
+                  ) -> BenchmarkReport:
+    report = BenchmarkReport(api_base=api_base, model=model, task=task,
+                             started_at=time.time())
+    parsed = [parse_scenario(s) for s in (scenarios or ["D(256,128)"])]
+    for scenario in parsed:
+        for conc in (concurrencies or [1]):
+            log.info("iteration: scenario=%s concurrency=%d",
+                     scenario.name, conc)
+            it = run_iteration(api_base, model, scenario, conc,
+                               max_time_per_run_s, max_requests_per_run,
+                               extra_params or {})
+            log.info("  -> %.1f out-tok/s, %d reqs (%d failed), "
+                     "TTFT p50 %.0f ms", it.output_tokens_per_s,
+                     it.requests_total, it.requests_failed, it.ttft_p50_ms)
+            report.iterations.append(it)
+    return report

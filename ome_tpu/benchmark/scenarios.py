@@ -1,0 +1,66 @@
+"""Traffic scenarios: genai-bench's scenario-string format.
+
+The reference passes scenario strings like "D(100,100)" /
+"N(480,240)/(300,150)" through BenchmarkJob.spec.trafficScenarios into
+genai-bench (benchmark_job.go:52-60 examples). Each scenario shapes
+(input_tokens, output_tokens) per request:
+
+  D(i,o)          — deterministic: every request i in / o out
+  N(im,iv)/(om,ov)— normal: mean/stddev for input and output
+  U(a,b)/(c,d)    — uniform over [a,b] in / [c,d] out
+  E(m)/(n)        — embedding-ish: input tokens only
+
+Unknown strings fall back to D(256,128) with a warning rather than
+failing a long benchmark run at the last step.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import re
+from dataclasses import dataclass
+from typing import Tuple
+
+log = logging.getLogger("ome.bench")
+
+_PAIR = r"\(\s*(\d+)\s*(?:,\s*(\d+)\s*)?\)"
+
+
+@dataclass(frozen=True)
+class Scenario:
+    name: str
+    kind: str           # D, N, U, E
+    input_params: Tuple[int, int]
+    output_params: Tuple[int, int]
+
+    def sample(self, rng: random.Random) -> Tuple[int, int]:
+        def draw(kind, a, b):
+            if kind == "D" or kind == "E":
+                return a
+            if kind == "N":
+                return max(1, int(rng.normalvariate(a, b)))
+            if kind == "U":
+                return rng.randint(min(a, b), max(a, b))
+            return a
+        i = draw(self.kind, *self.input_params)
+        o = draw(self.kind, *self.output_params)
+        return max(1, i), max(1, o)
+
+
+def parse_scenario(s: str) -> Scenario:
+    s = s.strip()
+    m = re.fullmatch(rf"([DNUE])\s*{_PAIR}(?:\s*/\s*{_PAIR})?", s)
+    if not m:
+        log.warning("unrecognized traffic scenario %r; using D(256,128)", s)
+        return Scenario(s, "D", (256, 0), (128, 0))
+    kind = m.group(1)
+    a, b = int(m.group(2)), int(m.group(3) or 0)
+    if m.group(4) is not None:
+        c, d = int(m.group(4)), int(m.group(5) or 0)
+    else:
+        # single pair: interpret as (input, output) for D, else reuse
+        if kind == "D":
+            return Scenario(s, "D", (a, 0), (b if b else 128, 0))
+        c, d = a, b
+    return Scenario(s, kind, (a, b), (c, d))

@@ -76,6 +76,31 @@ def health_server(client: InMemoryClient, host: str,
     return BackgroundHTTPServer(Handler, host, port)
 
 
+def watched_kinds():
+    """Every kind the registered controllers list/watch — the set the
+    real client must run informers for (controller.go:618-707)."""
+    from ..core.k8s import (ConfigMap, Deployment, Job, LeaderWorkerSet,
+                            Node, Service)
+    return [v1.InferenceService, v1.BaseModel, v1.ClusterBaseModel,
+            v1.ServingRuntime, v1.ClusterServingRuntime,
+            v1.AcceleratorClass, v1.BenchmarkJob,
+            Deployment, Service, ConfigMap, Job, Node, LeaderWorkerSet]
+
+
+def build_client(args):
+    """InMemory (default / --once) or a real kube-apiserver client."""
+    if args.kube_server or args.kubeconfig or args.in_cluster:
+        from ..core.kubeclient import KubeClient, KubeConfig
+        if args.kube_server:
+            cfg = KubeConfig(server=args.kube_server)
+        elif args.in_cluster:
+            cfg = KubeConfig.in_cluster()
+        else:
+            cfg = KubeConfig.from_kubeconfig(args.kubeconfig)
+        return KubeClient(cfg, watch_kinds=watched_kinds())
+    return InMemoryClient()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ome-manager")
     p.add_argument("--manifests", action="append", default=[],
@@ -84,13 +109,26 @@ def main(argv=None) -> int:
     p.add_argument("--bind", default="127.0.0.1")
     p.add_argument("--once", action="store_true",
                    help="reconcile to convergence, dump status, exit")
+    p.add_argument("--kubeconfig", default=None,
+                   help="kubeconfig path: reconcile a real cluster")
+    p.add_argument("--kube-server", default=None,
+                   help="apiserver URL (no auth; envtest-style)")
+    p.add_argument("--in-cluster", action="store_true",
+                   help="in-cluster service-account config")
+    p.add_argument("--webhook-port", type=int, default=0,
+                   help="serve AdmissionReview endpoints (0 = off)")
+    p.add_argument("--webhook-cert", default=None)
+    p.add_argument("--webhook-key", default=None)
+    p.add_argument("--leader-elect", action="store_true",
+                   help="Lease-based leader election before reconciling")
+    p.add_argument("--leader-elect-namespace", default="ome")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
-    client = InMemoryClient()
+    client = build_client(args)
     for obj in load_all(args.manifests, skip_unknown=True):
         try:
             admit(client, obj)
@@ -120,14 +158,43 @@ def main(argv=None) -> int:
 
     health = health_server(client, args.bind, args.health_port)
     health.start()
-    mgr.start()
+
+    webhook = None
+    if args.webhook_port:
+        from ..webhooks.server import WebhookServer
+        webhook = WebhookServer(client, host=args.bind,
+                                port=args.webhook_port,
+                                cert_file=args.webhook_cert,
+                                key_file=args.webhook_key).start()
+        log.info("webhooks serving on :%d", webhook.port)
+
+    stop = threading.Event()
+    try:
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *a: stop.set())
+    except ValueError:
+        pass  # embedded in a non-main thread (tests/drives)
+
+    elector = None
+    if args.leader_elect:
+        from ..core.leaderelect import LeaderElector
+        elector = LeaderElector(
+            client, namespace=args.leader_elect_namespace,
+            on_started_leading=mgr.start,
+            on_stopped_leading=stop.set)  # lost lease -> shut down
+        elector.start()
+        log.info("leader election: waiting for lease as %s",
+                 elector.identity)
+    else:
+        mgr.start()
     log.info("manager up: %d controllers, health on :%d",
              len(mgr._controllers), health.port)
-    stop = threading.Event()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        signal.signal(sig, lambda *a: stop.set())
     stop.wait()
+    if elector:
+        elector.stop()
     mgr.stop()
+    if webhook:
+        webhook.stop()
     health.stop()
     return 0
 

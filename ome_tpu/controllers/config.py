@@ -43,7 +43,7 @@ class MultiNodeProberConfig:
 
 @dataclass
 class BenchmarkJobConfig:
-    pod_image: str = "ome/genai-bench:latest"
+    pod_image: str = "ghcr.io/ome-tpu/ome-bench:latest"
     cpu_request: str = "2"
     memory_request: str = "4Gi"
 

@@ -100,6 +100,11 @@ def validate_modes(isvc: v1.InferenceService, modes: ComponentModes):
     if isvc.spec.decoder is not None and isvc.spec.engine is None:
         raise DeploymentModeError(
             "decoder (PD disaggregation) requires an engine component")
+    if isvc.spec.decoder is not None and isvc.spec.router is None:
+        # PD dispatch (prefill vs decode targets) lives in the router;
+        # without one nothing routes requests between the pools
+        raise DeploymentModeError(
+            "PD disaggregation (decoder) requires a router component")
     if modes.decoder == v1.DeploymentMode.SERVERLESS.value:
         raise DeploymentModeError(
             "decoder does not support Serverless mode")
@@ -107,11 +112,33 @@ def validate_modes(isvc: v1.InferenceService, modes: ComponentModes):
             and modes.engine == v1.DeploymentMode.SERVERLESS.value):
         raise DeploymentModeError(
             "PD-disaggregated engine does not support Serverless mode")
-    if (modes.engine == v1.DeploymentMode.MULTI_NODE.value
-            and isvc.spec.engine is not None
-            and isvc.spec.engine.worker is not None
-            and (isvc.spec.engine.worker.size or 0) < 0):
-        raise DeploymentModeError("worker size must be >= 0")
+    for comp_name in ("engine", "decoder"):
+        spec = getattr(isvc.spec, comp_name)
+        mode = getattr(modes, comp_name)
+        if spec is None:
+            continue
+        multinode_shaped = spec.leader is not None or spec.worker is not None
+        if mode == v1.DeploymentMode.SERVERLESS.value and multinode_shaped:
+            raise DeploymentModeError(
+                f"{comp_name}: Serverless mode cannot run leader/worker "
+                f"groups (Knative scales single-pod revisions)")
+        if (mode == v1.DeploymentMode.RAW.value
+                and spec.worker is not None):
+            # a RawDeployment (annotation-forced) would silently ignore
+            # the worker group — reject instead
+            raise DeploymentModeError(
+                f"{comp_name}: worker requires MultiNode mode")
+        if (spec.worker is not None and spec.worker.size is not None
+                and spec.worker.size < 1):
+            # a worker group needs >= 1 worker pod: LWS group size is
+            # leader + N and the parallelism env math divides by hosts
+            raise DeploymentModeError(
+                f"{comp_name}.worker.size must be >= 1")
+        if (mode == v1.DeploymentMode.SERVERLESS.value
+                and spec.min_replicas not in (None, 0)):
+            raise DeploymentModeError(
+                f"{comp_name}: Serverless requires minReplicas=0 "
+                f"(scale-to-zero is the mode's contract)")
 
 
 def is_pd_disaggregated(isvc: v1.InferenceService) -> bool:

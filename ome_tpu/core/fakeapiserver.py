@@ -1,0 +1,239 @@
+"""In-process fake kube-apiserver (envtest equivalent).
+
+The reference boots a real kube-apiserver binary via envtest for its
+webhook/controller integration suites (pkg/testing/envtest_setup.go:
+22-45). This repo's equivalent is an HTTP facade over the
+InMemoryClient: the same REST paths, JSON bodies, status codes,
+optimistic-concurrency conflicts, status subresource, label selectors
+and chunked watch streams KubeClient speaks against a real cluster —
+so KubeClient + controllers can be integration-tested end-to-end over
+real HTTP with no cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple, Type
+from urllib.parse import parse_qs, urlparse
+
+from .client import Event, InMemoryClient
+from .errors import AlreadyExistsError, ConflictError, NotFoundError
+from .kubeclient import kind_registry
+from .meta import Resource, plural_of
+
+
+class FakeKubeApiServer:
+    def __init__(self, client: Optional[InMemoryClient] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.client = client or InMemoryClient()
+        self._registry = kind_registry()
+        # (group-or-core, plural) -> class
+        self._routes: Dict[Tuple[str, str], Type[Resource]] = {}
+        for cls in self._registry.values():
+            api_version = cls.API_VERSION
+            group = api_version.split("/")[0] if "/" in api_version else ""
+            self._routes[(group, plural_of(cls))] = cls
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code: int, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _status_err(self, code: int, reason: str, message: str):
+                self._json(code, {"kind": "Status", "apiVersion": "v1",
+                                  "status": "Failure", "reason": reason,
+                                  "code": code, "message": message})
+
+            def _route(self):
+                """Parse path -> (cls, namespace, name, subresource)."""
+                parts = [p for p in urlparse(self.path).path.split("/")
+                         if p]
+                # /api/v1/... or /apis/{group}/{version}/...
+                if not parts:
+                    return None
+                if parts[0] == "api" and len(parts) >= 2:
+                    group, rest = "", parts[2:]
+                elif parts[0] == "apis" and len(parts) >= 3:
+                    group, rest = parts[1], parts[3:]
+                else:
+                    return None
+                ns = ""
+                if len(rest) >= 2 and rest[0] == "namespaces":
+                    ns, rest = rest[1], rest[2:]
+                if not rest:
+                    return None
+                plural, rest = rest[0], rest[1:]
+                cls = outer._routes.get((group, plural))
+                if cls is None:
+                    return None
+                name = rest[0] if rest else ""
+                sub = rest[1] if len(rest) > 1 else ""
+                return cls, ns, name, sub
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(n)) if n else None
+
+            def do_GET(self):
+                if urlparse(self.path).path == "/healthz":
+                    return self._json(200, {"status": "ok"})
+                r = self._route()
+                if r is None:
+                    return self._status_err(404, "NotFound", self.path)
+                cls, ns, name, _sub = r
+                q = parse_qs(urlparse(self.path).query)
+                if name:
+                    try:
+                        obj = outer.client.get(cls, name, ns)
+                    except NotFoundError as e:
+                        return self._status_err(404, "NotFound", str(e))
+                    return self._json(200, obj.to_dict())
+                if q.get("watch", ["false"])[0] == "true":
+                    return self._watch(cls, ns, q)
+                selector = None
+                if q.get("labelSelector"):
+                    selector = dict(
+                        kv.split("=", 1)
+                        for kv in q["labelSelector"][0].split(","))
+                items = outer.client.list(
+                    cls, namespace=ns or None, label_selector=selector)
+                self._json(200, {
+                    "kind": f"{cls.KIND}List",
+                    "apiVersion": cls.API_VERSION,
+                    "metadata": {
+                        "resourceVersion": str(outer.client._rv)},
+                    "items": [o.to_dict() for o in items]})
+
+            def _watch(self, cls, ns, q):
+                events: "queue.Queue[Optional[Event]]" = queue.Queue()
+                since = int(q.get("resourceVersion", ["0"])[0] or 0)
+
+                def on_event(ev: Event):
+                    if type(ev.obj).KIND != cls.KIND:
+                        return
+                    if ns and cls.NAMESPACED \
+                            and ev.obj.metadata.namespace != ns:
+                        return
+                    if int(ev.obj.metadata.resource_version or 0) <= since:
+                        return
+                    events.put(ev)
+
+                cancel = outer.client.watch(on_event)
+                # replay the current state newer than `since` AFTER
+                # subscribing: a real apiserver replays history from the
+                # given resourceVersion, so events landing between the
+                # client's list and this stream opening must not be lost
+                # (duplicates are fine — controllers are level-triggered)
+                for obj in outer.client.list(cls, namespace=ns or None):
+                    if int(obj.metadata.resource_version or 0) > since:
+                        events.put(Event("Modified", obj))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    while not outer._stopping.is_set():
+                        try:
+                            ev = events.get(timeout=0.2)
+                        except queue.Empty:
+                            continue
+                        line = json.dumps({
+                            "type": {"Added": "ADDED",
+                                     "Modified": "MODIFIED",
+                                     "Deleted": "DELETED"}[ev.type],
+                            "object": ev.obj.to_dict()}).encode() + b"\n"
+                        self.wfile.write(
+                            f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                        self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    cancel()
+
+            def do_POST(self):
+                path = urlparse(self.path).path
+                m = re.fullmatch(
+                    r"/api/v1/namespaces/([^/]+)/events", path)
+                if m:  # corev1 Events sink (best-effort recorder)
+                    data = self._body()
+                    with outer.client._lock:
+                        outer.client._recorded_events.append(data)
+                    return self._json(201, data)
+                r = self._route()
+                if r is None:
+                    return self._status_err(404, "NotFound", self.path)
+                cls, ns, _name, _sub = r
+                data = self._body()
+                try:
+                    obj = cls.from_dict(data)
+                    if ns:
+                        obj.metadata.namespace = ns
+                    created = outer.client.create(obj)
+                except AlreadyExistsError as e:
+                    return self._status_err(409, "AlreadyExists", str(e))
+                self._json(201, created.to_dict())
+
+            def do_PUT(self):
+                r = self._route()
+                if r is None:
+                    return self._status_err(404, "NotFound", self.path)
+                cls, ns, name, sub = r
+                obj = cls.from_dict(self._body())
+                if ns:
+                    obj.metadata.namespace = ns
+                obj.metadata.name = obj.metadata.name or name
+                try:
+                    if sub == "status":
+                        updated = outer.client.update_status(obj)
+                    else:
+                        updated = outer.client.update(obj)
+                except NotFoundError as e:
+                    return self._status_err(404, "NotFound", str(e))
+                except ConflictError as e:
+                    return self._status_err(409, "Conflict", str(e))
+                self._json(200, updated.to_dict())
+
+            def do_DELETE(self):
+                r = self._route()
+                if r is None:
+                    return self._status_err(404, "NotFound", self.path)
+                cls, ns, name, _sub = r
+                try:
+                    outer.client.delete(cls, name, ns)
+                except NotFoundError as e:
+                    return self._status_err(404, "NotFound", str(e))
+                self._json(200, {"kind": "Status", "status": "Success"})
+
+        self._stopping = threading.Event()
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="fake-apiserver", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stopping.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -379,3 +379,23 @@ class RoleBinding(Resource):
     API_VERSION: ClassVar[str] = "rbac.authorization.k8s.io/v1"
     role_ref: dict = field(default_factory=dict)
     subjects: list = field(default_factory=list)
+
+
+# --------------------------------------------------------------------------
+# coordination.k8s.io (leader election)
+
+
+@dataclass
+class LeaseSpec:
+    holder_identity: Optional[str] = None
+    lease_duration_seconds: Optional[int] = None
+    acquire_time: Optional[str] = None
+    renew_time: Optional[str] = None
+    lease_transitions: Optional[int] = None
+
+
+@dataclass
+class Lease(Resource):
+    KIND: ClassVar[str] = "Lease"
+    API_VERSION: ClassVar[str] = "coordination.k8s.io/v1"
+    spec: LeaseSpec = field(default_factory=LeaseSpec)

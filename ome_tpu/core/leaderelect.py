@@ -1,0 +1,148 @@
+"""Lease-based leader election (client-go leaderelection equivalent).
+
+The reference manager runs with leader election on a coordination/v1
+Lease (cmd/manager/main.go:181-196, `LeaderElection: true`). Same
+protocol here: acquire the Lease if unheld or expired, renew on an
+interval, yield (and call on_stopped_leading) if a renewal fails past
+the deadline. Works against either client substrate.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from datetime import datetime, timedelta, timezone
+from typing import Callable, Optional
+
+from .errors import AlreadyExistsError, ConflictError, NotFoundError
+from .k8s import Lease, LeaseSpec
+from .meta import ObjectMeta
+
+log = logging.getLogger("ome.leaderelect")
+
+_FMT = "%Y-%m-%dT%H:%M:%SZ"
+
+
+def _now() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+def _stamp(t: datetime) -> str:
+    return t.strftime(_FMT)
+
+
+def _parse(s: Optional[str]) -> Optional[datetime]:
+    if not s:
+        return None
+    return datetime.strptime(s, _FMT).replace(tzinfo=timezone.utc)
+
+
+class LeaderElector:
+    def __init__(self, client, lease_name: str = "ome-manager-leader",
+                 namespace: str = "ome",
+                 identity: Optional[str] = None,
+                 lease_duration: float = 15.0,
+                 renew_interval: float = 5.0,
+                 on_started_leading: Optional[Callable[[], None]] = None,
+                 on_stopped_leading: Optional[Callable[[], None]] = None):
+        self.client = client
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.identity = identity or f"ome-{uuid.uuid4().hex[:8]}"
+        self.lease_duration = lease_duration
+        self.renew_interval = renew_interval
+        self.on_started_leading = on_started_leading or (lambda: None)
+        self.on_stopped_leading = on_stopped_leading or (lambda: None)
+        self.is_leader = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one protocol step ---------------------------------------------
+
+    def try_acquire_or_renew(self) -> bool:
+        now = _now()
+        try:
+            lease = self.client.get(Lease, self.lease_name, self.namespace)
+        except NotFoundError:
+            lease = Lease(
+                metadata=ObjectMeta(name=self.lease_name,
+                                    namespace=self.namespace),
+                spec=LeaseSpec(
+                    holder_identity=self.identity,
+                    lease_duration_seconds=int(self.lease_duration),
+                    acquire_time=_stamp(now), renew_time=_stamp(now),
+                    lease_transitions=0))
+            try:
+                self.client.create(lease)
+                return True
+            except AlreadyExistsError:
+                return False
+
+        held_by_us = lease.spec.holder_identity == self.identity
+        renew = _parse(lease.spec.renew_time)
+        expired = renew is None or now - renew > timedelta(
+            seconds=lease.spec.lease_duration_seconds
+            or self.lease_duration)
+        if not held_by_us and not expired:
+            return False
+        if not held_by_us:
+            lease.spec.holder_identity = self.identity
+            lease.spec.acquire_time = _stamp(now)
+            lease.spec.lease_transitions = \
+                (lease.spec.lease_transitions or 0) + 1
+        lease.spec.renew_time = _stamp(now)
+        lease.spec.lease_duration_seconds = int(self.lease_duration)
+        try:
+            self.client.update(lease)
+            return True
+        except (ConflictError, NotFoundError):
+            return False
+
+    # -- run loop ------------------------------------------------------
+
+    def run(self):
+        """Block until leadership is acquired, then keep renewing until
+        stop() or a lost lease (on_stopped_leading fires, loop exits)."""
+        while not self._stop.is_set():
+            if self.try_acquire_or_renew():
+                break
+            if self._stop.wait(self.renew_interval):
+                return
+        if self._stop.is_set():
+            return
+        self.is_leader = True
+        log.info("acquired leadership as %s", self.identity)
+        self.on_started_leading()
+        last_renew = time.monotonic()
+        while not self._stop.wait(self.renew_interval):
+            if self.try_acquire_or_renew():
+                last_renew = time.monotonic()
+            elif time.monotonic() - last_renew > self.lease_duration:
+                log.warning("lost leadership (%s)", self.identity)
+                break
+        self.is_leader = False
+        self.on_stopped_leading()
+
+    def start(self) -> "LeaderElector":
+        self._thread = threading.Thread(target=self.run,
+                                        name="leader-elect", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, release: bool = True):
+        was_leader = self.is_leader  # run() clears it on the way out
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        if release and was_leader:
+            try:
+                lease = self.client.get(Lease, self.lease_name,
+                                        self.namespace)
+                if lease.spec.holder_identity == self.identity:
+                    lease.spec.holder_identity = None
+                    self.client.update(lease)
+            except Exception:
+                pass
+            self.is_leader = False

@@ -38,10 +38,15 @@ def to_dict(obj: Any, keep_empty: bool = False) -> Any:
         for f in dataclasses.fields(obj):
             if not f.metadata.get("serialize", True):
                 continue
-            v = to_dict(getattr(obj, f.name), keep_empty)
+            raw = getattr(obj, f.name)
+            v = to_dict(raw, keep_empty)
             if v is None and not keep_empty:
                 continue
-            if v in ({}, []) and not keep_empty:
+            # Go omitempty semantics: a present-but-empty STRUCT is kept
+            # (`engine: {}` is a meaningful component declaration on the
+            # wire); empty lists/maps/strings are dropped
+            if v in ({}, []) and not keep_empty \
+                    and not dataclasses.is_dataclass(raw):
                 continue
             out[_json_name(f)] = v
         return out

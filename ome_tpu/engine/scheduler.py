@@ -98,6 +98,11 @@ class Scheduler:
         return req
 
     def start(self):
+        # idempotent: EngineServer.start() also starts its scheduler, so
+        # a caller that started it explicitly must not end up with TWO
+        # driver threads racing donated state buffers
+        if self._thread is not None and self._thread.is_alive():
+            return
         self._thread = threading.Thread(target=self._run,
                                         name="ome-scheduler", daemon=True)
         self._thread.start()

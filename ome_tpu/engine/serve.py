@@ -1,0 +1,126 @@
+"""`python -m ome_tpu.engine.serve` — the engine container entrypoint.
+
+What the catalog's ServingRuntimes run (config/runtimes/ome/*.yaml):
+loads a staged model directory (config.json + safetensors via
+models/checkpoint.py + tokenizer), builds the compiled
+InferenceEngine + continuous-batching Scheduler, and serves the
+OpenAI-compatible HTTP surface (engine/server.py). Mirrors the role
+of the reference runtimes' `python -m sglang.launch_server` /
+`vllm serve` commands (SURVEY.md L0) but with the in-repo JAX engine.
+
+`--random-weights` skips checkpoint loading (hermetic tests, dry
+runs); `--task embed` is reserved until the embedding head lands.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+log = logging.getLogger("ome.engine.serve")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ome-engine", description="OME-TPU serving engine")
+    p.add_argument("--model-dir", required=True,
+                   help="staged model directory (config.json + safetensors)")
+    p.add_argument("--model-name", default=None,
+                   help="name reported by /v1/models (default: dir name)")
+    p.add_argument("--max-slots", type=int, default=16,
+                   help="decode batch width (continuous-batching slots)")
+    p.add_argument("--max-seq", type=int, default=None,
+                   help="KV capacity per slot (default: model max)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--task", choices=("generate", "embed"),
+                   default="generate")
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--random-weights", action="store_true",
+                   help="random init instead of loading safetensors "
+                        "(tests / dry runs)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel size over the local mesh")
+    return p
+
+
+def load_engine(args):
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import checkpoint, llama
+    from ..models.config import ModelConfig
+    from .core import InferenceEngine
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    if args.random_weights:
+        import json
+        import os
+        cfg_path = os.path.join(args.model_dir, "config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                cfg = ModelConfig.from_hf_config(json.load(f))
+        else:
+            from ..models.config import tiny_test
+            cfg = tiny_test()
+        cfg = cfg.replace(dtype=dtype)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        log.info("initialized random weights: %.2fM params",
+                 llama.param_count(params) / 1e6)
+    else:
+        params, cfg = checkpoint.load_params(args.model_dir, dtype=dtype)
+        cfg = cfg.replace(dtype=dtype)
+        import jax.numpy as jnp2  # params arrive as numpy: one transfer
+        params = jax.tree.map(jnp2.asarray, params)
+        log.info("loaded checkpoint from %s", args.model_dir)
+    if cfg.is_moe and args.tp == 1:
+        # single-device serving uses the ragged grouped-GEMM dispatch;
+        # tp>1 keeps the dense path (shardable through plain GSPMD)
+        cfg = cfg.replace(moe_impl="ragged")
+    max_seq = args.max_seq or min(cfg.max_seq_len, 8192)
+    if args.tp > 1:
+        from .sharded import ShardedInferenceEngine
+        return ShardedInferenceEngine(params, cfg, tp=args.tp,
+                                      max_slots=args.max_slots,
+                                      max_seq=max_seq)
+    return InferenceEngine(params, cfg, max_slots=args.max_slots,
+                           max_seq=max_seq)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    args = build_parser().parse_args(argv)
+    if args.task == "embed":
+        log.error("--task embed is not implemented yet")
+        return 2
+
+    from .scheduler import Scheduler
+    from .server import EngineServer
+    from .tokenizer import load_tokenizer
+
+    engine = load_engine(args)
+    scheduler = Scheduler(engine)
+    tok = load_tokenizer(args.model_dir)
+    name = args.model_name or args.model_dir.rstrip("/").rsplit("/", 1)[-1]
+    server = EngineServer(scheduler, tokenizer=tok, model_name=name,
+                          host=args.host, port=args.port)
+    log.info("serving %s on %s:%d (slots=%d)", name, args.host,
+             server.port, engine.max_slots)
+    server.start()
+    try:
+        import signal
+        import threading
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        signal.signal(signal.SIGINT, lambda *a: stop.set())
+        stop.wait()
+    finally:
+        server.stop()
+        scheduler.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

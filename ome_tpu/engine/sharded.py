@@ -1,0 +1,72 @@
+"""Tensor-parallel serving engine over a jax.sharding.Mesh.
+
+Connects parallel/ to the serving engine (the reference delegates this
+to SGLang/vLLM's NCCL tensor parallelism via --tp-size args,
+SURVEY.md §2.9; here TP is GSPMD over the mesh's "tp" axis):
+
+  * weights shard Megatron-style (attention heads / MLP hidden / vocab
+    on "tp" — parallel/sharding.py rules);
+  * the KV cache shards on the KV-head dim, so each device holds its
+    own heads' cache and decode attention needs NO collective at all —
+    the only cross-device traffic per step is the psum XLA inserts
+    after the o-projection and MLP down-projection (ride ICI);
+  * prefill/insert/decode are the same three compiled programs as the
+    single-chip InferenceEngine — GSPMD propagates shardings from the
+    committed inputs, so the host-side scheduler code is unchanged.
+
+This is what the LWS multi-host contract (controllers/reconcilers/
+multinode.py) targets: the same engine, mesh spanning hosts.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import llama
+from ..models.config import ModelConfig
+from ..parallel.mesh import MeshConfig, build_mesh
+from ..parallel.sharding import shard_params
+from .core import DecodeState, InferenceEngine
+
+
+class ShardedInferenceEngine(InferenceEngine):
+    """InferenceEngine with params + KV cache sharded over a tp mesh."""
+
+    def __init__(self, params, cfg: ModelConfig, tp: int = 1,
+                 max_slots: int = 8, max_seq: Optional[int] = None,
+                 prefill_buckets: Optional[List[int]] = None,
+                 mesh: Optional[Mesh] = None):
+        if cfg.num_kv_heads % tp != 0:
+            raise ValueError(
+                f"tp={tp} must divide num_kv_heads={cfg.num_kv_heads} "
+                f"(KV cache shards on the head dim)")
+        if cfg.num_heads % tp != 0:
+            raise ValueError(
+                f"tp={tp} must divide num_heads={cfg.num_heads}")
+        self.mesh = mesh or build_mesh(MeshConfig(tp=tp))
+        self.tp = tp
+        params = shard_params(params, self.mesh)
+        super().__init__(params, cfg, max_slots=max_slots, max_seq=max_seq,
+                         prefill_buckets=prefill_buckets)
+
+    def _kv_sharding(self) -> NamedSharding:
+        # [L, B, S, K, Dh]: KV heads on tp
+        return NamedSharding(self.mesh, P(None, None, None, "tp", None))
+
+    def _replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def new_state(self) -> DecodeState:
+        L, B, S = self.cfg.num_layers, self.max_slots, self.max_seq
+        shape = (L, B, S, self.cfg.num_kv_heads, self.cfg.head_dim)
+        kv = self._kv_sharding()
+        rep = self._replicated()
+        return DecodeState(
+            k=jax.device_put(jnp.zeros(shape, self.cfg.dtype), kv),
+            v=jax.device_put(jnp.zeros(shape, self.cfg.dtype), kv),
+            lengths=jax.device_put(jnp.zeros((B,), jnp.int32), rep),
+            tokens=jax.device_put(jnp.zeros((B,), jnp.int32), rep))

@@ -1,0 +1,315 @@
+"""Checkpoint IO: safetensors parsing + HF-weights -> JAX param trees.
+
+The reference operator never touches weights numerically — it stages
+files on nodes and lets SGLang/vLLM load them (gopher.go download
+paths, SURVEY.md §2.6). This repo owns a serving engine, so it owns
+the conversion from HuggingFace safetensors checkpoints to the stacked
+per-layer param pytree that models/llama.py scans over.
+
+Pure-numpy safetensors reader/writer (no torch, no safetensors pip
+package): the format is an 8-byte LE header length + JSON header of
+{name: {dtype, shape, data_offsets}} + raw little-endian tensor bytes.
+bf16 rides ml_dtypes (a JAX dependency). Reads are lazy and per-tensor
+(seek + read) so a 70B checkpoint never needs 2x RAM; multi-shard
+checkpoints resolve through model.safetensors.index.json exactly like
+huggingface_hub does.
+
+Name mapping covers the Llama superset the model implements: llama /
+mistral / qwen2 (attention bias) / qwen3 (qk-norm) / gemma2 (softcap)
+dense models, and mixtral / qwen2-moe / deepseek-style MoE with shared
+experts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax
+    import ml_dtypes
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+_DTYPES = {
+    "F64": np.dtype(np.float64), "F32": np.dtype(np.float32),
+    "F16": np.dtype(np.float16), "I64": np.dtype(np.int64),
+    "I32": np.dtype(np.int32), "I16": np.dtype(np.int16),
+    "I8": np.dtype(np.int8), "U8": np.dtype(np.uint8),
+    "BOOL": np.dtype(np.bool_),
+}
+if _BF16 is not None:
+    _DTYPES["BF16"] = _BF16
+_DTYPE_NAMES = {v: k for k, v in _DTYPES.items()}
+
+
+class SafetensorsError(Exception):
+    pass
+
+
+class SafetensorsFile:
+    """Lazy reader for one .safetensors file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            (hlen,) = struct.unpack("<Q", f.read(8))
+            if hlen > 100 * 1024 * 1024:
+                raise SafetensorsError(f"{path}: implausible header size")
+            header = json.loads(f.read(hlen))
+        self._data_start = 8 + hlen
+        self._meta = header.pop("__metadata__", {})
+        self._tensors: Dict[str, Tuple[np.dtype, tuple, int, int]] = {}
+        for name, info in header.items():
+            dt = _DTYPES.get(info["dtype"])
+            if dt is None:
+                raise SafetensorsError(
+                    f"{path}: unsupported dtype {info['dtype']} for {name}")
+            start, end = info["data_offsets"]
+            self._tensors[name] = (dt, tuple(info["shape"]), start, end)
+
+    def keys(self) -> List[str]:
+        return list(self._tensors)
+
+    def shape(self, name: str) -> tuple:
+        return self._tensors[name][1]
+
+    def read(self, name: str) -> np.ndarray:
+        dt, shape, start, end = self._tensors[name]
+        with open(self.path, "rb") as f:
+            f.seek(self._data_start + start)
+            buf = f.read(end - start)
+        n = int(np.prod(shape)) if shape else 1
+        if len(buf) != n * dt.itemsize:
+            raise SafetensorsError(f"{self.path}: short read for {name}")
+        return np.frombuffer(buf, dtype=dt).reshape(shape)
+
+
+def save_safetensors(path: str, tensors: Dict[str, np.ndarray],
+                     metadata: Optional[Dict[str, str]] = None) -> None:
+    """Write a safetensors file (used by tests, replica, and export)."""
+    header: Dict[str, Any] = {}
+    if metadata:
+        header["__metadata__"] = metadata
+    offset = 0
+    arrays = []
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        dt = _DTYPE_NAMES.get(arr.dtype)
+        if dt is None:
+            raise SafetensorsError(f"unsupported dtype {arr.dtype}")
+        nbytes = arr.nbytes
+        header[name] = {"dtype": dt, "shape": list(arr.shape),
+                        "data_offsets": [offset, offset + nbytes]}
+        arrays.append(arr)
+        offset += nbytes
+    hbytes = json.dumps(header).encode()
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hbytes)))
+        f.write(hbytes)
+        for arr in arrays:
+            f.write(arr.tobytes())
+
+
+class Checkpoint:
+    """A model directory's full weight set (single- or multi-shard)."""
+
+    def __init__(self, model_dir: str):
+        self.model_dir = model_dir
+        index = os.path.join(model_dir, "model.safetensors.index.json")
+        self._files: Dict[str, SafetensorsFile] = {}
+        self._where: Dict[str, str] = {}
+        if os.path.exists(index):
+            with open(index) as f:
+                weight_map = json.load(f)["weight_map"]
+            for name, fname in weight_map.items():
+                self._where[name] = fname
+        else:
+            shards = sorted(fn for fn in os.listdir(model_dir)
+                            if fn.endswith(".safetensors"))
+            if not shards:
+                raise SafetensorsError(
+                    f"no .safetensors files in {model_dir}")
+            for fname in shards:
+                for name in self._file(fname).keys():
+                    self._where[name] = fname
+
+    def _file(self, fname: str) -> SafetensorsFile:
+        if fname not in self._files:
+            self._files[fname] = SafetensorsFile(
+                os.path.join(self.model_dir, fname))
+        return self._files[fname]
+
+    def keys(self) -> List[str]:
+        return list(self._where)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._where
+
+    def read(self, name: str) -> np.ndarray:
+        if name not in self._where:
+            raise KeyError(name)
+        return self._file(self._where[name]).read(name)
+
+
+# -- HF -> llama.py param tree ---------------------------------------------
+
+
+def _np_dtype(dtype) -> np.dtype:
+    import jax.numpy as jnp
+    if dtype in (jnp.bfloat16, "bfloat16"):
+        return _BF16
+    return np.dtype(dtype)
+
+
+class _Stacker:
+    """Fills [L, ...] stacked arrays one layer at a time (no 2x peak)."""
+
+    def __init__(self, num_layers: int, dtype: np.dtype):
+        self.L = num_layers
+        self.dtype = dtype
+        self.out: Dict[str, np.ndarray] = {}
+
+    def put(self, key: str, layer: int, arr: np.ndarray) -> None:
+        if key not in self.out:
+            self.out[key] = np.empty((self.L,) + arr.shape, self.dtype)
+        self.out[key][layer] = arr.astype(self.dtype)
+
+
+def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
+    """Map HF checkpoint names/layouts onto the llama.py param tree.
+
+    HF linear weights are [out, in] (y = W x); the model's einsums take
+    [in, out]-shaped factors, so every projection transposes, and
+    attention projections reshape the fused head dim into [heads, Dh].
+    """
+    np_dt = _np_dtype(dtype or "bfloat16")
+    L, D, H, K, Dh = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
+                      cfg.num_kv_heads, cfg.head_dim)
+    st = _Stacker(L, np_dt)
+
+    def take(name: str) -> np.ndarray:
+        return ckpt.read(name).astype(np.float32)
+
+    def linear_in_out(name: str) -> np.ndarray:
+        return take(name).T  # [out,in] -> [in,out]
+
+    for i in range(L):
+        p = f"model.layers.{i}."
+        st.put("attn_norm", i, take(p + "input_layernorm.weight"))
+        st.put("mlp_norm", i, take(p + "post_attention_layernorm.weight"))
+        st.put("wq", i,
+               take(p + "self_attn.q_proj.weight").T.reshape(D, H, Dh))
+        st.put("wk", i,
+               take(p + "self_attn.k_proj.weight").T.reshape(D, K, Dh))
+        st.put("wv", i,
+               take(p + "self_attn.v_proj.weight").T.reshape(D, K, Dh))
+        st.put("wo", i,
+               take(p + "self_attn.o_proj.weight").T.reshape(H, Dh, D))
+        if getattr(cfg, "attn_bias", False):
+            st.put("bq", i,
+                   take(p + "self_attn.q_proj.bias").reshape(H, Dh))
+            st.put("bk", i,
+                   take(p + "self_attn.k_proj.bias").reshape(K, Dh))
+            st.put("bv", i,
+                   take(p + "self_attn.v_proj.bias").reshape(K, Dh))
+        if cfg.qk_norm:
+            st.put("q_norm", i, take(p + "self_attn.q_norm.weight"))
+            st.put("k_norm", i, take(p + "self_attn.k_norm.weight"))
+        if cfg.is_moe:
+            # router: mixtral block_sparse_moe.gate / qwen-moe+deepseek
+            # mlp.gate
+            for rn in ("block_sparse_moe.gate.weight", "mlp.gate.weight"):
+                if p + rn in ckpt:
+                    st.put("router", i, linear_in_out(p + rn))
+                    break
+            else:
+                raise SafetensorsError(f"no MoE router for layer {i}")
+            gates, ups, downs = [], [], []
+            for e in range(cfg.num_experts):
+                if f"{p}block_sparse_moe.experts.{e}.w1.weight" in ckpt:
+                    en = f"{p}block_sparse_moe.experts.{e}."
+                    g, u, d = en + "w1.weight", en + "w3.weight", \
+                        en + "w2.weight"
+                else:
+                    en = f"{p}mlp.experts.{e}."
+                    g, u, d = en + "gate_proj.weight", \
+                        en + "up_proj.weight", en + "down_proj.weight"
+                gates.append(linear_in_out(g))
+                ups.append(linear_in_out(u))
+                downs.append(linear_in_out(d))
+            st.put("we_gate", i, np.stack(gates))
+            st.put("we_up", i, np.stack(ups))
+            st.put("we_down", i, np.stack(downs))
+            if cfg.num_shared_experts > 0:
+                for sn in ("mlp.shared_experts.", "mlp.shared_expert."):
+                    if p + sn + "gate_proj.weight" in ckpt:
+                        st.put("ws_gate", i,
+                               linear_in_out(p + sn + "gate_proj.weight"))
+                        st.put("ws_up", i,
+                               linear_in_out(p + sn + "up_proj.weight"))
+                        st.put("ws_down", i,
+                               linear_in_out(p + sn + "down_proj.weight"))
+                        break
+        else:
+            st.put("w_gate", i, linear_in_out(p + "mlp.gate_proj.weight"))
+            st.put("w_up", i, linear_in_out(p + "mlp.up_proj.weight"))
+            st.put("w_down", i, linear_in_out(p + "mlp.down_proj.weight"))
+
+    params: Dict[str, Any] = {
+        "embed": take("model.embed_tokens.weight").astype(np_dt),
+        "final_norm": take("model.norm.weight").astype(np_dt),
+        "layers": st.out,
+    }
+    if not cfg.tie_word_embeddings:
+        if "lm_head.weight" in ckpt:
+            params["lm_head"] = linear_in_out(
+                "lm_head.weight").astype(np_dt)
+        # some checkpoints omit lm_head despite tie=False in config:
+        # fall back to tied embeddings (forward() handles the absence)
+    return params
+
+
+# architectures whose math models/llama.py implements faithfully; a
+# config.json outside this list loads only with allow_unsupported
+# (e.g. Gemma2 alternates sliding/global layers + GeGLU, DeepSeek V2+
+# uses MLA — loading them here would produce garbage silently)
+SUPPORTED_ARCHITECTURES = frozenset({
+    "LlamaForCausalLM", "MistralForCausalLM", "Qwen2ForCausalLM",
+    "Qwen3ForCausalLM", "MixtralForCausalLM",
+})
+
+
+def load_params(model_dir: str, cfg=None, dtype=None,
+                device_put: bool = True, allow_unsupported: bool = False,
+                ) -> Tuple[Dict[str, Any], Any]:
+    """Load (params, cfg) from a HF model directory.
+
+    cfg defaults to ModelConfig.from_hf_config(config.json). With
+    device_put the numpy tree is transferred to the default device as
+    one jnp tree (the sharded path goes through parallel/sharding.py
+    with the numpy tree instead).
+    """
+    from .config import ModelConfig
+    if cfg is None:
+        with open(os.path.join(model_dir, "config.json")) as f:
+            hf = json.load(f)
+        archs = hf.get("architectures") or []
+        if not allow_unsupported and archs and \
+                not set(archs) & SUPPORTED_ARCHITECTURES:
+            raise SafetensorsError(
+                f"architecture {archs} is not faithfully implemented by "
+                f"models/llama.py (supported: "
+                f"{sorted(SUPPORTED_ARCHITECTURES)}); pass "
+                f"allow_unsupported=True to force-load")
+        cfg = ModelConfig.from_hf_config(hf)
+    ckpt = Checkpoint(model_dir)
+    params = convert_llama(ckpt, cfg, dtype=dtype)
+    if device_put:
+        import jax
+        params = jax.tree.map(lambda a: jax.device_put(a), params)
+    return params, cfg

@@ -36,10 +36,15 @@ class ModelConfig:
     experts_per_token: int = 0
     moe_intermediate_size: int = 0
     num_shared_experts: int = 0
+    # "dense" computes every expert (GSPMD-shardable everywhere);
+    # "ragged" sorts tokens by expert and runs grouped matmuls
+    # (lax.ragged_dot) — O(k/E) of the dense FLOPs, the serving path
+    moe_impl: str = "dense"
     # attention extras
     sliding_window: Optional[int] = None
     attn_logit_softcap: Optional[float] = None
     qk_norm: bool = False
+    attn_bias: bool = False  # qwen2-style q/k/v projection biases
 
     @property
     def is_moe(self) -> bool:
@@ -50,16 +55,24 @@ class ModelConfig:
 
     @classmethod
     def from_hf_config(cls, cfg: Dict[str, Any]) -> "ModelConfig":
-        """Build from a HuggingFace config.json dict (llama/qwen2/mixtral)."""
+        """Build from a HuggingFace config.json dict (llama/qwen2/qwen3/
+        mistral/mixtral families — the set models/checkpoint.py
+        SUPPORTED_ARCHITECTURES accepts)."""
         hidden = cfg.get("hidden_size", 4096)
         heads = cfg.get("num_attention_heads", 32)
+        archs = cfg.get("architectures") or [""]
+        arch = archs[0]
+        # qwen2 uses qkv biases (not spelled out in its config.json);
+        # qwen3 replaces them with per-head q/k RMS norms
+        attn_bias = cfg.get("attention_bias",
+                            cfg.get("qkv_bias", arch.startswith("Qwen2")))
         return cls(
             vocab_size=cfg.get("vocab_size", 32000),
             hidden_size=hidden,
             num_layers=cfg.get("num_hidden_layers", 32),
             num_heads=heads,
             num_kv_heads=cfg.get("num_key_value_heads", heads),
-            head_dim=cfg.get("head_dim", hidden // heads),
+            head_dim=cfg.get("head_dim") or hidden // heads,
             intermediate_size=cfg.get("intermediate_size", 4 * hidden),
             rope_theta=cfg.get("rope_theta", 10000.0),
             rope_scaling=cfg.get("rope_scaling"),
@@ -72,7 +85,11 @@ class ModelConfig:
             experts_per_token=cfg.get("num_experts_per_tok", 0) or 0,
             moe_intermediate_size=cfg.get("moe_intermediate_size", 0) or 0,
             num_shared_experts=cfg.get("n_shared_experts", 0) or 0,
-            sliding_window=cfg.get("sliding_window"),
+            sliding_window=cfg.get("sliding_window")
+            if cfg.get("use_sliding_window", True) else None,
+            attn_logit_softcap=cfg.get("attn_logit_softcapping"),
+            qk_norm=arch.startswith("Qwen3"),
+            attn_bias=bool(attn_bias),
         )
 
 

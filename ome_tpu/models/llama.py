@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.attention import attention, make_causal_mask
+from ..ops.attention import attention
 from .config import ModelConfig
 
 Params = Dict[str, Any]
@@ -76,6 +76,10 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
     if cfg.qk_norm:
         layers["q_norm"] = jnp.ones((L, Dh), cfg.dtype)
         layers["k_norm"] = jnp.ones((L, Dh), cfg.dtype)
+    if cfg.attn_bias:
+        layers["bq"] = jnp.zeros((L, H, Dh), cfg.dtype)
+        layers["bk"] = jnp.zeros((L, K, Dh), cfg.dtype)
+        layers["bv"] = jnp.zeros((L, K, Dh), cfg.dtype)
     if cfg.is_moe:
         E, Fm = cfg.num_experts, cfg.moe_intermediate_size or F
         layers.update({
@@ -158,24 +162,66 @@ def dense_mlp(x: jax.Array, p: Params) -> jax.Array:
     return jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, p["w_down"])
 
 
-def moe_mlp(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
-    """Top-k MoE block (Mixtral-style).
-
-    Round-1 implementation computes every expert and mixes by router
-    weight — correct, fully static shapes, MXU-batched over experts; the
-    engine path swaps in a ragged-dispatch Pallas kernel later.
-    """
-    B, S, D = x.shape
+def _route(x: jax.Array, p: Params, cfg: ModelConfig):
+    """Router: top-k expert ids + softmaxed weights (fp32 routing)."""
     router_logits = jnp.einsum("bsd,de->bse", x, p["router"]).astype(jnp.float32)
     weights, idx = lax.top_k(router_logits, cfg.experts_per_token)
-    weights = jax.nn.softmax(weights, axis=-1)  # [B,S,k]
+    return jax.nn.softmax(weights, axis=-1), idx  # [B,S,k], [B,S,k]
+
+
+def moe_mlp_dense(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
+    """Top-k MoE computing EVERY expert and mixing by router weight.
+
+    O(E) FLOPs but fully static shapes and trivially GSPMD-shardable
+    (experts on the tp/ep axis) — the training/pipeline path.
+    """
+    weights, idx = _route(x, p, cfg)
     gate = jnp.einsum("bsd,edf->bsef", x, p["we_gate"])
     up = jnp.einsum("bsd,edf->bsef", x, p["we_up"])
     expert_out = jnp.einsum("bsef,efd->bsed", jax.nn.silu(gate) * up,
                             p["we_down"])  # [B,S,E,D]
     onehot = jax.nn.one_hot(idx, cfg.num_experts, dtype=weights.dtype)  # [B,S,k,E]
     mix = jnp.einsum("bske,bsk->bse", onehot, weights)  # [B,S,E]
-    out = jnp.einsum("bsed,bse->bsd", expert_out, mix.astype(expert_out.dtype))
+    return jnp.einsum("bsed,bse->bsd", expert_out,
+                      mix.astype(expert_out.dtype))
+
+
+def moe_mlp_ragged(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
+    """Dropless ragged dispatch: sort token-expert pairs by expert and
+    run grouped matmuls (lax.ragged_dot -> TPU grouped GEMM).
+
+    O(k/E) of the dense path's expert FLOPs with NO capacity dropping —
+    static [T*k] shapes, so it jits cleanly. The sort/gather/scatter
+    costs bandwidth proportional to activations (tiny next to expert
+    weights), which is the right trade on TPU where the MoE block is
+    weight-bound. Serving-path default (models/config.py moe_impl).
+    """
+    B, S, D = x.shape
+    k, E = cfg.experts_per_token, cfg.num_experts
+    T = B * S
+    weights, idx = _route(x, p, cfg)
+    xf = x.reshape(T, D)
+    expert_ids = idx.reshape(T * k)
+    order = jnp.argsort(expert_ids)                      # stable
+    token_of = order // k                                # source token
+    xs = jnp.take(xf, token_of, axis=0)                  # [T*k, D]
+    group_sizes = jnp.bincount(expert_ids, length=E).astype(jnp.int32)
+    gate = lax.ragged_dot(xs, p["we_gate"], group_sizes)
+    up = lax.ragged_dot(xs, p["we_up"], group_sizes)
+    h = jax.nn.silu(gate) * up  # same dtype flow as the dense path
+    out_sorted = lax.ragged_dot(h, p["we_down"], group_sizes)  # [T*k, D]
+    w_sorted = jnp.take(weights.reshape(T * k), order, axis=0)
+    contrib = out_sorted * w_sorted[:, None].astype(out_sorted.dtype)
+    out = jnp.zeros((T, D), contrib.dtype).at[token_of].add(contrib)
+    return out.reshape(B, S, D).astype(x.dtype)
+
+
+def moe_mlp(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
+    """Top-k MoE block (Mixtral/Qwen-MoE/DeepSeek-style)."""
+    if cfg.moe_impl == "ragged":
+        out = moe_mlp_ragged(x, p, cfg)
+    else:
+        out = moe_mlp_dense(x, p, cfg)
     if cfg.num_shared_experts > 0:
         # DeepSeek-MoE shared experts: always-active dense branch
         shared = {"w_gate": p["ws_gate"], "w_up": p["ws_up"],
@@ -187,20 +233,8 @@ def moe_mlp(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
 # -- forward ---------------------------------------------------------------
 
 
-def build_attn_mask(cfg: ModelConfig, positions: jax.Array, kv_pos: jax.Array,
-                    kv_len: Optional[jax.Array] = None) -> jax.Array:
-    """Causal (+ sliding-window) mask — shared by the dense and pipeline
-    forward paths so both attend identically."""
-    mask = make_causal_mask(positions, kv_pos, kv_len)
-    if cfg.sliding_window is not None:
-        window_ok = (kv_pos[None, None, :]
-                     > positions[:, :, None] - cfg.sliding_window)
-        mask = mask & window_ok
-    return mask
-
-
 def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
-           positions: jax.Array, mask: Optional[jax.Array],
+           positions: jax.Array, kv_len: Optional[jax.Array],
            cache_kv: Optional[Tuple[jax.Array, jax.Array]],
            cache_index: Optional[jax.Array]):
     """One transformer block. cache_kv: ([B,Smax,K,Dh], [B,Smax,K,Dh])."""
@@ -208,6 +242,10 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
     q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
     k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
     v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    if cfg.attn_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
     if cfg.qk_norm:
         q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
@@ -235,7 +273,8 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
         k_full, v_full = k, v
         new_cache = None
 
-    attn = attention(q, k_full, v_full, mask=mask,
+    attn = attention(q, k_full, v_full, positions=positions, kv_len=kv_len,
+                     sliding_window=cfg.sliding_window,
                      logit_softcap=cfg.attn_logit_softcap)
     x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
 
@@ -265,17 +304,12 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
     freqs = _rope_frequencies(cfg)
 
-    if cache is not None:
-        kv_pos = jnp.arange(cache.k.shape[2], dtype=jnp.int32)
-        kv_len = jnp.broadcast_to(cache.index + S, (B,))
-        mask = build_attn_mask(cfg, positions, kv_pos, kv_len)
-    else:
-        kv_pos = jnp.arange(S, dtype=jnp.int32)
-        mask = build_attn_mask(cfg, positions, kv_pos)
+    kv_len = jnp.broadcast_to(cache.index + S, (B,)) \
+        if cache is not None else None
 
     def body(x, per_layer):
         lp, layer_cache = per_layer
-        x, new_cache = _layer(x, lp, cfg, freqs, positions, mask,
+        x, new_cache = _layer(x, lp, cfg, freqs, positions, kv_len,
                               layer_cache, cache.index if cache is not None else None)
         return x, new_cache
 

@@ -1,15 +1,19 @@
 """Attention ops with a pluggable backend.
 
 `attention()` is the single entry point the models call. On TPU it
-dispatches to the Pallas flash-attention kernel (ome_tpu/ops/flash.py);
-elsewhere (CPU test mesh) it uses an XLA reference implementation. Both
-compute GQA attention with fp32 softmax accumulation — the MXU-friendly
-layout keeps heads x head_dim contiguous in the last two dims.
+dispatches to the Pallas flash-attention kernels (ome_tpu/ops/flash.py);
+elsewhere (CPU test mesh) it uses an XLA reference implementation. The
+interface is *structural* — query positions, valid-KV length, sliding
+window — never a materialized mask: the flash kernels turn these into
+iota comparisons against scalar limits, and only the XLA fallback
+builds a boolean mask. Both compute GQA attention with fp32 softmax
+accumulation.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -59,19 +63,44 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 
 def attention(q: jax.Array, k: jax.Array, v: jax.Array,
-              mask: Optional[jax.Array] = None,
+              positions: Optional[jax.Array] = None,
+              kv_len: Optional[jax.Array] = None,
+              sliding_window: Optional[int] = None,
               scale: Optional[float] = None,
               logit_softcap: Optional[float] = None,
               backend: Optional[str] = None) -> jax.Array:
-    """Dispatching attention entry point used by all models."""
+    """Dispatching attention entry point used by all models.
+
+    positions: [B, Sq] absolute query positions (contiguous per row);
+    None disables causal masking entirely (bidirectional attention).
+    kv_len: [B] valid KV rows for fixed-capacity caches.
+    backend: None (auto), "xla", "pallas", or "pallas_interpret" (the
+    Pallas kernels run interpreted on CPU — for numerics tests).
+    """
     if backend is None:
-        backend = "pallas" if _on_tpu() else "xla"
-    if backend == "pallas":
+        backend = os.environ.get("OME_ATTN_BACKEND") \
+            or ("pallas" if _on_tpu() else "xla")
+    if backend in ("pallas", "pallas_interpret"):
         from . import flash
-        out = flash.flash_attention(q, k, v, mask=mask, scale=scale,
-                                    logit_softcap=logit_softcap)
+        out = flash.flash_attention(
+            q, k, v, positions=positions, kv_len=kv_len,
+            sliding_window=sliding_window, scale=scale,
+            logit_softcap=logit_softcap,
+            interpret=(backend == "pallas_interpret"))
         if out is not None:
             return out
+    mask = None
+    if positions is not None:
+        kv_pos = jnp.arange(k.shape[1], dtype=jnp.int32)
+        mask = make_causal_mask(positions, kv_pos, kv_len)
+        if sliding_window is not None:
+            mask = mask & (kv_pos[None, None, :]
+                           > positions[:, :, None] - sliding_window)
+    elif kv_len is not None:
+        kv_pos = jnp.arange(k.shape[1], dtype=jnp.int32)
+        mask = jnp.broadcast_to(
+            kv_pos[None, None, :] < kv_len[:, None, None],
+            (q.shape[0], q.shape[1], k.shape[1]))
     return xla_attention(q, k, v, mask=mask, scale=scale,
                          logit_softcap=logit_softcap)
 

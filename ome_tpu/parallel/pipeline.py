@@ -49,11 +49,10 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
 
     freqs = llama._rope_frequencies(cfg)
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (mb, S))
-    mask = llama.build_attn_mask(cfg, positions, jnp.arange(S, dtype=jnp.int32))
 
     def stage_fn(stage_params, h):
         def body(h, lp):
-            h, _ = llama._layer(h, lp, cfg, freqs, positions, mask, None, None)
+            h, _ = llama._layer(h, lp, cfg, freqs, positions, None, None, None)
             return h, None
         h, _ = lax.scan(body, h, stage_params)
         return h

@@ -101,12 +101,21 @@ class S3CompatStorage(Storage):
 
     def __init__(self, endpoint: str, bucket: str,
                  headers: Optional[Dict[str, str]] = None,
-                 retries: int = 4, backoff: float = 0.2):
+                 retries: int = 4, backoff: float = 0.2,
+                 signer=None):
         self.endpoint = endpoint.rstrip("/")
         self.bucket = bucket
         self.headers = headers or {}
         self.retries = retries
         self.backoff = backoff
+        self.signer = signer  # storage/signing.py: SigV4 or GCS bearer
+
+    def _signed(self, url: str, method: str,
+                headers: Dict[str, str],
+                payload: bytes = b"") -> Dict[str, str]:
+        if self.signer is None:
+            return headers
+        return self.signer.sign(method, url, headers, payload)
 
     # -- http helpers --------------------------------------------------
 
@@ -123,9 +132,12 @@ class S3CompatStorage(Storage):
                  extra: Optional[Dict[str, str]] = None) -> bytes:
         last: Optional[Exception] = None
         for attempt in range(self.retries):
+            base = {**self.headers, **(extra or {})}
             req = urllib.request.Request(
                 url, data=data, method=method,
-                headers={**self.headers, **(extra or {})})
+                headers=self._signed(url, method or
+                                     ("PUT" if data is not None
+                                      else "GET"), base, data or b""))
             try:
                 with urllib.request.urlopen(req, timeout=60) as resp:
                     return resp.read()
@@ -192,7 +204,8 @@ class S3CompatStorage(Storage):
                 if etag:
                     extra["If-Range"] = f'"{etag}"'
             req = urllib.request.Request(
-                url, headers={**self.headers, **extra})
+                url, headers=self._signed(url, "GET",
+                                          {**self.headers, **extra}))
             try:
                 with urllib.request.urlopen(req, timeout=60) as resp:
                     if offset and resp.getcode() != 206:
@@ -272,7 +285,24 @@ def open_storage(components: StorageComponents,
             StorageType.GCS: "https://storage.googleapis.com",
             StorageType.OCI: "https://objectstorage.local",
         }[st]
+        from .signing import signer_from_env
         return S3CompatStorage(endpoints.get(st.value, default),
-                               components.bucket)
+                               components.bucket,
+                               signer=signer_from_env(st.value))
+    if st == StorageType.AZURE:
+        from .extra_providers import AzureBlobStorage
+        # az://account/container/prefix (account in namespace, container
+        # in bucket — uri.py); components.prefix stays a blob prefix
+        return AzureBlobStorage(components.namespace,
+                                components.bucket or "$root",
+                                endpoint=endpoints.get("az"))
+    if st == StorageType.GITHUB:
+        from .extra_providers import GitHubStorage
+        return GitHubStorage(components.repo_id, components.revision,
+                             api_endpoint=endpoints.get("github_api"),
+                             raw_endpoint=endpoints.get("github_raw"))
+    if st == StorageType.VENDOR:
+        from .extra_providers import open_vendor_storage
+        return open_vendor_storage(components)
     raise StorageURIError(f"no storage provider for {st.value!r} "
                           f"(hf:// uses the hub client)")

@@ -1,0 +1,191 @@
+"""Request signing for object stores: AWS SigV4 + GCS bearer tokens.
+
+Closes the auth gap the round-1 review flagged: S3CompatStorage sent
+unsigned requests, so gopher/replica could only read public buckets.
+The reference carries a multi-cloud credential factory
+(pkg/auth/factory.go:21, pkg/principals) wrapping each vendor SDK;
+TPU-first scope is GCP-before-AWS and zero SDK dependencies:
+
+  * SigV4Signer — full AWS Signature V4 (covers s3:// and every
+    S3-compatible endpoint incl. OCI object storage's S3 compat API);
+    verified against AWS's published signing test vector.
+  * GCSTokenSigner — OAuth bearer token for storage.googleapis.com;
+    token from the environment or the GCE metadata server (workload
+    identity — how a GKE model-agent DaemonSet actually authenticates).
+  * signer_from_env — credential discovery: explicit env keys first,
+    metadata server second, anonymous (None) last.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import json
+import os
+import threading
+import time
+import urllib.parse
+import urllib.request
+from typing import Dict, Optional
+
+EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+class SigV4Signer:
+    """AWS Signature Version 4 (header-based, single-chunk)."""
+
+    def __init__(self, access_key: str, secret_key: str,
+                 region: str = "us-east-1", service: str = "s3",
+                 session_token: Optional[str] = None):
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.service = service
+        self.session_token = session_token
+
+    # -- primitives ----------------------------------------------------
+
+    @staticmethod
+    def _hmac(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    def _signing_key(self, datestamp: str) -> bytes:
+        k = self._hmac(b"AWS4" + self.secret_key.encode(), datestamp)
+        k = self._hmac(k, self.region)
+        k = self._hmac(k, self.service)
+        return self._hmac(k, "aws4_request")
+
+    def canonical_request(self, method: str, url: str,
+                          headers: Dict[str, str],
+                          payload_hash: str) -> str:
+        parts = urllib.parse.urlsplit(url)
+        # canonical URI: RFC-3986 path, each segment encoded
+        path = urllib.parse.quote(urllib.parse.unquote(parts.path or "/"),
+                                  safe="/")
+        query = urllib.parse.parse_qsl(parts.query,
+                                       keep_blank_values=True)
+        canonical_query = "&".join(
+            f"{urllib.parse.quote(k, safe='-_.~')}="
+            f"{urllib.parse.quote(v, safe='-_.~')}"
+            for k, v in sorted(query))
+        lower = {k.lower(): " ".join(v.split())
+                 for k, v in headers.items()}
+        signed = sorted(lower)
+        canonical_headers = "".join(f"{k}:{lower[k]}\n" for k in signed)
+        return "\n".join([method.upper(), path, canonical_query,
+                          canonical_headers, ";".join(signed),
+                          payload_hash])
+
+    def sign(self, method: str, url: str,
+             headers: Optional[Dict[str, str]] = None,
+             payload: bytes = b"",
+             now: Optional[datetime.datetime] = None) -> Dict[str, str]:
+        """Return `headers` + Host/x-amz-date/x-amz-content-sha256/
+        Authorization for the request."""
+        now = now or datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        datestamp = now.strftime("%Y%m%d")
+        parts = urllib.parse.urlsplit(url)
+        payload_hash = hashlib.sha256(payload).hexdigest() if payload \
+            else EMPTY_SHA256
+
+        to_sign_headers = {"host": parts.netloc, "x-amz-date": amz_date,
+                           "x-amz-content-sha256": payload_hash}
+        if self.session_token:
+            to_sign_headers["x-amz-security-token"] = self.session_token
+        for k, v in (headers or {}).items():
+            if k.lower() == "range":
+                to_sign_headers[k.lower()] = v
+
+        creq = self.canonical_request(method, url, to_sign_headers,
+                                      payload_hash)
+        scope = f"{datestamp}/{self.region}/{self.service}/aws4_request"
+        string_to_sign = "\n".join([
+            "AWS4-HMAC-SHA256", amz_date, scope,
+            hashlib.sha256(creq.encode()).hexdigest()])
+        signature = hmac.new(self._signing_key(datestamp),
+                             string_to_sign.encode(),
+                             hashlib.sha256).hexdigest()
+        signed_list = ";".join(sorted(to_sign_headers))
+        auth = (f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+                f"SignedHeaders={signed_list}, Signature={signature}")
+        out = dict(headers or {})
+        out.update({"x-amz-date": amz_date,
+                    "x-amz-content-sha256": payload_hash,
+                    "Authorization": auth})
+        if self.session_token:
+            out["x-amz-security-token"] = self.session_token
+        return out
+
+
+class GCSTokenSigner:
+    """Bearer-token auth for GCS (JSON/XML APIs).
+
+    Token sources, in order: explicit token, $GOOGLE_OAUTH_ACCESS_TOKEN,
+    the GCE metadata server (workload identity). Metadata tokens are
+    cached until ~1 min before expiry.
+    """
+
+    METADATA_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                    "instance/service-accounts/default/token")
+
+    def __init__(self, token: Optional[str] = None):
+        self._static = token or os.environ.get("GOOGLE_OAUTH_ACCESS_TOKEN")
+        self._cached: Optional[str] = None
+        self._expiry = 0.0
+        self._lock = threading.Lock()
+
+    def _metadata_token(self) -> Optional[str]:
+        with self._lock:
+            if self._cached and time.time() < self._expiry - 60:
+                return self._cached
+            try:
+                req = urllib.request.Request(
+                    self.METADATA_URL,
+                    headers={"Metadata-Flavor": "Google"})
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    data = json.loads(resp.read())
+                self._cached = data["access_token"]
+                self._expiry = time.time() + data.get("expires_in", 300)
+                return self._cached
+            except Exception:
+                return None
+
+    def sign(self, method: str, url: str,
+             headers: Optional[Dict[str, str]] = None,
+             payload: bytes = b"", now=None) -> Dict[str, str]:
+        out = dict(headers or {})
+        token = self._static or self._metadata_token()
+        if token:
+            out["Authorization"] = f"Bearer {token}"
+        return out
+
+
+def signer_from_env(storage_type: str):
+    """Credential discovery for a storage scheme ('s3'/'gcs'/'oci').
+
+    Returns a signer or None (anonymous). OCI object storage is reached
+    through its S3-compatibility endpoint, so it takes SigV4 with the
+    customer secret key pair.
+    """
+    if storage_type in ("s3", "oci"):
+        access = os.environ.get("AWS_ACCESS_KEY_ID") \
+            or os.environ.get("OCI_S3_ACCESS_KEY_ID")
+        secret = os.environ.get("AWS_SECRET_ACCESS_KEY") \
+            or os.environ.get("OCI_S3_SECRET_ACCESS_KEY")
+        if access and secret:
+            return SigV4Signer(
+                access, secret,
+                region=os.environ.get("AWS_REGION",
+                                      os.environ.get("AWS_DEFAULT_REGION",
+                                                     "us-east-1")),
+                session_token=os.environ.get("AWS_SESSION_TOKEN"))
+        return None
+    if storage_type == "gcs":
+        signer = GCSTokenSigner()
+        if signer._static or os.environ.get("KUBERNETES_SERVICE_HOST") \
+                or os.environ.get("OME_GCS_METADATA_AUTH"):
+            return signer
+        return None
+    return None

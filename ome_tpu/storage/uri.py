@@ -90,10 +90,21 @@ def parse_storage_uri(uri: str) -> StorageComponents:
                                      namespace=namespace,
                                      prefix="/".join(parts[1:]))
         raise StorageURIError(f"invalid oci uri {uri!r}")
-    if st in (StorageType.GCS, StorageType.S3, StorageType.AZURE):
+    if st in (StorageType.GCS, StorageType.S3):
         parts = rest.strip("/").split("/", 1)
         return StorageComponents(type=st, bucket=parts[0],
                                  prefix=parts[1] if len(parts) > 1 else "")
+    if st == StorageType.AZURE:
+        # az://account/container/prefix — account rides `namespace` so
+        # `bucket`/`prefix` mean the same thing as for s3/gcs (callers
+        # pass prefix as the blob-name prefix inside the container)
+        parts = rest.strip("/").split("/", 2)
+        if len(parts) < 2:
+            raise StorageURIError(
+                f"az uri needs account/container: {uri!r}")
+        return StorageComponents(type=st, namespace=parts[0],
+                                 bucket=parts[1],
+                                 prefix=parts[2] if len(parts) > 2 else "")
     if st == StorageType.GITHUB:
         # github://org/repo[@ref]
         repo, _, revision = rest.partition("@")

@@ -21,11 +21,13 @@ MIN_CHUNK = 16 << 10
 AVG_CHUNK = 64 << 10  # power of two (FastCDC normalization)
 MAX_CHUNK = 256 << 10
 
-_LIB_PATHS = (
+_LIB_PATHS = tuple(p for p in (
+    os.path.join(os.environ.get("OME_NATIVE_DIR", ""), "libomechunk.so")
+    if os.environ.get("OME_NATIVE_DIR") else None,
     os.path.join(os.path.dirname(__file__), "..", "..", "native",
                  "libomechunk.so"),
     "libomechunk.so",
-)
+) if p)
 
 
 def _load_native() -> Optional[ctypes.CDLL]:

@@ -1,0 +1,176 @@
+"""AdmissionReview v1 webhook HTTP endpoints.
+
+The reference serves its defaulters/validators/mutators as webhook
+handlers registered on the manager's TLS server (cmd/manager/
+main.go:309-347, pod mutator Handle at pkg/webhook/admission/pod/
+mutator.go:31). This module gives the in-repo admission chain
+(webhooks/admission.py + pod_mutator.py) the same wire surface: an
+HTTPS (or plain-HTTP for tests) server speaking admission.k8s.io/v1
+AdmissionReview — mutating endpoints respond with RFC-6902 JSONPatch,
+validating endpoints with allowed/status.
+
+Paths (mirroring the reference's):
+  /mutate-pods                         pod mutator chain
+  /mutate-ome-io-v1-inferenceservice   isvc defaulter
+  /validate-ome-io-v1-inferenceservice isvc validator
+  /validate-ome-io-v1-servingruntime   (Cluster)ServingRuntime validator
+  /validate-ome-io-v1-benchmarkjob     BenchmarkJob validator
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+
+from ..apis import v1
+from ..core.k8s import Pod
+from .admission import (AdmissionError, default_inference_service,
+                        validate_benchmark_job, validate_inference_service,
+                        validate_serving_runtime)
+from .pod_mutator import mutate_pod
+
+log = logging.getLogger("ome.webhook")
+
+
+def json_patch(old: Any, new: Any, path: str = "") -> List[dict]:
+    """Minimal RFC-6902 patch turning `old` into `new` (dict trees)."""
+    ops: List[dict] = []
+    if isinstance(old, dict) and isinstance(new, dict):
+        for k in old:
+            esc = k.replace("~", "~0").replace("/", "~1")
+            if k not in new:
+                ops.append({"op": "remove", "path": f"{path}/{esc}"})
+            elif old[k] != new[k]:
+                ops.extend(json_patch(old[k], new[k], f"{path}/{esc}"))
+        for k in new:
+            if k not in old:
+                esc = k.replace("~", "~0").replace("/", "~1")
+                ops.append({"op": "add", "path": f"{path}/{esc}",
+                            "value": new[k]})
+        return ops
+    if isinstance(old, list) and isinstance(new, list) and old != new:
+        return [{"op": "replace", "path": path or "/", "value": new}]
+    if old != new:
+        return [{"op": "replace", "path": path or "/", "value": new}]
+    return ops
+
+
+class WebhookServer:
+    """admission.k8s.io/v1 endpoint server over the admission chain."""
+
+    def __init__(self, client, host: str = "0.0.0.0", port: int = 0,
+                 cert_file: Optional[str] = None,
+                 key_file: Optional[str] = None):
+        self.client = client
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code: int, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path in ("/healthz", "/readyz"):
+                    return self._json(200, {"status": "ok"})
+                self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    review = json.loads(self.rfile.read(n))
+                    request = review["request"]
+                except Exception as e:  # malformed review
+                    return self._json(400, {"error": str(e)})
+                response = outer.handle(self.path, request)
+                self._json(200, {
+                    "apiVersion": "admission.k8s.io/v1",
+                    "kind": "AdmissionReview",
+                    "response": response})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        if cert_file:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(cert_file, key_file)
+            self.httpd.socket = ctx.wrap_socket(self.httpd.socket,
+                                                server_side=True)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- dispatch ------------------------------------------------------
+
+    def handle(self, path: str, request: Dict[str, Any]) -> Dict[str, Any]:
+        uid = request.get("uid", "")
+        obj = request.get("object") or {}
+        try:
+            if path == "/mutate-pods":
+                return self._mutating(uid, obj, Pod,
+                                      lambda p: mutate_pod(self.client, p))
+            if path == "/mutate-ome-io-v1-inferenceservice":
+                return self._mutating(
+                    uid, obj, v1.InferenceService,
+                    lambda o: default_inference_service(self.client, o))
+            if path == "/validate-ome-io-v1-inferenceservice":
+                validate_inference_service(
+                    self.client, v1.InferenceService.from_dict(obj))
+            elif path == "/validate-ome-io-v1-servingruntime":
+                kind = obj.get("kind", "ServingRuntime")
+                cls = v1.ClusterServingRuntime \
+                    if kind == "ClusterServingRuntime" else v1.ServingRuntime
+                validate_serving_runtime(
+                    self.client, cls.from_dict(obj),
+                    cluster_scoped=(cls is v1.ClusterServingRuntime))
+            elif path == "/validate-ome-io-v1-benchmarkjob":
+                validate_benchmark_job(
+                    self.client, v1.BenchmarkJob.from_dict(obj))
+            else:
+                return {"uid": uid, "allowed": False, "status": {
+                    "code": 404, "message": f"unknown path {path}"}}
+            return {"uid": uid, "allowed": True}
+        except AdmissionError as e:
+            return {"uid": uid, "allowed": False, "status": {
+                "code": 403, "message": str(e)}}
+        except Exception as e:
+            log.exception("webhook %s failed", path)
+            return {"uid": uid, "allowed": False, "status": {
+                "code": 500, "message": f"webhook error: {e}"}}
+
+    def _mutating(self, uid: str, obj: dict, cls,
+                  fn: Callable) -> Dict[str, Any]:
+        before = cls.from_dict(obj)
+        after = fn(before.deepcopy())
+        patch = json_patch(before.to_dict(), after.to_dict())
+        resp: Dict[str, Any] = {"uid": uid, "allowed": True}
+        if patch:
+            resp["patchType"] = "JSONPatch"
+            resp["patch"] = base64.b64encode(
+                json.dumps(patch).encode()).decode()
+        return resp
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "WebhookServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="ome-webhook", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,125 @@
+"""ome-bench: scenario parsing, controller-arg compatibility, and an
+end-to-end sweep against the in-repo engine server.
+
+Closes the VERDICT's "phantom binary" finding: the exact argv the
+BenchmarkJob controller stamps into its Job must parse and drive a
+real benchmark producing a results JSON.
+"""
+
+import json
+import os
+import random
+
+import jax
+import pytest
+
+from ome_tpu.benchmark import build_parser, main, run_benchmark
+from ome_tpu.benchmark.scenarios import parse_scenario
+from ome_tpu.engine import ByteTokenizer, EngineServer, InferenceEngine, \
+    Scheduler
+from ome_tpu.models import config as cfgs
+from ome_tpu.models import llama
+
+
+class TestScenarios:
+    def test_deterministic(self):
+        s = parse_scenario("D(100,50)")
+        assert s.sample(random.Random(0)) == (100, 50)
+
+    def test_normal(self):
+        s = parse_scenario("N(480,240)/(300,150)")
+        i, o = s.sample(random.Random(0))
+        assert i >= 1 and o >= 1
+        assert s.kind == "N"
+
+    def test_uniform(self):
+        s = parse_scenario("U(10,20)/(5,8)")
+        for seed in range(5):
+            i, o = s.sample(random.Random(seed))
+            assert 10 <= i <= 20 and 5 <= o <= 8
+
+    def test_unknown_falls_back(self):
+        s = parse_scenario("garbage")
+        assert s.sample(random.Random(0)) == (256, 128)
+
+
+class TestControllerArgCompat:
+    def test_controller_stamped_args_parse(self):
+        """The argv controllers/benchmark.py builds must be accepted."""
+        from ome_tpu.apis import v1
+        from ome_tpu.controllers.benchmark import benchmark_args
+        from ome_tpu.core.meta import ObjectMeta
+        bj = v1.BenchmarkJob(
+            metadata=ObjectMeta(name="bj", namespace="default"),
+            spec=v1.BenchmarkJobSpec(
+                endpoint=v1.EndpointSpec(url="http://e:8080"),
+                task="text-to-text",
+                traffic_scenarios=["D(100,100)", "N(480,240)/(300,150)"],
+                num_concurrency=[1, 4],
+                max_time_per_iteration=2,
+                max_requests_per_iteration=10,
+                additional_request_params={"temperature": "0.5"},
+                output_location=v1.StorageSpec(
+                    storage_uri="local:///tmp/results"),
+                result_folder_name="run-1"))
+        argv = benchmark_args(bj, "http://e:8080", "m")
+        args = build_parser().parse_args(argv)
+        assert args.api_base == "http://e:8080"
+        assert args.traffic_scenario == ["D(100,100)",
+                                         "N(480,240)/(300,150)"]
+        assert args.num_concurrency == [1, 4]
+        assert args.upload_results and args.storage_uri == \
+            "local:///tmp/results"
+
+
+@pytest.fixture(scope="module")
+def served_engine():
+    cfg = cfgs.tiny_test().replace(max_seq_len=128)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(params, cfg, max_slots=4,
+                             prefill_buckets=[16, 32, 64])
+    sched = Scheduler(engine)
+    sched.start()
+    server = EngineServer(sched, tokenizer=ByteTokenizer(),
+                          model_name="tiny", port=0)
+    server.start()
+    yield f"http://127.0.0.1:{server.port}"
+    server.stop()
+    sched.stop()
+
+
+class TestEndToEnd:
+    def test_sweep_produces_report(self, served_engine):
+        report = run_benchmark(
+            api_base=served_engine, model="tiny", task="text-to-text",
+            scenarios=["D(8,4)"], concurrencies=[2],
+            max_time_per_run_s=20.0, max_requests_per_run=4)
+        assert len(report.iterations) == 1
+        it = report.iterations[0]
+        assert it.requests_total == 4
+        assert it.requests_failed == 0
+        assert it.output_tokens_total > 0
+        assert it.ttft_p50_ms > 0
+        assert report.summary()["best_output_tokens_per_s"] > 0
+
+    def test_cli_main_writes_report_and_uploads(self, served_engine,
+                                                tmp_path):
+        out_dir = str(tmp_path / "out")
+        upload_dir = str(tmp_path / "upload")
+        os.makedirs(upload_dir)
+        rc = main([
+            "benchmark", "--api-base", served_engine,
+            "--api-model-name", "tiny", "--task", "text-to-text",
+            "--traffic-scenario", "D(8,4)", "--num-concurrency", "1",
+            "--max-time-per-run", "20", "--max-requests-per-run", "2",
+            "--output-dir", out_dir,
+            "--upload-results", "--storage-uri", f"local://{upload_dir}",
+            "--result-folder", "run-x"])
+        assert rc == 0
+        reports = os.listdir(out_dir)
+        assert len(reports) == 1
+        with open(os.path.join(out_dir, reports[0])) as f:
+            data = json.load(f)
+        assert data["iterations"][0]["requests_total"] == 2
+        uploaded = os.listdir(os.path.join(upload_dir, "run-x"))
+        assert uploaded == reports

@@ -201,6 +201,33 @@ class TestDeploymentMode:
         with pytest.raises(DeploymentModeError):
             resolve_modes(isvc, "RawDeployment")
 
+    def test_pd_requires_router(self):
+        isvc = make_isvc()
+        isvc.spec.decoder = v1.EngineSpec()
+        with pytest.raises(DeploymentModeError, match="router"):
+            resolve_modes(isvc, "RawDeployment")
+
+    def test_serverless_rejects_leader_worker(self):
+        isvc = make_isvc(leader=v1.LeaderSpec(),
+                         worker=v1.WorkerSpec(size=2))
+        isvc.metadata.annotations[
+            constants.DEPLOYMENT_MODE_ANNOTATION] = "Serverless"
+        with pytest.raises(DeploymentModeError, match="leader/worker"):
+            resolve_modes(isvc, "RawDeployment")
+
+    def test_worker_size_zero_rejected(self):
+        isvc = make_isvc(leader=v1.LeaderSpec(),
+                         worker=v1.WorkerSpec(size=0))
+        with pytest.raises(DeploymentModeError, match="worker.size"):
+            resolve_modes(isvc, "RawDeployment")
+
+    def test_serverless_requires_scale_to_zero(self):
+        isvc = make_isvc(min_replicas=2)
+        isvc.metadata.annotations[
+            constants.DEPLOYMENT_MODE_ANNOTATION] = "Serverless"
+        with pytest.raises(DeploymentModeError, match="minReplicas"):
+            resolve_modes(isvc, "RawDeployment")
+
 
 # -- full reconcile ---------------------------------------------------------
 

@@ -1,0 +1,140 @@
+"""az:// github:// vendor:// providers against local fake endpoints."""
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from ome_tpu.storage import open_storage, parse_storage_uri
+from ome_tpu.storage.extra_providers import AzureBlobStorage, GitHubStorage
+from ome_tpu.storage.uri import StorageURIError
+
+
+@pytest.fixture()
+def http_server():
+    handlers = {}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _go(self):
+            for (method, prefix), fn in handlers.items():
+                if method == self.command and self.path.startswith(prefix):
+                    code, ctype, body = fn(self)
+                    self.send_response(code)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    if self.command != "HEAD":
+                        self.wfile.write(body)
+                    return
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        do_GET = do_PUT = do_HEAD = _go
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", handlers
+    srv.shutdown()
+
+
+AZ_LIST = b"""<?xml version="1.0" encoding="utf-8"?>
+<EnumerationResults><Blobs>
+<Blob><Name>models/a.bin</Name><Properties>
+<Content-Length>4</Content-Length><Etag>"e1"</Etag>
+</Properties></Blob>
+<Blob><Name>models/b.bin</Name><Properties>
+<Content-Length>2</Content-Length><Etag>"e2"</Etag>
+</Properties></Blob>
+</Blobs><NextMarker/></EnumerationResults>"""
+
+
+class TestAzure:
+    def test_list_get_exists(self, http_server):
+        base, handlers = http_server
+        handlers[("GET", "/cont?restype=container")] = \
+            lambda h: (200, "application/xml", AZ_LIST)
+        handlers[("GET", "/cont/models/a.bin")] = \
+            lambda h: (200, "application/octet-stream", b"DATA")
+        handlers[("HEAD", "/cont/models/a.bin")] = \
+            lambda h: (200, "application/octet-stream", b"")
+        store = AzureBlobStorage("acct", "cont", endpoint=base)
+        objs = store.list()
+        assert [(o.name, o.size) for o in objs] == \
+            [("models/a.bin", 4), ("models/b.bin", 2)]
+        assert store.get("models/a.bin") == b"DATA"
+        assert store.exists("models/a.bin")
+        assert not store.exists("models/missing.bin")
+
+    def test_sas_token_appended(self, http_server):
+        base, handlers = http_server
+        seen = {}
+
+        def capture(h):
+            seen["path"] = h.path
+            return (200, "application/octet-stream", b"X")
+        handlers[("GET", "/cont/blob")] = capture
+        store = AzureBlobStorage("acct", "cont", endpoint=base,
+                                 sas_token="?sv=2021&sig=abc")
+        store.get("blob")
+        assert "sv=2021&sig=abc" in seen["path"]
+
+
+class TestGitHub:
+    def test_list_and_get(self, http_server):
+        base, handlers = http_server
+        tree = {"tree": [
+            {"path": "config.json", "type": "blob", "size": 10,
+             "sha": "s1"},
+            {"path": "weights/model.safetensors", "type": "blob",
+             "size": 999, "sha": "s2"},
+            {"path": "weights", "type": "tree"}]}
+        handlers[("GET", "/repos/org/repo/git/trees/main")] = \
+            lambda h: (200, "application/json", json.dumps(tree).encode())
+        handlers[("GET", "/org/repo/main/config.json")] = \
+            lambda h: (200, "application/json", b'{"a":1}')
+        store = GitHubStorage("org/repo", "main", api_endpoint=base,
+                              raw_endpoint=base)
+        objs = store.list()
+        assert len(objs) == 2
+        assert store.list(prefix="weights/")[0].name == \
+            "weights/model.safetensors"
+        assert store.get("config.json") == b'{"a":1}'
+
+    def test_put_rejected(self):
+        store = GitHubStorage("org/repo")
+        with pytest.raises(StorageURIError, match="read-only"):
+            store.put("x", b"y")
+
+
+class TestFactory:
+    def test_open_az_uri(self):
+        comps = parse_storage_uri("az://acct/cont/models")
+        store = open_storage(comps, endpoints={"az": "http://x"})
+        assert isinstance(store, AzureBlobStorage)
+        assert store.container == "cont"
+
+    def test_open_github_uri(self):
+        comps = parse_storage_uri("github://org/repo@v1")
+        store = open_storage(comps)
+        assert isinstance(store, GitHubStorage)
+        assert store.revision == "v1"
+
+    def test_vendor_unconfigured_raises_actionable(self, monkeypatch):
+        monkeypatch.delenv("OME_VENDOR_ENDPOINT_ACME", raising=False)
+        comps = parse_storage_uri("vendor://acme/bucket/models")
+        with pytest.raises(StorageURIError, match="OME_VENDOR_ENDPOINT"):
+            open_storage(comps)
+
+    def test_vendor_configured(self, monkeypatch):
+        from ome_tpu.storage.providers import S3CompatStorage
+        monkeypatch.setenv("OME_VENDOR_ENDPOINT_ACME", "http://v.example")
+        comps = parse_storage_uri("vendor://acme/bucket/models")
+        store = open_storage(comps)
+        assert isinstance(store, S3CompatStorage)
+        assert store.bucket == "bucket"

@@ -1,0 +1,181 @@
+"""AdmissionReview v1 webhook endpoints + Lease leader election.
+
+Drives the webhook server over real HTTP the way a kube-apiserver
+would: POST AdmissionReview, decode the JSONPatch response, apply it,
+and check the mutation matches the in-process chain. Leader election
+is exercised with two competing electors on one fake cluster.
+"""
+
+import base64
+import copy
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from ome_tpu.apis import v1
+from ome_tpu.core.client import InMemoryClient
+from ome_tpu.core.meta import ObjectMeta
+from ome_tpu.webhooks.server import WebhookServer, json_patch
+
+
+def apply_patch(doc, ops):
+    doc = copy.deepcopy(doc)
+    for op in ops:
+        parts = [p.replace("~1", "/").replace("~0", "~")
+                 for p in op["path"].split("/")[1:]]
+        parent = doc
+        for p in parts[:-1]:
+            parent = parent[int(p)] if isinstance(parent, list) else parent[p]
+        key = parts[-1]
+        if op["op"] == "remove":
+            del parent[key]
+        else:
+            if isinstance(parent, list):
+                parent[int(key)] = op["value"]
+            else:
+                parent[key] = op["value"]
+    return doc
+
+
+class TestJsonPatch:
+    def test_roundtrip_nested(self):
+        old = {"a": {"b": 1, "c": [1, 2]}, "drop": "x"}
+        new = {"a": {"b": 2, "c": [1, 2, 3], "d": {"e": 5}}}
+        ops = json_patch(old, new)
+        assert apply_patch(old, ops) == new
+
+    def test_no_ops_on_equal(self):
+        assert json_patch({"x": 1}, {"x": 1}) == []
+
+
+@pytest.fixture()
+def hooked():
+    client = InMemoryClient()
+    srv = WebhookServer(client, host="127.0.0.1", port=0).start()
+    yield client, f"http://127.0.0.1:{srv.port}"
+    srv.stop()
+
+
+def _post(base, path, obj, kind):
+    review = {
+        "apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+        "request": {"uid": "u-1", "kind": {"kind": kind},
+                    "object": obj}}
+    req = urllib.request.Request(
+        base + path, data=json.dumps(review).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())["response"]
+
+
+class TestAdmissionEndpoints:
+    def test_isvc_defaulter_patches_over_http(self, hooked):
+        client, base = hooked
+        client.create(v1.ClusterBaseModel(
+            metadata=ObjectMeta(name="m"),
+            spec=v1.BaseModelSpec(
+                model_format=v1.ModelFormat(name="safetensors"))))
+        isvc = v1.InferenceService(
+            metadata=ObjectMeta(name="s", namespace="default"),
+            spec=v1.InferenceServiceSpec(model=v1.ModelRef(name="m")))
+        resp = _post(base, "/mutate-ome-io-v1-inferenceservice",
+                     isvc.to_dict(), "InferenceService")
+        assert resp["allowed"] and resp["uid"] == "u-1"
+        ops = json.loads(base64.b64decode(resp["patch"]))
+        patched = apply_patch(isvc.to_dict(), ops)
+        out = v1.InferenceService.from_dict(patched)
+        assert out.spec.model.kind == "ClusterBaseModel"  # defaulted
+        assert out.spec.engine is not None                # defaulted
+
+    def test_isvc_validator_denies_bad_spec(self, hooked):
+        _, base = hooked
+        isvc = v1.InferenceService(
+            metadata=ObjectMeta(name="s", namespace="default"),
+            spec=v1.InferenceServiceSpec())  # no model
+        resp = _post(base, "/validate-ome-io-v1-inferenceservice",
+                     isvc.to_dict(), "InferenceService")
+        assert not resp["allowed"]
+        assert "model.name" in resp["status"]["message"]
+
+    def test_pod_mutator_injects_over_http(self, hooked):
+        client, base = hooked
+        from ome_tpu import constants
+        from ome_tpu.core.k8s import Container, Pod, PodSpec
+        pod = Pod(
+            metadata=ObjectMeta(name="p", namespace="default",
+                                labels={constants.ISVC_LABEL: "svc"}),
+            spec=PodSpec(containers=[
+                Container(name=constants.MAIN_CONTAINER, image="e:1")]))
+        resp = _post(base, "/mutate-pods", pod.to_dict(), "Pod")
+        assert resp["allowed"]
+        ops = json.loads(base64.b64decode(resp["patch"]))
+        patched = apply_patch(pod.to_dict(), ops)
+        out = Pod.from_dict(patched)
+        assert out.metadata.annotations.get(
+            constants.PROMETHEUS_SCRAPE_ANNOTATION) == "true"
+
+    def test_runtime_validator_conflict_denied(self, hooked):
+        client, base = hooked
+        mk = lambda name: v1.ClusterServingRuntime(
+            metadata=ObjectMeta(name=name),
+            spec=v1.ServingRuntimeSpec(
+                supported_model_formats=[v1.SupportedModelFormat(
+                    name="safetensors",
+                    model_architecture="LlamaForCausalLM",
+                    auto_select=True, priority=1)],
+                engine_config=v1.EngineConfig(
+                    runner=v1.RunnerSpec(name="r", image="i"))))
+        client.create(mk("existing"))
+        resp = _post(base, "/validate-ome-io-v1-servingruntime",
+                     {**mk("new").to_dict(),
+                      "kind": "ClusterServingRuntime"},
+                     "ClusterServingRuntime")
+        assert not resp["allowed"]
+        assert "priority" in resp["status"]["message"]
+
+    def test_unknown_path_denied(self, hooked):
+        _, base = hooked
+        resp = _post(base, "/mutate-unknown", {}, "Pod")
+        assert not resp["allowed"]
+
+
+class TestLeaderElection:
+    def test_single_elector_acquires_and_releases(self):
+        from ome_tpu.core.k8s import Lease
+        from ome_tpu.core.leaderelect import LeaderElector
+        client = InMemoryClient()
+        started = threading.Event()
+        el = LeaderElector(client, identity="a", lease_duration=2.0,
+                           renew_interval=0.1,
+                           on_started_leading=started.set)
+        el.start()
+        assert started.wait(5)
+        lease = client.get(Lease, "ome-manager-leader", "ome")
+        assert lease.spec.holder_identity == "a"
+        el.stop()
+        lease = client.get(Lease, "ome-manager-leader", "ome")
+        assert lease.spec.holder_identity is None  # released
+
+    def test_second_elector_waits_then_takes_over(self):
+        from ome_tpu.core.leaderelect import LeaderElector
+        client = InMemoryClient()
+        a_started, b_started = threading.Event(), threading.Event()
+        # generous lease vs renew spread: a loaded single-core test box
+        # can stall the renew thread for a second or more
+        a = LeaderElector(client, identity="a", lease_duration=8.0,
+                          renew_interval=0.2,
+                          on_started_leading=a_started.set)
+        b = LeaderElector(client, identity="b", lease_duration=8.0,
+                          renew_interval=0.2,
+                          on_started_leading=b_started.set)
+        a.start()
+        assert a_started.wait(10)
+        b.start()
+        time.sleep(1.0)
+        assert not b_started.is_set()  # a holds the lease
+        a.stop(release=False)          # crash: no release, lease expires
+        assert b_started.wait(30)      # b takes over after expiry
+        b.stop()
