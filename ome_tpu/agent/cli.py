@@ -16,8 +16,14 @@ import time
 
 
 def _cmd_enigma(args) -> int:
-    from .enigma import LocalKMS, decrypt_dir, encrypt_dir
-    kms = LocalKMS(args.keyfile, create=args.mode == "encrypt")
+    from .cloudkms import open_kms
+    from .enigma import decrypt_dir, encrypt_dir
+    if not args.kms and not args.keyfile:
+        print("enigma: one of --kms or --keyfile is required",
+              file=sys.stderr)
+        return 2
+    spec = args.kms or f"local:{args.keyfile}"
+    kms = open_kms(spec, create=args.mode == "encrypt")
     if args.mode == "encrypt":
         n = encrypt_dir(args.input, args.output, kms)
     else:
@@ -86,7 +92,9 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("mode", choices=["encrypt", "decrypt"])
     e.add_argument("--input", required=True)
     e.add_argument("--output", required=True)
-    e.add_argument("--keyfile", required=True)
+    e.add_argument("--keyfile", help="shorthand for --kms local:<file>")
+    e.add_argument("--kms", default=None,
+                   help="KMS spec: local:<keyfile> | gcpkms:<key name>")
     e.set_defaults(fn=_cmd_enigma)
 
     r = sub.add_parser("replica", help="replicate a model between stores")

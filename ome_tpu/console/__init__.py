@@ -1,0 +1,9 @@
+"""Web console: REST backend + single-page UI.
+
+Re-designs web-console/ (backend: Go/gin over informers at
+web-console/backend/cmd/api/main.go:56-145; frontend: React). Same
+API surface, served by one stdlib HTTP server over either client
+substrate; the UI is a dependency-free single HTML file.
+"""
+
+from .server import ConsoleServer  # noqa: F401

@@ -1,0 +1,246 @@
+"""Console REST API.
+
+Mirrors the reference backend's routes (web-console/backend/cmd/api/
+main.go:56-145):
+
+  GET    /api/v1/namespaces
+  GET    /api/v1/models[?namespace=]         (cluster + namespaced)
+  GET    /api/v1/runtimes[?namespace=]
+  GET    /api/v1/services[?namespace=]
+  POST   /api/v1/services                    (create isvc, admission-checked)
+  DELETE /api/v1/services/{ns}/{name}
+  GET    /api/v1/accelerators
+  POST   /api/v1/validate                    (admission dry-run, no persist)
+  GET    /api/v1/huggingface?q=              (hub model search proxy)
+  GET    /                                   (single-page UI)
+
+Works against InMemoryClient or KubeClient — the console only speaks
+the shared client interface.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..apis import v1
+from ..webhooks.admission import (AdmissionError, default_inference_service,
+                                  validate_inference_service)
+from .ui import INDEX_HTML
+
+log = logging.getLogger("ome.console")
+
+HF_API_DEFAULT = "https://huggingface.co"
+
+
+def _summary(obj) -> dict:
+    d = obj.to_dict()
+    d["kind"] = type(obj).KIND
+    return d
+
+
+class ConsoleServer:
+    def __init__(self, client, host: str = "0.0.0.0", port: int = 0,
+                 hf_endpoint: Optional[str] = None):
+        self.client = client
+        self.hf_endpoint = (hf_endpoint or HF_API_DEFAULT).rstrip("/")
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code: int, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Access-Control-Allow-Origin", "*")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _html(self, body: bytes):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _query(self):
+                return {k: vs[0] for k, vs in urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query).items()}
+
+            def do_GET(self):
+                path = urllib.parse.urlparse(self.path).path
+                q = self._query()
+                ns = q.get("namespace")
+                try:
+                    if path in ("/", "/index.html"):
+                        return self._html(INDEX_HTML.encode())
+                    if path == "/healthz":
+                        return self._json(200, {"status": "ok"})
+                    if path == "/api/v1/namespaces":
+                        return self._json(200, outer.namespaces())
+                    if path == "/api/v1/models":
+                        items = [_summary(m) for m in outer.client.list(
+                            v1.ClusterBaseModel)]
+                        items += [_summary(m) for m in outer.client.list(
+                            v1.BaseModel, namespace=ns)]
+                        return self._json(200, {"items": items})
+                    if path == "/api/v1/runtimes":
+                        items = [_summary(r) for r in outer.client.list(
+                            v1.ClusterServingRuntime)]
+                        items += [_summary(r) for r in outer.client.list(
+                            v1.ServingRuntime, namespace=ns)]
+                        return self._json(200, {"items": items})
+                    if path == "/api/v1/services":
+                        items = [_summary(s) for s in outer.client.list(
+                            v1.InferenceService, namespace=ns)]
+                        return self._json(200, {"items": items})
+                    if path == "/api/v1/accelerators":
+                        items = [_summary(a) for a in outer.client.list(
+                            v1.AcceleratorClass)]
+                        return self._json(200, {"items": items})
+                    if path == "/api/v1/huggingface":
+                        return self._json(200, outer.hf_search(
+                            q.get("q", ""), int(q.get("limit", "10"))))
+                    return self._json(404, {"error": "not found"})
+                except Exception as e:
+                    log.exception("GET %s failed", path)
+                    return self._json(500, {"error": str(e)})
+
+            def do_POST(self):
+                path = urllib.parse.urlparse(self.path).path
+                n = int(self.headers.get("Content-Length") or 0)
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError as e:
+                    return self._json(400, {"error": f"bad json: {e}"})
+                try:
+                    if path == "/api/v1/validate":
+                        ok, msgs = outer.validate(body)
+                        return self._json(200, {"valid": ok,
+                                                "messages": msgs})
+                    if path == "/api/v1/services":
+                        created, errs = outer.create_service(body)
+                        if errs:
+                            return self._json(422, {"errors": errs})
+                        return self._json(201, _summary(created))
+                    return self._json(404, {"error": "not found"})
+                except Exception as e:
+                    log.exception("POST %s failed", path)
+                    return self._json(500, {"error": str(e)})
+
+            def do_DELETE(self):
+                parts = [p for p in urllib.parse.urlparse(self.path)
+                         .path.split("/") if p]
+                if len(parts) == 5 and parts[:3] == ["api", "v1",
+                                                     "services"]:
+                    _, _, _, ns, name = parts
+                    from ..core.errors import NotFoundError
+                    try:
+                        outer.client.delete(v1.InferenceService, name, ns)
+                        return self._json(200, {"deleted": f"{ns}/{name}"})
+                    except NotFoundError:
+                        return self._json(404, {"error": "not found"})
+                return self._json(404, {"error": "not found"})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- handlers ------------------------------------------------------
+
+    def namespaces(self) -> dict:
+        seen = set()
+        for cls in (v1.InferenceService, v1.BaseModel, v1.ServingRuntime,
+                    v1.BenchmarkJob):
+            for obj in self.client.list(cls):
+                if obj.metadata.namespace:
+                    seen.add(obj.metadata.namespace)
+        return {"items": sorted(seen) or ["default"]}
+
+    def validate(self, body: dict):
+        isvc = v1.InferenceService.from_dict(body)
+        try:
+            default_inference_service(self.client, isvc)
+            validate_inference_service(self.client, isvc)
+            return True, []
+        except AdmissionError as e:
+            return False, e.messages
+
+    def create_service(self, body: dict):
+        isvc = v1.InferenceService.from_dict(body)
+        if not isvc.metadata.namespace:
+            isvc.metadata.namespace = "default"
+        try:
+            default_inference_service(self.client, isvc)
+            validate_inference_service(self.client, isvc)
+        except AdmissionError as e:
+            return None, e.messages
+        return self.client.create(isvc), []
+
+    def hf_search(self, query: str, limit: int = 10) -> dict:
+        url = (f"{self.hf_endpoint}/api/models?"
+               + urllib.parse.urlencode({"search": query, "limit": limit}))
+        try:
+            with urllib.request.urlopen(url, timeout=15) as resp:
+                models = json.loads(resp.read())
+        except Exception as e:
+            return {"items": [], "error": f"hub unreachable: {e}"}
+        return {"items": [{
+            "id": m.get("modelId") or m.get("id"),
+            "downloads": m.get("downloads"),
+            "likes": m.get("likes"),
+            "pipeline_tag": m.get("pipeline_tag"),
+        } for m in models]}
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> "ConsoleServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="ome-console", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from ..cmd.manager import build_client
+    p = argparse.ArgumentParser(prog="ome-console")
+    p.add_argument("--port", type=int, default=8090)
+    p.add_argument("--bind", default="0.0.0.0")
+    p.add_argument("--kubeconfig", default=None)
+    p.add_argument("--kube-server", default=None)
+    p.add_argument("--in-cluster", action="store_true")
+    p.add_argument("--hf-endpoint", default=None)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    client = build_client(args)
+    srv = ConsoleServer(client, host=args.bind, port=args.port,
+                        hf_endpoint=args.hf_endpoint).start()
+    log.info("console on :%d", srv.port)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

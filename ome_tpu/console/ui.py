@@ -1,0 +1,145 @@
+"""Single-file console UI (the reference ships a React app; this is a
+dependency-free equivalent covering the same workflows: browse models/
+runtimes/services/accelerators, inspect status, validate + create an
+InferenceService, search the HF hub)."""
+
+INDEX_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>OME-TPU Console</title>
+<style>
+  :root { --bg:#0e1116; --panel:#161b24; --line:#283042; --fg:#dbe2ef;
+          --dim:#8b96ab; --acc:#4f8cff; --ok:#3fb68b; --bad:#e0635f; }
+  * { box-sizing: border-box; }
+  body { margin:0; background:var(--bg); color:var(--fg);
+         font:14px/1.5 system-ui, sans-serif; }
+  header { padding:14px 22px; border-bottom:1px solid var(--line);
+           display:flex; gap:18px; align-items:baseline; }
+  header h1 { font-size:17px; margin:0; }
+  header nav a { color:var(--dim); margin-right:14px; cursor:pointer;
+                 text-decoration:none; }
+  header nav a.active { color:var(--acc); }
+  main { padding:20px 22px; max-width:1100px; }
+  table { width:100%; border-collapse:collapse; background:var(--panel);
+          border:1px solid var(--line); border-radius:8px; }
+  th, td { text-align:left; padding:8px 12px;
+           border-bottom:1px solid var(--line); font-size:13px; }
+  th { color:var(--dim); font-weight:500; }
+  .ok { color:var(--ok); } .bad { color:var(--bad); }
+  textarea { width:100%; height:220px; background:var(--panel);
+             color:var(--fg); border:1px solid var(--line);
+             border-radius:8px; padding:10px; font:12px monospace; }
+  button { background:var(--acc); color:#fff; border:0; padding:8px 14px;
+           border-radius:6px; cursor:pointer; margin-right:8px; }
+  input { background:var(--panel); color:var(--fg); padding:8px;
+          border:1px solid var(--line); border-radius:6px; width:320px; }
+  pre { background:var(--panel); border:1px solid var(--line);
+        border-radius:8px; padding:12px; overflow:auto; font-size:12px; }
+</style>
+</head>
+<body>
+<header>
+  <h1>OME-TPU</h1>
+  <nav id="nav"></nav>
+</header>
+<main id="main"></main>
+<script>
+const TABS = ["services","models","runtimes","accelerators","create","hub"];
+let tab = "services";
+const $ = (h) => { const d = document.createElement("div");
+                   d.innerHTML = h; return d; };
+const get = (p) => fetch(p).then(r => r.json());
+
+function nav() {
+  document.getElementById("nav").innerHTML = TABS.map(t =>
+    `<a class="${t===tab?'active':''}" onclick="go('${t}')">${t}</a>`
+  ).join("");
+}
+function go(t) { tab = t; nav(); render(); }
+
+function rows(items, cols) {
+  return `<table><tr>${cols.map(c=>`<th>${c[0]}</th>`).join("")}</tr>` +
+    items.map(i=>`<tr>${cols.map(c=>`<td>${c[1](i)??""}</td>`).join("")}
+    </tr>`).join("") + "</table>";
+}
+const meta = i => i.metadata || {};
+const ready = s => { const c = (s.status?.conditions||[])
+    .find(c=>c.type==="Ready");
+  return c?.status==="True" ? '<span class="ok">Ready</span>'
+                            : '<span class="bad">NotReady</span>'; };
+
+async function render() {
+  const m = document.getElementById("main");
+  if (tab === "services") {
+    const d = await get("/api/v1/services");
+    m.replaceChildren($(rows(d.items, [
+      ["namespace", i=>meta(i).namespace], ["name", i=>meta(i).name],
+      ["model", i=>i.spec?.model?.name], ["mode",
+        i=>i.status?.deploymentMode], ["url", i=>i.status?.url],
+      ["status", ready]])));
+  } else if (tab === "models") {
+    const d = await get("/api/v1/models");
+    m.replaceChildren($(rows(d.items, [
+      ["kind", i=>i.kind], ["name", i=>meta(i).name],
+      ["architecture", i=>i.spec?.modelArchitecture],
+      ["params", i=>i.spec?.modelParameterSize],
+      ["storage", i=>i.spec?.storage?.storageUri],
+      ["state", i=>i.status?.lifecycle]])));
+  } else if (tab === "runtimes") {
+    const d = await get("/api/v1/runtimes");
+    m.replaceChildren($(rows(d.items, [
+      ["name", i=>meta(i).name],
+      ["formats", i=>(i.spec?.supportedModelFormats||[])
+         .map(f=>f.modelArchitecture||f.name).join(", ")],
+      ["sizeRange", i=>{const r=i.spec?.modelSizeRange;
+         return r?`${r.min||""}-${r.max||""}`:""}],
+      ["accelerators", i=>(i.spec?.acceleratorRequirements?
+         .acceleratorClasses||[]).join(", ")]])));
+  } else if (tab === "accelerators") {
+    const d = await get("/api/v1/accelerators");
+    m.replaceChildren($(rows(d.items, [
+      ["name", i=>meta(i).name], ["family", i=>i.spec?.family],
+      ["topology", i=>i.spec?.topology?.shape],
+      ["memoryGB", i=>i.spec?.capabilities?.memoryGb],
+      ["nodes", i=>i.status?.nodeCount]])));
+  } else if (tab === "create") {
+    m.replaceChildren($(`
+      <p>InferenceService JSON (validated by the admission chain):</p>
+      <textarea id="spec">{
+  "metadata": {"name": "my-svc", "namespace": "default"},
+  "spec": {"model": {"name": ""}, "engine": {}}
+}</textarea><br>
+      <button onclick="validate()">Validate</button>
+      <button onclick="create()">Create</button>
+      <pre id="out"></pre>`));
+  } else if (tab === "hub") {
+    m.replaceChildren($(`
+      <p><input id="q" placeholder="search huggingface models">
+      <button onclick="hub()">Search</button></p><div id="hubout"></div>`));
+  }
+}
+async function validate() {
+  const body = document.getElementById("spec").value;
+  const r = await fetch("/api/v1/validate", {method:"POST", body});
+  document.getElementById("out").textContent =
+    JSON.stringify(await r.json(), null, 2);
+}
+async function create() {
+  const body = document.getElementById("spec").value;
+  const r = await fetch("/api/v1/services", {method:"POST", body});
+  document.getElementById("out").textContent =
+    JSON.stringify(await r.json(), null, 2);
+}
+async function hub() {
+  const q = document.getElementById("q").value;
+  const d = await get("/api/v1/huggingface?q=" + encodeURIComponent(q));
+  document.getElementById("hubout").replaceChildren($(rows(d.items, [
+    ["model", i=>i.id], ["downloads", i=>i.downloads],
+    ["likes", i=>i.likes], ["task", i=>i.pipeline_tag]])));
+}
+nav(); render();
+</script>
+</body>
+</html>
+"""

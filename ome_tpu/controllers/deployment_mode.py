@@ -63,7 +63,8 @@ def resolve_modes(isvc: v1.InferenceService, default_mode: str,
     # isvc doesn't spell out leader/worker
     if (engine_spec is not None and runtime_spec is not None
             and runtime_spec.engine_config is not None
-            and runtime_spec.engine_config.worker is not None
+            and (runtime_spec.engine_config.worker is not None
+                 or runtime_spec.engine_config.worker_size)
             and engine_spec.leader is None and engine_spec.worker is None):
         engine_spec = v1.EngineSpec(
             leader=v1.LeaderSpec(),

@@ -1,0 +1,48 @@
+"""Istio Sidecar reconciler.
+
+Re-designs reconcilers/istiosidecar (istiosidecar_reconciler.go:28-70):
+when a component's pods opt into mesh injection
+(`sidecar.istio.io/inject: "true"` label), stamp a
+networking.istio.io Sidecar scoping the Envoy config to the component:
+ingress+egress on the serving port only, workload-selected by the
+InferenceService label. Multi-node groups chat leader<->workers on the
+pod subdomain; an unscoped mesh config would balloon every engine
+pod's Envoy with the whole cluster's services.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ... import constants
+from ...apis import v1
+from ...core.k8s import IstioSidecar
+from ..components import ComponentPlan
+from .common import child_meta, upsert
+
+ISTIO_INJECT_LABEL = "sidecar.istio.io/inject"
+
+
+def sidecar_enabled(plan: ComponentPlan) -> bool:
+    return plan.labels.get(ISTIO_INJECT_LABEL) == "true"
+
+
+def build_sidecar(isvc: v1.InferenceService,
+                  plan: ComponentPlan) -> IstioSidecar:
+    port = {"number": plan.port, "protocol": "HTTP"}
+    return IstioSidecar(
+        metadata=child_meta(isvc, plan.name, plan.labels),
+        spec={
+            "workloadSelector": {"labels": {
+                constants.ISVC_LABEL: isvc.metadata.name,
+                constants.COMPONENT_LABEL: plan.component}},
+            "ingress": [{"port": port}],
+            "egress": [{"hosts": ["./*"], "port": port}],
+        })
+
+
+def reconcile_istio_sidecar(client, isvc: v1.InferenceService,
+                            plan: ComponentPlan) -> Optional[IstioSidecar]:
+    if not sidecar_enabled(plan):
+        return None
+    return upsert(client, isvc, build_sidecar(isvc, plan))

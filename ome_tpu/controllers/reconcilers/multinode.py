@@ -108,6 +108,8 @@ def build_headless_service(isvc: v1.InferenceService, plan: ComponentPlan,
 
 def reconcile_multinode(client: InMemoryClient, isvc: v1.InferenceService,
                         plan: ComponentPlan) -> LeaderWorkerSet:
+    from .istiosidecar import reconcile_istio_sidecar
     lws = upsert(client, isvc, build_lws(isvc, plan))
     upsert(client, isvc, build_headless_service(isvc, plan))
+    reconcile_istio_sidecar(client, isvc, plan)
     return lws

@@ -399,3 +399,18 @@ class Lease(Resource):
     KIND: ClassVar[str] = "Lease"
     API_VERSION: ClassVar[str] = "coordination.k8s.io/v1"
     spec: LeaseSpec = field(default_factory=LeaseSpec)
+
+
+# --------------------------------------------------------------------------
+# Istio (service mesh)
+
+
+@dataclass
+class IstioSidecar(Resource):
+    """networking.istio.io Sidecar — scopes the Envoy sidecar's config
+    for multinode engine pods (reference: reconcilers/istiosidecar)."""
+
+    KIND: ClassVar[str] = "Sidecar"
+    API_VERSION: ClassVar[str] = "networking.istio.io/v1beta1"
+    PLURAL: ClassVar[str] = "sidecars"
+    spec: dict = field(default_factory=dict)

@@ -1,0 +1,100 @@
+"""Embedding engine: decoder-based text embeddings (e5-mistral style).
+
+The catalog's embeddings runtime (config/runtimes/ome/
+ome-engine-embeddings-rt.yaml) serves decoder-architecture embedding
+models (MistralModel / Qwen2Model — e5-mistral, gte-Qwen2): run the
+decoder over the prompt, pool the LAST real token's final hidden
+state, L2-normalize. Requests batch per length bucket into one
+compiled program per bucket — same compilation discipline as the
+generation engine, but stateless (no KV cache kept).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import llama
+from ..models.config import ModelConfig
+
+
+def forward_embed(params: llama.Params, cfg: ModelConfig,
+                  tokens: jax.Array, true_len: jax.Array) -> jax.Array:
+    """[B, S] tokens (right-padded) -> [B, D] unit-norm embeddings."""
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)
+    freqs = llama._rope_frequencies(cfg)
+
+    def body(x, lp):
+        x, _ = llama._layer(x, lp, cfg, freqs, positions, None, None,
+                            None)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = llama.rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
+                       cfg.unit_offset_norm)
+    # last REAL token pools the sequence (decoder embedding convention)
+    pooled = jnp.take_along_axis(
+        x, (true_len - 1)[:, None, None], axis=1)[:, 0].astype(jnp.float32)
+    return pooled / jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+
+
+class EmbeddingEngine:
+    """Bucketed batch embedding over one model."""
+
+    def __init__(self, params: llama.Params, cfg: ModelConfig,
+                 max_seq: Optional[int] = None,
+                 buckets: Optional[List[int]] = None):
+        self.params = params
+        self.cfg = cfg
+        self.max_seq = max_seq or min(cfg.max_seq_len, 8192)
+        if buckets is None:
+            buckets, b = [], 32
+            while b < self.max_seq:
+                buckets.append(b)
+                b *= 4
+            buckets.append(self.max_seq)
+        self.buckets = buckets
+        cfg_ = cfg
+
+        @functools.partial(jax.jit, static_argnames=("bucket",))
+        def _embed(params, padded, true_len, bucket: int):
+            return forward_embed(params, cfg_, padded, true_len)
+
+        self._embed = _embed
+
+    def embed(self, prompts_ids: List[List[int]]) -> np.ndarray:
+        """Embed token-id lists -> [N, D] float32.
+
+        Inputs group by length bucket and run as ONE [N_bucket, S]
+        program per bucket (batch amortizes dispatch; compilations stay
+        bounded by the bucket set x observed batch sizes)."""
+        for ids in prompts_ids:
+            if not ids:
+                raise ValueError("cannot embed an empty input")
+        out = np.zeros((len(prompts_ids), self.cfg.hidden_size),
+                       np.float32)
+        groups: dict = {}
+        for i, ids in enumerate(prompts_ids):
+            ids = ids[:self.max_seq]
+            bucket = next((b for b in self.buckets if len(ids) <= b),
+                          self.buckets[-1])
+            groups.setdefault(bucket, []).append((i, ids))
+        for bucket, members in groups.items():
+            padded = jnp.asarray(
+                [ids + [0] * (bucket - len(ids)) for _, ids in members],
+                jnp.int32)
+            lens = jnp.asarray([len(ids) for _, ids in members],
+                               jnp.int32)
+            embs = np.asarray(self._embed(self.params, padded, lens,
+                                          bucket=bucket))
+            for (i, _), e in zip(members, embs):
+                out[i] = e
+        return out

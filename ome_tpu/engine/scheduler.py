@@ -129,8 +129,17 @@ class Scheduler:
     # -- core loop -----------------------------------------------------
 
     def step(self) -> bool:
-        """One admission + decode round; returns True if work was done."""
-        admitted = self._admit()
+        """One admission + decode round; returns True if work was done.
+
+        Prefill/decode interleaving (the JetStream slicing pattern, per
+        the round-1 review): while streams are active, at most ONE
+        prefill is admitted per decode step, so a burst of long prompts
+        adds bounded latency to in-flight streams instead of stalling
+        them for the whole burst. An idle batch admits up to every free
+        slot at once — there is nothing to stall.
+        """
+        active = any(r is not None for r in self.slots)
+        admitted = self._admit(limit=1 if active else None)
         decoded = self._decode()
         with self._lock:
             self.stats["queue_depth"] = self.pending.qsize()
@@ -138,11 +147,14 @@ class Scheduler:
                 r is not None for r in self.slots)
         return admitted or decoded
 
-    def _admit(self) -> bool:
+    def _admit(self, limit: Optional[int] = None) -> bool:
         did = False
+        admitted = 0
         for slot, occupant in enumerate(self.slots):
             if occupant is not None:
                 continue
+            if limit is not None and admitted >= limit:
+                break
             try:
                 req = self.pending.get_nowait()
             except queue.Empty:
@@ -166,6 +178,7 @@ class Scheduler:
             req.emit(tok)
             self._maybe_finish(slot, tok)
             did = True
+            admitted += 1
         return did
 
     def _decode(self) -> bool:

@@ -9,7 +9,8 @@ of the reference runtimes' `python -m sglang.launch_server` /
 `vllm serve` commands (SURVEY.md L0) but with the in-repo JAX engine.
 
 `--random-weights` skips checkpoint loading (hermetic tests, dry
-runs); `--task embed` is reserved until the embedding head lands.
+runs); `--task embed` serves /v1/embeddings through the stateless
+EmbeddingEngine (engine/embed.py) instead of the generation stack.
 """
 
 from __future__ import annotations
@@ -88,26 +89,72 @@ def load_engine(args):
                            max_seq=max_seq)
 
 
+class _NullScheduler:
+    """Placeholder driving nothing — embeddings are stateless."""
+
+    healthy = True
+    stats: dict = {}
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def submit(self, req):
+        raise RuntimeError("this deployment serves embeddings only")
+
+
+def load_embedder(args):
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import checkpoint, llama
+    from .embed import EmbeddingEngine
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    if args.random_weights:
+        import json
+        import os
+        from ..models.config import ModelConfig, tiny_test
+        cfg_path = os.path.join(args.model_dir, "config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                cfg = ModelConfig.from_hf_config(json.load(f))
+        else:
+            cfg = tiny_test()
+        cfg = cfg.replace(dtype=dtype)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    else:
+        params, cfg = checkpoint.load_params(args.model_dir, dtype=dtype)
+        cfg = cfg.replace(dtype=dtype)
+        params = jax.tree.map(jnp.asarray, params)
+    return EmbeddingEngine(params, cfg, max_seq=args.max_seq)
+
+
 def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
     args = build_parser().parse_args(argv)
-    if args.task == "embed":
-        log.error("--task embed is not implemented yet")
-        return 2
 
     from .scheduler import Scheduler
     from .server import EngineServer
     from .tokenizer import load_tokenizer
 
-    engine = load_engine(args)
-    scheduler = Scheduler(engine)
+    embedder = None
+    if args.task == "embed":
+        embedder = load_embedder(args)
+        scheduler = _NullScheduler()
+    else:
+        engine = load_engine(args)
+        scheduler = Scheduler(engine)
     tok = load_tokenizer(args.model_dir)
     name = args.model_name or args.model_dir.rstrip("/").rsplit("/", 1)[-1]
     server = EngineServer(scheduler, tokenizer=tok, model_name=name,
-                          host=args.host, port=args.port)
-    log.info("serving %s on %s:%d (slots=%d)", name, args.host,
-             server.port, engine.max_slots)
+                          host=args.host, port=args.port,
+                          embedder=embedder)
+    log.info("serving %s on %s:%d (%s)", name, args.host, server.port,
+             "embeddings" if embedder else
+             f"slots={scheduler.engine.max_slots}")
     server.start()
     try:
         import signal

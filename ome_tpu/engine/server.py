@@ -24,10 +24,11 @@ from .tokenizer import load_tokenizer
 class EngineServer:
     def __init__(self, scheduler: Scheduler, tokenizer=None,
                  model_name: str = "ome-model", host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, embedder=None):
         self.scheduler = scheduler
         self.tokenizer = tokenizer or load_tokenizer()
         self.model_name = model_name
+        self.embedder = embedder  # engine/embed.py EmbeddingEngine
         self.started_at = time.time()
         outer = self
 
@@ -88,7 +89,34 @@ class EngineServer:
                     return self._complete(payload, chat=False)
                 if self.path == "/v1/chat/completions":
                     return self._complete(payload, chat=True)
+                if self.path == "/v1/embeddings":
+                    return self._embeddings(payload)
                 self._json(404, {"error": "not found"})
+
+            def _embeddings(self, payload):
+                if outer.embedder is None:
+                    return self._json(400, {
+                        "error": "this deployment does not serve "
+                                 "embeddings (--task embed)"})
+                texts = payload.get("input", [])
+                if isinstance(texts, str):
+                    texts = [texts]
+                tok = outer.tokenizer
+                try:
+                    # OpenAI-compat: elements may be strings or
+                    # pre-tokenized id arrays
+                    ids = [list(t) if isinstance(t, (list, tuple))
+                           else tok.encode(t) for t in texts]
+                    embs = outer.embedder.embed(ids)
+                except (TypeError, ValueError) as e:
+                    return self._json(400, {"error": str(e)})
+                self._json(200, {
+                    "object": "list", "model": outer.model_name,
+                    "data": [{"object": "embedding", "index": i,
+                              "embedding": emb.tolist()}
+                             for i, emb in enumerate(embs)],
+                    "usage": {"prompt_tokens": sum(map(len, ids)),
+                              "total_tokens": sum(map(len, ids))}})
 
             def _complete(self, payload, chat: bool):
                 tok = outer.tokenizer

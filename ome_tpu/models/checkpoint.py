@@ -193,6 +193,10 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
     st = _Stacker(L, np_dt)
 
     def take(name: str) -> np.ndarray:
+        if name not in ckpt and name.startswith("model."):
+            # bare AutoModel checkpoints (MistralModel/Qwen2Model
+            # embedding repos) drop the "model." prefix
+            name = name[len("model."):]
         return ckpt.read(name).astype(np.float32)
 
     def linear_in_out(name: str) -> np.ndarray:
@@ -201,7 +205,19 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
     for i in range(L):
         p = f"model.layers.{i}."
         st.put("attn_norm", i, take(p + "input_layernorm.weight"))
-        st.put("mlp_norm", i, take(p + "post_attention_layernorm.weight"))
+        if getattr(cfg, "post_block_norms", False):
+            # gemma2 block: post_attention_layernorm normalizes the
+            # attention OUTPUT (pre-residual); the MLP pre-norm is
+            # pre_feedforward_layernorm
+            st.put("attn_post_norm", i,
+                   take(p + "post_attention_layernorm.weight"))
+            st.put("mlp_norm", i,
+                   take(p + "pre_feedforward_layernorm.weight"))
+            st.put("mlp_post_norm", i,
+                   take(p + "post_feedforward_layernorm.weight"))
+        else:
+            st.put("mlp_norm", i,
+                   take(p + "post_attention_layernorm.weight"))
         st.put("wq", i,
                take(p + "self_attn.q_proj.weight").T.reshape(D, H, Dh))
         st.put("wk", i,
@@ -276,11 +292,14 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
 
 # architectures whose math models/llama.py implements faithfully; a
 # config.json outside this list loads only with allow_unsupported
-# (e.g. Gemma2 alternates sliding/global layers + GeGLU, DeepSeek V2+
-# uses MLA — loading them here would produce garbage silently)
+# (e.g. DeepSeek V2/V3 uses MLA attention, Mllama adds cross-attention
+# vision layers — loading them here would produce garbage silently)
 SUPPORTED_ARCHITECTURES = frozenset({
     "LlamaForCausalLM", "MistralForCausalLM", "Qwen2ForCausalLM",
-    "Qwen3ForCausalLM", "MixtralForCausalLM",
+    "Qwen3ForCausalLM", "MixtralForCausalLM", "Gemma2ForCausalLM",
+    # decoder embedding models (engine/embed.py): bare AutoModel
+    # checkpoints whose tensors lack the "model." prefix
+    "MistralModel", "Qwen2Model",
 })
 
 

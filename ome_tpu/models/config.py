@@ -45,6 +45,14 @@ class ModelConfig:
     attn_logit_softcap: Optional[float] = None
     qk_norm: bool = False
     attn_bias: bool = False  # qwen2-style q/k/v projection biases
+    # gemma2-family block shape (models/llama.py pair-scan path)
+    mlp_activation: str = "silu"      # "silu" | "gelu_tanh"
+    alt_sliding_window: bool = False  # even layers sliding, odd global
+    query_scale: Optional[float] = None  # overrides head_dim**-0.5
+    post_block_norms: bool = False    # post-attn/post-mlp RMSNorms
+    embed_scale: bool = False         # x *= sqrt(hidden) after embed
+    unit_offset_norm: bool = False    # RMSNorm scales by (1 + w)
+    final_logit_softcap: Optional[float] = None
 
     @property
     def is_moe(self) -> bool:
@@ -66,6 +74,10 @@ class ModelConfig:
         # qwen3 replaces them with per-head q/k RMS norms
         attn_bias = cfg.get("attention_bias",
                             cfg.get("qkv_bias", arch.startswith("Qwen2")))
+        gemma2 = arch == "Gemma2ForCausalLM"
+        qscale = None
+        if gemma2 and cfg.get("query_pre_attn_scalar"):
+            qscale = cfg["query_pre_attn_scalar"] ** -0.5
         return cls(
             vocab_size=cfg.get("vocab_size", 32000),
             hidden_size=hidden,
@@ -90,6 +102,13 @@ class ModelConfig:
             attn_logit_softcap=cfg.get("attn_logit_softcapping"),
             qk_norm=arch.startswith("Qwen3"),
             attn_bias=bool(attn_bias),
+            mlp_activation="gelu_tanh" if gemma2 else "silu",
+            alt_sliding_window=gemma2,
+            query_scale=qscale,
+            post_block_norms=gemma2,
+            embed_scale=gemma2,
+            unit_offset_norm=gemma2,
+            final_logit_softcap=cfg.get("final_logit_softcapping"),
         )
 
 

@@ -7,8 +7,10 @@ bf16 weights with fp32 softmax/norm accumulation, static shapes
 everywhere, and attention dispatched through ome_tpu.ops so the Pallas
 flash kernel is used on TPU with an XLA fallback on the CPU test mesh.
 
-Covers dense Llama/Qwen2-class models and (via cfg.num_experts) the
-Mixtral-style MoE variant with top-k routing.
+Covers dense Llama/Mistral/Qwen2 (qkv bias)/Qwen3 (qk-norm) models,
+the Mixtral-style top-k MoE variant (dense or ragged dispatch), and
+the gemma2 block shape (GeGLU, post-block (1+w) norms, alternating
+sliding-window/global attention via a layer-pair scan, softcaps).
 """
 
 from __future__ import annotations
@@ -65,21 +67,29 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
     def norm(shape, key, std=0.02):
         return (jax.random.normal(key, shape, jnp.float32) * std).astype(cfg.dtype)
 
+    def norm_scale(*shape):
+        # unit-offset (gemma) norms store scale-1: zeros == identity
+        fill = jnp.zeros if cfg.unit_offset_norm else jnp.ones
+        return fill(shape, cfg.dtype)
+
     layers: Params = {
-        "attn_norm": jnp.ones((L, D), cfg.dtype),
+        "attn_norm": norm_scale(L, D),
         "wq": norm((L, D, H, Dh), next(keys)),
         "wk": norm((L, D, K, Dh), next(keys)),
         "wv": norm((L, D, K, Dh), next(keys)),
         "wo": norm((L, H, Dh, D), next(keys), std=0.02 / (2 * L) ** 0.5),
-        "mlp_norm": jnp.ones((L, D), cfg.dtype),
+        "mlp_norm": norm_scale(L, D),
     }
     if cfg.qk_norm:
-        layers["q_norm"] = jnp.ones((L, Dh), cfg.dtype)
-        layers["k_norm"] = jnp.ones((L, Dh), cfg.dtype)
+        layers["q_norm"] = norm_scale(L, Dh)
+        layers["k_norm"] = norm_scale(L, Dh)
     if cfg.attn_bias:
         layers["bq"] = jnp.zeros((L, H, Dh), cfg.dtype)
         layers["bk"] = jnp.zeros((L, K, Dh), cfg.dtype)
         layers["bv"] = jnp.zeros((L, K, Dh), cfg.dtype)
+    if cfg.post_block_norms:
+        layers["attn_post_norm"] = norm_scale(L, D)
+        layers["mlp_post_norm"] = norm_scale(L, D)
     if cfg.is_moe:
         E, Fm = cfg.num_experts, cfg.moe_intermediate_size or F
         layers.update({
@@ -105,7 +115,7 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
     params: Params = {
         "embed": norm((cfg.vocab_size, D), next(keys)),
         "layers": layers,
-        "final_norm": jnp.ones((D,), cfg.dtype),
+        "final_norm": norm_scale(D),
     }
     if not cfg.tie_word_embeddings:
         params["lm_head"] = norm((D, cfg.vocab_size), next(keys))
@@ -119,10 +129,14 @@ def param_count(params: Params) -> int:
 # -- building blocks -------------------------------------------------------
 
 
-def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float,
+             unit_offset: bool = False) -> jax.Array:
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     y = x.astype(jnp.float32) * lax.rsqrt(var + eps)
-    return (y * scale.astype(jnp.float32)).astype(x.dtype)
+    w = scale.astype(jnp.float32)
+    if unit_offset:  # gemma convention: weight stored as (scale - 1)
+        w = 1.0 + w
+    return (y * w).astype(x.dtype)
 
 
 def _rope_frequencies(cfg: ModelConfig) -> jax.Array:
@@ -156,10 +170,18 @@ def apply_rope(x: jax.Array, positions: jax.Array, freqs: jax.Array) -> jax.Arra
     return out.astype(x.dtype)
 
 
-def dense_mlp(x: jax.Array, p: Params) -> jax.Array:
+def _activate(gate: jax.Array, cfg: Optional[ModelConfig]) -> jax.Array:
+    if cfg is not None and cfg.mlp_activation == "gelu_tanh":
+        return jax.nn.gelu(gate, approximate=True)
+    return jax.nn.silu(gate)
+
+
+def dense_mlp(x: jax.Array, p: Params,
+              cfg: Optional[ModelConfig] = None) -> jax.Array:
     gate = jnp.einsum("bsd,df->bsf", x, p["w_gate"])
     up = jnp.einsum("bsd,df->bsf", x, p["w_up"])
-    return jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, p["w_down"])
+    return jnp.einsum("bsf,fd->bsd", _activate(gate, cfg) * up,
+                      p["w_down"])
 
 
 def _route(x: jax.Array, p: Params, cfg: ModelConfig):
@@ -233,12 +255,21 @@ def moe_mlp(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
 # -- forward ---------------------------------------------------------------
 
 
+_WINDOW_FROM_CFG = object()  # sentinel: per-layer override unset
+
+
 def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
            positions: jax.Array, kv_len: Optional[jax.Array],
            cache_kv: Optional[Tuple[jax.Array, jax.Array]],
-           cache_index: Optional[jax.Array]):
-    """One transformer block. cache_kv: ([B,Smax,K,Dh], [B,Smax,K,Dh])."""
-    h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+           cache_index: Optional[jax.Array],
+           window=_WINDOW_FROM_CFG):
+    """One transformer block. cache_kv: ([B,Smax,K,Dh], [B,Smax,K,Dh]).
+    `window` overrides cfg.sliding_window (the gemma2 pair-scan passes
+    the per-layer value; None = global attention)."""
+    if window is _WINDOW_FROM_CFG:
+        window = cfg.sliding_window
+    uo = cfg.unit_offset_norm
+    h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, uo)
     q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
     k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
     v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
@@ -247,8 +278,8 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
         k = k + lp["bk"]
         v = v + lp["bv"]
     if cfg.qk_norm:
-        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
-        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps, uo)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps, uo)
     q = apply_rope(q, positions, freqs)
     k = apply_rope(k, positions, freqs)
 
@@ -274,12 +305,18 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
         new_cache = None
 
     attn = attention(q, k_full, v_full, positions=positions, kv_len=kv_len,
-                     sliding_window=cfg.sliding_window,
+                     sliding_window=window, scale=cfg.query_scale,
                      logit_softcap=cfg.attn_logit_softcap)
-    x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+    a = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+    if cfg.post_block_norms:
+        a = rms_norm(a, lp["attn_post_norm"], cfg.rms_norm_eps, uo)
+    x = x + a
 
-    h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-    mlp_out = moe_mlp(h, lp, cfg) if cfg.is_moe else dense_mlp(h, lp)
+    h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, uo)
+    mlp_out = moe_mlp(h, lp, cfg) if cfg.is_moe else dense_mlp(h, lp, cfg)
+    if cfg.post_block_norms:
+        mlp_out = rms_norm(mlp_out, lp["mlp_post_norm"],
+                           cfg.rms_norm_eps, uo)
     return x + mlp_out, new_cache
 
 
@@ -302,31 +339,84 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
             base = base + (idx[:, None] if idx.ndim == 1 else idx)
         positions = jnp.broadcast_to(base, (B, S))
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    if cfg.embed_scale:  # gemma: normalizer in the compute dtype
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)
     freqs = _rope_frequencies(cfg)
 
     kv_len = jnp.broadcast_to(cache.index + S, (B,)) \
         if cache is not None else None
+    index = cache.index if cache is not None else None
 
-    def body(x, per_layer):
-        lp, layer_cache = per_layer
-        x, new_cache = _layer(x, lp, cfg, freqs, positions, kv_len,
-                              layer_cache, cache.index if cache is not None else None)
-        return x, new_cache
-
-    if cache is not None:
-        x, (nk, nv) = lax.scan(body, x, (params["layers"], (cache.k, cache.v)))
-        new_cache = KVCache(k=nk, v=nv, index=cache.index + S)
+    if cfg.alt_sliding_window:
+        x, new_cache = _alt_window_scan(params, cfg, x, freqs, positions,
+                                        kv_len, cache)
     else:
-        x, _ = lax.scan(body, x, (params["layers"], None))
-        new_cache = None
+        def body(x, per_layer):
+            lp, layer_cache = per_layer
+            x, nc = _layer(x, lp, cfg, freqs, positions, kv_len,
+                           layer_cache, index)
+            return x, nc
 
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+        if cache is not None:
+            x, (nk, nv) = lax.scan(body, x,
+                                   (params["layers"], (cache.k, cache.v)))
+            new_cache = KVCache(k=nk, v=nv, index=cache.index + S)
+        else:
+            x, _ = lax.scan(body, x, (params["layers"], None))
+            new_cache = None
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
+                 cfg.unit_offset_norm)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
     logits = jnp.einsum("bsd,dv->bsv", x, head,
                         preferred_element_type=jnp.float32)
+    if cfg.final_logit_softcap:
+        logits = jnp.tanh(logits / cfg.final_logit_softcap) \
+            * cfg.final_logit_softcap
     return logits, new_cache
+
+
+def _alt_window_scan(params: Params, cfg: ModelConfig, x: jax.Array,
+                     freqs, positions, kv_len, cache: Optional[KVCache]):
+    """Scan over layer PAIRS: gemma2 alternates sliding-window (even
+    layers) and global (odd layers) attention. The pair body keeps both
+    window variants static — one compiled body, no dynamic masks."""
+    L = cfg.num_layers
+    assert L % 2 == 0, "alternating sliding window needs an even depth"
+
+    def pair(a):
+        return a.reshape(L // 2, 2, *a.shape[1:])
+
+    layers2 = jax.tree.map(pair, params["layers"])
+    index = cache.index if cache is not None else None
+
+    def body(x, per):
+        lp2, c2 = per
+        lp0 = jax.tree.map(lambda a: a[0], lp2)
+        lp1 = jax.tree.map(lambda a: a[1], lp2)
+        c0 = (c2[0][0], c2[1][0]) if c2 is not None else None
+        c1 = (c2[0][1], c2[1][1]) if c2 is not None else None
+        x, n0 = _layer(x, lp0, cfg, freqs, positions, kv_len, c0, index,
+                       window=cfg.sliding_window)
+        x, n1 = _layer(x, lp1, cfg, freqs, positions, kv_len, c1, index,
+                       window=None)
+        if n0 is None:
+            return x, None
+        return x, (jnp.stack([n0[0], n1[0]]), jnp.stack([n0[1], n1[1]]))
+
+    if cache is not None:
+        x, (nk, nv) = lax.scan(body, x,
+                               (layers2, (pair(cache.k), pair(cache.v))))
+        S = positions.shape[1]
+        new_cache = KVCache(k=nk.reshape(cache.k.shape),
+                            v=nv.reshape(cache.v.shape),
+                            index=cache.index + S)
+    else:
+        x, _ = lax.scan(body, x, (layers2, None))
+        new_cache = None
+    return x, new_cache
 
 
 def loss_fn(params: Params, cfg: ModelConfig, tokens: jax.Array,

@@ -41,6 +41,11 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
     B, S = tokens.shape
     M = num_microbatches
     assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+    if cfg.alt_sliding_window:
+        raise NotImplementedError(
+            "pipeline_forward does not support alternating-sliding-window "
+            "models (gemma2) yet — per-layer window flags don't fit the "
+            "uniform stage scan")
     mb = B // M
 
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)

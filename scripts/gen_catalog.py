@@ -142,6 +142,218 @@ MODELS = [
      "MistralModel", "7.11B", 32768, EMBED, None),
     ("baai", "bge-m3", "BAAI/bge-m3",
      "XLMRobertaModel", "568M", 8192, EMBED, None),
+    # -- meta (cont.) --
+    ("meta", "llama-2-7b-chat", "meta-llama/Llama-2-7b-chat-hf",
+     "LlamaForCausalLM", "6.74B", 4096, CHAT, None),
+    ("meta", "llama-2-13b-chat", "meta-llama/Llama-2-13b-chat-hf",
+     "LlamaForCausalLM", "13.0B", 4096, CHAT, None),
+    ("meta", "llama-2-70b-chat", "meta-llama/Llama-2-70b-chat-hf",
+     "LlamaForCausalLM", "69.0B", 4096, CHAT, None),
+    ("meta", "codellama-34b-instruct", "meta-llama/CodeLlama-34b-Instruct-hf",
+     "LlamaForCausalLM", "33.7B", 16384, TEXTGEN, None),
+    ("meta", "llama-3-2-11b-vision-instruct",
+     "meta-llama/Llama-3.2-11B-Vision-Instruct",
+     "MllamaForConditionalGeneration", "10.7B", 131072, VISION, None),
+    ("meta", "llama-3-2-90b-vision-instruct",
+     "meta-llama/Llama-3.2-90B-Vision-Instruct",
+     "MllamaForConditionalGeneration", "88.6B", 131072, VISION, None),
+    ("meta", "llama-4-maverick-17b-128e",
+     "meta-llama/Llama-4-Maverick-17B-128E-Instruct",
+     "Llama4ForConditionalGeneration", "402B", 1048576, VISION, None),
+    ("meta", "llama-3-1-405b-instruct",
+     "meta-llama/Llama-3.1-405B-Instruct",
+     "LlamaForCausalLM", "406B", 131072, CHAT, None),
+    # -- qwen (cont.) --
+    ("qwen", "qwen2-5-1-5b-instruct", "Qwen/Qwen2.5-1.5B-Instruct",
+     "Qwen2ForCausalLM", "1.54B", 32768, CHAT, None),
+    ("qwen", "qwen2-5-3b-instruct", "Qwen/Qwen2.5-3B-Instruct",
+     "Qwen2ForCausalLM", "3.09B", 32768, CHAT, None),
+    ("qwen", "qwen2-5-14b-instruct", "Qwen/Qwen2.5-14B-Instruct",
+     "Qwen2ForCausalLM", "14.8B", 131072, CHAT, None),
+    ("qwen", "qwen2-5-coder-7b-instruct",
+     "Qwen/Qwen2.5-Coder-7B-Instruct",
+     "Qwen2ForCausalLM", "7.62B", 131072, TEXTGEN, None),
+    ("qwen", "qwen2-5-coder-32b-instruct",
+     "Qwen/Qwen2.5-Coder-32B-Instruct",
+     "Qwen2ForCausalLM", "32.8B", 131072, TEXTGEN, None),
+    ("qwen", "qwq-32b", "Qwen/QwQ-32B",
+     "Qwen2ForCausalLM", "32.8B", 131072, CHAT, None),
+    ("qwen", "qwen3-0-6b", "Qwen/Qwen3-0.6B",
+     "Qwen3ForCausalLM", "596M", 40960, CHAT, None),
+    ("qwen", "qwen3-1-7b", "Qwen/Qwen3-1.7B",
+     "Qwen3ForCausalLM", "1.72B", 40960, CHAT, None),
+    ("qwen", "qwen3-4b", "Qwen/Qwen3-4B",
+     "Qwen3ForCausalLM", "4.02B", 40960, CHAT, None),
+    ("qwen", "qwen3-14b", "Qwen/Qwen3-14B",
+     "Qwen3ForCausalLM", "14.8B", 40960, CHAT, None),
+    ("qwen", "qwen3-30b-a3b", "Qwen/Qwen3-30B-A3B",
+     "Qwen3MoeForCausalLM", "30.5B", 40960, CHAT, None),
+    ("qwen", "qwen2-5-vl-7b-instruct", "Qwen/Qwen2.5-VL-7B-Instruct",
+     "Qwen2_5_VLForConditionalGeneration", "8.29B", 128000, VISION, None),
+    ("qwen", "qwen2-5-vl-72b-instruct", "Qwen/Qwen2.5-VL-72B-Instruct",
+     "Qwen2_5_VLForConditionalGeneration", "73.4B", 128000, VISION, None),
+    # -- mistral (cont.) --
+    ("mistralai", "mistral-nemo-instruct-2407",
+     "mistralai/Mistral-Nemo-Instruct-2407",
+     "MistralForCausalLM", "12.2B", 131072, CHAT, None),
+    ("mistralai", "ministral-8b-instruct-2410",
+     "mistralai/Ministral-8B-Instruct-2410",
+     "MistralForCausalLM", "8.02B", 131072, CHAT, None),
+    ("mistralai", "mistral-small-24b-instruct-2501",
+     "mistralai/Mistral-Small-24B-Instruct-2501",
+     "MistralForCausalLM", "23.6B", 32768, CHAT, None),
+    ("mistralai", "mistral-large-instruct-2411",
+     "mistralai/Mistral-Large-Instruct-2411",
+     "MistralForCausalLM", "123B", 131072, CHAT, None),
+    ("mistralai", "mathstral-7b-v0-1", "mistralai/Mathstral-7B-v0.1",
+     "MistralForCausalLM", "7.25B", 32768, TEXTGEN, None),
+    # -- deepseek (cont.) --
+    ("deepseek", "deepseek-v2-5", "deepseek-ai/DeepSeek-V2.5",
+     "DeepseekV2ForCausalLM", "236B", 163840, CHAT, None),
+    ("deepseek", "deepseek-coder-v2-instruct",
+     "deepseek-ai/DeepSeek-Coder-V2-Instruct",
+     "DeepseekV2ForCausalLM", "236B", 163840, TEXTGEN, None),
+    ("deepseek", "deepseek-llm-7b-chat", "deepseek-ai/deepseek-llm-7b-chat",
+     "LlamaForCausalLM", "6.91B", 4096, CHAT, None),
+    ("deepseek", "deepseek-r1-distill-qwen-1-5b",
+     "deepseek-ai/DeepSeek-R1-Distill-Qwen-1.5B",
+     "Qwen2ForCausalLM", "1.78B", 131072, CHAT, None),
+    ("deepseek", "deepseek-r1-distill-qwen-7b",
+     "deepseek-ai/DeepSeek-R1-Distill-Qwen-7B",
+     "Qwen2ForCausalLM", "7.62B", 131072, CHAT, None),
+    ("deepseek", "deepseek-r1-distill-qwen-14b",
+     "deepseek-ai/DeepSeek-R1-Distill-Qwen-14B",
+     "Qwen2ForCausalLM", "14.8B", 131072, CHAT, None),
+    ("deepseek", "deepseek-r1-distill-qwen-32b",
+     "deepseek-ai/DeepSeek-R1-Distill-Qwen-32B",
+     "Qwen2ForCausalLM", "32.8B", 131072, CHAT, None),
+    ("deepseek", "deepseek-r1-distill-llama-8b",
+     "deepseek-ai/DeepSeek-R1-Distill-Llama-8B",
+     "LlamaForCausalLM", "8.03B", 131072, CHAT, None),
+    ("deepseek", "deepseek-r1-distill-llama-70b",
+     "deepseek-ai/DeepSeek-R1-Distill-Llama-70B",
+     "LlamaForCausalLM", "70.6B", 131072, CHAT, None),
+    # -- google (cont.) --
+    ("google", "gemma-2-2b-it", "google/gemma-2-2b-it",
+     "Gemma2ForCausalLM", "2.61B", 8192, CHAT, None),
+    ("google", "gemma-3-1b-it", "google/gemma-3-1b-it",
+     "Gemma3ForCausalLM", "1.00B", 32768, CHAT, None),
+    ("google", "gemma-3-4b-it", "google/gemma-3-4b-it",
+     "Gemma3ForConditionalGeneration", "4.30B", 131072, VISION, None),
+    ("google", "gemma-3-12b-it", "google/gemma-3-12b-it",
+     "Gemma3ForConditionalGeneration", "12.2B", 131072, VISION, None),
+    ("google", "codegemma-7b-it", "google/codegemma-7b-it",
+     "GemmaForCausalLM", "8.54B", 8192, TEXTGEN, None),
+    # -- microsoft (cont.) --
+    ("microsoft", "phi-3-mini-4k-instruct",
+     "microsoft/Phi-3-mini-4k-instruct",
+     "Phi3ForCausalLM", "3.82B", 4096, CHAT, None),
+    ("microsoft", "phi-3-5-mini-instruct",
+     "microsoft/Phi-3.5-mini-instruct",
+     "Phi3ForCausalLM", "3.82B", 131072, CHAT, None),
+    ("microsoft", "phi-3-medium-128k-instruct",
+     "microsoft/Phi-3-medium-128k-instruct",
+     "Phi3ForCausalLM", "14.0B", 131072, CHAT, None),
+    ("microsoft", "phi-3-5-moe-instruct",
+     "microsoft/Phi-3.5-MoE-instruct",
+     "PhiMoEForCausalLM", "41.9B", 131072, CHAT, None),
+    # -- openai oss --
+    ("openai", "gpt-oss-20b", "openai/gpt-oss-20b",
+     "GptOssForCausalLM", "20.9B", 131072, CHAT, None),
+    # -- cohere (cont.) --
+    ("cohere", "command-r", "CohereForAI/c4ai-command-r-v01",
+     "CohereForCausalLM", "35.0B", 131072, CHAT, None),
+    ("cohere", "aya-expanse-8b", "CohereForAI/aya-expanse-8b",
+     "CohereForCausalLM", "8.03B", 8192, CHAT, None),
+    # -- 01-ai --
+    ("01-ai", "yi-1-5-6b-chat", "01-ai/Yi-1.5-6B-Chat",
+     "LlamaForCausalLM", "6.06B", 4096, CHAT, None),
+    ("01-ai", "yi-1-5-9b-chat", "01-ai/Yi-1.5-9B-Chat",
+     "LlamaForCausalLM", "8.83B", 4096, CHAT, None),
+    ("01-ai", "yi-1-5-34b-chat", "01-ai/Yi-1.5-34B-Chat",
+     "LlamaForCausalLM", "34.4B", 4096, CHAT, None),
+    # -- tii --
+    ("tii", "falcon-7b-instruct", "tiiuae/falcon-7b-instruct",
+     "FalconForCausalLM", "7.22B", 2048, CHAT, None),
+    ("tii", "falcon-40b-instruct", "tiiuae/falcon-40b-instruct",
+     "FalconForCausalLM", "41.8B", 2048, CHAT, None),
+    ("tii", "falcon3-10b-instruct", "tiiuae/Falcon3-10B-Instruct",
+     "LlamaForCausalLM", "10.3B", 32768, CHAT, None),
+    # -- ibm --
+    ("ibm", "granite-3-1-2b-instruct",
+     "ibm-granite/granite-3.1-2b-instruct",
+     "GraniteForCausalLM", "2.53B", 131072, CHAT, None),
+    ("ibm", "granite-3-1-8b-instruct",
+     "ibm-granite/granite-3.1-8b-instruct",
+     "GraniteForCausalLM", "8.17B", 131072, CHAT, None),
+    # -- allenai --
+    ("allenai", "olmo-2-7b-instruct", "allenai/OLMo-2-1124-7B-Instruct",
+     "Olmo2ForCausalLM", "7.30B", 4096, CHAT, None),
+    ("allenai", "olmo-2-13b-instruct", "allenai/OLMo-2-1124-13B-Instruct",
+     "Olmo2ForCausalLM", "13.7B", 4096, CHAT, None),
+    # -- huggingface --
+    ("huggingface", "smollm2-1-7b-instruct",
+     "HuggingFaceTB/SmolLM2-1.7B-Instruct",
+     "LlamaForCausalLM", "1.71B", 8192, CHAT, None),
+    ("huggingface", "tinyllama-1-1b-chat",
+     "TinyLlama/TinyLlama-1.1B-Chat-v1.0",
+     "LlamaForCausalLM", "1.10B", 2048, CHAT, None),
+    # -- zhipu --
+    ("zhipu", "glm-4-9b-chat", "THUDM/glm-4-9b-chat",
+     "ChatGLMModel", "9.40B", 131072, CHAT, None),
+    # -- databricks --
+    ("databricks", "dbrx-instruct", "databricks/dbrx-instruct",
+     "DbrxForCausalLM", "132B", 32768, CHAT, None),
+    # -- ai21 --
+    ("ai21", "jamba-1-5-mini", "ai21labs/AI21-Jamba-1.5-Mini",
+     "JambaForCausalLM", "51.6B", 262144, CHAT, None),
+    # -- nvidia --
+    ("nvidia", "llama-3-1-nemotron-70b-instruct",
+     "nvidia/Llama-3.1-Nemotron-70B-Instruct-HF",
+     "LlamaForCausalLM", "70.6B", 131072, CHAT, None),
+    # -- bigcode --
+    ("bigcode", "starcoder2-3b", "bigcode/starcoder2-3b",
+     "Starcoder2ForCausalLM", "3.03B", 16384, TEXTGEN, None),
+    ("bigcode", "starcoder2-15b", "bigcode/starcoder2-15b",
+     "Starcoder2ForCausalLM", "16.0B", 16384, TEXTGEN, None),
+    # -- lg --
+    ("lg", "exaone-3-5-7-8b-instruct",
+     "LGAI-EXAONE/EXAONE-3.5-7.8B-Instruct",
+     "ExaoneForCausalLM", "7.82B", 32768, CHAT, None),
+    # -- moonshot / others moe --
+    ("moonshotai", "moonlight-16b-a3b-instruct",
+     "moonshotai/Moonlight-16B-A3B-Instruct",
+     "DeepseekV3ForCausalLM", "16.0B", 8192, CHAT, None),
+    # -- quantized variants --
+    ("meta", "llama-3-1-8b-instruct-awq-int4",
+     "hugging-quants/Meta-Llama-3.1-8B-Instruct-AWQ-INT4",
+     "LlamaForCausalLM", "8.03B", 131072, CHAT, "int4"),
+    ("meta", "llama-3-1-70b-instruct-awq-int4",
+     "hugging-quants/Meta-Llama-3.1-70B-Instruct-AWQ-INT4",
+     "LlamaForCausalLM", "70.6B", 131072, CHAT, "int4"),
+    ("qwen", "qwen2-5-72b-instruct-gptq-int4",
+     "Qwen/Qwen2.5-72B-Instruct-GPTQ-Int4",
+     "Qwen2ForCausalLM", "72.7B", 131072, CHAT, "int4"),
+    ("neuralmagic", "llama-3-1-405b-instruct-fbgemm-fp8",
+     "neuralmagic/Meta-Llama-3.1-405B-Instruct-FP8",
+     "LlamaForCausalLM", "406B", 131072, CHAT, "fbgemm_fp8"),
+    # -- embeddings (cont.) --
+    ("baai", "bge-large-en-v1-5", "BAAI/bge-large-en-v1.5",
+     "BertModel", "335M", 512, EMBED, None),
+    ("alibaba", "gte-qwen2-7b-instruct",
+     "Alibaba-NLP/gte-Qwen2-7B-instruct",
+     "Qwen2Model", "7.61B", 131072, EMBED, None),
+    ("intfloat", "multilingual-e5-large",
+     "intfloat/multilingual-e5-large",
+     "XLMRobertaModel", "560M", 512, EMBED, None),
+    ("nomic", "nomic-embed-text-v1-5", "nomic-ai/nomic-embed-text-v1.5",
+     "NomicBertModel", "137M", 8192, EMBED, None),
+    ("sentence-transformers", "all-minilm-l6-v2",
+     "sentence-transformers/all-MiniLM-L6-v2",
+     "BertModel", "22.7M", 512, EMBED, None),
+    ("mixedbread", "mxbai-embed-large-v1",
+     "mixedbread-ai/mxbai-embed-large-v1",
+     "BertModel", "335M", 512, EMBED, None),
 ]
 
 
@@ -374,15 +586,16 @@ def runtime_docs():
             ],
         },
     }
-    # 6. embeddings
+    # 6. embeddings — decoder-architecture embedding models only (the
+    # in-repo engine pools decoder hidden states; encoder families
+    # [Bert/XLMRoberta] route to vllm-tpu-embeddings)
     yield "runtimes/ome/ome-engine-embeddings-rt.yaml", {
         "apiVersion": "ome.io/v1",
         "kind": "ClusterServingRuntime",
         "metadata": {"name": "ome-engine-embeddings"},
         "spec": {
             "supportedModelFormats": [fmt("MistralModel", prio=2),
-                                      fmt("XLMRobertaModel", prio=2),
-                                      fmt("BertModel", prio=2)],
+                                      fmt("Qwen2Model", prio=2)],
             "modelSizeRange": {"min": "10M", "max": "10B"},
             "protocolVersions": ["openAI"],
             "engineConfig": {"runner": {
@@ -399,6 +612,200 @@ def runtime_docs():
                 "minChips": 1},
         },
     }
+
+
+def _tpu_runner(image, args, chips):
+    return {"name": "ome-container", "image": image, "args": args,
+            "resources": {"requests": {"google.com/tpu": str(chips)},
+                          "limits": {"google.com/tpu": str(chips)}}}
+
+
+def _csr(name, formats, size_min, size_max, engine, accel, decoder=None,
+         router=None, accel_cfgs=None, annotations=None):
+    spec = {"supportedModelFormats": formats,
+            "modelSizeRange": {"min": size_min, "max": size_max},
+            "protocolVersions": ["openAI"],
+            "engineConfig": engine,
+            "acceleratorRequirements": accel}
+    if decoder:
+        spec["decoderConfig"] = decoder
+    if router:
+        spec["routerConfig"] = router
+    if accel_cfgs:
+        spec["acceleratorConfigs"] = accel_cfgs
+    doc = {"apiVersion": "ome.io/v1", "kind": "ClusterServingRuntime",
+           "metadata": {"name": name}, "spec": spec}
+    if annotations:
+        doc["metadata"]["annotations"] = annotations
+    return doc
+
+
+def extra_runtime_docs():
+    """Size-class / MoE / PD / multislice / quantized coverage.
+
+    Priorities are assigned so every (format, architecture,
+    quantization) key has a unique priority among auto-selectable
+    runtimes whose size ranges overlap — the admission webhook enforces
+    exactly that, and tests/test_catalog.py runs the whole catalog
+    through it.
+    """
+    vllm = "vllm/vllm-tpu:latest"
+    ome = "ghcr.io/ome-tpu/engine:latest"
+
+    # mid-size dense: 15-35B on 4 chips (ours) / 8 chips (vllm)
+    yield "runtimes/ome/ome-engine-mid-rt.yaml", _csr(
+        "ome-engine-mid",
+        [fmt(a, prio=2) for a in DENSE_ARCHS],
+        "16B", "35B",
+        {"runner": _tpu_runner(
+            ome, ["--model-dir", "$(MODEL_PATH)", "--tp", "4",
+                  "--max-slots", "32", "--port", "8080"], 4)},
+        {"acceleratorClasses": ["tpu-v5e", "tpu-v5p", "tpu-v6e"],
+         "minChips": 4, "topologies": ["2x2", "2x2x1"]},
+        accel_cfgs=[{"acceleratorClass": "tpu-v5p",
+                     "parallelism": {"tensorParallelSize": 4,
+                                     "iciMesh": "2,2,1"}}])
+    yield "runtimes/vllm/vllm-tpu-mid-rt.yaml", _csr(
+        "vllm-tpu-mid",
+        [fmt(a, prio=3) for a in DENSE_ARCHS],
+        "16B", "35B",
+        {"runner": _tpu_runner(
+            vllm, ["--model", "$(MODEL_PATH)",
+                   "--tensor-parallel-size", "8",
+                   "--max-model-len", "32768", "--port", "8080"], 4),
+         "workerSize": 1},
+        {"acceleratorClasses": ["tpu-v5e", "tpu-v6e"],
+         "minChips": 8, "topologies": ["2x4"]})
+
+    # MoE: in-repo ragged dispatch (single host) + vllm EP (multi-host)
+    yield "runtimes/ome/ome-engine-moe-rt.yaml", _csr(
+        "ome-engine-moe",
+        [fmt(a, prio=2) for a in
+         ("MixtralForCausalLM", "Qwen2MoeForCausalLM",
+          "Qwen3MoeForCausalLM")],
+        "10B", "150B",
+        {"runner": _tpu_runner(
+            ome, ["--model-dir", "$(MODEL_PATH)", "--tp", "8",
+                  "--max-slots", "32", "--port", "8080"], 8)},
+        {"acceleratorClasses": ["tpu-v5p", "tpu-v6e"], "minChips": 8,
+         "topologies": ["2x2x2", "2x4"]})
+    yield "runtimes/vllm/vllm-tpu-moe-mid-rt.yaml", _csr(
+        "vllm-tpu-moe-mid",
+        [fmt(a, prio=3) for a in
+         ("MixtralForCausalLM", "Qwen3MoeForCausalLM",
+          "PhiMoEForCausalLM", "DbrxForCausalLM")],
+        "30B", "250B",
+        {"runner": _tpu_runner(
+            vllm, ["--model", "$(MODEL_PATH)",
+                   "--tensor-parallel-size", "16",
+                   "--enable-expert-parallel", "--port", "8080"], 4),
+         "workerSize": 3},
+        {"acceleratorClasses": ["tpu-v5e", "tpu-v5p", "tpu-v6e"],
+         "minChips": 16, "topologies": ["4x4", "2x2x4"]},
+        accel_cfgs=[{"acceleratorClass": "tpu-v5p",
+                     "parallelism": {"tensorParallelSize": 16,
+                                     "expertParallelSize": 4,
+                                     "iciMesh": "2,2,4"}}])
+
+    # multi-host JetStream for 70B-class (alternative to vllm-70b,
+    # which stays the auto-select winner at prio 5; 4 dodges the
+    # overlap with ome-engine-mid/vllm-tpu-mid at 30-35B [2, 3] and
+    # the multislice runtime at 100-110B [6])
+    yield "runtimes/jetstream/jetstream-llama-70b-rt.yaml", _csr(
+        "jetstream-llama-70b",
+        [fmt("LlamaForCausalLM", prio=4)],
+        "30B", "110B",
+        {"runner": _tpu_runner(
+            "us-docker.pkg.dev/jetstream/maxengine:latest",
+            ["--model-path", "$(MODEL_PATH)",
+             "--ici-tensor-parallelism", "16", "--port", "8080"], 4),
+         "workerSize": 3},
+        {"acceleratorClasses": ["tpu-v5e", "tpu-v6e"], "minChips": 16,
+         "topologies": ["4x4"]})
+
+    # PD disaggregation: Mixtral-class and Kimi-class
+    pd_router = {"runner": {"name": "router",
+                            "image": "ghcr.io/ome-tpu/router:latest",
+                            "args": ["--policy", "cache_aware",
+                                     "--port", "8000"]},
+                 "config": {
+                     "engine-selector": "component.ome.io/name=engine",
+                     "decoder-selector": "component.ome.io/name=decoder"}}
+    yield "runtimes/vllm/vllm-tpu-pd-mixtral-rt.yaml", _csr(
+        "vllm-tpu-pd-mixtral",
+        [fmt("MixtralForCausalLM", prio=4)],
+        "100B", "200B",
+        {"runner": _tpu_runner(
+            vllm, ["--model", "$(MODEL_PATH)", "--disaggregation-mode",
+                   "prefill", "--tensor-parallel-size", "16",
+                   "--port", "8080"], 4), "workerSize": 3},
+        {"acceleratorClasses": ["tpu-v5p"], "minChips": 16,
+         "topologies": ["2x2x4"]},
+        decoder={"runner": _tpu_runner(
+            vllm, ["--model", "$(MODEL_PATH)", "--disaggregation-mode",
+                   "decode", "--tensor-parallel-size", "16",
+                   "--port", "8080"], 4), "workerSize": 3},
+        router=pd_router)
+    yield "runtimes/vllm/vllm-tpu-pd-kimi-rt.yaml", _csr(
+        "vllm-tpu-pd-kimi",
+        [fmt("DeepseekV3ForCausalLM", quant="fp8", prio=9),
+         fmt("DeepseekV3ForCausalLM", prio=7)],
+        "900B", "1500B",
+        {"runner": _tpu_runner(
+            vllm, ["--model", "$(MODEL_PATH)", "--disaggregation-mode",
+                   "prefill", "--tensor-parallel-size", "64",
+                   "--enable-expert-parallel", "--port", "8080"], 4),
+         "workerSize": 15},
+        {"acceleratorClasses": ["tpu-v5p"], "minChips": 64,
+         "topologies": ["4x4x4"]},
+        decoder={"runner": _tpu_runner(
+            vllm, ["--model", "$(MODEL_PATH)", "--disaggregation-mode",
+                   "decode", "--tensor-parallel-size", "64",
+                   "--enable-expert-parallel", "--port", "8080"], 4),
+         "workerSize": 15},
+        router=pd_router)
+
+    # multislice over DCN for 405B-class dense (MEGASCALE_* injected by
+    # the pod webhook's multislice profile)
+    yield "runtimes/vllm/vllm-tpu-multislice-405b-rt.yaml", _csr(
+        "vllm-tpu-multislice-405b",
+        [fmt("LlamaForCausalLM", prio=6),
+         fmt("LlamaForCausalLM", quant="fp8", prio=7),
+         fmt("LlamaForCausalLM", quant="fbgemm_fp8", prio=7)],
+        "100B", "500B",
+        {"runner": _tpu_runner(
+            vllm, ["--model", "$(MODEL_PATH)",
+                   "--tensor-parallel-size", "64", "--port", "8080"], 4),
+         "workerSize": 15,
+         "annotations": {"tpu.ome.io/profile": "multislice",
+                         "tpu.ome.io/num-slices": "2"}},
+        {"acceleratorClasses": ["tpu-v5p", "tpu-v6e"], "minChips": 64,
+         "topologies": ["4x4x4", "8x8"]})
+
+    # weight-quantized dense serving (int4/int8 checkpoints)
+    yield "runtimes/vllm/vllm-tpu-int4-rt.yaml", _csr(
+        "vllm-tpu-int4",
+        [fmt(a, quant="int4", prio=4) for a in
+         ("LlamaForCausalLM", "Qwen2ForCausalLM")],
+        "1B", "110B",
+        {"runner": _tpu_runner(
+            vllm, ["--model", "$(MODEL_PATH)", "--quantization", "awq",
+                   "--tensor-parallel-size", "4", "--port", "8080"], 4)},
+        {"acceleratorClasses": ["tpu-v5e", "tpu-v6e"], "minChips": 4,
+         "topologies": ["2x2"]})
+
+    # embeddings on vLLM (alternative; the in-repo embeddings engine
+    # stays the auto-select winner at prio 2)
+    yield "runtimes/vllm/vllm-tpu-embeddings-rt.yaml", _csr(
+        "vllm-tpu-embeddings",
+        [fmt(a, prio=1) for a in
+         ("MistralModel", "XLMRobertaModel", "BertModel", "Qwen2Model",
+          "NomicBertModel")],
+        "10M", "10B",
+        {"runner": _tpu_runner(
+            vllm, ["--model", "$(MODEL_PATH)", "--task", "embed",
+                   "--port", "8080"], 1)},
+        {"acceleratorClasses": ["tpu-v5e", "tpu-v6e"], "minChips": 1})
 
 
 def supported_models_md() -> str:
@@ -421,7 +828,8 @@ def supported_models_md() -> str:
 
 def main():
     count = 0
-    for rel, doc in (*accelerator_docs(), *model_docs(), *runtime_docs()):
+    for rel, doc in (*accelerator_docs(), *model_docs(), *runtime_docs(),
+                     *extra_runtime_docs()):
         path = os.path.join(ROOT, "config", rel)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "w") as f:

@@ -127,6 +127,23 @@ def test_mixtral_moe_logits_match_transformers(tmp_path):
     _compare_logits(model, d, atol=5e-4)
 
 
+def test_gemma2_logits_match_transformers(tmp_path):
+    # the full gemma2 block shape: GeGLU, (1+w) norms, post-block
+    # norms, alternating sliding window, query_pre_attn_scalar,
+    # softcaps, scaled embeddings, tied head
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rope_theta=10000.0,
+        sliding_window=4, query_pre_attn_scalar=16,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0)
+    model, d = _save_hf(tmp_path, hf_cfg)
+    params, cfg = ck.load_params(d, dtype=jnp.float32)
+    assert cfg.alt_sliding_window and cfg.unit_offset_norm
+    assert "attn_post_norm" in params["layers"]
+    _compare_logits(model, d, atol=5e-4)
+
+
 def test_llama3_rope_scaling_matches_transformers(tmp_path):
     hf_cfg = transformers.LlamaConfig(
         vocab_size=128, hidden_size=64, intermediate_size=128,

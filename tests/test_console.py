@@ -1,0 +1,140 @@
+"""Console REST API over real HTTP (reference: web-console backend
+routes at backend/cmd/api/main.go:56-145)."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from ome_tpu.apis import v1
+from ome_tpu.console import ConsoleServer
+from ome_tpu.core.client import InMemoryClient
+from ome_tpu.core.meta import ObjectMeta
+
+
+@pytest.fixture()
+def console():
+    client = InMemoryClient()
+    client.create(v1.ClusterBaseModel(
+        metadata=ObjectMeta(name="m1"),
+        spec=v1.BaseModelSpec(
+            model_format=v1.ModelFormat(name="safetensors"),
+            model_architecture="LlamaForCausalLM",
+            model_parameter_size="8B")))
+    client.create(v1.ClusterServingRuntime(
+        metadata=ObjectMeta(name="rt1"),
+        spec=v1.ServingRuntimeSpec(
+            supported_model_formats=[v1.SupportedModelFormat(
+                name="safetensors",
+                model_architecture="LlamaForCausalLM",
+                auto_select=True, priority=1)],
+            engine_config=v1.EngineConfig(
+                runner=v1.RunnerSpec(name="r", image="i")))))
+    client.create(v1.AcceleratorClass(
+        metadata=ObjectMeta(name="tpu-v5e"),
+        spec=v1.AcceleratorClassSpec(vendor="google", family="tpu")))
+    srv = ConsoleServer(client, host="127.0.0.1", port=0).start()
+    yield client, f"http://127.0.0.1:{srv.port}"
+    srv.stop()
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _post(base, path, obj, expect_error=False):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        if not expect_error:
+            raise
+        return e.code, json.loads(e.read())
+
+
+class TestConsoleAPI:
+    def test_ui_served(self, console):
+        _, base = console
+        with urllib.request.urlopen(base + "/", timeout=30) as r:
+            body = r.read().decode()
+        assert "OME-TPU Console" in body
+
+    def test_models_runtimes_accelerators(self, console):
+        _, base = console
+        assert [m["metadata"]["name"]
+                for m in _get(base, "/api/v1/models")["items"]] == ["m1"]
+        assert [r["metadata"]["name"]
+                for r in _get(base, "/api/v1/runtimes")["items"]] == ["rt1"]
+        accs = _get(base, "/api/v1/accelerators")["items"]
+        assert accs[0]["metadata"]["name"] == "tpu-v5e"
+
+    def test_validate_and_create_service(self, console):
+        client, base = console
+        isvc = {"metadata": {"name": "s1", "namespace": "default"},
+                "spec": {"model": {"name": "m1"}, "engine": {}}}
+        _, out = _post(base, "/api/v1/validate", isvc)
+        assert out["valid"], out
+        code, created = _post(base, "/api/v1/services", isvc)
+        assert code == 201
+        assert client.get(v1.InferenceService, "s1", "default")
+        items = _get(base, "/api/v1/services?namespace=default")["items"]
+        assert items[0]["metadata"]["name"] == "s1"
+        assert "default" in _get(base, "/api/v1/namespaces")["items"]
+
+    def test_create_invalid_rejected(self, console):
+        _, base = console
+        bad = {"metadata": {"name": "s2", "namespace": "default"},
+               "spec": {}}
+        code, out = _post(base, "/api/v1/services", bad,
+                          expect_error=True)
+        assert code == 422
+        assert any("model.name" in e for e in out["errors"])
+
+    def test_delete_service(self, console):
+        client, base = console
+        isvc = {"metadata": {"name": "s3", "namespace": "default"},
+                "spec": {"model": {"name": "m1"}, "engine": {}}}
+        _post(base, "/api/v1/services", isvc)
+        req = urllib.request.Request(
+            base + "/api/v1/services/default/s3", method="DELETE")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 200
+        assert client.try_get(v1.InferenceService, "s3",
+                              "default") is None
+
+    def test_hf_search_proxy(self, console):
+        client, _ = console
+        models = [{"modelId": "org/m", "downloads": 5, "likes": 1,
+                   "pipeline_tag": "text-generation"}]
+
+        class HubHandler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = json.dumps(models).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        hub = HTTPServer(("127.0.0.1", 0), HubHandler)
+        threading.Thread(target=hub.serve_forever, daemon=True).start()
+        srv = ConsoleServer(
+            client, host="127.0.0.1", port=0,
+            hf_endpoint=f"http://127.0.0.1:{hub.server_address[1]}"
+        ).start()
+        try:
+            out = _get(f"http://127.0.0.1:{srv.port}",
+                       "/api/v1/huggingface?q=llama")
+            assert out["items"][0]["id"] == "org/m"
+        finally:
+            srv.stop()
+            hub.shutdown()

@@ -345,6 +345,33 @@ class TestMultiNodeReconcile:
         worker = tmpl.worker_template.spec.containers[0]
         assert worker.get_env(constants.TPU_WORKER_HOSTNAMES_ENV) == hostnames
 
+    def test_istio_sidecar_stamped_when_injected(self, world):
+        from ome_tpu.core.k8s import IstioSidecar
+        client, mgr = world
+        isvc = make_isvc(leader=v1.LeaderSpec(),
+                         worker=v1.WorkerSpec(size=3))
+        isvc.metadata.labels["sidecar.istio.io/inject"] = "true"
+        client.create(isvc)
+        reconcile(client, mgr)
+        sc = client.try_get(IstioSidecar, "svc-engine", "default")
+        if sc is None:
+            # injection label must flow through component labels; if it
+            # doesn't, this documents the gap loudly
+            pytest.fail("Sidecar not stamped for istio-injected isvc")
+        sel = sc.spec["workloadSelector"]["labels"]
+        assert sel[constants.ISVC_LABEL] == "svc"
+        assert sc.spec["egress"][0]["hosts"] == ["./*"]
+
+    def test_no_istio_sidecar_by_default(self, world):
+        from ome_tpu.core.k8s import IstioSidecar
+        client, mgr = world
+        isvc = make_isvc(leader=v1.LeaderSpec(),
+                         worker=v1.WorkerSpec(size=3))
+        client.create(isvc)
+        reconcile(client, mgr)
+        assert client.try_get(IstioSidecar, "svc-engine",
+                              "default") is None
+
     def test_lws_ready_propagates(self, world):
         client, mgr = world
         isvc = make_isvc(leader=v1.LeaderSpec(), worker=v1.WorkerSpec())

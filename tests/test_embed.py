@@ -1,0 +1,87 @@
+"""Embedding engine: pooling correctness vs transformers, bucket
+padding invariance, and the /v1/embeddings HTTP surface."""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ome_tpu.engine.embed import EmbeddingEngine
+from ome_tpu.models import llama
+from ome_tpu.models.config import tiny_test
+
+
+def test_padding_invariance():
+    """The same prompt must embed identically at different buckets."""
+    cfg = tiny_test().replace(dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    eng = EmbeddingEngine(params, cfg, max_seq=64, buckets=[8, 32, 64])
+    ids = [1, 5, 9, 3]
+    a = eng.embed([ids])[0]                      # bucket 8
+    b = eng.embed([ids + [2] * 10])[0]           # bucket 32 (different)
+    c = eng.embed([ids])[0]
+    np.testing.assert_allclose(a, c, atol=1e-6)
+    assert np.linalg.norm(a) == pytest.approx(1.0, abs=1e-5)
+    assert not np.allclose(a, b)
+
+
+def test_embeddings_match_transformers(tmp_path):
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+    from ome_tpu.models import checkpoint as ck
+
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16,
+        max_position_embeddings=64, rope_theta=10000.0)
+    model = transformers.MistralModel(hf_cfg).eval()
+    d = str(tmp_path / "m")
+    model.save_pretrained(d, safe_serialization=True)
+    # bare AutoModel checkpoints carry "MistralModel" architecture and
+    # tensors without the "model." prefix
+    with open(f"{d}/config.json") as f:
+        cfg_json = json.load(f)
+    cfg_json["architectures"] = ["MistralModel"]
+    with open(f"{d}/config.json", "w") as f:
+        json.dump(cfg_json, f)
+
+    params, cfg = ck.load_params(d, dtype=jnp.float32)
+    eng = EmbeddingEngine(params, cfg.replace(dtype=jnp.float32),
+                          max_seq=32, buckets=[8, 32])
+    ids = [3, 17, 42, 7, 99]
+    got = eng.embed([ids])[0]
+
+    with torch.no_grad():
+        hidden = model(torch.tensor([ids])).last_hidden_state[0, -1]
+    want = hidden.numpy()
+    want = want / np.linalg.norm(want)
+    np.testing.assert_allclose(got, want, atol=2e-4)
+
+
+def test_v1_embeddings_endpoint():
+    from ome_tpu.engine import ByteTokenizer, EngineServer
+    from ome_tpu.engine.serve import _NullScheduler
+
+    cfg = tiny_test().replace(dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    eng = EmbeddingEngine(params, cfg, max_seq=64, buckets=[32, 64])
+    server = EngineServer(_NullScheduler(), tokenizer=ByteTokenizer(),
+                          model_name="emb", port=0, embedder=eng)
+    server.start()
+    try:
+        body = json.dumps({"model": "emb",
+                           "input": ["hello", "world"]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/embeddings", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())
+        assert len(out["data"]) == 2
+        assert len(out["data"][0]["embedding"]) == cfg.hidden_size
+        assert out["usage"]["prompt_tokens"] > 0
+    finally:
+        server.stop()

@@ -108,6 +108,25 @@ class TestEngineCorrectness:
         assert len(req.output_ids) == 4
         assert req.finish_reason == "length"
 
+    def test_prefill_decode_interleaving(self, world):
+        """With active streams, at most ONE prefill is admitted per
+        step (long-prompt bursts must not stall in-flight decodes); an
+        idle batch fills every free slot at once."""
+        cfg, params, engine = world
+        sched = Scheduler(engine)
+        # idle: a burst fills all free slots in one step
+        burst = [sched.submit(Request(prompt_ids=[1, i], max_new_tokens=8))
+                 for i in range(3)]
+        sched.step()
+        assert sum(r is not None for r in sched.slots) == 3
+        # active: new arrivals are admitted one per step
+        extra = sched.submit(Request(prompt_ids=[9, 9], max_new_tokens=8))
+        sched.step()
+        assert sum(r is not None for r in sched.slots) == 4
+        for r in burst + [extra]:
+            while not r.done.is_set():
+                sched.step()
+
     def test_scheduler_failure_fails_requests_and_health(self, world):
         cfg, params, engine = world
         sched = Scheduler(engine)
