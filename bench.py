@@ -108,8 +108,9 @@ def main() -> None:
         logits, cache = llama.forward(params, cfg, tokens, cache=cache)
         return jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32), cache
 
-    tok = jax.random.randint(jax.random.PRNGKey(1), (BATCH, PREFILL), 0,
-                             cfg.vocab_size, dtype=jnp.int32)
+    tok = tok_init = jax.random.randint(
+        jax.random.PRNGKey(1), (BATCH, PREFILL), 0, cfg.vocab_size,
+        dtype=jnp.int32)
     t0 = time.perf_counter()
     tok, cache = prefill(params, tok, cache)
     sync(tok)
@@ -139,6 +140,25 @@ def main() -> None:
     dt = time.perf_counter() - t0
     steps = DECODE_STEPS - 1
     toks_per_s = BATCH * steps / dt
+
+    # secondary: weight-only int8 serving (models/quant.py) — same
+    # model, weights at half the bytes; the serving engine's
+    # --quantization int8 path
+    from ome_tpu.models.quant import quantize_params
+    qparams = quantize_params(params)
+    qcache = llama.KVCache.create(cfg, BATCH, CACHE_LEN)
+    qtok, qcache = prefill(qparams, tok_init, qcache)
+    qtok, qcache = decode(qparams, qtok, qcache)
+    sync(qtok)
+    t0 = time.perf_counter()
+    for _ in range(DECODE_STEPS - 1):
+        qtok, qcache = decode(qparams, qtok, qcache)
+    sync(qtok)
+    qdt = time.perf_counter() - t0
+    int8_toks = BATCH * (DECODE_STEPS - 1) / qdt
+    log(f"bench: int8 weight-only decode -> {int8_toks:.1f} tok/s "
+        f"({100 * int8_toks / toks_per_s - 100:+.0f}% vs bf16)")
+    del qparams, qcache
 
     # Roofline: per decode step the chip must read all weights once
     # (amortized across the batch) + each sequence's KV cache.

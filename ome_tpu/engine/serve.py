@@ -43,6 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(tests / dry runs)")
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel size over the local mesh")
+    p.add_argument("--quantization", choices=("none", "int8"),
+                   default="none",
+                   help="weight-only quantization at load time (int8 "
+                        "halves decode HBM traffic)")
     return p
 
 
@@ -79,6 +83,10 @@ def load_engine(args):
         # single-device serving uses the ragged grouped-GEMM dispatch;
         # tp>1 keeps the dense path (shardable through plain GSPMD)
         cfg = cfg.replace(moe_impl="ragged")
+    if args.quantization == "int8":
+        from ..models.quant import quantize_params
+        params = quantize_params(params)
+        log.info("quantized weights to int8 (weight-only)")
     max_seq = args.max_seq or min(cfg.max_seq_len, 8192)
     if args.tp > 1:
         from .sharded import ShardedInferenceEngine

@@ -24,8 +24,20 @@ from jax import lax
 
 from ..ops.attention import attention
 from .config import ModelConfig
+from .quant import QTensor
 
 Params = Dict[str, Any]
+
+
+def _w(p: Params, name: str, dtype=None) -> jax.Array:
+    """Weight accessor: dequantizes int8 QTensor leaves at use (XLA
+    fuses the convert+scale into the consuming matmul's operand read,
+    so quantized serving streams int8 bytes from HBM). dtype is the
+    compute dtype (cfg.dtype); defaults to bfloat16."""
+    w = p[name]
+    if isinstance(w, QTensor):
+        return w.dequant(dtype or jnp.bfloat16)
+    return w
 
 
 @jax.tree_util.register_dataclass
@@ -178,10 +190,10 @@ def _activate(gate: jax.Array, cfg: Optional[ModelConfig]) -> jax.Array:
 
 def dense_mlp(x: jax.Array, p: Params,
               cfg: Optional[ModelConfig] = None) -> jax.Array:
-    gate = jnp.einsum("bsd,df->bsf", x, p["w_gate"])
-    up = jnp.einsum("bsd,df->bsf", x, p["w_up"])
+    gate = jnp.einsum("bsd,df->bsf", x, _w(p, "w_gate", cfg.dtype if cfg else None))
+    up = jnp.einsum("bsd,df->bsf", x, _w(p, "w_up", cfg.dtype if cfg else None))
     return jnp.einsum("bsf,fd->bsd", _activate(gate, cfg) * up,
-                      p["w_down"])
+                      _w(p, "w_down", cfg.dtype if cfg else None))
 
 
 def _route(x: jax.Array, p: Params, cfg: ModelConfig):
@@ -198,10 +210,10 @@ def moe_mlp_dense(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     (experts on the tp/ep axis) — the training/pipeline path.
     """
     weights, idx = _route(x, p, cfg)
-    gate = jnp.einsum("bsd,edf->bsef", x, p["we_gate"])
-    up = jnp.einsum("bsd,edf->bsef", x, p["we_up"])
+    gate = jnp.einsum("bsd,edf->bsef", x, _w(p, "we_gate", cfg.dtype))
+    up = jnp.einsum("bsd,edf->bsef", x, _w(p, "we_up", cfg.dtype))
     expert_out = jnp.einsum("bsef,efd->bsed", jax.nn.silu(gate) * up,
-                            p["we_down"])  # [B,S,E,D]
+                            _w(p, "we_down", cfg.dtype))  # [B,S,E,D]
     onehot = jax.nn.one_hot(idx, cfg.num_experts, dtype=weights.dtype)  # [B,S,k,E]
     mix = jnp.einsum("bske,bsk->bse", onehot, weights)  # [B,S,E]
     return jnp.einsum("bsed,bse->bsd", expert_out,
@@ -228,10 +240,10 @@ def moe_mlp_ragged(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     token_of = order // k                                # source token
     xs = jnp.take(xf, token_of, axis=0)                  # [T*k, D]
     group_sizes = jnp.bincount(expert_ids, length=E).astype(jnp.int32)
-    gate = lax.ragged_dot(xs, p["we_gate"], group_sizes)
-    up = lax.ragged_dot(xs, p["we_up"], group_sizes)
+    gate = lax.ragged_dot(xs, _w(p, "we_gate", cfg.dtype), group_sizes)
+    up = lax.ragged_dot(xs, _w(p, "we_up", cfg.dtype), group_sizes)
     h = jax.nn.silu(gate) * up  # same dtype flow as the dense path
-    out_sorted = lax.ragged_dot(h, p["we_down"], group_sizes)  # [T*k, D]
+    out_sorted = lax.ragged_dot(h, _w(p, "we_down", cfg.dtype), group_sizes)  # [T*k, D]
     w_sorted = jnp.take(weights.reshape(T * k), order, axis=0)
     contrib = out_sorted * w_sorted[:, None].astype(out_sorted.dtype)
     out = jnp.zeros((T, D), contrib.dtype).at[token_of].add(contrib)
@@ -247,7 +259,7 @@ def moe_mlp(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     if cfg.num_shared_experts > 0:
         # DeepSeek-MoE shared experts: always-active dense branch
         shared = {"w_gate": p["ws_gate"], "w_up": p["ws_up"],
-                  "w_down": p["ws_down"]}
+                  "w_down": p["ws_down"]}  # dense_mlp dequantizes via _w
         out = out + dense_mlp(x, shared)
     return out
 
@@ -270,9 +282,9 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
         window = cfg.sliding_window
     uo = cfg.unit_offset_norm
     h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, uo)
-    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
-    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
-    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    q = jnp.einsum("bsd,dhk->bshk", h, _w(lp, "wq", cfg.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, _w(lp, "wk", cfg.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, _w(lp, "wv", cfg.dtype))
     if cfg.attn_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -307,7 +319,7 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
     attn = attention(q, k_full, v_full, positions=positions, kv_len=kv_len,
                      sliding_window=window, scale=cfg.query_scale,
                      logit_softcap=cfg.attn_logit_softcap)
-    a = jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+    a = jnp.einsum("bshk,hkd->bsd", attn, _w(lp, "wo", cfg.dtype))
     if cfg.post_block_norms:
         a = rms_norm(a, lp["attn_post_norm"], cfg.rms_norm_eps, uo)
     x = x + a
@@ -338,7 +350,9 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
             idx = cache.index
             base = base + (idx[:, None] if idx.ndim == 1 else idx)
         positions = jnp.broadcast_to(base, (B, S))
-    x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    emb = params["embed"]
+    x = emb.take(tokens, cfg.dtype) if isinstance(emb, QTensor) \
+        else jnp.take(emb, tokens, axis=0).astype(cfg.dtype)
     if cfg.embed_scale:  # gemma: normalizer in the compute dtype
         x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)
     freqs = _rope_frequencies(cfg)
@@ -369,7 +383,11 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
                  cfg.unit_offset_norm)
     head = params.get("lm_head")
     if head is None:
-        head = params["embed"].T
+        head = params["embed"]
+        head = head.dequant(cfg.dtype).T if isinstance(head, QTensor) \
+            else head.T
+    elif isinstance(head, QTensor):
+        head = head.dequant(cfg.dtype)
     logits = jnp.einsum("bsd,dv->bsv", x, head,
                         preferred_element_type=jnp.float32)
     if cfg.final_logit_softcap:

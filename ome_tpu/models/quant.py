@@ -1,0 +1,106 @@
+"""Weight-only int8 quantization for serving.
+
+Decode is HBM-bandwidth-bound: every generated token streams all
+weights once (bench.py roofline). Symmetric per-output-channel int8
+halves the bytes per step vs bf16 — XLA fuses the int8->bf16 convert
+and scale multiply into the matmul operand read, so the MXU still
+computes in bf16 while HBM traffic drops ~2x. This is the runtime
+analog of the reference catalog's int4/fp8 model-format entries
+(model.go:262-268) for checkpoints that ship full-precision.
+
+QTensor is a registered pytree (scan/jit/shard-friendly): `q` int8
+plus a per-output-channel `s` scale, dequantized at use by
+models/llama.py's weight accessor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class QTensor:
+    """Symmetric int8 weight + broadcastable f32 scale."""
+
+    q: jax.Array          # int8, original shape
+    s: jax.Array          # f32, shape with contraction dims = 1
+
+    def dequant(self, dtype=jnp.bfloat16) -> jax.Array:
+        return (self.q.astype(jnp.float32) * self.s).astype(dtype)
+
+    def take(self, idx: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
+        """Row gather (embedding lookup) without full dequant."""
+        rows = jnp.take(self.q, idx, axis=0).astype(jnp.float32)
+        scales = jnp.take(self.s, idx, axis=0)
+        return (rows * scales).astype(dtype)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def size(self):
+        return self.q.size
+
+
+def quantize_tensor(w: jax.Array, contract_axes) -> QTensor:
+    """Per-output-channel symmetric int8: scales span `contract_axes`
+    (the dims the matmul sums over), so each output channel gets its
+    own dynamic range."""
+    w32 = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=tuple(contract_axes),
+                   keepdims=True)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, s=s)
+
+
+# contraction axes per stacked-layer leaf ([L, ...]; axis 0 = layer)
+_LAYER_CONTRACT = {
+    "wq": (1,), "wk": (1,), "wv": (1,),   # [L, D, H, Dh]: sum over D
+    "wo": (1, 2),                          # [L, H, Dh, D]: sum over H,Dh
+    "w_gate": (1,), "w_up": (1,),          # [L, D, F]
+    "w_down": (1,),                        # [L, F, D]
+    "we_gate": (2,), "we_up": (2,),        # [L, E, D, F]
+    "we_down": (2,),                       # [L, E, F, D]
+    "ws_gate": (1,), "ws_up": (1,), "ws_down": (1,),
+}
+_TOP_CONTRACT = {
+    "embed": (1,),     # per-ROW scales: rows are both lookup outputs
+    "lm_head": (0,),   # [D, V]: sum over D
+}
+
+
+def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """int8-quantize the big matmul weights; norms/biases/router stay
+    full precision (tiny, and routing is precision-sensitive)."""
+    out: Dict[str, Any] = {}
+    for name, leaf in params.items():
+        if name == "layers":
+            out["layers"] = {
+                k: (quantize_tensor(v, _LAYER_CONTRACT[k])
+                    if k in _LAYER_CONTRACT else v)
+                for k, v in leaf.items()
+            }
+        elif name in _TOP_CONTRACT:
+            out[name] = quantize_tensor(leaf, _TOP_CONTRACT[name])
+        else:
+            out[name] = leaf
+    return out
+
+
+def quantized_bytes(params: Dict[str, Any]) -> int:
+    """Weight bytes per full read (the decode-roofline numerator)."""
+    total = 0
+    for leaf in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QTensor)):
+        if isinstance(leaf, QTensor):
+            total += leaf.q.size + leaf.s.size * 4
+        else:
+            total += leaf.size * leaf.dtype.itemsize
+    return total

@@ -67,10 +67,28 @@ def param_specs(params: Dict[str, Any], pipeline: bool = False) -> Dict[str, Any
 
 
 def shard_params(params, mesh: Mesh, pipeline: bool = False):
+    from ..models.quant import QTensor
+
     specs = param_specs(params, pipeline)
-    return jax.device_put(
-        params, jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                             is_leaf=lambda x: isinstance(x, P)))
+
+    def put(leaf, spec):
+        if isinstance(leaf, QTensor):
+            # the int8 payload shards like the full-precision weight;
+            # the per-output-channel scale keeps size-1 (contraction)
+            # dims unsharded
+            s_spec = P(*[
+                None if dim == 1 else ax
+                for ax, dim in zip(tuple(spec) + (None,) * 8,
+                                   leaf.s.shape)])
+            return QTensor(
+                q=jax.device_put(leaf.q, NamedSharding(mesh, spec)),
+                s=jax.device_put(leaf.s, NamedSharding(mesh, s_spec)))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    flat_specs = jax.tree.map(lambda s: s, specs,
+                              is_leaf=lambda x: isinstance(x, P))
+    return jax.tree.map(put, params, flat_specs,
+                        is_leaf=lambda x: isinstance(x, QTensor))
 
 
 def logical(x, mesh: Optional[Mesh], *spec):
