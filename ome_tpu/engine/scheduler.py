@@ -166,7 +166,11 @@ class Scheduler:
                     self.state, kv, slot, true_len, tok, bucket)
             except Exception:
                 # req is out of the queue but not yet slotted — _fail_all
-                # cannot see it, so fail it here before propagating
+                # cannot see it, so fail it here before propagating.
+                # Health flips FIRST: a waiter woken by this failure must
+                # never observe a healthy scheduler (the _run handler
+                # also sets it, but only after this frame unwinds)
+                self.healthy = False
                 req.finish("error")
                 raise
             self.slots[slot] = req

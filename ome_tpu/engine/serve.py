@@ -47,18 +47,24 @@ def build_parser() -> argparse.ArgumentParser:
                    default="none",
                    help="weight-only quantization at load time (int8 "
                         "halves decode HBM traffic)")
+    p.add_argument("--adapter", default=None,
+                   help="PEFT LoRA adapter dir merged into the base "
+                        "weights at load (FineTunedWeight serving)")
     return p
 
 
-def load_engine(args):
+def _load_params_cfg(args, dtype):
+    """Shared load path: checkpoint (or random init) + LoRA merge.
+
+    Returns a NUMPY param tree for the checkpoint path — device
+    placement is the caller's job (single-device asarray, or
+    shard_params for tp>1 so the full tree never lands on one chip).
+    """
     import jax
-    import jax.numpy as jnp
 
     from ..models import checkpoint, llama
     from ..models.config import ModelConfig
-    from .core import InferenceEngine
 
-    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     if args.random_weights:
         import json
         import os
@@ -73,12 +79,24 @@ def load_engine(args):
         params = llama.init_params(jax.random.PRNGKey(0), cfg)
         log.info("initialized random weights: %.2fM params",
                  llama.param_count(params) / 1e6)
-    else:
-        params, cfg = checkpoint.load_params(args.model_dir, dtype=dtype)
-        cfg = cfg.replace(dtype=dtype)
-        import jax.numpy as jnp2  # params arrive as numpy: one transfer
-        params = jax.tree.map(jnp2.asarray, params)
-        log.info("loaded checkpoint from %s", args.model_dir)
+        return params, cfg
+    params, cfg = checkpoint.load_params(args.model_dir, dtype=dtype,
+                                         device_put=False)
+    if args.adapter:
+        from ..models.lora import merge_lora
+        merged = merge_lora(params, cfg, args.adapter)
+        log.info("merged %d LoRA deltas from %s", merged, args.adapter)
+    log.info("loaded checkpoint from %s", args.model_dir)
+    return params, cfg
+
+
+def load_engine(args):
+    import jax.numpy as jnp
+
+    from .core import InferenceEngine
+
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    params, cfg = _load_params_cfg(args, dtype)
     if cfg.is_moe and args.tp == 1:
         # single-device serving uses the ragged grouped-GEMM dispatch;
         # tp>1 keeps the dense path (shardable through plain GSPMD)
@@ -89,10 +107,14 @@ def load_engine(args):
         log.info("quantized weights to int8 (weight-only)")
     max_seq = args.max_seq or min(cfg.max_seq_len, 8192)
     if args.tp > 1:
+        # hand the host tree straight to shard_params: materializing it
+        # on one device first would OOM exactly the models tp serves
         from .sharded import ShardedInferenceEngine
         return ShardedInferenceEngine(params, cfg, tp=args.tp,
                                       max_slots=args.max_slots,
                                       max_seq=max_seq)
+    import jax
+    params = jax.tree.map(jnp.asarray, params)  # one transfer
     return InferenceEngine(params, cfg, max_slots=args.max_slots,
                            max_seq=max_seq)
 
@@ -117,25 +139,10 @@ def load_embedder(args):
     import jax
     import jax.numpy as jnp
 
-    from ..models import checkpoint, llama
     from .embed import EmbeddingEngine
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    if args.random_weights:
-        import json
-        import os
-        from ..models.config import ModelConfig, tiny_test
-        cfg_path = os.path.join(args.model_dir, "config.json")
-        if os.path.exists(cfg_path):
-            with open(cfg_path) as f:
-                cfg = ModelConfig.from_hf_config(json.load(f))
-        else:
-            cfg = tiny_test()
-        cfg = cfg.replace(dtype=dtype)
-        params = llama.init_params(jax.random.PRNGKey(0), cfg)
-    else:
-        params, cfg = checkpoint.load_params(args.model_dir, dtype=dtype)
-        cfg = cfg.replace(dtype=dtype)
-        params = jax.tree.map(jnp.asarray, params)
+    params, cfg = _load_params_cfg(args, dtype)
+    params = jax.tree.map(jnp.asarray, params)
     return EmbeddingEngine(params, cfg, max_seq=args.max_seq)
 
 
@@ -143,6 +150,10 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
     args = build_parser().parse_args(argv)
+    if args.adapter and args.random_weights:
+        log.error("--adapter requires a real checkpoint "
+                  "(incompatible with --random-weights)")
+        return 2
 
     from .scheduler import Scheduler
     from .server import EngineServer

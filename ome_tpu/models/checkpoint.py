@@ -328,6 +328,8 @@ def load_params(model_dir: str, cfg=None, dtype=None,
         cfg = ModelConfig.from_hf_config(hf)
     ckpt = Checkpoint(model_dir)
     params = convert_llama(ckpt, cfg, dtype=dtype)
+    if dtype is not None:
+        cfg = cfg.replace(dtype=dtype)  # compute dtype follows weights
     if device_put:
         import jax
         params = jax.tree.map(lambda a: jax.device_put(a), params)

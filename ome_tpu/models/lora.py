@@ -1,0 +1,109 @@
+"""LoRA adapter loading: merge PEFT adapters into base weights.
+
+The control plane already moves fine-tuned adapters (FineTunedWeight
+CRD, agent/serving_agent.py sidecar downloads); this is the engine
+side: read a PEFT-format adapter directory (adapter_config.json +
+adapter_model.safetensors with lora_A [r, in] / lora_B [out, r]
+pairs) and fold `W += (alpha/r) * B @ A` into the converted param
+tree before device upload. Merge-at-load serves ONE adapter at full
+base-model speed — the TPU-friendly choice for static shapes (the
+reference's runtimes likewise pass a merged or single-adapter path to
+their engines).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+from typing import Any, Dict
+
+import numpy as np
+
+from .checkpoint import Checkpoint
+
+log = logging.getLogger("ome.lora")
+
+# HF module name -> (our stacked leaf, reshaper from [out, in] delta)
+_TARGETS = {
+    "q_proj": ("wq", lambda d, cfg: d.T.reshape(
+        cfg.hidden_size, cfg.num_heads, cfg.head_dim)),
+    "k_proj": ("wk", lambda d, cfg: d.T.reshape(
+        cfg.hidden_size, cfg.num_kv_heads, cfg.head_dim)),
+    "v_proj": ("wv", lambda d, cfg: d.T.reshape(
+        cfg.hidden_size, cfg.num_kv_heads, cfg.head_dim)),
+    "o_proj": ("wo", lambda d, cfg: d.T.reshape(
+        cfg.num_heads, cfg.head_dim, cfg.hidden_size)),
+    "gate_proj": ("w_gate", lambda d, cfg: d.T),
+    "up_proj": ("w_up", lambda d, cfg: d.T),
+    "down_proj": ("w_down", lambda d, cfg: d.T),
+}
+
+_KEY_RE = re.compile(
+    r"(?:base_model\.model\.)?model\.layers\.(\d+)\.(?:self_attn|mlp)\."
+    r"(\w+_proj)\.lora_(A|B)\.weight")
+
+
+def merge_lora(params: Dict[str, Any], cfg, adapter_dir: str) -> int:
+    """Fold the adapter into `params` (numpy tree, pre-device-put).
+
+    Returns the number of (layer, module) pairs merged. Raises on rank
+    mismatches or targets the model doesn't have.
+    """
+    with open(os.path.join(adapter_dir, "adapter_config.json")) as f:
+        acfg = json.load(f)
+    cfg_rank = acfg.get("r", 8)
+    alpha = acfg.get("lora_alpha", cfg_rank)
+    rslora = bool(acfg.get("use_rslora", False))
+
+    ckpt = Checkpoint(adapter_dir)
+    pairs: Dict[tuple, Dict[str, np.ndarray]] = {}
+    unmatched = []
+    for key in ckpt.keys():
+        m = _KEY_RE.fullmatch(key)
+        if not m:
+            unmatched.append(key)
+            continue
+        layer, module, ab = int(m.group(1)), m.group(2), m.group(3)
+        pairs.setdefault((layer, module), {})[ab] = \
+            ckpt.read(key).astype(np.float32)
+    if unmatched:
+        # silently dropping deltas would serve a subtly wrong model
+        raise ValueError(
+            f"adapter carries weights this merge does not cover "
+            f"(supported targets: {sorted(_TARGETS)}): "
+            f"{unmatched[:5]}{'...' if len(unmatched) > 5 else ''}")
+
+    merged = 0
+    layers = params["layers"]
+    for (layer, module), mats in sorted(pairs.items()):
+        if "A" not in mats or "B" not in mats:
+            raise ValueError(f"adapter incomplete for layer {layer} "
+                             f"{module}: needs both lora_A and lora_B")
+        rank = mats["A"].shape[0]
+        if mats["B"].shape[1] != rank:
+            raise ValueError(
+                f"layer {layer} {module}: lora_A rank {rank} != "
+                f"lora_B rank {mats['B'].shape[1]}")
+        if rank != cfg_rank:
+            raise ValueError(
+                f"layer {layer} {module}: tensor rank {rank} != "
+                f"adapter_config r={cfg_rank}")
+        # PEFT scaling: alpha/r, or alpha/sqrt(r) with rsLoRA
+        scaling = alpha / (rank ** 0.5 if rslora else rank)
+        leaf_name, reshape = _TARGETS[module]
+        if leaf_name not in layers:
+            raise ValueError(f"model has no {leaf_name} for adapter "
+                             f"target {module}")
+        delta = scaling * (mats["B"] @ mats["A"])  # [out, in]
+        leaf = np.array(layers[leaf_name])  # writable copy
+        leaf[layer] = (np.asarray(leaf[layer], np.float32)
+                       + reshape(delta, cfg)).astype(leaf.dtype)
+        layers[leaf_name] = leaf
+        merged += 1
+    if merged == 0:
+        raise ValueError(f"no LoRA weights recognized in {adapter_dir}")
+    log.info("merged %d LoRA deltas (r=%d, alpha=%s) from %s",
+             merged, rank, alpha, adapter_dir)
+    return merged

@@ -1,0 +1,73 @@
+"""Training checkpoint/resume (orbax-backed).
+
+The reference is a serving operator with no training loop, so its
+"checkpointing" is resumable downloads (SURVEY.md §5.4); this repo
+ships a training step, so it ships real state checkpointing: params +
+optimizer state + step counter through orbax (sharding-aware — each
+host saves its addressable shards, restore re-shards onto the current
+mesh), with a latest-step symlink-style lookup and bounded retention.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+log = logging.getLogger("ome.train.ckpt")
+
+
+def _manager(directory: str, keep: int = 3):
+    import orbax.checkpoint as ocp
+    return ocp.CheckpointManager(
+        directory,
+        options=ocp.CheckpointManagerOptions(max_to_keep=keep,
+                                             create=True))
+
+
+def save_train_state(directory: str, step: int, params: Dict[str, Any],
+                     opt_state: Any, keep: int = 3) -> None:
+    """Save one training-step snapshot; prunes to `keep` newest."""
+    import orbax.checkpoint as ocp
+    mgr = _manager(os.path.abspath(directory), keep)
+    mgr.save(step, args=ocp.args.Composite(
+        params=ocp.args.StandardSave(params),
+        opt_state=ocp.args.StandardSave(opt_state)))
+    mgr.wait_until_finished()
+    mgr.close()
+    log.info("saved training state at step %d to %s", step, directory)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    import orbax.checkpoint as ocp
+    if not os.path.isdir(directory):
+        return None
+    mgr = _manager(os.path.abspath(directory))
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore_train_state(directory: str, params_like: Dict[str, Any],
+                        opt_state_like: Any,
+                        step: Optional[int] = None,
+                        ) -> Tuple[int, Dict[str, Any], Any]:
+    """Restore (step, params, opt_state).
+
+    `*_like` trees supply structure/sharding/dtype targets (build them
+    with init_state on the CURRENT mesh — restore re-shards the saved
+    arrays onto it, so resuming on a different mesh layout works).
+    """
+    import orbax.checkpoint as ocp
+    mgr = _manager(os.path.abspath(directory))
+    step = step if step is not None else mgr.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoints under {directory}")
+    restored = mgr.restore(step, args=ocp.args.Composite(
+        params=ocp.args.StandardRestore(params_like),
+        opt_state=ocp.args.StandardRestore(opt_state_like)))
+    mgr.close()
+    log.info("restored training state from step %d", step)
+    return step, restored["params"], restored["opt_state"]

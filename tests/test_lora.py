@@ -1,0 +1,113 @@
+"""LoRA merge-at-load: PEFT adapter deltas land on the right stacked
+leaves with the right scaling/layout, and the merged model actually
+changes its outputs."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ome_tpu.models import checkpoint as ck
+from ome_tpu.models import llama
+from ome_tpu.models.config import ModelConfig
+from ome_tpu.models.lora import merge_lora
+
+
+def _mk_base(tmp_path, D=32, H=4, K=2, Dh=8, F=64, L=2, V=128):
+    d = tmp_path / "base"
+    d.mkdir()
+    hf = {"architectures": ["LlamaForCausalLM"], "vocab_size": V,
+          "hidden_size": D, "num_hidden_layers": L,
+          "num_attention_heads": H, "num_key_value_heads": K,
+          "head_dim": Dh, "intermediate_size": F,
+          "max_position_embeddings": 64, "rope_theta": 10000.0,
+          "rms_norm_eps": 1e-5, "tie_word_embeddings": False}
+    (d / "config.json").write_text(json.dumps(hf))
+    rng = np.random.RandomState(0)
+    w = lambda *s: rng.randn(*s).astype(np.float32) * 0.02
+    T = {"model.embed_tokens.weight": w(V, D),
+         "model.norm.weight": np.ones(D, np.float32),
+         "lm_head.weight": w(V, D)}
+    for i in range(L):
+        p = f"model.layers.{i}."
+        T.update({
+            p + "input_layernorm.weight": np.ones(D, np.float32),
+            p + "post_attention_layernorm.weight": np.ones(D, np.float32),
+            p + "self_attn.q_proj.weight": w(H * Dh, D),
+            p + "self_attn.k_proj.weight": w(K * Dh, D),
+            p + "self_attn.v_proj.weight": w(K * Dh, D),
+            p + "self_attn.o_proj.weight": w(D, H * Dh),
+            p + "mlp.gate_proj.weight": w(F, D),
+            p + "mlp.up_proj.weight": w(F, D),
+            p + "mlp.down_proj.weight": w(D, F)})
+    ck.save_safetensors(str(d / "model.safetensors"), T)
+    return str(d)
+
+
+def _mk_adapter(tmp_path, D=32, H=4, Dh=8, r=4, alpha=8.0):
+    a = tmp_path / "adapter"
+    a.mkdir()
+    (a / "adapter_config.json").write_text(json.dumps(
+        {"r": r, "lora_alpha": alpha,
+         "target_modules": ["q_proj", "down_proj"]}))
+    rng = np.random.RandomState(7)
+    A_q = rng.randn(r, D).astype(np.float32) * 0.1
+    B_q = rng.randn(H * Dh, r).astype(np.float32) * 0.1
+    A_d = rng.randn(r, 64).astype(np.float32) * 0.1
+    B_d = rng.randn(D, r).astype(np.float32) * 0.1
+    pre = "base_model.model.model.layers.0."
+    ck.save_safetensors(str(a / "adapter_model.safetensors"), {
+        pre + "self_attn.q_proj.lora_A.weight": A_q,
+        pre + "self_attn.q_proj.lora_B.weight": B_q,
+        pre + "mlp.down_proj.lora_A.weight": A_d,
+        pre + "mlp.down_proj.lora_B.weight": B_d})
+    return str(a), (A_q, B_q, A_d, B_d, alpha / r)
+
+
+def test_merge_applies_exact_delta(tmp_path):
+    base = _mk_base(tmp_path)
+    adapter, (A_q, B_q, A_d, B_d, scale) = _mk_adapter(tmp_path)
+    params, cfg = ck.load_params(base, dtype=jnp.float32,
+                                 device_put=False)
+    wq_before = np.array(params["layers"]["wq"][0])
+    wdown_before = np.array(params["layers"]["w_down"][0])
+    wq1_before = np.array(params["layers"]["wq"][1])
+
+    assert merge_lora(params, cfg, adapter) == 2
+
+    want_q = wq_before + (scale * (B_q @ A_q)).T.reshape(32, 4, 8)
+    np.testing.assert_allclose(params["layers"]["wq"][0], want_q,
+                               atol=1e-5)
+    want_d = wdown_before + (scale * (B_d @ A_d)).T
+    np.testing.assert_allclose(params["layers"]["w_down"][0], want_d,
+                               atol=1e-5)
+    # untouched: other layers and modules
+    np.testing.assert_array_equal(params["layers"]["wq"][1], wq1_before)
+
+
+def test_merged_model_changes_output(tmp_path):
+    import jax
+    base = _mk_base(tmp_path)
+    adapter, _ = _mk_adapter(tmp_path)
+    tok = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    params, cfg = ck.load_params(base, dtype=jnp.float32,
+                                 device_put=False)
+    ref, _ = llama.forward(jax.tree.map(jnp.asarray, params), cfg, tok)
+    merge_lora(params, cfg, adapter)
+    got, _ = llama.forward(jax.tree.map(jnp.asarray, params), cfg, tok)
+    assert not np.allclose(np.asarray(got), np.asarray(ref))
+
+
+def test_incomplete_adapter_rejected(tmp_path):
+    base = _mk_base(tmp_path)
+    a = tmp_path / "bad"
+    a.mkdir()
+    (a / "adapter_config.json").write_text(json.dumps({"r": 4}))
+    ck.save_safetensors(str(a / "adapter_model.safetensors"), {
+        "base_model.model.model.layers.0.self_attn.q_proj.lora_A"
+        ".weight": np.zeros((4, 32), np.float32)})
+    params, cfg = ck.load_params(base, dtype=jnp.float32,
+                                 device_put=False)
+    with pytest.raises(ValueError, match="lora_B"):
+        merge_lora(params, cfg, str(a))

@@ -1,0 +1,67 @@
+"""Tokenizer layer: byte fallback semantics and the real HF path
+(constructed tokenizer.json + chat template) — the round-1 review
+flagged the HF branch as untested in-repo."""
+
+import json
+
+import pytest
+
+from ome_tpu.engine.tokenizer import ByteTokenizer, load_tokenizer
+
+
+class TestByteTokenizer:
+    def test_roundtrip_unicode(self):
+        tok = ByteTokenizer()
+        s = "héllo wörld \U0001f600"
+        assert tok.decode(tok.encode(s, add_bos=False)) == s
+
+    def test_bos(self):
+        tok = ByteTokenizer()
+        assert tok.encode("a")[0] == tok.bos_id
+
+
+@pytest.fixture()
+def hf_model_dir(tmp_path):
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    trainer = trainers.BpeTrainer(
+        vocab_size=200, special_tokens=["<unk>", "<s>", "</s>"])
+    corpus = ["hello world how are you today",
+              "the quick brown fox jumps over the lazy dog",
+              "serving large language models on tensor processors"] * 10
+    tok.train_from_iterator(corpus, trainer)
+    d = tmp_path / "model"
+    d.mkdir()
+    tok.save(str(d / "tokenizer.json"))
+    (d / "tokenizer_config.json").write_text(json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "bos_token": "<s>", "eos_token": "</s>", "unk_token": "<unk>",
+        "chat_template":
+            "{% for m in messages %}[{{ m.role }}]: {{ m.content }}\n"
+            "{% endfor %}[assistant]:",
+    }))
+    return str(d)
+
+
+class TestHFTokenizer:
+    def test_loads_and_roundtrips(self, hf_model_dir):
+        tok = load_tokenizer(hf_model_dir)
+        from ome_tpu.engine.tokenizer import HFTokenizer
+        assert isinstance(tok, HFTokenizer)
+        ids = tok.encode("hello world", add_bos=True)
+        assert ids[0] == tok.bos_id
+        assert "hello world" in tok.decode(ids)
+
+    def test_chat_template_applied(self, hf_model_dir):
+        tok = load_tokenizer(hf_model_dir)
+        out = tok.apply_chat_template(
+            [{"role": "user", "content": "hello"},
+             {"role": "assistant", "content": "hi"},
+             {"role": "user", "content": "how are you"}])
+        assert out == ("[user]: hello\n[assistant]: hi\n"
+                       "[user]: how are you\n[assistant]:")
+
+    def test_fallback_to_bytes_without_tokenizer_json(self, tmp_path):
+        assert isinstance(load_tokenizer(str(tmp_path)), ByteTokenizer)
