@@ -77,6 +77,7 @@ def merge_lora(params: Dict[str, Any], cfg, adapter_dir: str) -> int:
 
     merged = 0
     layers = params["layers"]
+    writable: set = set()  # stacked leaves copied once, not per layer
     for (layer, module), mats in sorted(pairs.items()):
         if "A" not in mats or "B" not in mats:
             raise ValueError(f"adapter incomplete for layer {layer} "
@@ -97,10 +98,12 @@ def merge_lora(params: Dict[str, Any], cfg, adapter_dir: str) -> int:
             raise ValueError(f"model has no {leaf_name} for adapter "
                              f"target {module}")
         delta = scaling * (mats["B"] @ mats["A"])  # [out, in]
-        leaf = np.array(layers[leaf_name])  # writable copy
+        if leaf_name not in writable:
+            layers[leaf_name] = np.array(layers[leaf_name])
+            writable.add(leaf_name)
+        leaf = layers[leaf_name]
         leaf[layer] = (np.asarray(leaf[layer], np.float32)
                        + reshape(delta, cfg)).astype(leaf.dtype)
-        layers[leaf_name] = leaf
         merged += 1
     if merged == 0:
         raise ValueError(f"no LoRA weights recognized in {adapter_dir}")

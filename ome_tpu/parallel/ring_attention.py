@@ -84,8 +84,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         l = jnp.zeros((B, K, G, sl, 1), jnp.float32)
         acc = jnp.zeros((B, K, G, sl, D), jnp.float32)
 
-        def step(carry, r):
-            m, l, acc, k, v, kv_idx = carry
+        def merge(m, l, acc, kv_idx, k, v):
             kv_pos = kv_idx * sl + lax.broadcasted_iota(
                 jnp.int32, (sl, 1), 0)[:, 0]
             bm, bl, bacc = _block_attend(q5, k, v, q_pos, kv_pos,
@@ -93,17 +92,27 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             m_new = jnp.maximum(m, bm)
             alpha = jnp.exp(m - m_new)
             beta = jnp.exp(bm - m_new)
-            l = alpha * l + beta * bl
-            acc = alpha * acc + beta * bacc
+            return (m_new, alpha * l + beta * bl,
+                    alpha * acc + beta * bacc)
+
+        def step(carry, _):
+            m, l, acc, k, v, kv_idx = carry
+            m, l, acc = merge(m, l, acc, kv_idx, k, v)
             # rotate K/V (and their block index) to the next device
             perm = [(i, (i + 1) % n) for i in range(n)]
             k = lax.ppermute(k, axis, perm)
             v = lax.ppermute(v, axis, perm)
             kv_idx = lax.ppermute(kv_idx, axis, perm)
-            return (m_new, l, acc, k, v, kv_idx), None
+            return (m, l, acc, k, v, kv_idx), None
 
-        (m, l, acc, _, _, _), _ = lax.scan(
-            step, (m, l, acc, k, v, idx), None, length=n)
+        # n-1 rotated steps; the last block merges WITHOUT rotating (a
+        # final ppermute would ship every K/V shard once for nothing)
+        if n > 1:
+            (m, l, acc, k, v, kv_idx), _ = lax.scan(
+                step, (m, l, acc, k, v, idx), None, length=n - 1)
+        else:
+            kv_idx = idx
+        m, l, acc = merge(m, l, acc, kv_idx, k, v)
         out = acc / jnp.maximum(l, 1e-30)
         # [B, K, G, sl, D] -> [B, sl, H, D]
         return out.transpose(0, 3, 1, 2, 4).reshape(B, sl, H, D) \

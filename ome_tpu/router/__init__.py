@@ -1,0 +1,11 @@
+"""ome-router: OpenAI-API load balancer / PD request router.
+
+The binary behind the catalog's RouterConfig (the reference deploys
+sglang-router for this role — deepseek-rdma-pd-rt.yaml:490-515 runs it
+with worker service-discovery selectors and `--policy`). Routes
+OpenAI-surface requests across engine replicas with cache-aware
+(prefix-affinity), round-robin, or random policies, health-checks its
+backends, and fails over on errors.
+"""
+
+from .server import RouterServer, main  # noqa: F401

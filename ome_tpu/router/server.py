@@ -1,0 +1,423 @@
+"""Router implementation.
+
+Design (vs the reference's sglang-router, which it deploys as the
+router component — SURVEY.md §2.9 "PD disaggregation"):
+
+  * backends come from static --backend flags or from watching
+    Endpoints-like service discovery through the shared client
+    (component selectors, the same contract RouterConfig carries in
+    the catalog: engine-selector / decoder-selector);
+  * policies: `cache_aware` (consistent prefix-hash affinity, so a
+    conversation keeps hitting the replica whose KV cache already
+    holds its prefix), `round_robin`, `random`;
+  * health: background probing of each backend's /health; unhealthy
+    backends leave the rotation, failed requests retry on the next
+    backend;
+  * streaming passthrough: SSE bodies relay chunk-by-chunk.
+
+PD note: with PD-disaggregated engines the KV handoff happens inside
+the serving engines (vLLM/SGLang disaggregation protocols); the
+router's PD job is steering — prefill-heavy requests to the engine
+(prefill) pool, continuation traffic to decoders — which reduces to
+pool selection + affinity here.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import itertools
+import json
+import logging
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+log = logging.getLogger("ome.router")
+
+
+class _ClientGone(Exception):
+    """The requesting client disconnected; abort without failover."""
+
+
+class _ResponseStarted(Exception):
+    """Backend failed after response bytes reached the client —
+    failover would corrupt the stream."""
+
+
+class Backend:
+    def __init__(self, url: str, pool: str = "engine"):
+        self.url = url.rstrip("/")
+        self.pool = pool
+        self.healthy = True
+        self.inflight = 0
+        self.last_checked = 0.0
+
+    def __repr__(self):
+        return f"Backend({self.url}, {self.pool}, " \
+               f"{'up' if self.healthy else 'down'})"
+
+
+class Router:
+    def __init__(self, backends: List[Backend],
+                 policy: str = "cache_aware",
+                 health_interval: float = 10.0):
+        self.backends = backends
+        self.policy = policy
+        self.health_interval = health_interval
+        self._rr = itertools.count()
+        self._rng = random.Random(0)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+        self.stats: Dict[str, float] = {
+            "requests_total": 0, "retries_total": 0,
+            "no_backend_total": 0}
+
+    def inc(self, key: str, by: float = 1):
+        with self._lock:  # handler threads are concurrent
+            self.stats[key] = self.stats.get(key, 0) + by
+
+    # -- selection -----------------------------------------------------
+
+    def _alive(self, pool: str) -> List[Backend]:
+        return [b for b in self.backends
+                if b.pool == pool and b.healthy]
+
+    def pick(self, pool: str, affinity_key: str = "",
+             exclude: Optional[set] = None) -> Optional[Backend]:
+        with self._lock:
+            alive = [b for b in self._alive(pool)
+                     if not exclude or b.url not in exclude]
+            if not alive:
+                return None
+            if self.policy == "random":
+                return self._rng.choice(alive)
+            if self.policy == "cache_aware" and affinity_key:
+                # rendezvous (highest-random-weight) hashing: stable
+                # under backend set changes, no ring state
+                def weight(b: Backend) -> int:
+                    return int.from_bytes(hashlib.blake2b(
+                        f"{affinity_key}|{b.url}".encode(),
+                        digest_size=8).digest(), "big")
+                return max(alive, key=weight)
+            return alive[next(self._rr) % len(alive)]
+
+    # -- health --------------------------------------------------------
+
+    def check_health_once(self):
+        for b in list(self.backends):
+            try:
+                with urllib.request.urlopen(b.url + "/health",
+                                            timeout=5) as resp:
+                    b.healthy = resp.status == 200
+            except Exception:
+                b.healthy = False
+            b.last_checked = time.time()
+
+    def start_health_loop(self):
+        def loop():
+            while not self._stop.wait(self.health_interval):
+                self.check_health_once()
+        self._health_thread = threading.Thread(
+            target=loop, name="router-health", daemon=True)
+        self._health_thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+
+def affinity_from_payload(payload: dict) -> str:
+    """Prefix-affinity key: the leading content of the request, so a
+    continuing conversation maps to the replica already holding its
+    KV prefix."""
+    if "prompt" in payload:
+        p = payload["prompt"]
+        p = p if isinstance(p, str) else "".join(map(str, p))
+        return p[:256]
+    msgs = payload.get("messages")
+    if msgs:
+        return json.dumps(msgs[:2])[:256]
+    return ""
+
+
+class RouterServer:
+    def __init__(self, router: Router, host: str = "0.0.0.0",
+                 port: int = 0, retries: int = 2):
+        self.router = router
+        self.retries = retries
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code: int, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path in ("/health", "/healthz"):
+                    up = any(b.healthy for b in outer.router.backends)
+                    return self._json(200 if up else 503, {
+                        "status": "ok" if up else "no healthy backends",
+                        "backends": [
+                            {"url": b.url, "pool": b.pool,
+                             "healthy": b.healthy}
+                            for b in outer.router.backends]})
+                if self.path == "/metrics":
+                    lines = []
+                    for k, v in outer.router.stats.items():
+                        lines.append(f"# TYPE ome_router_{k} counter")
+                        lines.append(f"ome_router_{k} {v}")
+                    up = sum(b.healthy for b in outer.router.backends)
+                    lines.append("# TYPE ome_router_backends_up gauge")
+                    lines.append(f"ome_router_backends_up {up}")
+                    body = ("\n".join(lines) + "\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    return self.wfile.write(body)
+                # pass through model listings etc. to any backend
+                return self._proxy(b"", stream=False)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n)
+                try:
+                    payload = json.loads(body or b"{}")
+                except ValueError:
+                    payload = {}
+                stream = bool(payload.get("stream"))
+                self._proxy(body, stream=stream,
+                            affinity=affinity_from_payload(payload))
+
+            def _pick_pool(self) -> str:
+                # explicit steer via header; else engine pool, falling
+                # back to decoders when no engine is configured/healthy
+                want = (self.headers.get("X-OME-Pool") or "engine")
+                if outer.router._alive(want):
+                    return want
+                other = "decoder" if want == "engine" else "engine"
+                return other if outer.router._alive(other) else want
+
+            def _proxy(self, body: bytes, stream: bool,
+                       affinity: str = ""):
+                outer.router.inc("requests_total")
+                pool = self._pick_pool()
+                tried: set = set()
+                last_err = "no healthy backends"
+                for attempt in range(outer.retries + 1):
+                    backend = outer.router.pick(pool, affinity,
+                                                exclude=tried)
+                    if backend is None:
+                        break
+                    tried.add(backend.url)
+                    try:
+                        return self._forward(backend, body, stream)
+                    except _ClientGone:
+                        # the CLIENT went away: nothing to retry, and
+                        # the backend did nothing wrong
+                        return None
+                    except _ResponseStarted as e:
+                        # bytes already reached the client: a retry
+                        # would interleave two responses on one socket
+                        backend.healthy = False
+                        log.warning("backend %s died mid-response: %s",
+                                    backend.url, e)
+                        try:
+                            self.wfile.write(b"0\r\n\r\n")
+                        except OSError:
+                            pass
+                        self.close_connection = True
+                        return None
+                    except (urllib.error.URLError, OSError,
+                            ConnectionError) as e:
+                        last_err = str(e)
+                        backend.healthy = False
+                        outer.router.inc("retries_total")
+                        log.warning("backend %s failed (%s); retrying",
+                                    backend.url, e)
+                outer.router.inc("no_backend_total")
+                self._json(503, {"error": f"routing failed: {last_err}"})
+
+            def _client_write(self, data: bytes):
+                try:
+                    self.wfile.write(data)
+                except (OSError, ConnectionError) as e:
+                    raise _ClientGone(str(e)) from e
+
+            def _forward(self, backend: Backend, body: bytes,
+                         stream: bool):
+                req = urllib.request.Request(
+                    backend.url + self.path, data=body or None,
+                    method=self.command,
+                    headers={"Content-Type": "application/json"})
+                backend.inflight += 1
+                try:
+                    resp = urllib.request.urlopen(req, timeout=600)
+                except urllib.error.HTTPError as e:
+                    # HTTP errors are APPLICATION responses (4xx):
+                    # relay, don't failover
+                    data = e.read()
+                    self.send_response(e.code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self._client_write(data)
+                    return None
+                finally:
+                    backend.inflight -= 1
+                with resp:
+                    if stream:
+                        self.send_response(resp.status)
+                        self.send_header("Content-Type",
+                                         resp.headers.get("Content-Type",
+                                                          "text/event-stream"))
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        started = True
+                        while True:
+                            try:
+                                raw = resp.readline()
+                            except (urllib.error.URLError, OSError,
+                                    ConnectionError) as e:
+                                raise _ResponseStarted(str(e)) from e
+                            if not raw:
+                                break
+                            self._client_write(
+                                f"{len(raw):x}\r\n".encode() + raw
+                                + b"\r\n")
+                            try:
+                                self.wfile.flush()
+                            except (OSError, ConnectionError) as e:
+                                raise _ClientGone(str(e)) from e
+                        self._client_write(b"0\r\n\r\n")
+                        return None
+                    try:
+                        data = resp.read()
+                    except (urllib.error.URLError, OSError,
+                            ConnectionError) as e:
+                        # nothing sent to the client yet: retryable
+                        raise urllib.error.URLError(str(e)) from e
+                    self.send_response(resp.status)
+                    self.send_header("Content-Type",
+                                     resp.headers.get("Content-Type",
+                                                      "application/json"))
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self._client_write(data)
+                    return None
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "RouterServer":
+        self.router.start_health_loop()
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="ome-router", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.router.stop()
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def discover_backends(client, namespace: str, selector: Dict[str, str],
+                      pool: str, port: int = 8080) -> List[Backend]:
+    """Service discovery through the shared client: Services matching
+    the selector labels become backends at their cluster DNS names
+    (the RouterConfig engine-selector/decoder-selector contract)."""
+    from ..core.k8s import Service
+    out = []
+    for svc in client.list(Service, namespace=namespace,
+                           label_selector=selector):
+        svc_port = port
+        if svc.spec.ports:
+            svc_port = svc.spec.ports[0].port
+        out.append(Backend(
+            f"http://{svc.metadata.name}.{svc.metadata.namespace}"
+            f".svc.cluster.local:{svc_port}", pool))
+    return out
+
+
+def _parse_selector(s: str) -> Dict[str, str]:
+    return dict(kv.split("=", 1) for kv in s.split(",") if "=" in kv)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ome-router")
+    p.add_argument("--backend", action="append", default=[],
+                   help="engine URL (repeatable); pool prefix with "
+                        "'decoder=' routes to the decode pool")
+    p.add_argument("--policy", default="cache_aware",
+                   choices=("cache_aware", "round_robin", "random"))
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--bind", default="0.0.0.0")
+    p.add_argument("--health-interval", type=float, default=10.0)
+    p.add_argument("--engine-selector", default=None,
+                   help="k8s label selector for engine Services "
+                        "(k=v[,k=v]); requires --in-cluster/--kube-*")
+    p.add_argument("--decoder-selector", default=None)
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--kubeconfig", default=None)
+    p.add_argument("--kube-server", default=None)
+    p.add_argument("--in-cluster", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    backends = []
+    for spec in args.backend:
+        # only known pool prefixes split — URLs may contain '='
+        if spec.startswith("decoder="):
+            backends.append(Backend(spec[len("decoder="):], "decoder"))
+        elif spec.startswith("engine="):
+            backends.append(Backend(spec[len("engine="):], "engine"))
+        else:
+            backends.append(Backend(spec, "engine"))
+    if args.engine_selector or args.decoder_selector:
+        from ..cmd.manager import build_client
+        client = build_client(args)
+        if args.engine_selector:
+            backends += discover_backends(
+                client, args.namespace,
+                _parse_selector(args.engine_selector), "engine")
+        if args.decoder_selector:
+            backends += discover_backends(
+                client, args.namespace,
+                _parse_selector(args.decoder_selector), "decoder")
+        log.info("discovered %d backends via selectors", len(backends))
+    if not backends:
+        p.error("at least one --backend or --engine-selector is required")
+    router = Router(backends, policy=args.policy,
+                    health_interval=args.health_interval)
+    router.check_health_once()
+    srv = RouterServer(router, host=args.bind, port=args.port).start()
+    log.info("router on :%d over %d backends (policy=%s)", srv.port,
+             len(backends), args.policy)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -19,19 +19,19 @@ import jax
 log = logging.getLogger("ome.train.ckpt")
 
 
-def _manager(directory: str, keep: int = 3):
+def _manager(directory: str, keep: int = 3, create: bool = False):
     import orbax.checkpoint as ocp
     return ocp.CheckpointManager(
         directory,
         options=ocp.CheckpointManagerOptions(max_to_keep=keep,
-                                             create=True))
+                                             create=create))
 
 
 def save_train_state(directory: str, step: int, params: Dict[str, Any],
                      opt_state: Any, keep: int = 3) -> None:
     """Save one training-step snapshot; prunes to `keep` newest."""
     import orbax.checkpoint as ocp
-    mgr = _manager(os.path.abspath(directory), keep)
+    mgr = _manager(os.path.abspath(directory), keep, create=True)
     mgr.save(step, args=ocp.args.Composite(
         params=ocp.args.StandardSave(params),
         opt_state=ocp.args.StandardSave(opt_state)))
@@ -61,6 +61,9 @@ def restore_train_state(directory: str, params_like: Dict[str, Any],
     arrays onto it, so resuming on a different mesh layout works).
     """
     import orbax.checkpoint as ocp
+    if not os.path.isdir(directory):
+        # read path: never create the directory as a side effect
+        raise FileNotFoundError(f"no checkpoint directory {directory}")
     mgr = _manager(os.path.abspath(directory))
     step = step if step is not None else mgr.latest_step()
     if step is None:

@@ -1,0 +1,173 @@
+"""ome-router: policies, health/failover, streaming passthrough —
+including routing over two real in-repo engine servers."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from ome_tpu.engine import ByteTokenizer, EngineServer, InferenceEngine, \
+    Scheduler
+from ome_tpu.models import config as cfgs
+from ome_tpu.models import llama
+from ome_tpu.router.server import (Backend, Router, RouterServer,
+                                   affinity_from_payload)
+
+
+class TestPolicies:
+    def _router(self, policy):
+        return Router([Backend("http://a"), Backend("http://b"),
+                       Backend("http://c")], policy=policy)
+
+    def test_cache_aware_is_sticky(self):
+        r = self._router("cache_aware")
+        picks = {r.pick("engine", "conversation-42").url
+                 for _ in range(10)}
+        assert len(picks) == 1  # same prefix -> same backend
+
+    def test_cache_aware_spreads_keys(self):
+        r = self._router("cache_aware")
+        picks = {r.pick("engine", f"prompt-{i}").url for i in range(40)}
+        assert len(picks) == 3  # different prefixes use the fleet
+
+    def test_round_robin_cycles(self):
+        r = self._router("round_robin")
+        seq = [r.pick("engine").url for _ in range(6)]
+        assert seq[:3] != seq[0:1] * 3
+
+    def test_unhealthy_excluded(self):
+        r = self._router("round_robin")
+        r.backends[0].healthy = False
+        assert all(r.pick("engine").url != "http://a"
+                   for _ in range(6))
+
+    def test_pool_separation(self):
+        r = Router([Backend("http://e", "engine"),
+                    Backend("http://d", "decoder")])
+        assert r.pick("decoder").url == "http://d"
+        assert r.pick("engine").url == "http://e"
+
+    def test_affinity_key(self):
+        assert affinity_from_payload({"prompt": "abc"}) == "abc"
+        key = affinity_from_payload(
+            {"messages": [{"role": "user", "content": "hi"}]})
+        assert "hi" in key
+
+
+@pytest.fixture(scope="module")
+def two_engines():
+    cfg = cfgs.tiny_test().replace(max_seq_len=64)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    servers = []
+    for i in range(2):
+        engine = InferenceEngine(params, cfg, max_slots=2,
+                                 prefill_buckets=[16, 32])
+        sched = Scheduler(engine)
+        srv = EngineServer(sched, tokenizer=ByteTokenizer(),
+                           model_name=f"m{i}", port=0)
+        srv.start()
+        servers.append((srv, sched))
+    yield [f"http://127.0.0.1:{s.port}" for s, _ in servers]
+    for srv, sched in servers:
+        srv.stop()
+        sched.stop()
+
+
+class TestEndToEnd:
+    def test_routes_and_fails_over(self, two_engines):
+        router = Router([Backend(u) for u in two_engines],
+                        policy="round_robin")
+        rs = RouterServer(router, host="127.0.0.1", port=0).start()
+        try:
+            base = f"http://127.0.0.1:{rs.port}"
+            # health aggregates backends
+            with urllib.request.urlopen(base + "/health",
+                                        timeout=30) as r:
+                h = json.loads(r.read())
+            assert h["status"] == "ok" and len(h["backends"]) == 2
+
+            def ask():
+                body = json.dumps({"model": "m", "prompt": "hi",
+                                   "max_tokens": 3}).encode()
+                req = urllib.request.Request(
+                    base + "/v1/completions", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    return json.loads(r.read())
+
+            out = ask()
+            assert out["usage"]["completion_tokens"] == 3
+
+            # kill one backend: requests still succeed via failover
+            router.backends[0].url = "http://127.0.0.1:9"  # dead port
+            out = ask()
+            assert out["usage"]["completion_tokens"] == 3
+            assert not router.backends[0].healthy
+        finally:
+            rs.stop()
+
+    def test_streaming_passthrough(self, two_engines):
+        router = Router([Backend(two_engines[0])])
+        rs = RouterServer(router, host="127.0.0.1", port=0).start()
+        try:
+            body = json.dumps({"model": "m", "prompt": "hi",
+                               "max_tokens": 3, "stream": True}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{rs.port}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            events = []
+            with urllib.request.urlopen(req, timeout=120) as r:
+                for raw in r:
+                    line = raw.decode().strip()
+                    if line.startswith("data:"):
+                        events.append(line)
+            assert events[-1] == "data: [DONE]"
+            assert len(events) >= 2
+        finally:
+            rs.stop()
+
+    def test_all_backends_down_503(self):
+        router = Router([Backend("http://127.0.0.1:9")])
+        router.backends[0].healthy = True  # not yet probed
+        rs = RouterServer(router, host="127.0.0.1", port=0).start()
+        try:
+            body = json.dumps({"prompt": "x"}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{rs.port}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 503
+        finally:
+            rs.stop()
+
+
+class TestDiscovery:
+    def test_discovers_services_by_selector(self):
+        from ome_tpu import constants
+        from ome_tpu.core.client import InMemoryClient
+        from ome_tpu.core.k8s import Service, ServicePort, ServiceSpec
+        from ome_tpu.core.meta import ObjectMeta
+        from ome_tpu.router.server import discover_backends
+        client = InMemoryClient()
+        client.create(Service(
+            metadata=ObjectMeta(
+                name="svc-engine", namespace="prod",
+                labels={constants.COMPONENT_LABEL: "engine"}),
+            spec=ServiceSpec(ports=[ServicePort(name="http", port=8080)])))
+        client.create(Service(
+            metadata=ObjectMeta(
+                name="svc-decoder", namespace="prod",
+                labels={constants.COMPONENT_LABEL: "decoder"}),
+            spec=ServiceSpec(ports=[ServicePort(name="http", port=8080)])))
+        engines = discover_backends(
+            client, "prod", {constants.COMPONENT_LABEL: "engine"},
+            "engine")
+        assert [b.url for b in engines] == \
+            ["http://svc-engine.prod.svc.cluster.local:8080"]
+        decoders = discover_backends(
+            client, "prod", {constants.COMPONENT_LABEL: "decoder"},
+            "decoder")
+        assert decoders[0].pool == "decoder"
