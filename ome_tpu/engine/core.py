@@ -53,12 +53,64 @@ def _bucketize(n: int, buckets: List[int]) -> int:
     return buckets[-1]
 
 
+class PrefixCache:
+    """LRU of prompt-prefix KV (device arrays).
+
+    Coarse-grained prefix caching: after a prefill, the full prompt's
+    KV stays cached; a later prompt sharing that prefix (same system
+    prompt, a continuing conversation) prefills only its suffix.
+    Entries hold [L, 1, bucket, K, Dh] device buffers — size the
+    capacity to HBM headroom (bytes/entry ≈ 2 * L*bucket*K*Dh * 2).
+    """
+
+    def __init__(self, capacity: int = 8, min_prefix: int = 16):
+        from collections import OrderedDict
+        self.capacity = capacity
+        self.min_prefix = min_prefix
+        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def put(self, ids, k, v, true_len: int, bucket: int):
+        if self.capacity <= 0 or true_len < self.min_prefix:
+            return
+        key = tuple(ids)
+        self._entries.pop(key, None)
+        self._entries[key] = (k, v, true_len, bucket)
+        while len(self._entries) > self.capacity:
+            self._entries.popitem(last=False)
+
+    def match(self, ids) -> Optional[tuple]:
+        """Longest cached STRICT prefix of `ids` (the last prompt token
+        must re-run so its logits exist for sampling)."""
+        if self.capacity <= 0:
+            return None
+        ids_t = tuple(ids)
+        best_key, best_eff = None, 0
+        for key, entry in self._entries.items():
+            # an exact repeat reuses all but the last token (its logits
+            # must be recomputed for sampling)
+            eff = min(entry[2], len(ids_t) - 1)
+            if eff < self.min_prefix:
+                continue
+            if ids_t[:eff] == key[:eff] and eff > best_eff:
+                best_key, best_eff = key, eff
+        if best_key is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        self._entries.move_to_end(best_key)
+        k, v, _, bucket = self._entries[best_key]
+        return (k, v, best_eff, bucket)
+
+
 class InferenceEngine:
     """Compiled prefill/insert/decode over one model + one mesh."""
 
     def __init__(self, params: Params, cfg: ModelConfig,
                  max_slots: int = 8, max_seq: Optional[int] = None,
-                 prefill_buckets: Optional[List[int]] = None):
+                 prefill_buckets: Optional[List[int]] = None,
+                 prefix_cache_size: int = 0):
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -70,6 +122,7 @@ class InferenceEngine:
                 b *= 2
             prefill_buckets.append(self.max_seq)
         self.prefill_buckets = prefill_buckets
+        self.prefix_cache = PrefixCache(prefix_cache_size)
 
         cfg_ = cfg
 
@@ -87,6 +140,33 @@ class InferenceEngine:
             # last REAL token's logits (right padding occupies the tail)
             last = jnp.take_along_axis(
                 logits, (true_len - 1)[:, None, None], axis=1)[:, 0]
+            tok = sample(last, key, temperature, top_k, top_p)
+            return tok[0], new_cache.k, new_cache.v
+
+        @functools.partial(jax.jit,
+                           static_argnames=("total_bucket", "keep"))
+        def _prefill_suffix(params, prefix_k, prefix_v,
+                            prefix_len: jax.Array, padded: jax.Array,
+                            suffix_len: jax.Array, temperature, top_k,
+                            top_p, key, total_bucket: int, keep: int):
+            """Chunked prefill atop a cached prefix: seed a
+            total_bucket cache with the prefix KV, run only the suffix
+            (positions continue at prefix_len). Rows past the valid
+            lengths hold stale data — kv_len masking makes them
+            unreachable."""
+            shape = (cfg_.num_layers, 1, total_bucket,
+                     cfg_.num_kv_heads, cfg_.head_dim)
+            k0 = lax.dynamic_update_slice(
+                jnp.zeros(shape, cfg_.dtype),
+                prefix_k[:, :, :keep], (0, 0, 0, 0, 0))
+            v0 = lax.dynamic_update_slice(
+                jnp.zeros(shape, cfg_.dtype),
+                prefix_v[:, :, :keep], (0, 0, 0, 0, 0))
+            cache = llama.KVCache(k=k0, v=v0, index=prefix_len)
+            logits, new_cache = llama.forward(params, cfg_, padded,
+                                              cache=cache)
+            last = jnp.take_along_axis(
+                logits, (suffix_len - 1)[:, None, None], axis=1)[:, 0]
             tok = sample(last, key, temperature, top_k, top_p)
             return tok[0], new_cache.k, new_cache.v
 
@@ -116,6 +196,7 @@ class InferenceEngine:
                                tokens=toks), toks
 
         self._prefill_fn = _prefill
+        self._prefill_suffix_fn = _prefill_suffix
         self._insert_fn = _insert
         self._decode_fn = _decode
         self._step = 0
@@ -136,20 +217,44 @@ class InferenceEngine:
 
     def prefill(self, prompt_ids: List[int], temperature: float = 0.0,
                 top_k: int = 0, top_p: float = 1.0):
-        """Returns (first_token:int, kv pair, true_len, bucket)."""
+        """Returns (first_token:int, kv pair, true_len, bucket).
+
+        With a prefix cache enabled, a prompt whose leading tokens were
+        prefetched by an earlier request runs only its suffix through
+        the model (chunked prefill atop the cached KV)."""
         # leave room for one generated token; cap at the largest bucket
         max_prompt = min(self.max_seq - 1, self.prefill_buckets[-1])
         ids = prompt_ids[-max_prompt:]
-        bucket = _bucketize(len(ids), self.prefill_buckets)
-        padded = jnp.asarray(
-            [ids + [0] * (bucket - len(ids))], jnp.int32)
         self._step += 1
         key = jax.random.fold_in(self._root_key, self._step)
-        tok, k, v = self._prefill_fn(
-            self.params, padded, jnp.asarray([len(ids)], jnp.int32),
-            jnp.asarray([temperature], jnp.float32),
-            jnp.asarray([top_k], jnp.int32),
-            jnp.asarray([top_p], jnp.float32), key, bucket=bucket)
+        sampling = (jnp.asarray([temperature], jnp.float32),
+                    jnp.asarray([top_k], jnp.int32),
+                    jnp.asarray([top_p], jnp.float32))
+
+        hit = self.prefix_cache.match(ids)
+        if hit is not None:
+            pk, pv, plen, pbucket = hit
+            suffix = ids[plen:]
+            sbucket = _bucketize(len(suffix), self.prefill_buckets)
+            if plen + sbucket > self.prefill_buckets[-1]:
+                hit = None  # prefix + suffix overflows: full prefill
+        if hit is not None:
+            bucket = _bucketize(plen + sbucket, self.prefill_buckets)
+            padded = jnp.asarray(
+                [suffix + [0] * (sbucket - len(suffix))], jnp.int32)
+            tok, k, v = self._prefill_suffix_fn(
+                self.params, pk, pv, jnp.asarray(plen, jnp.int32),
+                padded, jnp.asarray([len(suffix)], jnp.int32),
+                *sampling, key, total_bucket=bucket,
+                keep=min(pbucket, bucket))
+        else:
+            bucket = _bucketize(len(ids), self.prefill_buckets)
+            padded = jnp.asarray(
+                [ids + [0] * (bucket - len(ids))], jnp.int32)
+            tok, k, v = self._prefill_fn(
+                self.params, padded, jnp.asarray([len(ids)], jnp.int32),
+                *sampling, key, bucket=bucket)
+        self.prefix_cache.put(ids, k, v, len(ids), bucket)
         return int(tok), (k, v), len(ids), bucket
 
     def insert(self, state: DecodeState, kv, slot: int, true_len: int,

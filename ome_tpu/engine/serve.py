@@ -50,6 +50,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--adapter", default=None,
                    help="PEFT LoRA adapter dir merged into the base "
                         "weights at load (FineTunedWeight serving)")
+    p.add_argument("--prefix-cache", type=int, default=8,
+                   help="prompt-prefix KV cache entries (0 disables); "
+                        "repeat prompts/conversations prefill only "
+                        "their suffix")
     return p
 
 
@@ -112,11 +116,13 @@ def load_engine(args):
         from .sharded import ShardedInferenceEngine
         return ShardedInferenceEngine(params, cfg, tp=args.tp,
                                       max_slots=args.max_slots,
-                                      max_seq=max_seq)
+                                      max_seq=max_seq,
+                                      prefix_cache_size=args.prefix_cache)
     import jax
     params = jax.tree.map(jnp.asarray, params)  # one transfer
     return InferenceEngine(params, cfg, max_slots=args.max_slots,
-                           max_seq=max_seq)
+                           max_seq=max_seq,
+                           prefix_cache_size=args.prefix_cache)
 
 
 class _NullScheduler:

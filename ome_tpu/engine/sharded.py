@@ -39,7 +39,8 @@ class ShardedInferenceEngine(InferenceEngine):
     def __init__(self, params, cfg: ModelConfig, tp: int = 1,
                  max_slots: int = 8, max_seq: Optional[int] = None,
                  prefill_buckets: Optional[List[int]] = None,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None,
+                 prefix_cache_size: int = 0):
         if cfg.num_kv_heads % tp != 0:
             raise ValueError(
                 f"tp={tp} must divide num_kv_heads={cfg.num_kv_heads} "
@@ -51,7 +52,8 @@ class ShardedInferenceEngine(InferenceEngine):
         self.tp = tp
         params = shard_params(params, self.mesh)
         super().__init__(params, cfg, max_slots=max_slots, max_seq=max_seq,
-                         prefill_buckets=prefill_buckets)
+                         prefill_buckets=prefill_buckets,
+                         prefix_cache_size=prefix_cache_size)
 
     def _kv_sharding(self) -> NamedSharding:
         # [L, B, S, K, Dh]: KV heads on tp

@@ -1,0 +1,102 @@
+"""Prefix caching: suffix prefill atop cached KV must produce the
+same tokens as a cold full prefill, hits/misses/LRU behave, and the
+engine stays correct through insert+decode."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ome_tpu.engine.core import InferenceEngine, PrefixCache
+from ome_tpu.models import llama
+from ome_tpu.models.config import tiny_test
+
+
+def _greedy(engine, prompt, steps=6, slot=0):
+    state = engine.new_state()
+    tok, kv, true_len, bucket = engine.prefill(prompt)
+    state = engine.insert(state, kv, slot, true_len, tok, bucket)
+    out = [tok]
+    B = engine.max_slots
+    for _ in range(steps):
+        state, toks = engine.decode(state, np.zeros(B, np.float32),
+                                    np.zeros(B, np.int32),
+                                    np.ones(B, np.float32))
+        out.append(int(np.asarray(toks)[slot]))
+    return out
+
+
+def _cfg():
+    return tiny_test().replace(dtype=jnp.float32, max_seq_len=256)
+
+
+def test_suffix_prefill_matches_cold_prefill():
+    cfg = _cfg()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    base = list(range(2, 40))  # 38-token shared prefix
+    prompt = base + [77, 78, 79]
+
+    cold = InferenceEngine(params, cfg, max_slots=2, max_seq=128,
+                           prefill_buckets=[16, 32, 64, 128])
+    want = _greedy(cold, prompt)
+
+    warm = InferenceEngine(params, cfg, max_slots=2, max_seq=128,
+                           prefill_buckets=[16, 32, 64, 128],
+                           prefix_cache_size=4)
+    _greedy(warm, base)                     # seeds the cache
+    assert warm.prefix_cache.misses == 1
+    got = _greedy(warm, prompt)             # suffix path
+    assert warm.prefix_cache.hits == 1
+    assert got == want
+
+
+def test_exact_repeat_reuses_all_but_last_token():
+    cfg = _cfg()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    eng = InferenceEngine(params, cfg, max_slots=2, max_seq=128,
+                          prefill_buckets=[16, 32, 64],
+                          prefix_cache_size=4)
+    prompt = list(range(1, 30))
+    a = _greedy(eng, prompt)
+    b = _greedy(eng, prompt)  # strict-prefix rule: matches 28 of 29
+    assert eng.prefix_cache.hits >= 1
+    assert a == b
+
+
+def test_cache_disabled_by_default():
+    cfg = _cfg()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    eng = InferenceEngine(params, cfg, max_slots=2, max_seq=64,
+                          prefill_buckets=[16, 32])
+    _greedy(eng, list(range(1, 20)))
+    assert eng.prefix_cache.hits == 0
+    assert eng.prefix_cache.misses == 0
+
+
+class TestPrefixCacheUnit:
+    def test_lru_eviction(self):
+        pc = PrefixCache(capacity=2, min_prefix=2)
+        pc.put([1, 2, 3], "k1", "v1", 3, 16)
+        pc.put([4, 5, 6], "k2", "v2", 3, 16)
+        pc.put([7, 8, 9], "k3", "v3", 3, 16)  # evicts [1,2,3]
+        assert pc.match([1, 2, 3, 4]) is None
+        assert pc.match([4, 5, 6, 7])[0] == "k2"
+
+    def test_longest_prefix_wins(self):
+        pc = PrefixCache(capacity=4, min_prefix=2)
+        pc.put([1, 2], "short", "v", 2, 16)
+        pc.put([1, 2, 3, 4], "long", "v", 4, 16)
+        assert pc.match([1, 2, 3, 4, 5])[0] == "long"
+
+    def test_strict_prefix_semantics(self):
+        pc = PrefixCache(capacity=4, min_prefix=2)
+        pc.put([1, 2, 3], "k", "v", 3, 16)
+        # equal prompt: reuses all but the last token
+        assert pc.match([1, 2, 3])[2] == 2
+        assert pc.match([1, 9, 3, 4]) is None   # diverges
+        hit = pc.match([1, 2, 3, 4])
+        assert hit is not None and hit[2] == 3
+
+    def test_min_prefix_floor(self):
+        pc = PrefixCache(capacity=4, min_prefix=16)
+        pc.put([1, 2, 3], "k", "v", 3, 16)      # too short to keep
+        assert pc.match([1, 2, 3, 4]) is None
