@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..models import llama
@@ -227,9 +228,9 @@ class InferenceEngine:
         ids = prompt_ids[-max_prompt:]
         self._step += 1
         key = jax.random.fold_in(self._root_key, self._step)
-        sampling = (jnp.asarray([temperature], jnp.float32),
-                    jnp.asarray([top_k], jnp.int32),
-                    jnp.asarray([top_p], jnp.float32))
+        sampling = (np.asarray([temperature], np.float32),
+                    np.asarray([top_k], np.int32),
+                    np.asarray([top_p], np.float32))
 
         hit = self.prefix_cache.match(ids)
         if hit is not None:
@@ -240,29 +241,32 @@ class InferenceEngine:
                 hit = None  # prefix + suffix overflows: full prefill
         if hit is not None:
             bucket = _bucketize(plen + sbucket, self.prefill_buckets)
-            padded = jnp.asarray(
-                [suffix + [0] * (sbucket - len(suffix))], jnp.int32)
+            padded = np.asarray(
+                [suffix + [0] * (sbucket - len(suffix))], np.int32)
             tok, k, v = self._prefill_suffix_fn(
-                self.params, pk, pv, jnp.asarray(plen, jnp.int32),
-                padded, jnp.asarray([len(suffix)], jnp.int32),
+                self.params, pk, pv, np.asarray(plen, np.int32),
+                padded, np.asarray([len(suffix)], np.int32),
                 *sampling, key, total_bucket=bucket,
                 keep=min(pbucket, bucket))
         else:
             bucket = _bucketize(len(ids), self.prefill_buckets)
-            padded = jnp.asarray(
-                [ids + [0] * (bucket - len(ids))], jnp.int32)
+            padded = np.asarray(
+                [ids + [0] * (bucket - len(ids))], np.int32)
             tok, k, v = self._prefill_fn(
-                self.params, padded, jnp.asarray([len(ids)], jnp.int32),
+                self.params, padded, np.asarray([len(ids)], np.int32),
                 *sampling, key, bucket=bucket)
         self.prefix_cache.put(ids, k, v, len(ids), bucket)
-        return int(tok), (k, v), len(ids), bucket
+        # multi-host: int() on an array spanning non-addressable
+        # devices raises; fetch the local replica instead
+        from .multihost import host_value
+        return int(host_value(tok)), (k, v), len(ids), bucket
 
     def insert(self, state: DecodeState, kv, slot: int, true_len: int,
                token: int, bucket: int) -> DecodeState:
         return self._insert_fn(
-            state, kv[0], kv[1], jnp.asarray(slot, jnp.int32),
-            jnp.asarray(true_len, jnp.int32),
-            jnp.asarray(token, jnp.int32), bucket=bucket)
+            state, kv[0], kv[1], np.asarray(slot, np.int32),
+            np.asarray(true_len, np.int32),
+            np.asarray(token, np.int32), bucket=bucket)
 
     def decode(self, state: DecodeState, temperature, top_k, top_p,
                ) -> Tuple[DecodeState, jax.Array]:
@@ -270,6 +274,6 @@ class InferenceEngine:
         self._step += 1
         key = jax.random.fold_in(self._root_key, self._step)
         return self._decode_fn(self.params, state,
-                               jnp.asarray(temperature, jnp.float32),
-                               jnp.asarray(top_k, jnp.int32),
-                               jnp.asarray(top_p, jnp.float32), key)
+                               np.asarray(temperature, np.float32),
+                               np.asarray(top_k, np.int32),
+                               np.asarray(top_p, np.float32), key)

@@ -1,0 +1,274 @@
+"""Multi-host serving: jax.distributed rendezvous + op replication.
+
+Honors the LWS contract the operator stamps out
+(controllers/reconcilers/multinode.py:53-58): every pod in the group
+gets JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID, the
+engine joins the cross-host rendezvous at startup, and the compiled
+prefill/insert/decode programs run SPMD over a mesh spanning every
+host's chips. This is the role the reference's runtimes fill with
+`--dist-init-addr $(LWS_LEADER_ADDRESS):5757 --nnodes ... --node-rank`
+(config/runtimes/srt/deepseek-rdma-pd-rt.yaml:108-115 in
+/root/reference) — redesigned for XLA's execution model:
+
+  * SPMD means every process must enqueue the SAME compiled programs
+    in the SAME order (collectives rendezvous across hosts). Only the
+    leader (process 0) sees HTTP traffic, so the leader REPLICATES its
+    op stream (prefill/insert/decode + host args) to followers over a
+    TCP control channel, and followers replay it. Device results never
+    cross the channel — each process computes identical values from
+    identical programs (sampling keys derive from a shared fold_in
+    counter), so the only bytes on the wire are op headers and token
+    ids. This is JetStream/Pathways-style leader-driven serving.
+  * Worker loss fails FAST: a dropped control socket kills the whole
+    group (followers exit nonzero, the leader marks itself unhealthy),
+    and the LeaderWorkerSet recreates the group — the same crash-and-
+    recreate discipline the reference's multinode runtimes rely on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import socket
+import struct
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .. import constants
+
+log = logging.getLogger("ome.engine.multihost")
+
+# leader's op-replication channel; distinct from the jax.distributed
+# coordinator port (JAX_COORDINATOR_PORT in controllers/reconcilers)
+CONTROL_PORT = 5858
+
+
+@dataclasses.dataclass(frozen=True)
+class DistContext:
+    coordinator: str          # host:port of the jax.distributed service
+    num_processes: int
+    process_id: int
+
+    @property
+    def is_leader(self) -> bool:
+        return self.process_id == 0
+
+    @property
+    def coordinator_host(self) -> str:
+        return self.coordinator.rsplit(":", 1)[0]
+
+
+def init_from_env(env=None) -> Optional[DistContext]:
+    """Join the cross-host rendezvous if the operator injected one.
+
+    Reads the env contract from controllers/reconcilers/multinode.py;
+    returns None (single-host mode) when JAX_COORDINATOR_ADDRESS is
+    absent. MUST run before any other JAX call — jax.distributed can
+    only initialize ahead of backend creation.
+    """
+    env = env if env is not None else os.environ
+    coord = env.get(constants.JAX_COORDINATOR_ENV)
+    if not coord:
+        return None
+    num = int(env.get(constants.JAX_NUM_PROCESSES_ENV, "1"))
+    pid = int(env.get(constants.JAX_PROCESS_ID_ENV, "0"))
+    if num <= 1:
+        return None
+    import jax
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=num, process_id=pid)
+    log.info("joined jax.distributed rendezvous %s as process %d/%d "
+             "(%d global devices)", coord, pid, num, jax.device_count())
+    return DistContext(coordinator=coord, num_processes=num,
+                       process_id=pid)
+
+
+def host_value(x) -> np.ndarray:
+    """Fetch a (replicated) device value to host, multi-host safe.
+
+    np.asarray on an array spanning non-addressable devices raises;
+    the local shard of a replicated value is the whole value.
+    """
+    if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+        return np.asarray(x.addressable_shards[0].data)
+    return np.asarray(x)
+
+
+# -- control channel -------------------------------------------------------
+
+
+def _send_msg(sock: socket.socket, msg: dict) -> None:
+    data = json.dumps(msg).encode()
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket) -> Optional[dict]:
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (n,) = struct.unpack("<I", hdr)
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return json.loads(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class OpPublisher:
+    """Leader side: accepts every follower, then fans ops out in order.
+
+    TCP per-connection ordering + one sender thread per send() caller
+    (the scheduler thread) gives all followers the identical op
+    sequence. A send failure means a follower died — the caller (the
+    scheduler step) propagates, flipping the leader unhealthy so the
+    LWS group restarts together.
+    """
+
+    def __init__(self, n_followers: int, port: int = CONTROL_PORT,
+                 host: str = "0.0.0.0", accept_timeout: float = 600.0):
+        self._server = socket.create_server((host, port))
+        self._server.settimeout(accept_timeout)
+        self._socks: List[socket.socket] = []
+        for _ in range(n_followers):
+            conn, addr = self._server.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks.append(conn)
+            log.info("follower joined from %s (%d/%d)", addr,
+                     len(self._socks), n_followers)
+
+    def send(self, msg: dict) -> None:
+        for sock in self._socks:
+            _send_msg(sock, msg)
+
+    def close(self) -> None:
+        try:
+            self.send({"op": "stop"})
+        except OSError:
+            pass
+        for s in self._socks:
+            s.close()
+        self._server.close()
+
+
+class OpSubscriber:
+    """Follower side: connect (with retry — the leader pod may still be
+    loading weights) and stream ops."""
+
+    def __init__(self, host: str, port: int = CONTROL_PORT,
+                 connect_timeout: float = 600.0):
+        deadline = time.monotonic() + connect_timeout
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=10)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(1.0)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+
+    def recv(self) -> Optional[dict]:
+        return _recv_msg(self._sock)
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+# -- leader / follower engine drivers --------------------------------------
+
+
+class ReplicatedEngine:
+    """Wraps an InferenceEngine so every device-touching op is
+    published to the followers before the leader runs it. Drop-in for
+    the Scheduler: same prefill/insert/decode surface."""
+
+    def __init__(self, engine, publisher: OpPublisher):
+        self._engine = engine
+        self._pub = publisher
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+    def new_state(self):
+        return self._engine.new_state()
+
+    def prefill(self, prompt_ids, temperature: float = 0.0,
+                top_k: int = 0, top_p: float = 1.0):
+        self._pub.send({"op": "prefill", "ids": list(map(int, prompt_ids)),
+                        "temperature": float(temperature),
+                        "top_k": int(top_k), "top_p": float(top_p)})
+        return self._engine.prefill(prompt_ids, temperature, top_k, top_p)
+
+    def insert(self, state, kv, slot: int, true_len: int, token: int,
+               bucket: int):
+        self._pub.send({"op": "insert", "slot": int(slot),
+                        "true_len": int(true_len), "token": int(token),
+                        "bucket": int(bucket)})
+        return self._engine.insert(state, kv, slot, true_len, token,
+                                   bucket)
+
+    def decode(self, state, temperature, top_k, top_p):
+        self._pub.send({"op": "decode",
+                        "temperature": np.asarray(temperature,
+                                                  np.float32).tolist(),
+                        "top_k": np.asarray(top_k, np.int32).tolist(),
+                        "top_p": np.asarray(top_p,
+                                            np.float32).tolist()})
+        state, toks = self._engine.decode(state, temperature, top_k,
+                                          top_p)
+        return state, host_value(toks)
+
+
+def follower_loop(engine, sub: OpSubscriber) -> int:
+    """Replay the leader's op stream against the local engine.
+
+    Every value the replay needs beyond the op headers (prefill KV,
+    sampled tokens) is recomputed locally — identical programs +
+    identical inputs + shared RNG counters give identical results, so
+    insert() can consume the follower's OWN last prefill output.
+    Returns an exit code: 0 on orderly stop, 1 on a dropped leader.
+    """
+    state = engine.new_state()
+    last_prefill: Optional[Tuple] = None
+    while True:
+        msg = sub.recv()
+        if msg is None:
+            log.error("control channel dropped; exiting for group "
+                      "restart")
+            return 1
+        op = msg["op"]
+        if op == "stop":
+            return 0
+        if op == "prefill":
+            last_prefill = engine.prefill(
+                msg["ids"], msg["temperature"], msg["top_k"],
+                msg["top_p"])
+        elif op == "insert":
+            tok, kv, _true_len, _bucket = last_prefill
+            state = engine.insert(state, kv, msg["slot"],
+                                  msg["true_len"], tok, msg["bucket"])
+        elif op == "decode":
+            state, _ = engine.decode(
+                state,
+                np.asarray(msg["temperature"], np.float32),
+                np.asarray(msg["top_k"], np.int32),
+                np.asarray(msg["top_p"], np.float32))
+        else:
+            log.error("unknown op %r from leader", op)
+            return 1

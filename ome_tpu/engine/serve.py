@@ -43,10 +43,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(tests / dry runs)")
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel size over the local mesh")
-    p.add_argument("--quantization", choices=("none", "int8"),
+    p.add_argument("--quantization", choices=("none", "int8", "int4"),
                    default="none",
                    help="weight-only quantization at load time (int8 "
-                        "halves decode HBM traffic)")
+                        "halves decode HBM traffic; int4 groupwise "
+                        "quarters it)")
     p.add_argument("--adapter", default=None,
                    help="PEFT LoRA adapter dir merged into the base "
                         "weights at load (FineTunedWeight serving)")
@@ -54,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="prompt-prefix KV cache entries (0 disables); "
                         "repeat prompts/conversations prefill only "
                         "their suffix")
+    p.add_argument("--control-port", type=int, default=None,
+                   help="leader->follower op-replication port for "
+                        "multi-host serving (default: engine/multihost "
+                        "CONTROL_PORT)")
     return p
 
 
@@ -94,21 +99,29 @@ def _load_params_cfg(args, dtype):
     return params, cfg
 
 
-def load_engine(args):
+def load_engine(args, dist=None):
     import jax.numpy as jnp
 
     from .core import InferenceEngine
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     params, cfg = _load_params_cfg(args, dtype)
+    if dist is not None and args.tp <= 1:
+        # multi-host slice: tp spans every chip of every host by
+        # default (the LWS north-star layout, e.g. v5e-16 = 4x4)
+        import jax
+        args.tp = jax.device_count()
+        log.info("multi-host: tp=%d over %d processes", args.tp,
+                 dist.num_processes)
     if cfg.is_moe and args.tp == 1:
         # single-device serving uses the ragged grouped-GEMM dispatch;
         # tp>1 keeps the dense path (shardable through plain GSPMD)
         cfg = cfg.replace(moe_impl="ragged")
-    if args.quantization == "int8":
+    if args.quantization in ("int8", "int4"):
         from ..models.quant import quantize_params
-        params = quantize_params(params)
-        log.info("quantized weights to int8 (weight-only)")
+        params = quantize_params(params, mode=args.quantization)
+        log.info("quantized weights to %s (weight-only)",
+                 args.quantization)
     max_seq = args.max_seq or min(cfg.max_seq_len, 8192)
     if args.tp > 1:
         # hand the host tree straight to shard_params: materializing it
@@ -161,16 +174,48 @@ def main(argv=None) -> int:
                   "(incompatible with --random-weights)")
         return 2
 
+    # join the cross-host rendezvous FIRST (before any jax call) when
+    # the operator injected the LWS contract env (multinode.py:53-58)
+    from . import multihost
+    dist = multihost.init_from_env()
+    control_port = args.control_port or multihost.CONTROL_PORT
+
     from .scheduler import Scheduler
     from .server import EngineServer
     from .tokenizer import load_tokenizer
+
+    if dist is not None and args.task == "embed":
+        # embeddings are stateless single-host programs; a multi-host
+        # embed group would leave followers waiting on a control
+        # channel the embed leader never opens
+        log.error("--task embed does not support multi-host serving "
+                  "(unset JAX_COORDINATOR_ADDRESS or use one process)")
+        return 2
+
+    if dist is not None and not dist.is_leader:
+        # followers never serve HTTP: they join the mesh, then replay
+        # the leader's op stream (SPMD requires identical programs in
+        # identical order on every process)
+        engine = load_engine(args, dist)
+        sub = multihost.OpSubscriber(dist.coordinator_host,
+                                     control_port)
+        log.info("follower %d/%d replaying leader ops",
+                 dist.process_id, dist.num_processes)
+        try:
+            return multihost.follower_loop(engine, sub)
+        finally:
+            sub.close()
 
     embedder = None
     if args.task == "embed":
         embedder = load_embedder(args)
         scheduler = _NullScheduler()
     else:
-        engine = load_engine(args)
+        engine = load_engine(args, dist)
+        if dist is not None:
+            pub = multihost.OpPublisher(dist.num_processes - 1,
+                                        port=control_port)
+            engine = multihost.ReplicatedEngine(engine, pub)
         scheduler = Scheduler(engine)
     tok = load_tokenizer(args.model_dir)
     name = args.model_name or args.model_dir.rstrip("/").rsplit("/", 1)[-1]
@@ -191,6 +236,12 @@ def main(argv=None) -> int:
     finally:
         server.stop()
         scheduler.stop()
+        if dist is not None:
+            # orderly group teardown: the stop op releases followers
+            # from recv() so every process reaches jax.distributed
+            # shutdown (which waits for ALL clients) instead of
+            # deadlocking the leader's exit on a blocked worker
+            engine._pub.close()
     return 0
 
 

@@ -1,51 +1,104 @@
-"""Weight-only int8 quantization for serving.
+"""Weight-only quantization (int8 per-channel, int4 groupwise) for serving.
 
 Decode is HBM-bandwidth-bound: every generated token streams all
 weights once (bench.py roofline). Symmetric per-output-channel int8
-halves the bytes per step vs bf16 — XLA fuses the int8->bf16 convert
-and scale multiply into the matmul operand read, so the MXU still
-computes in bf16 while HBM traffic drops ~2x. This is the runtime
-analog of the reference catalog's int4/fp8 model-format entries
-(model.go:262-268) for checkpoints that ship full-precision.
+halves the bytes per step vs bf16; groupwise int4 halves them again.
+XLA fuses the dequant (nibble unpack, convert, scale) into the matmul
+operand read, so the MXU still computes in bf16 while HBM traffic
+drops 2x/4x. This is the runtime analog of the reference catalog's
+int4/fp8 model-format entries (model.go:262-268) for checkpoints that
+ship full precision.
 
-QTensor is a registered pytree (scan/jit/shard-friendly): `q` int8
-plus a per-output-channel `s` scale, dequantized at use by
-models/llama.py's weight accessor.
+int4 packing is TPU-deliberate: two nibbles per int8 byte, paired
+*within a scale group* as [first half | second half] along the packing
+axis, so dequant is two arithmetic shifts + ONE concatenate — no
+stride-2 interleave, which XLA:TPU cannot fuse into the matmul read
+(measured 1.8x slower than the concat layout on v5e). Scales are
+per-(group x output-channel), GPTQ-style.
+
+QTensor is a registered pytree (scan/jit/shard-friendly), dequantized
+at use by models/llama.py's weight accessor `_w`.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict
+import logging
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 
-@jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class QTensor:
-    """Symmetric int8 weight + broadcastable f32 scale."""
+    """Quantized weight + broadcastable f32 scale.
 
-    q: jax.Array          # int8, original shape
-    s: jax.Array          # f32, shape with contraction dims = 1
+    bits=8: `q` int8 in the original shape, `s` with contraction dims
+    of size 1 (per-output-channel).
+    bits=4: `q` int8 carrying two nibbles, with the packing axis
+    halved; `s` with the packing axis sized n_groups and other
+    contraction dims 1.
+    """
+
+    q: jax.Array
+    s: jax.Array
+    bits: int = 8            # static
+    # static: packing/group axis for bits=4, stored NEGATIVE (offset
+    # from the last dim) so it survives lax.scan slicing layer leaves
+    # off the stacked [L, ...] tree and gather prepending index dims
+    axis: int = -1
 
     def dequant(self, dtype=jnp.bfloat16) -> jax.Array:
-        return (self.q.astype(jnp.float32) * self.s).astype(dtype)
+        if self.bits == 8:
+            return (self.q.astype(jnp.float32) * self.s).astype(dtype)
+        return _unpack4(self.q, self.s, self.axis).astype(dtype)
 
     def take(self, idx: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
         """Row gather (embedding lookup) without full dequant."""
-        rows = jnp.take(self.q, idx, axis=0).astype(jnp.float32)
+        rows = jnp.take(self.q, idx, axis=0)
         scales = jnp.take(self.s, idx, axis=0)
-        return (rows * scales).astype(dtype)
+        if self.bits == 8:
+            return (rows.astype(jnp.float32) * scales).astype(dtype)
+        return _unpack4(rows, scales, self.axis).astype(dtype)
 
     @property
     def shape(self):
-        return self.q.shape
+        if self.bits == 8:
+            return self.q.shape
+        sh = list(self.q.shape)
+        sh[self.axis] *= 2
+        return tuple(sh)
 
     @property
     def size(self):
-        return self.q.size
+        n = 1
+        for d in self.shape:
+            n *= d
+        return n
+
+
+jax.tree_util.register_dataclass(
+    QTensor, data_fields=("q", "s"), meta_fields=("bits", "axis"))
+
+
+def _unpack4(q: jax.Array, s: jax.Array, axis: int) -> jax.Array:
+    """Dequantize concat-packed int4: q [..., K/2, ...] -> f32 [..., K, ...].
+
+    s has n_groups at `axis`; each group's first half lives in the low
+    nibbles, second half in the high nibbles of the same bytes.
+    """
+    axis = axis % q.ndim
+    n_groups = s.shape[axis]
+    half = q.shape[axis] // n_groups              # (K / n_groups) / 2
+    pre, post = q.shape[:axis], q.shape[axis + 1:]
+    qr = q.reshape(pre + (n_groups, half) + post)
+    lo = jnp.left_shift(qr, 4) >> 4               # sign-extended nibble
+    hi = qr >> 4                                  # arithmetic shift
+    grouped = jnp.concatenate([lo, hi], axis=axis + 1).astype(jnp.float32)
+    sr = s.reshape(s.shape[:axis] + (n_groups, 1) + s.shape[axis + 1:])
+    out = grouped * sr
+    return out.reshape(pre + (2 * q.shape[axis],) + post)
 
 
 def quantize_tensor(w: jax.Array, contract_axes) -> QTensor:
@@ -57,13 +110,43 @@ def quantize_tensor(w: jax.Array, contract_axes) -> QTensor:
                    keepdims=True)
     s = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
-    return QTensor(q=q, s=s)
+    return QTensor(q=q, s=s, bits=8)
+
+
+def quantize_tensor_int4(w: jax.Array, contract_axes,
+                         group: int = 128) -> QTensor:
+    """Groupwise symmetric int4, concat-packed along the first
+    contraction axis. Falls back to one group when the axis doesn't
+    split evenly into even-sized groups."""
+    axis = contract_axes[0]
+    w32 = jnp.asarray(w, jnp.float32)
+    K = w32.shape[axis]
+    if K % group == 0 and group % 2 == 0:
+        n_groups = K // group
+    elif K % 2 == 0:
+        n_groups = 1  # axis too small/ragged for groups: one scale
+    else:
+        raise ValueError(f"int4 needs an even packing dim, got {K}")
+    gsize = K // n_groups
+    pre, post = w32.shape[:axis], w32.shape[axis + 1:]
+    wg = w32.reshape(pre + (n_groups, gsize) + post)
+    # scales span the group slice plus the OTHER contraction dims
+    other = tuple(a + 1 if a > axis else a
+                  for a in contract_axes[1:])
+    amax = jnp.max(jnp.abs(wg), axis=(axis + 1,) + other, keepdims=True)
+    s = jnp.maximum(amax, 1e-8) / 7.0
+    qg = jnp.clip(jnp.round(wg / s), -7, 7).astype(jnp.int8)
+    lo, hi = jnp.split(qg, 2, axis=axis + 1)      # halves of each group
+    packed = ((hi << 4) | (lo & 0x0F)).reshape(
+        pre + (K // 2,) + post)
+    s = jnp.squeeze(s, axis=axis + 1)             # [., n_groups, .(1s)]
+    return QTensor(q=packed, s=s, bits=4, axis=axis - w32.ndim)
 
 
 # contraction axes per stacked-layer leaf ([L, ...]; axis 0 = layer)
 _LAYER_CONTRACT = {
     "wq": (1,), "wk": (1,), "wv": (1,),   # [L, D, H, Dh]: sum over D
-    "wo": (1, 2),                          # [L, H, Dh, D]: sum over H,Dh
+    "wo": (2, 1),                          # [L, H, Dh, D]: sum over H,Dh
     "w_gate": (1,), "w_up": (1,),          # [L, D, F]
     "w_down": (1,),                        # [L, F, D]
     "we_gate": (2,), "we_up": (2,),        # [L, E, D, F]
@@ -76,17 +159,42 @@ _TOP_CONTRACT = {
 }
 
 
-def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
-    """int8-quantize the big matmul weights; norms/biases/router stay
-    full precision (tiny, and routing is precision-sensitive)."""
+def quantize_params(params: Dict[str, Any], mode: str = "int8",
+                    group: int = 128) -> Dict[str, Any]:
+    """Quantize the big matmul weights; norms/biases/router stay full
+    precision (tiny, and routing is precision-sensitive).
+
+    mode="int8": per-output-channel symmetric int8 everywhere.
+    mode="int4": groupwise int4 for the layer matmuls; embed/lm_head
+    stay int8 (their error feeds every position — the GPTQ convention
+    of keeping embeddings at higher precision), and so do the
+    down-projections (w_down/ws_down): their packing axis F is the
+    tp-sharded row dim (parallel/sharding._LAYER_RULES), and nibble
+    pairs spanning device shards would force GSPMD to all-gather the
+    weight every step — worse than the bytes saved.
+    """
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"unknown quantization mode {mode!r}")
+    int4 = mode == "int4"
+    _INT8_ONLY = {"w_down", "ws_down"}
+    log = logging.getLogger("ome.models.quant")
+
+    def q_layer(k: str, v):
+        if k not in _LAYER_CONTRACT:
+            return v
+        axes = _LAYER_CONTRACT[k]
+        if int4 and k not in _INT8_ONLY:
+            try:
+                return quantize_tensor_int4(v, axes, group=group)
+            except ValueError as e:
+                log.info("int4: %s falls back to int8 (%s)", k, e)
+                return quantize_tensor(v, axes)
+        return quantize_tensor(v, axes)
+
     out: Dict[str, Any] = {}
     for name, leaf in params.items():
         if name == "layers":
-            out["layers"] = {
-                k: (quantize_tensor(v, _LAYER_CONTRACT[k])
-                    if k in _LAYER_CONTRACT else v)
-                for k, v in leaf.items()
-            }
+            out["layers"] = {k: q_layer(k, v) for k, v in leaf.items()}
         elif name in _TOP_CONTRACT:
             out[name] = quantize_tensor(leaf, _TOP_CONTRACT[name])
         else:

@@ -80,9 +80,21 @@ def shard_params(params, mesh: Mesh, pipeline: bool = False):
                 None if dim == 1 else ax
                 for ax, dim in zip(tuple(spec) + (None,) * 8,
                                    leaf.s.shape)])
+            if leaf.bits == 4:
+                # int4 leaves pack along an UNSHARDED contraction dim
+                # (quantize_params keeps w_down/ws_down — whose rows
+                # are on tp — at int8), so the q spec carries over;
+                # group scales keep size-1 dims + the group axis
+                # unsharded
+                gaxis = leaf.axis % leaf.q.ndim
+                s_spec = P(*[
+                    None if dim == 1 or i == gaxis else ax
+                    for i, (ax, dim) in enumerate(
+                        zip(tuple(spec) + (None,) * 8, leaf.s.shape))])
             return QTensor(
                 q=jax.device_put(leaf.q, NamedSharding(mesh, spec)),
-                s=jax.device_put(leaf.s, NamedSharding(mesh, s_spec)))
+                s=jax.device_put(leaf.s, NamedSharding(mesh, s_spec)),
+                bits=leaf.bits, axis=leaf.axis)
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     flat_specs = jax.tree.map(lambda s: s, specs,

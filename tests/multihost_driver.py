@@ -1,0 +1,93 @@
+"""Subprocess driver for the 2-process multi-host serving test.
+
+One process of a jax.distributed CPU group: builds the tp=2 sharded
+engine over the GLOBAL (cross-process) mesh, then either drives a
+scripted request sequence through the leader's ReplicatedEngine or
+replays it in the follower loop. The leader writes its token stream to
+an output file for the test to compare against a single-process run.
+
+Usage: multihost_driver.py <pid> <nproc> <coord_port> <ctrl_port> <out>
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    pid, nproc = int(sys.argv[1]), int(sys.argv[2])
+    coord_port, ctrl_port = sys.argv[3], int(sys.argv[4])
+    out_path = sys.argv[5]
+
+    import jax
+    # the image's sitecustomize pre-imports jax pinned to the axon TPU
+    # backend; force the 1-local-CPU-device platform before distributed
+    # init (same dance as __graft_entry__._force_cpu_devices)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 1)
+    except RuntimeError:
+        import jax.extend.backend as jeb
+        jeb.clear_backends()
+        jax.config.update("jax_num_cpu_devices", 1)
+    jax.distributed.initialize(f"127.0.0.1:{coord_port}", nproc, pid)
+    assert jax.device_count() == nproc, jax.devices()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ome_tpu.engine import multihost
+    from ome_tpu.engine.sharded import ShardedInferenceEngine
+    from ome_tpu.models import llama
+    from ome_tpu.models.config import tiny_test
+
+    cfg = tiny_test().replace(dtype=jnp.float32)
+    params = jax.tree.map(np.asarray,
+                          llama.init_params(jax.random.PRNGKey(0), cfg))
+    eng = ShardedInferenceEngine(params, cfg, tp=nproc, max_slots=2,
+                                 max_seq=64, prefill_buckets=[16])
+
+    if pid == 0:
+        pub = multihost.OpPublisher(nproc - 1, port=ctrl_port,
+                                    host="127.0.0.1")
+        reng = multihost.ReplicatedEngine(eng, pub)
+        tokens = run_script(reng)
+        pub.close()
+        with open(out_path, "w") as f:
+            json.dump(tokens, f)
+        return 0
+    sub = multihost.OpSubscriber("127.0.0.1", port=ctrl_port)
+    rc = multihost.follower_loop(eng, sub)
+    sub.close()
+    return rc
+
+
+def run_script(eng) -> list:
+    """The scripted request mix (mirrors what the Scheduler would do);
+    also used by the test for the single-process reference."""
+    import numpy as np
+
+    tokens = {0: [], 1: []}
+    state = eng.new_state()
+    t0, kv0, tl0, b0 = eng.prefill([5, 6, 7, 8])
+    state = eng.insert(state, kv0, 0, tl0, t0, b0)
+    tokens[0].append(t0)
+    t1, kv1, tl1, b1 = eng.prefill([9, 10, 11, 12, 13])
+    state = eng.insert(state, kv1, 1, tl1, t1, b1)
+    tokens[1].append(t1)
+    temp = np.zeros(2, np.float32)
+    top_k = np.zeros(2, np.int32)
+    top_p = np.ones(2, np.float32)
+    for _ in range(6):
+        state, toks = eng.decode(state, temp, top_k, top_p)
+        host = np.asarray(toks)
+        tokens[0].append(int(host[0]))
+        tokens[1].append(int(host[1]))
+    return [tokens[0], tokens[1]]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,156 @@
+"""Multi-host serving (engine/multihost.py): the LWS contract's
+engine side. A real 2-process jax.distributed CPU group (leader +
+follower over the op-replication channel) must decode token-identically
+to a single-process engine with the same tp=2 partitioning — proving
+the leader's op stream fully determines the group's computation.
+
+Reference role: config/runtimes/srt/deepseek-rdma-pd-rt.yaml:108-115
+(--dist-init-addr / --nnodes / --node-rank rendezvous).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ome_tpu.engine import multihost
+from ome_tpu.models import llama
+from ome_tpu.models.config import tiny_test
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DRIVER = os.path.join(HERE, "multihost_driver.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_init_from_env_absent_is_single_host():
+    assert multihost.init_from_env(env={}) is None
+    assert multihost.init_from_env(
+        env={"JAX_COORDINATOR_ADDRESS": "x:1",
+             "JAX_NUM_PROCESSES": "1"}) is None
+
+
+def test_two_process_group_matches_single_process():
+    """Leader+follower (2 jax.distributed CPU processes, tp=2 spanning
+    both) must produce the exact token streams of a single-process
+    tp=2 engine running the same scripted request mix."""
+    coord, ctrl = _free_port(), _free_port()
+    out_path = os.path.join("/tmp", f"mh_{os.getpid()}.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, DRIVER, str(pid), "2", str(coord),
+             str(ctrl), out_path],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for pid in (0, 1)
+    ]
+    outs = [p.communicate(timeout=420) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, se.decode()[-2000:]
+
+    with open(out_path) as f:
+        group_tokens = json.load(f)
+    os.unlink(out_path)
+
+    # single-process reference: same tp=2 layout on the local CPU mesh
+    from ome_tpu.engine.sharded import ShardedInferenceEngine
+    from tests.multihost_driver import run_script
+    cfg = tiny_test().replace(dtype=jnp.float32)
+    params = jax.tree.map(np.asarray,
+                          llama.init_params(jax.random.PRNGKey(0), cfg))
+    ref = ShardedInferenceEngine(params, cfg, tp=2, max_slots=2,
+                                 max_seq=64, prefill_buckets=[16])
+    ref_tokens = run_script(ref)
+    assert group_tokens == ref_tokens
+
+
+def test_replicated_engine_publishes_op_stream():
+    """Every device-touching call on the leader must reach followers
+    in order, carrying only host args."""
+    class FakePub:
+        def __init__(self):
+            self.msgs = []
+
+        def send(self, m):
+            self.msgs.append(m)
+
+    class FakeEngine:
+        def prefill(self, ids, t, k, p):
+            return 7, ("k", "v"), len(ids), 16
+
+        def insert(self, state, kv, slot, true_len, token, bucket):
+            return state
+
+        def decode(self, state, t, k, p):
+            return state, np.asarray([1, 2], np.int32)
+
+    pub = FakePub()
+    eng = multihost.ReplicatedEngine(FakeEngine(), pub)
+    tok, kv, tl, b = eng.prefill([1, 2, 3], 0.5, 4, 0.9)
+    eng.insert(None, kv, 1, tl, tok, b)
+    eng.decode(None, np.zeros(2, np.float32), np.zeros(2, np.int32),
+               np.ones(2, np.float32))
+    assert [m["op"] for m in pub.msgs] == ["prefill", "insert", "decode"]
+    assert pub.msgs[0]["ids"] == [1, 2, 3]
+    assert pub.msgs[0]["temperature"] == 0.5
+    assert pub.msgs[1] == {"op": "insert", "slot": 1, "true_len": 3,
+                           "token": 7, "bucket": 16}
+    assert pub.msgs[2]["temperature"] == [0.0, 0.0]
+
+
+def test_follower_replays_and_exits_on_drop():
+    """The follower replays prefill/insert/decode against its own
+    engine and exits nonzero when the channel drops (group restart)."""
+    ops = [
+        {"op": "prefill", "ids": [1, 2], "temperature": 0.0,
+         "top_k": 0, "top_p": 1.0},
+        {"op": "insert", "slot": 0, "true_len": 2, "token": 9,
+         "bucket": 16},
+        {"op": "decode", "temperature": [0.0], "top_k": [0],
+         "top_p": [1.0]},
+    ]
+
+    class FakeSub:
+        def __init__(self, msgs):
+            self.msgs = list(msgs)
+
+        def recv(self):
+            return self.msgs.pop(0) if self.msgs else None
+
+    calls = []
+
+    class FakeEngine:
+        def new_state(self):
+            return "s0"
+
+        def prefill(self, ids, t, k, p):
+            calls.append(("prefill", tuple(ids)))
+            return 9, "kv", len(ids), 16
+
+        def insert(self, state, kv, slot, true_len, token, bucket):
+            calls.append(("insert", slot, true_len, token))
+            return "s1"
+
+        def decode(self, state, t, k, p):
+            calls.append(("decode", state))
+            return "s2", np.asarray([3], np.int32)
+
+    rc = multihost.follower_loop(FakeEngine(), FakeSub(ops))
+    assert rc == 1  # stream ended without an orderly stop
+    assert calls == [("prefill", (1, 2)), ("insert", 0, 2, 9),
+                     ("decode", "s1")]
+
+    rc = multihost.follower_loop(FakeEngine(),
+                                 FakeSub([{"op": "stop"}]))
+    assert rc == 0
