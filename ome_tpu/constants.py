@@ -49,6 +49,7 @@ BENCHMARK_FINALIZER = f"benchmarkjob.finalizers.{GROUP}"
 MODEL_PATH_ENV = "MODEL_PATH"
 SERVED_MODEL_NAME_ENV = "SERVED_MODEL_NAME"
 PARALLELISM_SIZE_ENV = "PARALLELISM_SIZE"  # constants.go:272 analog (chips)
+PREFILL_SERVICE_URL_ENV = "PREFILL_SERVICE_URL"  # PD decode -> prefill pool
 FINE_TUNED_WEIGHT_INFO_ENV = "FINE_TUNED_WEIGHT_INFO"
 
 # libtpu / GKE podslice rendezvous contract (replaces NCCL_*/GLOO_* env)

@@ -280,6 +280,13 @@ def build_component(ctx: BuildContext, component: str,
         constants.MODEL_PATH_ENV: model_mount_path(ctx.model, ctx.model_name),
         constants.SERVED_MODEL_NAME_ENV: ctx.model_name,
     }
+    if component == v1.DECODER:
+        # PD decode nodes fetch KV from the prefill (engine) pool —
+        # resolve its cluster-local service (engine/pd.py contract)
+        subst[constants.PREFILL_SERVICE_URL_ENV] = (
+            f"http://{constants.engine_name(isvc.metadata.name)}."
+            f"{isvc.metadata.namespace}.svc.cluster.local:"
+            f"{constants.ENGINE_PORT}")
     for pod in filter(None, (base_pod, worker_pod)):
         for c in pod.containers:
             env = {**subst, **{e.name: e.value or "" for e in c.env}}

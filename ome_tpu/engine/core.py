@@ -55,54 +55,126 @@ def _bucketize(n: int, buckets: List[int]) -> int:
 
 
 class PrefixCache:
-    """LRU of prompt-prefix KV (device arrays).
+    """Radix (token-block trie) cache of prompt-prefix KV with an HBM
+    byte budget.
 
-    Coarse-grained prefix caching: after a prefill, the full prompt's
-    KV stays cached; a later prompt sharing that prefix (same system
-    prompt, a continuing conversation) prefills only its suffix.
-    Entries hold [L, 1, bucket, K, Dh] device buffers — size the
-    capacity to HBM headroom (bytes/entry ≈ 2 * L*bucket*K*Dh * 2).
+    Prompts are split into fixed token BLOCKS; each trie node owns one
+    block's KV slice ([L, 1, block, K, Dh] device buffers). Sibling
+    prompts therefore share every common leading block — a prompt that
+    diverges halfway through a cached entry still reuses the shared
+    half (the sharing the sglang-router's cache-aware steering relies
+    on, round-2 review weak #5). Eviction is byte-accounted LRU over
+    leaf nodes: total device bytes never exceed `capacity_bytes`
+    regardless of entry count or sequence lengths.
+
+    A hit returns the concatenated leading blocks, so suffix-prefill
+    `keep` lengths are block multiples (bounded recompilation:
+    max_seq/block variants).
     """
 
-    def __init__(self, capacity: int = 8, min_prefix: int = 16):
-        from collections import OrderedDict
-        self.capacity = capacity
+    def __init__(self, capacity_bytes: int = 0, block: int = 32,
+                 min_prefix: int = 16):
+        self.capacity_bytes = capacity_bytes
+        self.block = block
         self.min_prefix = min_prefix
-        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._root: Dict[tuple, dict] = {}
+        self._tick = 0
+        self.bytes = 0
         self.hits = 0
         self.misses = 0
 
-    def put(self, ids, k, v, true_len: int, bucket: int):
-        if self.capacity <= 0 or true_len < self.min_prefix:
-            return
-        key = tuple(ids)
-        self._entries.pop(key, None)
-        self._entries[key] = (k, v, true_len, bucket)
-        while len(self._entries) > self.capacity:
-            self._entries.popitem(last=False)
+    def _leaf_bytes(self, k, v) -> int:
+        return k.nbytes + v.nbytes
 
-    def match(self, ids) -> Optional[tuple]:
-        """Longest cached STRICT prefix of `ids` (the last prompt token
-        must re-run so its logits exist for sampling)."""
-        if self.capacity <= 0:
+    def put(self, ids, k, v, true_len: int, bucket: int):
+        """Store the KV of `ids[:true_len]` block by block. k/v:
+        [L, 1, S>=true_len, K, D*] device arrays (rows past true_len
+        are padding and never stored)."""
+        if self.capacity_bytes <= 0 or true_len < self.min_prefix:
+            return
+        node_map = self._root
+        self._tick += 1
+        for off in range(0, (true_len // self.block) * self.block,
+                         self.block):
+            key = tuple(ids[off:off + self.block])
+            node = node_map.get(key)
+            if node is None:
+                ks = k[:, :, off:off + self.block]
+                vs = v[:, :, off:off + self.block]
+                node = {"kv": (ks, vs), "children": {},
+                        "last": self._tick}
+                node_map[key] = node
+                self.bytes += self._leaf_bytes(ks, vs)
+            node["last"] = self._tick
+            node_map = node["children"]
+        self._evict()
+
+    def _evict(self):
+        """Drop least-recently-used LEAF nodes until within budget
+        (parents stay useful for the prompts that still share them).
+        One DFS collects every current leaf; evicting a leaf can
+        expose its parent as a new leaf, so loop (bounded by trie
+        depth) only if a whole pass wasn't enough."""
+        while self.bytes > self.capacity_bytes:
+            leaves = []
+            stack = [self._root]
+            while stack:
+                node_map = stack.pop()
+                for key, node in node_map.items():
+                    if node["children"]:
+                        stack.append(node["children"])
+                    else:
+                        leaves.append((node["last"], node_map, key,
+                                       node))
+            if not leaves:
+                return
+            leaves.sort(key=lambda t: t[0])
+            for _, parent_map, key, node in leaves:
+                if self.bytes <= self.capacity_bytes:
+                    return
+                self.bytes -= self._leaf_bytes(*node["kv"])
+                del parent_map[key]
+
+    def match(self, ids, usable=None) -> Optional[tuple]:
+        """Longest cached STRICT prefix of `ids` in whole blocks (the
+        last prompt token must re-run so its logits exist for
+        sampling). Returns (k, v, eff, eff) with k/v concatenated over
+        the matched blocks.
+
+        `usable(eff) -> bool` lets the caller veto prefix lengths its
+        downstream budget cannot use (e.g. prefix + suffix bucket
+        overflowing the largest prefill bucket) BEFORE the hit is
+        counted and recency refreshed — shorter candidates are tried
+        block by block."""
+        if self.capacity_bytes <= 0:
             return None
-        ids_t = tuple(ids)
-        best_key, best_eff = None, 0
-        for key, entry in self._entries.items():
-            # an exact repeat reuses all but the last token (its logits
-            # must be recomputed for sampling)
-            eff = min(entry[2], len(ids_t) - 1)
-            if eff < self.min_prefix:
-                continue
-            if ids_t[:eff] == key[:eff] and eff > best_eff:
-                best_key, best_eff = key, eff
-        if best_key is None:
+        limit = len(ids) - 1
+        node_map = self._root
+        slices = []
+        eff = 0
+        self._tick += 1
+        while eff + self.block <= limit:
+            key = tuple(ids[eff:eff + self.block])
+            node = node_map.get(key)
+            if node is None:
+                break
+            node["last"] = self._tick
+            slices.append(node["kv"])
+            eff += self.block
+            node_map = node["children"]
+        while slices and usable is not None and not usable(eff):
+            slices.pop()
+            eff -= self.block
+        if eff < self.min_prefix:
             self.misses += 1
             return None
         self.hits += 1
-        self._entries.move_to_end(best_key)
-        k, v, _, bucket = self._entries[best_key]
-        return (k, v, best_eff, bucket)
+        if len(slices) == 1:
+            k, v = slices[0]
+        else:
+            k = jnp.concatenate([s[0] for s in slices], axis=2)
+            v = jnp.concatenate([s[1] for s in slices], axis=2)
+        return (k, v, eff, eff)
 
 
 class InferenceEngine:
@@ -111,7 +183,7 @@ class InferenceEngine:
     def __init__(self, params: Params, cfg: ModelConfig,
                  max_slots: int = 8, max_seq: Optional[int] = None,
                  prefill_buckets: Optional[List[int]] = None,
-                 prefix_cache_size: int = 0):
+                 prefix_cache_bytes: int = 0):
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -123,19 +195,14 @@ class InferenceEngine:
                 b *= 2
             prefill_buckets.append(self.max_seq)
         self.prefill_buckets = prefill_buckets
-        self.prefix_cache = PrefixCache(prefix_cache_size)
+        self.prefix_cache = PrefixCache(prefix_cache_bytes)
 
         cfg_ = cfg
 
         @functools.partial(jax.jit, static_argnames=("bucket",))
         def _prefill(params, padded: jax.Array, true_len: jax.Array,
                      temperature, top_k, top_p, key, bucket: int):
-            cache = llama.KVCache(
-                k=jnp.zeros((cfg_.num_layers, 1, bucket, cfg_.num_kv_heads,
-                             cfg_.head_dim), cfg_.dtype),
-                v=jnp.zeros((cfg_.num_layers, 1, bucket, cfg_.num_kv_heads,
-                             cfg_.head_dim), cfg_.dtype),
-                index=jnp.zeros((), jnp.int32))
+            cache = llama.KVCache.create(cfg_, 1, bucket)
             logits, new_cache = llama.forward(params, cfg_, padded,
                                               cache=cache)
             # last REAL token's logits (right padding occupies the tail)
@@ -155,13 +222,13 @@ class InferenceEngine:
             (positions continue at prefix_len). Rows past the valid
             lengths hold stale data — kv_len masking makes them
             unreachable."""
-            shape = (cfg_.num_layers, 1, total_bucket,
-                     cfg_.num_kv_heads, cfg_.head_dim)
+            base = (cfg_.num_layers, 1, total_bucket,
+                    cfg_.kv_cache_heads)
             k0 = lax.dynamic_update_slice(
-                jnp.zeros(shape, cfg_.dtype),
+                jnp.zeros(base + (cfg_.kv_cache_k_dim,), cfg_.dtype),
                 prefix_k[:, :, :keep], (0, 0, 0, 0, 0))
             v0 = lax.dynamic_update_slice(
-                jnp.zeros(shape, cfg_.dtype),
+                jnp.zeros(base + (cfg_.kv_cache_v_dim,), cfg_.dtype),
                 prefix_v[:, :, :keep], (0, 0, 0, 0, 0))
             cache = llama.KVCache(k=k0, v=v0, index=prefix_len)
             logits, new_cache = llama.forward(params, cfg_, padded,
@@ -202,15 +269,25 @@ class InferenceEngine:
         self._decode_fn = _decode
         self._step = 0
         self._root_key = jax.random.PRNGKey(0)
+        # prefill (admission thread) and decode (scheduler thread) both
+        # draw keys; the counter bump must be atomic for distinct keys
+        import threading
+        self._rng_lock = threading.Lock()
+
+    def _next_key(self):
+        with self._rng_lock:
+            self._step += 1
+            return jax.random.fold_in(self._root_key, self._step)
 
     # -- state ---------------------------------------------------------
 
     def new_state(self) -> DecodeState:
-        L, B, S = self.cfg.num_layers, self.max_slots, self.max_seq
-        shape = (L, B, S, self.cfg.num_kv_heads, self.cfg.head_dim)
+        cfg = self.cfg
+        L, B, S = cfg.num_layers, self.max_slots, self.max_seq
+        base = (L, B, S, cfg.kv_cache_heads)
         return DecodeState(
-            k=jnp.zeros(shape, self.cfg.dtype),
-            v=jnp.zeros(shape, self.cfg.dtype),
+            k=jnp.zeros(base + (cfg.kv_cache_k_dim,), cfg.dtype),
+            v=jnp.zeros(base + (cfg.kv_cache_v_dim,), cfg.dtype),
             lengths=jnp.zeros((B,), jnp.int32),
             tokens=jnp.zeros((B,), jnp.int32))
 
@@ -226,20 +303,37 @@ class InferenceEngine:
         # leave room for one generated token; cap at the largest bucket
         max_prompt = min(self.max_seq - 1, self.prefill_buckets[-1])
         ids = prompt_ids[-max_prompt:]
-        self._step += 1
-        key = jax.random.fold_in(self._root_key, self._step)
+        key = self._next_key()
         sampling = (np.asarray([temperature], np.float32),
                     np.asarray([top_k], np.int32),
                     np.asarray([top_p], np.float32))
 
-        hit = self.prefix_cache.match(ids)
+        def _pow2_keep(plen: int) -> int:
+            # quantize the reused prefix length to a power of two:
+            # `keep` is a STATIC jit arg, so arbitrary block multiples
+            # would compile a fresh _prefill_suffix program per length
+            # (seconds each on TPU); powers of two bound the compile
+            # space to ~log2(max_seq) x len(buckets) variants
+            return 1 << (max(plen, 1).bit_length() - 1)
+
+        def _usable(plen: int) -> bool:
+            k = _pow2_keep(plen)
+            # quantized prefix + bucketized suffix must fit the
+            # largest bucket
+            return (k >= self.prefix_cache.min_prefix
+                    and k + _bucketize(len(ids) - k,
+                                       self.prefill_buckets)
+                    <= self.prefill_buckets[-1])
+
+        hit = self.prefix_cache.match(ids, usable=_usable)
         if hit is not None:
-            pk, pv, plen, pbucket = hit
+            pk, pv, plen, _pbucket = hit
+            plen = _pow2_keep(plen)  # discard the ragged tail blocks
+            # slice to the quantized length HOST-side: the arrays'
+            # shapes are part of the jit compile key too
+            pk, pv = pk[:, :, :plen], pv[:, :, :plen]
             suffix = ids[plen:]
             sbucket = _bucketize(len(suffix), self.prefill_buckets)
-            if plen + sbucket > self.prefill_buckets[-1]:
-                hit = None  # prefix + suffix overflows: full prefill
-        if hit is not None:
             bucket = _bucketize(plen + sbucket, self.prefill_buckets)
             padded = np.asarray(
                 [suffix + [0] * (sbucket - len(suffix))], np.int32)
@@ -247,7 +341,7 @@ class InferenceEngine:
                 self.params, pk, pv, np.asarray(plen, np.int32),
                 padded, np.asarray([len(suffix)], np.int32),
                 *sampling, key, total_bucket=bucket,
-                keep=min(pbucket, bucket))
+                keep=min(plen, bucket))
         else:
             bucket = _bucketize(len(ids), self.prefill_buckets)
             padded = np.asarray(
@@ -271,8 +365,7 @@ class InferenceEngine:
     def decode(self, state: DecodeState, temperature, top_k, top_p,
                ) -> Tuple[DecodeState, jax.Array]:
         """One decode step for ALL slots. Sampling params: [B] arrays."""
-        self._step += 1
-        key = jax.random.fold_in(self._root_key, self._step)
+        key = self._next_key()
         return self._decode_fn(self.params, state,
                                np.asarray(temperature, np.float32),
                                np.asarray(top_k, np.int32),

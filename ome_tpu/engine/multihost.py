@@ -210,6 +210,19 @@ class ReplicatedEngine:
 
     def prefill(self, prompt_ids, temperature: float = 0.0,
                 top_k: int = 0, top_p: float = 1.0):
+        blob_fn = getattr(self._engine, "prefill_blob", None)
+        if blob_fn is not None:
+            # PD decode group: the leader fetches the KV wire blob
+            # ONCE and ships the bytes to followers — a follower
+            # re-fetching could draw a different sampled token on the
+            # prefill node (its RNG advances per request)
+            import base64
+            blob = blob_fn(prompt_ids, temperature, top_k, top_p)
+            self._pub.send({"op": "prefill_blob",
+                            "blob": base64.b64encode(blob).decode()})
+            from .pd import deserialize_kv
+            token, k, v, true_len, bucket = deserialize_kv(blob)
+            return token, (k, v), true_len, bucket
         self._pub.send({"op": "prefill", "ids": list(map(int, prompt_ids)),
                         "temperature": float(temperature),
                         "top_k": int(top_k), "top_p": float(top_p)})
@@ -259,6 +272,14 @@ def follower_loop(engine, sub: OpSubscriber) -> int:
             last_prefill = engine.prefill(
                 msg["ids"], msg["temperature"], msg["top_k"],
                 msg["top_p"])
+        elif op == "prefill_blob":
+            # PD decode group: the leader shipped the prefill pool's
+            # KV bytes; deserialize locally — no fetch, no compute
+            import base64
+            from .pd import deserialize_kv
+            token, k, v, true_len, bucket = deserialize_kv(
+                base64.b64decode(msg["blob"]))
+            last_prefill = (token, (k, v), true_len, bucket)
         elif op == "insert":
             tok, kv, _true_len, _bucket = last_prefill
             state = engine.insert(state, kv, msg["slot"],

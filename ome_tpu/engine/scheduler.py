@@ -3,8 +3,21 @@
 Host-side orchestration around InferenceEngine's three compiled
 programs: admit pending requests into free slots (prefill + insert),
 then run decode steps for the whole batch, streaming tokens out to
-per-request queues. One scheduler thread drives the device; request
+per-request queues. One scheduler thread drives decode; request
 threads (HTTP handlers) only touch queues.
+
+Prefill/decode overlap (the JetStream separation, round-2 review
+weak #3): prefill runs on a dedicated admission thread, so the decode
+cadence never waits for a prefill to COMPLETE — the admission thread
+blocks on the prefill result (and, in PD-disaggregated decode mode, on
+the remote KV fetch) while the scheduler thread keeps stepping the
+batch; `insert` is the only synchronization point. A slot semaphore
+paces admission: the thread holds at most max_slots in-flight
+prefills, and a finished request releases its slot back.
+
+Multi-host leaders (engine/multihost.ReplicatedEngine) disable the
+overlap: followers replay the leader's op stream strictly in order, so
+ops must be published from one thread in execution order.
 """
 
 from __future__ import annotations
@@ -61,17 +74,26 @@ class Request:
 class Scheduler:
     """Drives one InferenceEngine; thread-safe submit()."""
 
-    def __init__(self, engine: InferenceEngine, max_pending: int = 512):
+    # overlap is opt-in (serve.py enables it for single-host serving):
+    # it needs the admission thread from start(), while tests and
+    # multi-host leaders drive step() synchronously
+    def __init__(self, engine: InferenceEngine, max_pending: int = 512,
+                 overlap: bool = False):
         self.engine = engine
         self.state: DecodeState = engine.new_state()
         self.pending: "queue.Queue[Request]" = queue.Queue(max_pending)
         self.slots: List[Optional[Request]] = [None] * engine.max_slots
         B = engine.max_slots
+        self.overlap = overlap
+        # prefilled-and-awaiting-insert items from the admission thread
+        self._ready: "queue.Queue[tuple]" = queue.Queue()
+        self._free_slots = threading.Semaphore(B)
         self._temp = np.zeros(B, np.float32)
         self._top_k = np.zeros(B, np.int32)
         self._top_p = np.ones(B, np.float32)
         self._true_len = np.zeros(B, np.int32)  # admitted prompt len/slot
         self._thread: Optional[threading.Thread] = None
+        self._admit_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()  # guards submit-vs-stop + stats
         self.healthy = True
@@ -106,12 +128,19 @@ class Scheduler:
         self._thread = threading.Thread(target=self._run,
                                         name="ome-scheduler", daemon=True)
         self._thread.start()
+        if self.overlap:
+            self._admit_thread = threading.Thread(
+                target=self._admit_loop, name="ome-admission",
+                daemon=True)
+            self._admit_thread.start()
 
     def stop(self):
         with self._lock:
             self._stop.set()
         if self._thread:
             self._thread.join(timeout=10)
+        if self._admit_thread:
+            self._admit_thread.join(timeout=10)
         self._fail_all("shutdown")
 
     def _fail_all(self, reason: str):
@@ -121,31 +150,117 @@ class Scheduler:
                     self.pending.get_nowait().finish(reason)
                 except queue.Empty:
                     break
+            while True:
+                try:
+                    item = self._ready.get_nowait()
+                except queue.Empty:
+                    break
+                item[0].finish(reason)
+                self._free_slots.release()
             for slot, r in enumerate(self.slots):
                 if r is not None:
                     self.slots[slot] = None
                     r.finish(reason)
+                    if self.overlap:
+                        self._free_slots.release()
 
     # -- core loop -----------------------------------------------------
 
     def step(self) -> bool:
         """One admission + decode round; returns True if work was done.
 
-        Prefill/decode interleaving (the JetStream slicing pattern, per
-        the round-1 review): while streams are active, at most ONE
-        prefill is admitted per decode step, so a burst of long prompts
-        adds bounded latency to in-flight streams instead of stalling
-        them for the whole burst. An idle batch admits up to every free
-        slot at once — there is nothing to stall.
+        Overlap mode inserts whatever the admission thread finished
+        prefilling since the last step (insert is cheap — one compiled
+        dynamic_update_slice). Synchronous mode (multi-host leaders)
+        admits at most ONE prefill per decode step while streams are
+        active — the JetStream slicing pattern — so a burst of long
+        prompts adds bounded latency instead of stalling the batch.
         """
-        active = any(r is not None for r in self.slots)
-        admitted = self._admit(limit=1 if active else None)
+        if self.overlap:
+            admitted = self._insert_ready()
+        else:
+            active = any(r is not None for r in self.slots)
+            admitted = self._admit(limit=1 if active else None)
         decoded = self._decode()
         with self._lock:
             self.stats["queue_depth"] = self.pending.qsize()
             self.stats["active_slots"] = sum(
                 r is not None for r in self.slots)
         return admitted or decoded
+
+    # -- overlap mode: admission thread prefills, step() inserts -------
+
+    def _admit_loop(self):
+        while not self._stop.is_set() and self.healthy:
+            # slot credit first: at most max_slots prefills in flight
+            # ahead of their inserts
+            if not self._free_slots.acquire(timeout=0.05):
+                continue
+            try:
+                req = self.pending.get(timeout=0.05)
+            except queue.Empty:
+                self._free_slots.release()
+                continue
+            try:
+                tok, kv, true_len, bucket = self.engine.prefill(
+                    req.prompt_ids, req.temperature, req.top_k,
+                    req.top_p)
+            except Exception as e:  # noqa: BLE001
+                import logging
+                # engines that fetch prefill remotely (PD decode
+                # nodes) declare which errors are TRANSIENT — a peer
+                # restarting mid-rollout fails one request, not every
+                # in-flight stream on this node
+                transient = getattr(self.engine,
+                                    "transient_prefill_errors", ())
+                if transient and isinstance(e, transient):
+                    logging.getLogger("ome.engine").warning(
+                        "transient prefill failure for request %s: %s",
+                        req.id, e)
+                    req.finish("error")
+                    self._free_slots.release()
+                    continue
+                # local engine faults keep the fail-fast contract: no
+                # waiter may observe a healthy scheduler after its
+                # request failed
+                logging.getLogger("ome.engine").exception(
+                    "prefill failed; failing scheduler")
+                self.healthy = False
+                req.finish("error")
+                self._free_slots.release()
+                return
+            self._inc("prefill_total")
+            # under _lock so a prefill that outlives stop()'s join or a
+            # scheduler-thread death (e.g. a slow remote PD fetch)
+            # cannot strand its request in _ready after _fail_all
+            # drained it — the waiter would hang forever
+            with self._lock:
+                if self._stop.is_set() or not self.healthy:
+                    req.finish("shutdown" if self._stop.is_set()
+                               else "error")
+                    self._free_slots.release()
+                    return
+                self._ready.put((req, tok, kv, true_len, bucket))
+
+    def _insert_ready(self) -> bool:
+        did = False
+        while True:
+            try:
+                req, tok, kv, true_len, bucket = self._ready.get_nowait()
+            except queue.Empty:
+                break
+            slot = self.slots.index(None)  # semaphore guarantees one
+            self.state = self.engine.insert(
+                self.state, kv, slot, true_len, tok, bucket)
+            self.slots[slot] = req
+            self._temp[slot] = req.temperature
+            self._top_k[slot] = req.top_k
+            self._top_p[slot] = req.top_p
+            self._true_len[slot] = true_len
+            req.emit(tok)
+            self._maybe_finish(slot, tok)
+            did = True
+        return did
 
     def _admit(self, limit: Optional[int] = None) -> bool:
         did = False
@@ -217,10 +332,16 @@ class Scheduler:
         self.slots[slot] = None
         self._temp[slot] = 0.0
         req.finish(reason)
+        if self.overlap:
+            self._free_slots.release()
 
     def _run(self):
         while not self._stop.is_set():
             try:
+                if not self.healthy:
+                    # the admission thread died; fail waiters fast
+                    self._fail_all("error")
+                    return
                 if not self.step():
                     time.sleep(0.001)
             except Exception:  # noqa: BLE001 — a dead loop must not

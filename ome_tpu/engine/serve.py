@@ -51,14 +51,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--adapter", default=None,
                    help="PEFT LoRA adapter dir merged into the base "
                         "weights at load (FineTunedWeight serving)")
-    p.add_argument("--prefix-cache", type=int, default=8,
-                   help="prompt-prefix KV cache entries (0 disables); "
-                        "repeat prompts/conversations prefill only "
+    p.add_argument("--prefix-cache-mb", type=int, default=256,
+                   help="HBM byte budget (MiB) for the radix prompt-"
+                        "prefix KV cache (0 disables); prompts sharing "
+                        "cached leading token blocks prefill only "
                         "their suffix")
     p.add_argument("--control-port", type=int, default=None,
                    help="leader->follower op-replication port for "
                         "multi-host serving (default: engine/multihost "
                         "CONTROL_PORT)")
+    p.add_argument("--disaggregation-mode",
+                   choices=("none", "prefill", "decode"), default="none",
+                   help="PD-disaggregated serving role: 'prefill' "
+                        "exports KV over /pd/prefill; 'decode' fetches "
+                        "KV from --prefill-peer instead of computing "
+                        "prefill locally")
+    p.add_argument("--prefill-peer", default=None,
+                   help="prefill pool URL (required for "
+                        "--disaggregation-mode decode)")
     return p
 
 
@@ -130,12 +140,12 @@ def load_engine(args, dist=None):
         return ShardedInferenceEngine(params, cfg, tp=args.tp,
                                       max_slots=args.max_slots,
                                       max_seq=max_seq,
-                                      prefix_cache_size=args.prefix_cache)
+                                      prefix_cache_bytes=args.prefix_cache_mb << 20)
     import jax
     params = jax.tree.map(jnp.asarray, params)  # one transfer
     return InferenceEngine(params, cfg, max_slots=args.max_slots,
                            max_seq=max_seq,
-                           prefix_cache_size=args.prefix_cache)
+                           prefix_cache_bytes=args.prefix_cache_mb << 20)
 
 
 class _NullScheduler:
@@ -143,6 +153,7 @@ class _NullScheduler:
 
     healthy = True
     stats: dict = {}
+    reject = "this deployment serves embeddings only"
 
     def start(self):
         pass
@@ -151,7 +162,18 @@ class _NullScheduler:
         pass
 
     def submit(self, req):
-        raise RuntimeError("this deployment serves embeddings only")
+        raise RuntimeError(self.reject)
+
+
+class _PrefillNodeScheduler(_NullScheduler):
+    """PD prefill nodes have no decode loop; /v1/* is rejected and the
+    work arrives via /pd/prefill instead."""
+
+    reject = ("this node serves PD prefill only (route completions to "
+              "the decode pool)")
+
+    def __init__(self, engine):
+        self.engine = engine
 
 
 def load_embedder(args):
@@ -191,6 +213,9 @@ def main(argv=None) -> int:
         log.error("--task embed does not support multi-host serving "
                   "(unset JAX_COORDINATOR_ADDRESS or use one process)")
         return 2
+    if args.disaggregation_mode == "decode" and not args.prefill_peer:
+        log.error("--disaggregation-mode decode requires --prefill-peer")
+        return 2
 
     if dist is not None and not dist.is_leader:
         # followers never serve HTTP: they join the mesh, then replay
@@ -207,21 +232,42 @@ def main(argv=None) -> int:
             sub.close()
 
     embedder = None
+    pd_prefill = None
     if args.task == "embed":
         embedder = load_embedder(args)
         scheduler = _NullScheduler()
+    elif args.disaggregation_mode == "prefill":
+        from .pd import make_pd_prefill_handler
+        engine = load_engine(args, dist)
+        if dist is not None:
+            # multi-host prefill pool: every /pd/prefill compute runs
+            # SPMD across the group via the same op replication the
+            # generation leader uses
+            pub = multihost.OpPublisher(dist.num_processes - 1,
+                                        port=control_port)
+            engine = multihost.ReplicatedEngine(engine, pub)
+        pd_prefill = make_pd_prefill_handler(engine)
+        scheduler = _PrefillNodeScheduler(engine)
     else:
         engine = load_engine(args, dist)
+        if args.disaggregation_mode == "decode":
+            from .pd import RemotePrefillEngine
+            engine = RemotePrefillEngine(engine, args.prefill_peer)
+            log.info("PD decode node: prefill via %s", args.prefill_peer)
         if dist is not None:
             pub = multihost.OpPublisher(dist.num_processes - 1,
                                         port=control_port)
             engine = multihost.ReplicatedEngine(engine, pub)
-        scheduler = Scheduler(engine)
+        # prefill/decode overlap is single-host only: multi-host
+        # leaders publish ops from ONE thread in execution order
+        # (followers replay strictly sequentially); on PD decode nodes
+        # it moves the remote KV fetch off the decode thread
+        scheduler = Scheduler(engine, overlap=dist is None)
     tok = load_tokenizer(args.model_dir)
     name = args.model_name or args.model_dir.rstrip("/").rsplit("/", 1)[-1]
     server = EngineServer(scheduler, tokenizer=tok, model_name=name,
                           host=args.host, port=args.port,
-                          embedder=embedder)
+                          embedder=embedder, pd_prefill=pd_prefill)
     log.info("serving %s on %s:%d (%s)", name, args.host, server.port,
              "embeddings" if embedder else
              f"slots={scheduler.engine.max_slots}")

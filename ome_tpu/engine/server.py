@@ -24,11 +24,12 @@ from .tokenizer import load_tokenizer
 class EngineServer:
     def __init__(self, scheduler: Scheduler, tokenizer=None,
                  model_name: str = "ome-model", host: str = "127.0.0.1",
-                 port: int = 0, embedder=None):
+                 port: int = 0, embedder=None, pd_prefill=None):
         self.scheduler = scheduler
         self.tokenizer = tokenizer or load_tokenizer()
         self.model_name = model_name
         self.embedder = embedder  # engine/embed.py EmbeddingEngine
+        self.pd_prefill = pd_prefill  # engine/pd.py prefill-node handler
         self.started_at = time.time()
         outer = self
 
@@ -91,7 +92,26 @@ class EngineServer:
                     return self._complete(payload, chat=True)
                 if self.path == "/v1/embeddings":
                     return self._embeddings(payload)
+                if self.path == "/pd/prefill":
+                    return self._pd_prefill(payload)
                 self._json(404, {"error": "not found"})
+
+            def _pd_prefill(self, payload):
+                if outer.pd_prefill is None:
+                    return self._json(404, {
+                        "error": "this node does not serve PD prefill "
+                                 "(--disaggregation-mode prefill)"})
+                try:
+                    blob = outer.pd_prefill(payload)
+                except Exception as e:  # noqa: BLE001 — surface to the
+                    # decode node, which fails the one request
+                    return self._json(500, {"error": str(e)})
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
 
             def _embeddings(self, payload):
                 if outer.embedder is None:

@@ -40,8 +40,8 @@ class ShardedInferenceEngine(InferenceEngine):
                  max_slots: int = 8, max_seq: Optional[int] = None,
                  prefill_buckets: Optional[List[int]] = None,
                  mesh: Optional[Mesh] = None,
-                 prefix_cache_size: int = 0):
-        if cfg.num_kv_heads % tp != 0:
+                 prefix_cache_bytes: int = 0):
+        if not cfg.mla and cfg.num_kv_heads % tp != 0:
             raise ValueError(
                 f"tp={tp} must divide num_kv_heads={cfg.num_kv_heads} "
                 f"(KV cache shards on the head dim)")
@@ -53,22 +53,30 @@ class ShardedInferenceEngine(InferenceEngine):
         params = shard_params(params, self.mesh)
         super().__init__(params, cfg, max_slots=max_slots, max_seq=max_seq,
                          prefill_buckets=prefill_buckets,
-                         prefix_cache_size=prefix_cache_size)
+                         prefix_cache_bytes=prefix_cache_bytes)
 
     def _kv_sharding(self) -> NamedSharding:
-        # [L, B, S, K, Dh]: KV heads on tp
+        # [L, B, S, K, Dh]: KV heads on tp. MLA caches ONE latent head
+        # (kv_cache_heads == 1) — replicated; the latent cache is tiny
+        # (kv_lora_rank+rope per token) so replication is the right
+        # trade vs collectives in the absorbed decode path
+        if self.cfg.mla:
+            return self._replicated()
         return NamedSharding(self.mesh, P(None, None, None, "tp", None))
 
     def _replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 
     def new_state(self) -> DecodeState:
-        L, B, S = self.cfg.num_layers, self.max_slots, self.max_seq
-        shape = (L, B, S, self.cfg.num_kv_heads, self.cfg.head_dim)
+        cfg = self.cfg
+        L, B, S = cfg.num_layers, self.max_slots, self.max_seq
+        base = (L, B, S, cfg.kv_cache_heads)
         kv = self._kv_sharding()
         rep = self._replicated()
         return DecodeState(
-            k=jax.device_put(jnp.zeros(shape, self.cfg.dtype), kv),
-            v=jax.device_put(jnp.zeros(shape, self.cfg.dtype), kv),
+            k=jax.device_put(
+                jnp.zeros(base + (cfg.kv_cache_k_dim,), cfg.dtype), kv),
+            v=jax.device_put(
+                jnp.zeros(base + (cfg.kv_cache_v_dim,), cfg.dtype), kv),
             lengths=jax.device_put(jnp.zeros((B,), jnp.int32), rep),
             tokens=jax.device_put(jnp.zeros((B,), jnp.int32), rep))

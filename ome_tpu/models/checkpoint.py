@@ -174,10 +174,12 @@ class _Stacker:
         self.dtype = dtype
         self.out: Dict[str, np.ndarray] = {}
 
-    def put(self, key: str, layer: int, arr: np.ndarray) -> None:
+    def put(self, key: str, layer: int, arr: np.ndarray,
+            dtype: Optional[np.dtype] = None) -> None:
+        dt = dtype or self.dtype
         if key not in self.out:
-            self.out[key] = np.empty((self.L,) + arr.shape, self.dtype)
-        self.out[key][layer] = arr.astype(self.dtype)
+            self.out[key] = np.empty((self.L,) + arr.shape, dt)
+        self.out[key][layer] = arr.astype(dt)
 
 
 def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
@@ -186,11 +188,19 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
     HF linear weights are [out, in] (y = W x); the model's einsums take
     [in, out]-shaped factors, so every projection transposes, and
     attention projections reshape the fused head dim into [heads, Dh].
+
+    DeepSeek (MLA) checkpoints additionally split kv_b_proj into the
+    absorbed-path factors w_uk/w_uv, and route the first_k_dense
+    leading layers into a separate "dense_layers" stack.
     """
     np_dt = _np_dtype(dtype or "bfloat16")
     L, D, H, K, Dh = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
                       cfg.num_kv_heads, cfg.head_dim)
-    st = _Stacker(L, np_dt)
+    mla = getattr(cfg, "mla", False)
+    n_dense = cfg.first_k_dense if (cfg.is_moe
+                                    and cfg.first_k_dense) else 0
+    st_main = _Stacker(L - n_dense, np_dt)
+    st_dense = _Stacker(n_dense, np_dt) if n_dense else None
 
     def take(name: str) -> np.ndarray:
         if name not in ckpt and name.startswith("model."):
@@ -202,8 +212,13 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
     def linear_in_out(name: str) -> np.ndarray:
         return take(name).T  # [out,in] -> [in,out]
 
-    for i in range(L):
-        p = f"model.layers.{i}."
+    for li in range(L):
+        p = f"model.layers.{li}."
+        if li < n_dense:
+            st, i = st_dense, li
+        else:
+            st, i = st_main, li - n_dense
+        layer_is_moe = cfg.is_moe and li >= n_dense
         st.put("attn_norm", i, take(p + "input_layernorm.weight"))
         if getattr(cfg, "post_block_norms", False):
             # gemma2 block: post_attention_layernorm normalizes the
@@ -218,14 +233,43 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
         else:
             st.put("mlp_norm", i,
                    take(p + "post_attention_layernorm.weight"))
-        st.put("wq", i,
-               take(p + "self_attn.q_proj.weight").T.reshape(D, H, Dh))
-        st.put("wk", i,
-               take(p + "self_attn.k_proj.weight").T.reshape(D, K, Dh))
-        st.put("wv", i,
-               take(p + "self_attn.v_proj.weight").T.reshape(D, K, Dh))
-        st.put("wo", i,
-               take(p + "self_attn.o_proj.weight").T.reshape(H, Dh, D))
+        if mla:
+            qk = cfg.qk_nope_head_dim + cfg.qk_rope_head_dim
+            r, vd = cfg.kv_lora_rank, cfg.v_head_dim
+            if cfg.q_lora_rank:
+                st.put("wq_a", i,
+                       linear_in_out(p + "self_attn.q_a_proj.weight"))
+                st.put("q_a_norm", i,
+                       take(p + "self_attn.q_a_layernorm.weight"))
+                st.put("wq_b", i,
+                       take(p + "self_attn.q_b_proj.weight").T.reshape(
+                           cfg.q_lora_rank, H, qk))
+            else:
+                st.put("wq", i,
+                       take(p + "self_attn.q_proj.weight").T.reshape(
+                           D, H, qk))
+            st.put("wkv_a", i, linear_in_out(
+                p + "self_attn.kv_a_proj_with_mqa.weight"))
+            st.put("kv_a_norm", i,
+                   take(p + "self_attn.kv_a_layernorm.weight"))
+            # kv_b_proj [H*(nope+v), r] carries both absorbed factors
+            kv_b = take(p + "self_attn.kv_b_proj.weight").reshape(
+                H, cfg.qk_nope_head_dim + vd, r)
+            st.put("w_uk", i, kv_b[:, :cfg.qk_nope_head_dim])
+            st.put("w_uv", i,
+                   kv_b[:, cfg.qk_nope_head_dim:].transpose(0, 2, 1))
+            st.put("wo", i,
+                   take(p + "self_attn.o_proj.weight").T.reshape(
+                       H, vd, D))
+        else:
+            st.put("wq", i,
+                   take(p + "self_attn.q_proj.weight").T.reshape(D, H, Dh))
+            st.put("wk", i,
+                   take(p + "self_attn.k_proj.weight").T.reshape(D, K, Dh))
+            st.put("wv", i,
+                   take(p + "self_attn.v_proj.weight").T.reshape(D, K, Dh))
+            st.put("wo", i,
+                   take(p + "self_attn.o_proj.weight").T.reshape(H, Dh, D))
         if getattr(cfg, "attn_bias", False):
             st.put("bq", i,
                    take(p + "self_attn.q_proj.bias").reshape(H, Dh))
@@ -236,7 +280,7 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
         if cfg.qk_norm:
             st.put("q_norm", i, take(p + "self_attn.q_norm.weight"))
             st.put("k_norm", i, take(p + "self_attn.k_norm.weight"))
-        if cfg.is_moe:
+        if layer_is_moe:
             # router: mixtral block_sparse_moe.gate / qwen-moe+deepseek
             # mlp.gate
             for rn in ("block_sparse_moe.gate.weight", "mlp.gate.weight"):
@@ -244,7 +288,13 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
                     st.put("router", i, linear_in_out(p + rn))
                     break
             else:
-                raise SafetensorsError(f"no MoE router for layer {i}")
+                raise SafetensorsError(f"no MoE router for layer {li}")
+            if getattr(cfg, "router_bias", False):
+                # selection bias stays fp32: bf16 rounding could flip
+                # expert choices
+                st.put("router_bias", i,
+                       take(p + "mlp.gate.e_score_correction_bias"),
+                       dtype=np.dtype(np.float32))
             gates, ups, downs = [], [], []
             for e in range(cfg.num_experts):
                 if f"{p}block_sparse_moe.experts.{e}.w1.weight" in ckpt:
@@ -279,8 +329,10 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
     params: Dict[str, Any] = {
         "embed": take("model.embed_tokens.weight").astype(np_dt),
         "final_norm": take("model.norm.weight").astype(np_dt),
-        "layers": st.out,
+        "layers": st_main.out,
     }
+    if st_dense is not None:
+        params["dense_layers"] = st_dense.out
     if not cfg.tie_word_embeddings:
         if "lm_head.weight" in ckpt:
             params["lm_head"] = linear_in_out(
@@ -292,11 +344,14 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
 
 # architectures whose math models/llama.py implements faithfully; a
 # config.json outside this list loads only with allow_unsupported
-# (e.g. DeepSeek V2/V3 uses MLA attention, Mllama adds cross-attention
-# vision layers — loading them here would produce garbage silently)
+# (e.g. Mllama adds cross-attention vision layers — loading it here
+# would produce garbage silently)
 SUPPORTED_ARCHITECTURES = frozenset({
     "LlamaForCausalLM", "MistralForCausalLM", "Qwen2ForCausalLM",
     "Qwen3ForCausalLM", "MixtralForCausalLM", "Gemma2ForCausalLM",
+    # MLA family (models/mla.py): DeepSeek-V2/V3; Kimi-K2 ships the
+    # DeepseekV3ForCausalLM architecture
+    "DeepseekV2ForCausalLM", "DeepseekV3ForCausalLM",
     # decoder embedding models (engine/embed.py): bare AutoModel
     # checkpoints whose tensors lack the "model." prefix
     "MistralModel", "Qwen2Model",

@@ -40,6 +40,25 @@ class ModelConfig:
     # "ragged" sorts tokens by expert and runs grouped matmuls
     # (lax.ragged_dot) — O(k/E) of the dense FLOPs, the serving path
     moe_impl: str = "dense"
+    # MLA (DeepSeek-V2/V3, Kimi-K2): compressed-KV attention — the KV
+    # cache stores per-token latents [kv_lora_rank + qk_rope_head_dim]
+    # instead of per-head K/V (models/mla.py)
+    mla: bool = False
+    q_lora_rank: Optional[int] = None
+    kv_lora_rank: int = 0
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int = 0
+    first_k_dense: int = 0     # leading dense layers before MoE blocks
+    # routing flavor: "mixtral" (softmax over the selected top-k),
+    # "softmax_v2" (full softmax, optional group-limited greedy),
+    # "sigmoid_v3" (sigmoid + selection bias + top-2-sum group scores)
+    router_scoring: str = "mixtral"
+    n_group: int = 0
+    topk_group: int = 0
+    routed_scaling_factor: float = 1.0
+    norm_topk_prob: bool = False
+    router_bias: bool = False  # e_score_correction_bias tensor present
     # attention extras
     sliding_window: Optional[int] = None
     attn_logit_softcap: Optional[float] = None
@@ -58,6 +77,37 @@ class ModelConfig:
     def is_moe(self) -> bool:
         return self.num_experts > 0
 
+    # KV-cache geometry (engine + KVCache.create): MLA caches ONE
+    # latent "head" of kv_lora_rank+rope dims and no separate V rows
+    @property
+    def kv_cache_heads(self) -> int:
+        return 1 if self.mla else self.num_kv_heads
+
+    @property
+    def kv_cache_k_dim(self) -> int:
+        if self.mla:
+            return self.kv_lora_rank + self.qk_rope_head_dim
+        return self.head_dim
+
+    @property
+    def kv_cache_v_dim(self) -> int:
+        return 0 if self.mla else self.head_dim
+
+    @property
+    def mla_scale(self) -> float:
+        """qk_head_dim**-0.5, yarn-mscale-corrected when rope_scaling
+        carries mscale_all_dim (DeepseekV3Attention.__init__)."""
+        s = (self.qk_nope_head_dim + self.qk_rope_head_dim) ** -0.5
+        rs = self.rope_scaling or {}
+        mscale_all = rs.get("mscale_all_dim", 0)
+        if mscale_all:
+            factor = rs.get("factor", 1.0)
+            if factor > 1.0:
+                import math
+                m = 0.1 * mscale_all * math.log(factor) + 1.0
+                s *= m * m
+        return s
+
     def replace(self, **kw) -> "ModelConfig":
         return dataclasses.replace(self, **kw)
 
@@ -70,6 +120,30 @@ class ModelConfig:
         heads = cfg.get("num_attention_heads", 32)
         archs = cfg.get("architectures") or [""]
         arch = archs[0]
+        deepseek = arch.startswith("Deepseek")
+        mla_kw = {}
+        if deepseek:
+            # DeepSeek-V2/V3 family (Kimi-K2 ships the V3 architecture):
+            # MLA attention + first-k-dense MoE + its routing flavor
+            v3 = arch.startswith("DeepseekV3")
+            mla_kw = dict(
+                mla=True,
+                q_lora_rank=cfg.get("q_lora_rank"),
+                kv_lora_rank=cfg.get("kv_lora_rank", 512),
+                qk_nope_head_dim=cfg.get("qk_nope_head_dim", 128),
+                qk_rope_head_dim=cfg.get("qk_rope_head_dim", 64),
+                v_head_dim=cfg.get("v_head_dim", 128),
+                first_k_dense=cfg.get("first_k_dense_replace", 0),
+                router_scoring="sigmoid_v3" if v3 else "softmax_v2",
+                n_group=cfg.get("n_group", 0) or 0,
+                topk_group=cfg.get("topk_group", 0) or 0,
+                routed_scaling_factor=cfg.get("routed_scaling_factor",
+                                              1.0),
+                norm_topk_prob=bool(cfg.get("norm_topk_prob", v3)),
+                router_bias=v3,
+            )
+            if not v3 and cfg.get("topk_method") == "greedy":
+                mla_kw["n_group"] = 0  # V2-lite: plain greedy top-k
         # qwen2 uses qkv biases (not spelled out in its config.json);
         # qwen3 replaces them with per-head q/k RMS norms
         attn_bias = cfg.get("attention_bias",
@@ -109,6 +183,7 @@ class ModelConfig:
             embed_scale=gemma2,
             unit_offset_norm=gemma2,
             final_logit_softcap=cfg.get("final_logit_softcapping"),
+            **mla_kw,
         )
 
 

@@ -61,20 +61,26 @@ class KVCache:
                dtype=None) -> "KVCache":
         S = max_seq or cfg.max_seq_len
         dtype = dtype or cfg.dtype
-        shape = (cfg.num_layers, batch, S, cfg.num_kv_heads, cfg.head_dim)
-        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+        # MLA caches one latent "head" of kv_lora_rank+rope dims and a
+        # zero-width v plane (models/mla.py); dense models cache K/V
+        K, Dk, Dv = (cfg.kv_cache_heads, cfg.kv_cache_k_dim,
+                     cfg.kv_cache_v_dim)
+        L = cfg.num_layers
+        return cls(k=jnp.zeros((L, batch, S, K, Dk), dtype),
+                   v=jnp.zeros((L, batch, S, K, Dv), dtype),
                    index=jnp.zeros((), jnp.int32))
 
 
 # -- init ------------------------------------------------------------------
 
 
-def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
-    """Initialize parameters (normal init scaled like Llama pretraining)."""
-    L, D, H, K, Dh, F = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
-                         cfg.num_kv_heads, cfg.head_dim,
-                         cfg.intermediate_size)
-    keys = iter(jax.random.split(rng, 16))
+def _init_layer_block(rng: jax.Array, cfg: ModelConfig, L: int,
+                      moe: bool) -> Params:
+    """One stacked block of L structurally-identical layers."""
+    D, H, K, Dh, F = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+                      cfg.head_dim, cfg.intermediate_size)
+    keys = iter(jax.random.split(rng, 24))
+    depth = cfg.num_layers
 
     def norm(shape, key, std=0.02):
         return (jax.random.normal(key, shape, jnp.float32) * std).astype(cfg.dtype)
@@ -86,12 +92,32 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
 
     layers: Params = {
         "attn_norm": norm_scale(L, D),
-        "wq": norm((L, D, H, Dh), next(keys)),
-        "wk": norm((L, D, K, Dh), next(keys)),
-        "wv": norm((L, D, K, Dh), next(keys)),
-        "wo": norm((L, H, Dh, D), next(keys), std=0.02 / (2 * L) ** 0.5),
         "mlp_norm": norm_scale(L, D),
     }
+    if cfg.mla:
+        qk = cfg.qk_nope_head_dim + cfg.qk_rope_head_dim
+        r = cfg.kv_lora_rank
+        if cfg.q_lora_rank:
+            layers["wq_a"] = norm((L, D, cfg.q_lora_rank), next(keys))
+            layers["q_a_norm"] = norm_scale(L, cfg.q_lora_rank)
+            layers["wq_b"] = norm((L, cfg.q_lora_rank, H, qk), next(keys))
+        else:
+            layers["wq"] = norm((L, D, H, qk), next(keys))
+        layers["wkv_a"] = norm((L, D, r + cfg.qk_rope_head_dim),
+                               next(keys))
+        layers["kv_a_norm"] = norm_scale(L, r)
+        layers["w_uk"] = norm((L, H, cfg.qk_nope_head_dim, r), next(keys))
+        layers["w_uv"] = norm((L, H, r, cfg.v_head_dim), next(keys))
+        layers["wo"] = norm((L, H, cfg.v_head_dim, D), next(keys),
+                            std=0.02 / (2 * depth) ** 0.5)
+    else:
+        layers.update({
+            "wq": norm((L, D, H, Dh), next(keys)),
+            "wk": norm((L, D, K, Dh), next(keys)),
+            "wv": norm((L, D, K, Dh), next(keys)),
+            "wo": norm((L, H, Dh, D), next(keys),
+                       std=0.02 / (2 * depth) ** 0.5),
+        })
     if cfg.qk_norm:
         layers["q_norm"] = norm_scale(L, Dh)
         layers["k_norm"] = norm_scale(L, Dh)
@@ -102,33 +128,59 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
     if cfg.post_block_norms:
         layers["attn_post_norm"] = norm_scale(L, D)
         layers["mlp_post_norm"] = norm_scale(L, D)
-    if cfg.is_moe:
+    if moe:
         E, Fm = cfg.num_experts, cfg.moe_intermediate_size or F
         layers.update({
             "router": norm((L, D, E), next(keys)),
             "we_gate": norm((L, E, D, Fm), next(keys)),
             "we_up": norm((L, E, D, Fm), next(keys)),
-            "we_down": norm((L, E, Fm, D), next(keys), std=0.02 / (2 * L) ** 0.5),
+            "we_down": norm((L, E, Fm, D), next(keys),
+                            std=0.02 / (2 * depth) ** 0.5),
         })
+        if cfg.router_bias:
+            layers["router_bias"] = jnp.zeros((L, E), jnp.float32)
         if cfg.num_shared_experts > 0:
             Fs = Fm * cfg.num_shared_experts
             layers.update({
                 "ws_gate": norm((L, D, Fs), next(keys)),
                 "ws_up": norm((L, D, Fs), next(keys)),
                 "ws_down": norm((L, Fs, D), next(keys),
-                                std=0.02 / (2 * L) ** 0.5),
+                                std=0.02 / (2 * depth) ** 0.5),
             })
     else:
         layers.update({
             "w_gate": norm((L, D, F), next(keys)),
             "w_up": norm((L, D, F), next(keys)),
-            "w_down": norm((L, F, D), next(keys), std=0.02 / (2 * L) ** 0.5),
+            "w_down": norm((L, F, D), next(keys),
+                           std=0.02 / (2 * depth) ** 0.5),
         })
+    return layers
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Initialize parameters (normal init scaled like Llama pretraining).
+
+    MoE models with first_k_dense (DeepSeek) get a separate
+    "dense_layers" block for the leading dense-MLP layers.
+    """
+    D = cfg.hidden_size
+    k_top, k_dense, k_moe = jax.random.split(rng, 3)
+    keys = iter(jax.random.split(k_top, 4))
+
+    def norm(shape, key, std=0.02):
+        return (jax.random.normal(key, shape, jnp.float32) * std).astype(cfg.dtype)
+
+    n_dense = cfg.first_k_dense if cfg.is_moe else 0
     params: Params = {
         "embed": norm((cfg.vocab_size, D), next(keys)),
-        "layers": layers,
-        "final_norm": norm_scale(D),
+        "layers": _init_layer_block(k_moe, cfg, cfg.num_layers - n_dense,
+                                    cfg.is_moe),
+        "final_norm": (jnp.zeros if cfg.unit_offset_norm
+                       else jnp.ones)((D,), cfg.dtype),
     }
+    if n_dense:
+        params["dense_layers"] = _init_layer_block(k_dense, cfg, n_dense,
+                                                   moe=False)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = norm((D, cfg.vocab_size), next(keys))
     return params
@@ -197,10 +249,48 @@ def dense_mlp(x: jax.Array, p: Params,
 
 
 def _route(x: jax.Array, p: Params, cfg: ModelConfig):
-    """Router: top-k expert ids + softmaxed weights (fp32 routing)."""
-    router_logits = jnp.einsum("bsd,de->bse", x, p["router"]).astype(jnp.float32)
-    weights, idx = lax.top_k(router_logits, cfg.experts_per_token)
-    return jax.nn.softmax(weights, axis=-1), idx  # [B,S,k], [B,S,k]
+    """Router: top-k expert ids + weights (fp32 routing).
+
+    Three flavors (cfg.router_scoring):
+      * "mixtral"    — softmax over the selected top-k logits
+        (Mixtral/Qwen-MoE);
+      * "softmax_v2" — full softmax scores, optional group-limited
+        greedy selection (DeepseekV2TopkRouter);
+      * "sigmoid_v3" — sigmoid scores, a selection-only correction
+        bias, groups scored by their top-2 sum
+        (DeepseekV3TopkRouter.get_topk_indices).
+    """
+    router_logits = jnp.einsum("bsd,de->bse", x,
+                               p["router"]).astype(jnp.float32)
+    k = cfg.experts_per_token
+    if cfg.router_scoring == "mixtral":
+        weights, idx = lax.top_k(router_logits, k)
+        return jax.nn.softmax(weights, axis=-1), idx  # [B,S,k] x2
+    if cfg.router_scoring == "sigmoid_v3":
+        scores = jax.nn.sigmoid(router_logits)
+        choice = scores + p["router_bias"] if "router_bias" in p \
+            else scores
+        def group_reduce(g):  # a group's merit: sum of its best two
+            return jnp.sum(lax.top_k(g, 2)[0], axis=-1)
+    else:  # softmax_v2
+        scores = jax.nn.softmax(router_logits, axis=-1)
+        choice = scores
+        def group_reduce(g):
+            return jnp.max(g, axis=-1)
+    if cfg.n_group > 1 and 0 < cfg.topk_group < cfg.n_group:
+        B, S, E = choice.shape
+        g = choice.reshape(B, S, cfg.n_group, E // cfg.n_group)
+        _, gidx = lax.top_k(group_reduce(g), cfg.topk_group)
+        gmask = jnp.sum(jax.nn.one_hot(gidx, cfg.n_group,
+                                       dtype=jnp.float32), axis=-2) > 0
+        choice = jnp.where(
+            jnp.repeat(gmask, E // cfg.n_group, axis=-1), choice, 0.0)
+    _, idx = lax.top_k(choice, k)
+    weights = jnp.take_along_axis(scores, idx, axis=-1)
+    if cfg.norm_topk_prob:
+        weights = weights / (jnp.sum(weights, axis=-1, keepdims=True)
+                             + 1e-20)
+    return weights * cfg.routed_scaling_factor, idx
 
 
 def moe_mlp_dense(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
@@ -274,14 +364,39 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
            positions: jax.Array, kv_len: Optional[jax.Array],
            cache_kv: Optional[Tuple[jax.Array, jax.Array]],
            cache_index: Optional[jax.Array],
-           window=_WINDOW_FROM_CFG):
+           window=_WINDOW_FROM_CFG, moe: Optional[bool] = None):
     """One transformer block. cache_kv: ([B,Smax,K,Dh], [B,Smax,K,Dh]).
     `window` overrides cfg.sliding_window (the gemma2 pair-scan passes
-    the per-layer value; None = global attention)."""
+    the per-layer value; None = global attention). `moe` overrides
+    cfg.is_moe (DeepSeek's first_k_dense leading dense layers)."""
     if window is _WINDOW_FROM_CFG:
         window = cfg.sliding_window
     uo = cfg.unit_offset_norm
     h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, uo)
+    if cfg.mla:
+        from .mla import mla_attention
+        a, new_cache = mla_attention(h, lp, cfg, positions, kv_len,
+                                     cache_kv, cache_index)
+    else:
+        a, new_cache = _mha(h, lp, cfg, freqs, positions, kv_len,
+                            cache_kv, cache_index, window, uo)
+    if cfg.post_block_norms:
+        a = rms_norm(a, lp["attn_post_norm"], cfg.rms_norm_eps, uo)
+    x = x + a
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, uo)
+    use_moe = cfg.is_moe if moe is None else moe
+    mlp_out = moe_mlp(h, lp, cfg) if use_moe else dense_mlp(h, lp, cfg)
+    if cfg.post_block_norms:
+        mlp_out = rms_norm(mlp_out, lp["mlp_post_norm"],
+                           cfg.rms_norm_eps, uo)
+    return x + mlp_out, new_cache
+
+
+def _mha(h: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
+         positions: jax.Array, kv_len, cache_kv, cache_index, window,
+         uo: bool):
+    """Standard multi-head (GQA) attention on the pre-normed input."""
     q = jnp.einsum("bsd,dhk->bshk", h, _w(lp, "wq", cfg.dtype))
     k = jnp.einsum("bsd,dhk->bshk", h, _w(lp, "wk", cfg.dtype))
     v = jnp.einsum("bsd,dhk->bshk", h, _w(lp, "wv", cfg.dtype))
@@ -320,16 +435,7 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
                      sliding_window=window, scale=cfg.query_scale,
                      logit_softcap=cfg.attn_logit_softcap)
     a = jnp.einsum("bshk,hkd->bsd", attn, _w(lp, "wo", cfg.dtype))
-    if cfg.post_block_norms:
-        a = rms_norm(a, lp["attn_post_norm"], cfg.rms_norm_eps, uo)
-    x = x + a
-
-    h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, uo)
-    mlp_out = moe_mlp(h, lp, cfg) if cfg.is_moe else dense_mlp(h, lp, cfg)
-    if cfg.post_block_norms:
-        mlp_out = rms_norm(mlp_out, lp["mlp_post_norm"],
-                           cfg.rms_norm_eps, uo)
-    return x + mlp_out, new_cache
+    return a, new_cache
 
 
 def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
@@ -365,18 +471,37 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
         x, new_cache = _alt_window_scan(params, cfg, x, freqs, positions,
                                         kv_len, cache)
     else:
-        def body(x, per_layer):
-            lp, layer_cache = per_layer
-            x, nc = _layer(x, lp, cfg, freqs, positions, kv_len,
-                           layer_cache, index)
+        # DeepSeek first_k_dense: leading dense-MLP layers scan as
+        # their own block; the cache's layer dim covers both blocks
+        n_dense = cfg.first_k_dense if "dense_layers" in params else 0
+
+        def scan_block(x, block, ck, cv, moe):
+            def body(x, per_layer):
+                lp, layer_cache = per_layer
+                x, nc = _layer(x, lp, cfg, freqs, positions, kv_len,
+                               layer_cache, index, moe=moe)
+                return x, nc
+
+            carry_cache = (ck, cv) if cache is not None else None
+            x, nc = lax.scan(body, x, (block, carry_cache))
             return x, nc
 
         if cache is not None:
-            x, (nk, nv) = lax.scan(body, x,
-                                   (params["layers"], (cache.k, cache.v)))
+            dk, dv = cache.k[:n_dense], cache.v[:n_dense]
+            mk, mv = cache.k[n_dense:], cache.v[n_dense:]
+        else:
+            dk = dv = mk = mv = None
+        if n_dense:
+            x, dnc = scan_block(x, params["dense_layers"], dk, dv,
+                                moe=False)
+        x, mnc = scan_block(x, params["layers"], mk, mv, moe=None)
+        if cache is not None:
+            nk, nv = mnc
+            if n_dense:
+                nk = jnp.concatenate([dnc[0], nk], axis=0)
+                nv = jnp.concatenate([dnc[1], nv], axis=0)
             new_cache = KVCache(k=nk, v=nv, index=cache.index + S)
         else:
-            x, _ = lax.scan(body, x, (params["layers"], None))
             new_cache = None
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps,

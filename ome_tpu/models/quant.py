@@ -152,6 +152,12 @@ _LAYER_CONTRACT = {
     "we_gate": (2,), "we_up": (2,),        # [L, E, D, F]
     "we_down": (2,),                       # [L, E, F, D]
     "ws_gate": (1,), "ws_up": (1,), "ws_down": (1,),
+    # MLA projections (models/mla.py); norms/biases stay fp
+    "wq_a": (1,),                          # [L, D, q_rank]
+    "wq_b": (1,),                          # [L, q_rank, H, qk]
+    "wkv_a": (1,),                         # [L, D, r+rope]
+    "w_uk": (2,),                          # [L, H, nope, r]
+    "w_uv": (2,),                          # [L, H, r, v]
 }
 _TOP_CONTRACT = {
     "embed": (1,),     # per-ROW scales: rows are both lookup outputs
@@ -193,8 +199,8 @@ def quantize_params(params: Dict[str, Any], mode: str = "int8",
 
     out: Dict[str, Any] = {}
     for name, leaf in params.items():
-        if name == "layers":
-            out["layers"] = {k: q_layer(k, v) for k, v in leaf.items()}
+        if name in ("layers", "dense_layers"):
+            out[name] = {k: q_layer(k, v) for k, v in leaf.items()}
         elif name in _TOP_CONTRACT:
             out[name] = quantize_tensor(leaf, _TOP_CONTRACT[name])
         else:

@@ -41,14 +41,21 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
     B, S = tokens.shape
     M = num_microbatches
     assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
-    if cfg.alt_sliding_window:
+    if cfg.is_moe and cfg.first_k_dense:
         raise NotImplementedError(
-            "pipeline_forward does not support alternating-sliding-window "
-            "models (gemma2) yet — per-layer window flags don't fit the "
-            "uniform stage scan")
+            "pipeline_forward needs structurally uniform stages; "
+            "first_k_dense (DeepSeek) models mix dense and MoE layers "
+            "— serve them via tp (engine/sharded.py) instead")
+    if cfg.alt_sliding_window and (cfg.num_layers // pp) % 2 != 0:
+        raise ValueError(
+            "alternating-sliding-window (gemma2) pipeline stages must "
+            f"hold an even layer count; {cfg.num_layers} layers / "
+            f"pp={pp} gives {cfg.num_layers // pp}")
     mb = B // M
 
     x = jnp.take(params["embed"], tokens, axis=0).astype(cfg.dtype)
+    if cfg.embed_scale:  # gemma: normalizer in the compute dtype
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)
     x = x.reshape(M, mb, S, -1)
     x = logical(x, mesh, None, "dp", "tp", None)
 
@@ -56,6 +63,25 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (mb, S))
 
     def stage_fn(stage_params, h):
+        if cfg.alt_sliding_window:
+            # gemma2: scan layer PAIRS (even = sliding window, odd =
+            # global), the same shape as llama._alt_window_scan — both
+            # window variants stay static inside one compiled body
+            def pair_body(h, lp2):
+                lp0 = jax.tree.map(lambda a: a[0], lp2)
+                lp1 = jax.tree.map(lambda a: a[1], lp2)
+                h, _ = llama._layer(h, lp0, cfg, freqs, positions, None,
+                                    None, None, window=cfg.sliding_window)
+                h, _ = llama._layer(h, lp1, cfg, freqs, positions, None,
+                                    None, None, window=None)
+                return h, None
+
+            layers2 = jax.tree.map(
+                lambda a: a.reshape(a.shape[0] // 2, 2, *a.shape[1:]),
+                stage_params)
+            h, _ = lax.scan(pair_body, h, layers2)
+            return h
+
         def body(h, lp):
             h, _ = llama._layer(h, lp, cfg, freqs, positions, None, None, None)
             return h, None
@@ -88,12 +114,16 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
     (state, out), _ = lax.scan(step, (state, out),
                                jnp.arange(M + pp - 1, dtype=jnp.int32))
     h = out.reshape(B, S, D)
-    h = llama.rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    h = llama.rms_norm(h, params["final_norm"], cfg.rms_norm_eps,
+                       cfg.unit_offset_norm)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"].T
     logits = jnp.einsum("bsd,dv->bsv", h, head,
                         preferred_element_type=jnp.float32)
+    if cfg.final_logit_softcap:
+        logits = jnp.tanh(logits / cfg.final_logit_softcap) \
+            * cfg.final_logit_softcap
     return logits
 
 

@@ -25,6 +25,11 @@ _LAYER_RULES: Dict[str, tuple] = {
     "mlp_norm": (None,),
     "q_norm": (None,),
     "k_norm": (None,),
+    "attn_post_norm": (None,),   # gemma2 post-block norms
+    "mlp_post_norm": (None,),
+    "bq": ("tp", None),          # qwen2 attention biases: heads on tp
+    "bk": ("tp", None),          # [H|K, Dh] — follows wq/wk/wv
+    "bv": ("tp", None),
     "wq": (None, "tp", None),      # [D, H, Dh]
     "wk": (None, "tp", None),
     "wv": (None, "tp", None),
@@ -39,6 +44,17 @@ _LAYER_RULES: Dict[str, tuple] = {
     "ws_gate": (None, "tp"),        # shared experts: dense Megatron split
     "ws_up": (None, "tp"),
     "ws_down": ("tp", None),
+    # MLA (models/mla.py): heads shard on tp; the latent projections
+    # and the shared rope key are replicated (they are tiny, and the
+    # latent cache itself is replicated — kv_cache_heads == 1)
+    "wq_a": (None, None),           # [D, q_rank]
+    "q_a_norm": (None,),
+    "wq_b": (None, "tp", None),     # [q_rank, H, qk_dim]
+    "wkv_a": (None, None),          # [D, r + rope]
+    "kv_a_norm": (None,),
+    "w_uk": ("tp", None, None),     # [H, nope, r]
+    "w_uv": ("tp", None, None),     # [H, r, v_dim]
+    "router_bias": (None,),
 }
 
 _TOP_RULES: Dict[str, tuple] = {
@@ -57,8 +73,8 @@ def param_specs(params: Dict[str, Any], pipeline: bool = False) -> Dict[str, Any
     layer_prefix = ("pp", None) if pipeline else (None,)
     out: Dict[str, Any] = {}
     for name, leaf in params.items():
-        if name == "layers":
-            out["layers"] = {
+        if name in ("layers", "dense_layers"):
+            out[name] = {
                 k: P(*layer_prefix, *_LAYER_RULES[k]) for k in leaf
             }
         else:

@@ -67,12 +67,30 @@ class TestCatalogRouting:
                           model_name=model_name).runtime.metadata.name
 
     def test_llama70b_routes_to_multihost(self, catalog):
+        # round 3: the in-repo engine spans hosts (engine/multihost.py),
+        # so the north-star 70B config routes to it over the wrapped
+        # vllm image (prio 7 > 5)
         assert self._select(catalog, "llama-3-3-70b-instruct") == \
-            "vllm-tpu-llama-70b"
+            "ome-engine-llama-70b"
 
-    def test_llama8b_routes_to_single_host(self, catalog):
+    def test_llama8b_routes_to_per_generation_runtime(self, catalog):
+        # per-family v5e-tuned in-repo entry (prio 8) wins the 8B class
         assert self._select(catalog, "llama-3-1-8b-instruct") == \
-            "vllm-tpu"
+            "ome-engine-llama-8b-v5e"
+
+    def test_deepseek_v2_routes_to_native_mla_engine(self, catalog):
+        # round 3: MLA is implemented natively (models/mla.py)
+        client, _ = catalog
+        sel = RuntimeSelector(client)
+        spec = v1.BaseModelSpec(
+            model_format=v1.ModelFormat(name="safetensors"),
+            model_architecture="DeepseekV2ForCausalLM",
+            model_parameter_size="236B")
+        got = sel.select(spec, "default",
+                         accelerator=client.get(v1.AcceleratorClass,
+                                                "tpu-v5p"),
+                         model_name="deepseek-v2")
+        assert got.runtime.metadata.name == "ome-engine-deepseek-v2"
 
     def test_tiny_qwen_routes_to_ome_engine(self, catalog):
         # 494M is below vllm-tpu's 1B size floor

@@ -109,6 +109,66 @@ def test_replicated_engine_publishes_op_stream():
     assert pub.msgs[2]["temperature"] == [0.0, 0.0]
 
 
+def test_pd_blob_replication_single_fetch():
+    """A PD decode-group leader fetches the KV wire blob ONCE and
+    ships the bytes; followers deserialize without fetching (a second
+    fetch could sample a different prompt token on the prefill node)."""
+    import base64
+
+    from ome_tpu.engine.pd import serialize_kv
+
+    blob = serialize_kv(5, np.ones((1, 1, 2, 1, 2), np.float32),
+                        np.zeros((1, 1, 2, 1, 2), np.float32), 2, 2)
+    fetches = []
+
+    class FakeRemoteEngine:
+        def prefill_blob(self, ids, t, k, p):
+            fetches.append(tuple(ids))
+            return blob
+
+    class FakePub:
+        def __init__(self):
+            self.msgs = []
+
+        def send(self, m):
+            self.msgs.append(m)
+
+    pub = FakePub()
+    eng = multihost.ReplicatedEngine(FakeRemoteEngine(), pub)
+    tok, kv, tl, b = eng.prefill([1, 2, 3])
+    assert fetches == [(1, 2, 3)]          # exactly one fetch
+    assert (tok, tl, b) == (5, 2, 2)
+    assert pub.msgs[0]["op"] == "prefill_blob"
+
+    # follower side: the blob op primes last_prefill for insert
+    inserted = []
+
+    class FakeEngine:
+        def new_state(self):
+            return "s0"
+
+        def insert(self, state, kv, slot, true_len, token, bucket):
+            inserted.append((slot, true_len, token, bucket,
+                             np.asarray(kv[0]).sum()))
+            return "s1"
+
+    class FakeSub:
+        def __init__(self, msgs):
+            self.msgs = list(msgs)
+
+        def recv(self):
+            return self.msgs.pop(0) if self.msgs else {"op": "stop"}
+
+    rc = multihost.follower_loop(FakeEngine(), FakeSub([
+        {"op": "prefill_blob",
+         "blob": base64.b64encode(blob).decode()},
+        {"op": "insert", "slot": 1, "true_len": 2, "token": 5,
+         "bucket": 2},
+    ]))
+    assert rc == 0
+    assert inserted == [(1, 2, 5, 2, 4.0)]  # ones(1,1,2,1,2).sum()
+
+
 def test_follower_replays_and_exits_on_drop():
     """The follower replays prefill/insert/decode against its own
     engine and exits nonzero when the channel drops (group restart)."""

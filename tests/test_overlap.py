@@ -1,0 +1,130 @@
+"""Prefill/decode overlap (Scheduler overlap=True): the admission
+thread prefills while the scheduler thread keeps stepping decode —
+insert is the only synchronization point. JetStream separates prefill
+and generate machines for the same reason (round-2 review weak #3)."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ome_tpu.engine import InferenceEngine, Request, Scheduler
+from ome_tpu.models import config as cfgs
+from ome_tpu.models import llama
+
+
+class SlowFakeEngine:
+    """Engine double with a deliberately slow prefill and fast decode,
+    recording the wall-clock of every decode call. No device work, so
+    the test isolates SCHEDULER behavior from 1-core CPU contention."""
+
+    max_slots = 8
+    max_seq = 1024
+
+    def __init__(self, prefill_s=0.25, decode_s=0.002):
+        self.prefill_s = prefill_s
+        self.decode_s = decode_s
+        self.decode_times = []
+
+    def new_state(self):
+        return "state"
+
+    def prefill(self, ids, t, k, p):
+        time.sleep(self.prefill_s)
+        return 1, "kv", len(ids), 64
+
+    def insert(self, state, kv, slot, true_len, token, bucket):
+        return state
+
+    def decode(self, state, t, k, p):
+        self.decode_times.append(time.monotonic())
+        time.sleep(self.decode_s)
+        return state, np.full(self.max_slots, 3, np.int32)
+
+
+def _drive(overlap: bool) -> float:
+    """Max gap between decode steps while 8 slow prefills arrive
+    mid-stream."""
+    eng = SlowFakeEngine()
+    sched = Scheduler(eng, overlap=overlap)
+    sched.start()
+    try:
+        # one long-running stream keeps decode active
+        sched.submit(Request(prompt_ids=[1, 2], max_new_tokens=10_000))
+        deadline = time.monotonic() + 10
+        while len(eng.decode_times) < 20:
+            assert time.monotonic() < deadline, "decode never started"
+            time.sleep(0.005)
+        # burst: 8 long prompts arrive during active decode
+        for i in range(7):
+            sched.submit(Request(prompt_ids=[1] * 64,
+                                 max_new_tokens=10_000))
+        start = len(eng.decode_times)
+        while len(eng.decode_times) < start + 400:
+            assert time.monotonic() < deadline + 20
+            time.sleep(0.005)
+    finally:
+        sched.stop()
+    times = eng.decode_times[start:start + 400]
+    gaps = np.diff(np.asarray(times))
+    return float(np.percentile(gaps, 99))
+
+
+def test_burst_prefills_do_not_stall_decode_cadence():
+    """With overlap, p99 decode-step gap during a burst of slow
+    prefills stays near the decode cost; without it, gaps include
+    whole prefills (the stall the overlap removes)."""
+    p99_overlap = _drive(overlap=True)
+    eng_prefill_s = SlowFakeEngine().prefill_s
+    # well under one prefill: decode cadence never absorbed a prefill
+    assert p99_overlap < eng_prefill_s / 2, p99_overlap
+    p99_sync = _drive(overlap=False)
+    assert p99_sync > eng_prefill_s  # the synchronous path does stall
+
+
+def test_overlap_matches_synchronous_tokens():
+    """Same requests through overlap and synchronous scheduling must
+    produce identical greedy token streams (insert-order independent
+    because each slot's stream only depends on its own prefill)."""
+    cfg = cfgs.tiny_test().replace(max_seq_len=64)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [[1, 7, 42], [9, 9, 9, 9], [3, 14, 15, 92, 6]]
+
+    def run(overlap):
+        engine = InferenceEngine(params, cfg, max_slots=4,
+                                 prefill_buckets=[16])
+        sched = Scheduler(engine, overlap=overlap)
+        sched.start()
+        try:
+            reqs = [sched.submit(Request(prompt_ids=p, max_new_tokens=6))
+                    for p in prompts]
+            outs = [r.wait_output(120) for r in reqs]
+        finally:
+            sched.stop()
+        return outs
+
+    assert run(True) == run(False)
+
+
+def test_overlap_failure_fails_requests_and_health():
+    """A prefill error on the admission thread must fail the request,
+    flip health, and fail in-flight work (same contract as sync)."""
+    eng = SlowFakeEngine(prefill_s=0.01)
+
+    def boom(ids, t, k, p):
+        raise RuntimeError("device fell over")
+
+    eng.prefill = boom
+    sched = Scheduler(eng, overlap=True)
+    sched.start()
+    try:
+        req = sched.submit(Request(prompt_ids=[1, 2], max_new_tokens=4))
+        assert req.done.wait(30)
+        assert req.finish_reason == "error"
+        assert not sched.healthy
+        with pytest.raises(RuntimeError):
+            sched.submit(Request(prompt_ids=[1], max_new_tokens=1))
+    finally:
+        sched.stop()

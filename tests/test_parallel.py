@@ -96,6 +96,41 @@ class TestPipelineEquivalence:
                                    atol=2e-4, rtol=2e-4)
 
 
+    def test_pipeline_gemma2_matches_dense(self, mesh8):
+        """The gemma2 block shape (alternating sliding/global windows,
+        GeGLU, post-block (1+w) norms, softcaps, scaled embeddings)
+        rides the pipeline via the per-stage layer-pair scan (round-2
+        review weak #6 lifted)."""
+        cfg = cfgs.tiny_test().replace(
+            dtype=jnp.float32, alt_sliding_window=True, sliding_window=8,
+            mlp_activation="gelu_tanh", post_block_norms=True,
+            embed_scale=True, unit_offset_norm=True,
+            attn_logit_softcap=50.0, final_logit_softcap=30.0,
+            query_scale=16 ** -0.5)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(3), (4, 16), 0,
+                                    cfg.vocab_size)
+        ref_logits, _ = llama.forward(params, cfg, tokens)
+        staged = sharding.stack_to_stages(params, 2)
+        staged = sharding.shard_params(staged, mesh8, pipeline=True)
+        with jax.set_mesh(mesh8):
+            out = jax.jit(lambda p, t: pipeline.pipeline_forward(
+                p, cfg, t, pp=2, num_microbatches=2, mesh=mesh8))(staged,
+                                                                  tokens)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(ref_logits),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_pipeline_gemma2_odd_stage_depth_refused(self, mesh8):
+        cfg = cfgs.tiny_test().replace(alt_sliding_window=True,
+                                       num_layers=4)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        with pytest.raises(ValueError, match="even layer count"):
+            pipeline.pipeline_forward(
+                params, cfg, jnp.zeros((2, 8), jnp.int32), pp=4,
+                num_microbatches=2)
+
+
 class TestTrainStep:
     def test_sharded_train_step_loss_decreases(self, mesh8):
         cfg = cfgs.tiny_test(moe=True)

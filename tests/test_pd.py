@@ -1,0 +1,153 @@
+"""PD-disaggregated serving (engine/pd.py): KV wire format, the
+remote-prefill engine, and the e2e contract — a prefill+decode node
+pair must produce byte-identical completions to a monolithic engine.
+
+Reference role: SGLang's --disaggregation-mode pair with RDMA KV
+transfer (/root/reference/config/runtimes/srt/deepseek-rdma-pd-rt.yaml
+:101-103), re-owned because this repo's engine is in-repo.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ome_tpu.engine import InferenceEngine, Scheduler
+from ome_tpu.engine.pd import (PDError, RemotePrefillEngine,
+                               deserialize_kv, make_pd_prefill_handler,
+                               serialize_kv)
+from ome_tpu.engine.server import EngineServer
+from ome_tpu.models import config as cfgs
+from ome_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = cfgs.tiny_test().replace(max_seq_len=128, dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _engine(world, **kw):
+    cfg, params = world
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("prefill_buckets", [16, 32])
+    return InferenceEngine(params, cfg, **kw)
+
+
+def test_kv_wire_roundtrip():
+    k = np.arange(2 * 1 * 4 * 2 * 3, dtype=np.float32).reshape(
+        2, 1, 4, 2, 3)
+    v = -k
+    blob = serialize_kv(7, k, v, true_len=3, bucket=4)
+    tok, k2, v2, tl, b = deserialize_kv(blob)
+    assert (tok, tl, b) == (7, 3, 4)
+    np.testing.assert_array_equal(k, k2)
+    np.testing.assert_array_equal(v, v2)
+
+
+def test_kv_wire_rejects_truncation():
+    blob = serialize_kv(1, np.zeros((1, 1, 2, 1, 2), np.float32),
+                        np.zeros((1, 1, 2, 1, 2), np.float32), 2, 2)
+    with pytest.raises(PDError):
+        deserialize_kv(blob[:-8])
+    with pytest.raises(PDError):
+        deserialize_kv(b"\x01")
+
+
+def test_prefill_handler_exports_engine_result(world):
+    eng = _engine(world)
+    handler = make_pd_prefill_handler(eng)
+    blob = handler({"ids": [5, 6, 7], "temperature": 0.0})
+    tok, k, v, tl, b = deserialize_kv(blob)
+    want_tok, (wk, wv), wtl, wb = eng.prefill([5, 6, 7])
+    assert (tl, b) == (wtl, wb)
+    assert tok == want_tok  # greedy: same logits both calls
+    np.testing.assert_array_equal(np.asarray(wk), k)
+    with pytest.raises(PDError):
+        handler({"ids": []})
+
+
+def test_pd_pair_matches_monolithic_over_http(world):
+    """The full e2e: completions served by a decode node whose prefill
+    comes from a separate prefill node over HTTP must be byte-identical
+    to a monolithic engine's output (same params, greedy)."""
+    # monolithic reference
+    mono = EngineServer(Scheduler(_engine(world)), model_name="m")
+    mono.start()
+    # prefill node (serve.py wiring: no decode loop, /v1/* rejected)
+    from ome_tpu.engine.serve import _PrefillNodeScheduler
+    pre_engine = _engine(world)
+    pre_srv = EngineServer(_PrefillNodeScheduler(pre_engine),
+                           model_name="m",
+                           pd_prefill=make_pd_prefill_handler(
+                               pre_engine))
+    pre_srv.start()
+    # decode node (overlap on: the remote fetch rides the admission
+    # thread, like production)
+    decode_engine = RemotePrefillEngine(
+        _engine(world), f"http://127.0.0.1:{pre_srv.port}")
+    pd_srv = EngineServer(Scheduler(decode_engine, overlap=True),
+                          model_name="m")
+    pd_srv.start()
+
+    def complete(port, stream=False):
+        body = json.dumps({"model": "m", "prompt": "hi there pd",
+                           "max_tokens": 6, "temperature": 0,
+                           "stream": stream}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.read()
+
+    try:
+        want = json.loads(complete(mono.port))
+        got = json.loads(complete(pd_srv.port))
+        assert got["choices"] == want["choices"]
+        assert got["usage"] == want["usage"]
+        # streaming surface: identical SSE event payload bytes modulo
+        # the request id counter
+        want_s = complete(mono.port, stream=True)
+        got_s = complete(pd_srv.port, stream=True)
+        # identical SSE event payloads modulo the request-id counter
+        assert [l.split(b'", ', 1)[-1] for l in want_s.splitlines()
+                if l.startswith(b"data:")] == \
+               [l.split(b'", ', 1)[-1] for l in got_s.splitlines()
+                if l.startswith(b"data:")]
+        # the prefill node rejects completions; the decode node rejects
+        # nothing extra
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            complete(pre_srv.port)
+        assert ei.value.code == 503
+    finally:
+        for s in (mono, pre_srv, pd_srv):
+            s.stop()
+
+
+def test_remote_prefill_failure_fails_request_not_server(world):
+    """A dead prefill peer fails the in-flight request but leaves the
+    decode node HEALTHY (transient_prefill_errors contract): a peer
+    restarting mid-rollout must not kill every stream on this node."""
+    decode_engine = RemotePrefillEngine(_engine(world),
+                                        "http://127.0.0.1:1",  # nothing
+                                        timeout=2.0)
+    sched = Scheduler(decode_engine, overlap=True)
+    sched.start()
+    try:
+        from ome_tpu.engine import Request
+        req = sched.submit(Request(prompt_ids=[1, 2, 3],
+                                   max_new_tokens=4))
+        assert req.done.wait(60)
+        assert req.finish_reason == "error"
+        assert sched.healthy  # transient: the node keeps serving
+        req2 = sched.submit(Request(prompt_ids=[4, 5],
+                                    max_new_tokens=2))
+        assert req2.done.wait(60)
+        assert req2.finish_reason == "error"
+    finally:
+        sched.stop()

@@ -1,6 +1,7 @@
-"""Prefix caching: suffix prefill atop cached KV must produce the
-same tokens as a cold full prefill, hits/misses/LRU behave, and the
-engine stays correct through insert+decode."""
+"""Radix prefix caching: suffix prefill atop cached KV must produce
+the same tokens as a cold full prefill, partial (block-level) prefix
+sharing works across sibling prompts, and the HBM byte budget bounds
+the cache under churn."""
 
 import jax
 import jax.numpy as jnp
@@ -9,6 +10,8 @@ import numpy as np
 from ome_tpu.engine.core import InferenceEngine, PrefixCache
 from ome_tpu.models import llama
 from ome_tpu.models.config import tiny_test
+
+MB64 = 64 << 20
 
 
 def _greedy(engine, prompt, steps=6, slot=0):
@@ -32,7 +35,7 @@ def _cfg():
 def test_suffix_prefill_matches_cold_prefill():
     cfg = _cfg()
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
-    base = list(range(2, 40))  # 38-token shared prefix
+    base = list(range(2, 40))  # 38 tokens -> one cached 32-block
     prompt = base + [77, 78, 79]
 
     cold = InferenceEngine(params, cfg, max_slots=2, max_seq=128,
@@ -41,7 +44,7 @@ def test_suffix_prefill_matches_cold_prefill():
 
     warm = InferenceEngine(params, cfg, max_slots=2, max_seq=128,
                            prefill_buckets=[16, 32, 64, 128],
-                           prefix_cache_size=4)
+                           prefix_cache_bytes=MB64)
     _greedy(warm, base)                     # seeds the cache
     assert warm.prefix_cache.misses == 1
     got = _greedy(warm, prompt)             # suffix path
@@ -49,15 +52,38 @@ def test_suffix_prefill_matches_cold_prefill():
     assert got == want
 
 
-def test_exact_repeat_reuses_all_but_last_token():
+def test_sibling_prompts_share_partial_prefix():
+    """A prompt that diverges from a cached one after the first block
+    still reuses the shared block — the radix sharing a whole-entry
+    LRU cannot give."""
+    cfg = _cfg()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    shared = list(range(2, 34))             # exactly one 32-block
+    a = shared + list(range(50, 80))        # diverges after block 1
+    b = shared + list(range(90, 120))       # different continuation
+
+    cold = InferenceEngine(params, cfg, max_slots=2, max_seq=128,
+                           prefill_buckets=[16, 32, 64, 128])
+    want_b = _greedy(cold, b)
+
+    warm = InferenceEngine(params, cfg, max_slots=2, max_seq=128,
+                           prefill_buckets=[16, 32, 64, 128],
+                           prefix_cache_bytes=MB64)
+    _greedy(warm, a)
+    got_b = _greedy(warm, b)                # hits the shared block
+    assert warm.prefix_cache.hits == 1
+    assert got_b == want_b
+
+
+def test_exact_repeat_reuses_cached_blocks():
     cfg = _cfg()
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     eng = InferenceEngine(params, cfg, max_slots=2, max_seq=128,
                           prefill_buckets=[16, 32, 64],
-                          prefix_cache_size=4)
-    prompt = list(range(1, 30))
+                          prefix_cache_bytes=MB64)
+    prompt = list(range(1, 40))
     a = _greedy(eng, prompt)
-    b = _greedy(eng, prompt)  # strict-prefix rule: matches 28 of 29
+    b = _greedy(eng, prompt)  # strict-prefix rule: last token re-runs
     assert eng.prefix_cache.hits >= 1
     assert a == b
 
@@ -73,30 +99,75 @@ def test_cache_disabled_by_default():
 
 
 class TestPrefixCacheUnit:
-    def test_lru_eviction(self):
-        pc = PrefixCache(capacity=2, min_prefix=2)
-        pc.put([1, 2, 3], "k1", "v1", 3, 16)
-        pc.put([4, 5, 6], "k2", "v2", 3, 16)
-        pc.put([7, 8, 9], "k3", "v3", 3, 16)  # evicts [1,2,3]
-        assert pc.match([1, 2, 3, 4]) is None
-        assert pc.match([4, 5, 6, 7])[0] == "k2"
+    """Trie mechanics with small device arrays ([L=1,1,S,1,2])."""
 
-    def test_longest_prefix_wins(self):
-        pc = PrefixCache(capacity=4, min_prefix=2)
-        pc.put([1, 2], "short", "v", 2, 16)
-        pc.put([1, 2, 3, 4], "long", "v", 4, 16)
-        assert pc.match([1, 2, 3, 4, 5])[0] == "long"
+    @staticmethod
+    def _kv(n):
+        k = jnp.arange(n * 2, dtype=jnp.float32).reshape(1, 1, n, 1, 2)
+        return k, -k
+
+    def test_block_dedup_and_bytes(self):
+        pc = PrefixCache(capacity_bytes=1 << 30, block=4, min_prefix=4)
+        k, v = self._kv(8)
+        pc.put(list(range(8)), k, v, 8, 8)
+        first = pc.bytes
+        assert first == 2 * (1 * 1 * 8 * 1 * 2 * 4)  # both planes
+        # same prefix again: no new bytes (blocks deduped)
+        k2, v2 = self._kv(12)
+        pc.put(list(range(8)) + [99], k2, v2, 9, 16)
+        assert pc.bytes == first
+
+    def test_partial_match_in_blocks(self):
+        pc = PrefixCache(capacity_bytes=1 << 30, block=4, min_prefix=4)
+        k, v = self._kv(8)
+        pc.put([1, 2, 3, 4, 5, 6, 7, 8], k, v, 8, 8)
+        # diverges in the second block: first block still matches
+        hit = pc.match([1, 2, 3, 4, 9, 9, 9, 9, 9])
+        assert hit is not None and hit[2] == 4
+        np.testing.assert_array_equal(np.asarray(hit[0]),
+                                      np.asarray(k[:, :, :4]))
+        # full match across both blocks (strict: needs len > 8)
+        hit = pc.match([1, 2, 3, 4, 5, 6, 7, 8, 9])
+        assert hit[2] == 8
+        np.testing.assert_array_equal(np.asarray(hit[0]), np.asarray(k))
 
     def test_strict_prefix_semantics(self):
-        pc = PrefixCache(capacity=4, min_prefix=2)
-        pc.put([1, 2, 3], "k", "v", 3, 16)
-        # equal prompt: reuses all but the last token
-        assert pc.match([1, 2, 3])[2] == 2
-        assert pc.match([1, 9, 3, 4]) is None   # diverges
-        hit = pc.match([1, 2, 3, 4])
-        assert hit is not None and hit[2] == 3
+        pc = PrefixCache(capacity_bytes=1 << 30, block=4, min_prefix=4)
+        k, v = self._kv(8)
+        pc.put([1, 2, 3, 4, 5, 6, 7, 8], k, v, 8, 8)
+        # equal prompt: the last token must re-run -> only block 1
+        assert pc.match([1, 2, 3, 4, 5, 6, 7, 8])[2] == 4
+        assert pc.match([9, 2, 3, 4, 5]) is None   # diverges at start
 
     def test_min_prefix_floor(self):
-        pc = PrefixCache(capacity=4, min_prefix=16)
-        pc.put([1, 2, 3], "k", "v", 3, 16)      # too short to keep
-        assert pc.match([1, 2, 3, 4]) is None
+        pc = PrefixCache(capacity_bytes=1 << 30, block=4, min_prefix=8)
+        k, v = self._kv(4)
+        pc.put([1, 2, 3, 4], k, v, 4, 4)
+        assert pc.match([1, 2, 3, 4, 5]) is None   # 4 < min_prefix
+        assert pc.misses == 1
+
+    def test_byte_budget_bounds_cache_under_churn(self):
+        block_bytes = 2 * (1 * 1 * 4 * 1 * 2 * 4)
+        pc = PrefixCache(capacity_bytes=3 * block_bytes, block=4,
+                         min_prefix=4)
+        for start in range(0, 40, 4):
+            k, v = self._kv(4)
+            pc.put(list(range(start, start + 4)), k, v, 4, 4)
+            assert pc.bytes <= 3 * block_bytes
+        # the most recent blocks survived, the oldest were evicted
+        assert pc.match(list(range(36, 41))) is not None
+        assert pc.match(list(range(0, 5))) is None
+
+    def test_lru_eviction_prefers_stale_leaves(self):
+        block_bytes = 2 * (1 * 1 * 4 * 1 * 2 * 4)
+        pc = PrefixCache(capacity_bytes=2 * block_bytes, block=4,
+                         min_prefix=4)
+        k1, v1 = self._kv(4)
+        pc.put([1, 2, 3, 4], k1, v1, 4, 4)
+        k2, v2 = self._kv(4)
+        pc.put([5, 6, 7, 8], k2, v2, 4, 4)
+        pc.match([1, 2, 3, 4, 9])           # refresh the first entry
+        k3, v3 = self._kv(4)
+        pc.put([9, 10, 11, 12], k3, v3, 4, 4)  # evicts [5,6,7,8]
+        assert pc.match([1, 2, 3, 4, 0]) is not None
+        assert pc.match([5, 6, 7, 8, 0]) is None

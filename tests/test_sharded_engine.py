@@ -36,7 +36,10 @@ def _greedy_run(engine, prompts, steps=12):
 
 
 def test_tp2_decode_matches_single_device():
-    cfg = tiny_test()
+    # fp32: bf16 logit margins on random tiny weights are thinner than
+    # the tp reduction-order jitter, which flips greedy argmax ties
+    import jax.numpy as jnp
+    cfg = tiny_test().replace(dtype=jnp.float32)
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [11, 12, 13, 14, 15, 16, 17]]
 

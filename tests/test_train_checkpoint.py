@@ -64,9 +64,10 @@ def test_restore_onto_different_mesh(tmp_path):
         _, params_b, opt_b = restore_train_state(str(tmp_path / "c"),
                                                  p_like, o_like)
         _, _, loss_b = train_step_b(params_b, opt_b, tokens_b, targets_b)
-    # same state, different sharding: same next loss
+    # same state, different sharding: same next loss up to the
+    # reduction-order jitter a different mesh layout introduces
     np.testing.assert_allclose(float(loss_b), float(
-        _continue_once(mc_a, tmp_path)), rtol=1e-4)
+        _continue_once(mc_a, tmp_path)), rtol=5e-4)
 
 
 def _continue_once(mc, tmp_path):
