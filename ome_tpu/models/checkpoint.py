@@ -354,7 +354,7 @@ SUPPORTED_ARCHITECTURES = frozenset({
     "DeepseekV2ForCausalLM", "DeepseekV3ForCausalLM",
     # decoder embedding models (engine/embed.py): bare AutoModel
     # checkpoints whose tensors lack the "model." prefix
-    "MistralModel", "Qwen2Model",
+    "MistralModel", "Qwen2Model", "Qwen3Model",
 })
 
 

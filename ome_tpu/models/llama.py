@@ -40,6 +40,34 @@ def _w(p: Params, name: str, dtype=None) -> jax.Array:
     return w
 
 
+def _proj(x: jax.Array, w, dtype, out_dims=None, flatten: int = 1):
+    """Contract x's trailing `flatten` dims with weight `w`.
+
+    int4 QTensor leaves route through the fused Pallas kernel
+    (ops/int4_matmul.py) so the nibble unpack happens in VMEM and HBM
+    streams packed bytes; everything else (bf16, int8, unsupported
+    shapes, non-TPU) takes the dequant + einsum path, which XLA fuses
+    for int8. Callers must only pass weights whose dims up to and
+    including the pack axis are contraction dims (wq/wk/wv/wo,
+    w_gate/w_up — not expert-stacked or per-head-factored leaves).
+    """
+    import math
+    lead = x.shape[:-flatten]
+    K = math.prod(x.shape[len(lead):])
+    x2 = x.reshape(*lead, K)
+    y = None
+    if isinstance(w, QTensor) and w.bits == 4:
+        from ..ops.int4_matmul import int4_matmul
+        y = int4_matmul(x2, w, dtype or jnp.bfloat16)
+    if y is None:
+        wd = w.dequant(dtype or jnp.bfloat16) \
+            if isinstance(w, QTensor) else w
+        y = jnp.einsum("...k,kn->...n", x2, wd.reshape(K, -1))
+    if out_dims:
+        y = y.reshape(*y.shape[:-1], *out_dims)
+    return y
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class KVCache:
@@ -242,10 +270,10 @@ def _activate(gate: jax.Array, cfg: Optional[ModelConfig]) -> jax.Array:
 
 def dense_mlp(x: jax.Array, p: Params,
               cfg: Optional[ModelConfig] = None) -> jax.Array:
-    gate = jnp.einsum("bsd,df->bsf", x, _w(p, "w_gate", cfg.dtype if cfg else None))
-    up = jnp.einsum("bsd,df->bsf", x, _w(p, "w_up", cfg.dtype if cfg else None))
-    return jnp.einsum("bsf,fd->bsd", _activate(gate, cfg) * up,
-                      _w(p, "w_down", cfg.dtype if cfg else None))
+    dt = cfg.dtype if cfg else None
+    gate = _proj(x, p["w_gate"], dt)
+    up = _proj(x, p["w_up"], dt)
+    return _proj(_activate(gate, cfg) * up, p["w_down"], dt)
 
 
 def _route(x: jax.Array, p: Params, cfg: ModelConfig):
@@ -397,9 +425,12 @@ def _mha(h: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
          positions: jax.Array, kv_len, cache_kv, cache_index, window,
          uo: bool):
     """Standard multi-head (GQA) attention on the pre-normed input."""
-    q = jnp.einsum("bsd,dhk->bshk", h, _w(lp, "wq", cfg.dtype))
-    k = jnp.einsum("bsd,dhk->bshk", h, _w(lp, "wk", cfg.dtype))
-    v = jnp.einsum("bsd,dhk->bshk", h, _w(lp, "wv", cfg.dtype))
+    q = _proj(h, lp["wq"], cfg.dtype,
+              out_dims=(cfg.num_heads, cfg.head_dim))
+    k = _proj(h, lp["wk"], cfg.dtype,
+              out_dims=(cfg.num_kv_heads, cfg.head_dim))
+    v = _proj(h, lp["wv"], cfg.dtype,
+              out_dims=(cfg.num_kv_heads, cfg.head_dim))
     if cfg.attn_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -434,7 +465,7 @@ def _mha(h: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
     attn = attention(q, k_full, v_full, positions=positions, kv_len=kv_len,
                      sliding_window=window, scale=cfg.query_scale,
                      logit_softcap=cfg.attn_logit_softcap)
-    a = jnp.einsum("bshk,hkd->bsd", attn, _w(lp, "wo", cfg.dtype))
+    a = _proj(attn, lp["wo"], cfg.dtype, flatten=2)
     return a, new_cache
 
 

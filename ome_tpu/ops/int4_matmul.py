@@ -1,0 +1,157 @@
+"""Fused int4 weight-only matmul (Pallas TPU kernel).
+
+XLA cannot keep the int4 nibble unpack fused into a matmul operand
+read — the dequantized bf16 weight round-trips through HBM, which is
+why `--quantization int4` measured ~flat vs bf16 through the XLA path
+(BASELINE.md round 3). This kernel streams the PACKED bytes (plus the
+small group scales) into VMEM, unpacks with i32 shifts (Mosaic has no
+i8 vector shifts), scales per group, and feeds the MXU — HBM traffic
+is the packed 0.5 byte/weight, the decode roofline's whole point.
+
+Layout contract (models/quant.py concat-pack): the packing axis holds
+pairs (g, g+G/2) within each scale group; flattened 2D view
+`[K/2, N]` where every dim up to and including the pack axis is a
+CONTRACTION dim (callers guarantee this — true for wq/wk/wv/wo and
+the MLP gate/up projections) and the trailing dims are output
+channels. Scales flatten to `[K/G, N]` after broadcasting collapsed
+contract dims.
+
+Dispatch rules (kernel falls back to the XLA dequant path otherwise):
+  * K divisible by BK = 8*G (Mosaic sublane alignment on the scale
+    slice), N divisible by 128, group size G even;
+  * M (flattened batch) <= MAX_M — the kernel is for DECODE steps;
+    big prefill matmuls are compute-bound and stay on the MXU-tiled
+    XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+MAX_M = 256
+
+
+def _kernel(x_ref, qp_ref, s_ref, o_ref, acc_ref, *, gsize: int,
+            bk: int):
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    qp = qp_ref[...].astype(jnp.int32)
+    # nibble extraction in i32: arithmetic shifts sign-extend
+    hi = qp >> 4
+    lo = (qp << 28) >> 28
+    bkp, bn = qp_ref.shape
+    g2 = gsize // 2
+    lo3 = lo.reshape(bkp // g2, g2, bn)
+    hi3 = hi.reshape(bkp // g2, g2, bn)
+    w = jnp.concatenate([lo3, hi3], axis=1)       # [BK/G, G, BN]
+    s = s_ref[pl.ds(k * (bk // gsize), bk // gsize), :]
+    w = (w.astype(jnp.float32) * s[:, None, :]).reshape(
+        2 * bkp, bn).astype(jnp.bfloat16)
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[...], w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(k == pl.num_programs(1) - 1)
+    def _done():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("gsize", "bk", "bn", "out_dtype",
+                                    "interpret"))
+def _mm4(x2, qp2, s2, gsize: int, bk: int, bn: int, out_dtype,
+         interpret: bool = False):
+    m, k = x2.shape
+    n = qp2.shape[1]
+    return pl.pallas_call(
+        functools.partial(_kernel, gsize=gsize, bk=bk),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        grid=(n // bn, k // bk),
+        in_specs=[
+            pl.BlockSpec((m, bk), lambda i, kk: (0, kk)),
+            pl.BlockSpec((bk // 2, bn), lambda i, kk: (kk, i)),
+            pl.BlockSpec((k // gsize, bn), lambda i, kk: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((m, bn), lambda i, kk: (0, i)),
+        scratch_shapes=[pltpu.VMEM((m, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(x2, qp2, s2)
+
+
+def flatten_qtensor(qt) -> Optional[tuple]:
+    """(qp2 [K/2, N], s2 [K/G, N], K, N, G) — 2D views of a packed
+    leaf whose pre-pack dims are all contraction dims; None if the
+    shapes don't flatten cleanly."""
+    q, s = qt.q, qt.s
+    if getattr(qt, "bits", 8) != 4:
+        return None
+    a = qt.axis % q.ndim
+    pre, post = q.shape[:a], q.shape[a + 1:]
+    kp = int(np.prod(pre)) * q.shape[a]
+    n = int(np.prod(post))
+    k = 2 * kp
+    n_groups = s.shape[a]
+    gsize = (2 * q.shape[a]) // n_groups
+    if gsize < 2 or gsize % 2:
+        return None
+    # broadcast collapsed (size-1) contract dims of the scales to the
+    # weight's, so groups stay contiguous after flattening
+    s_target = pre + (n_groups,) + post
+    try:
+        s_full = jnp.broadcast_to(s, s_target)
+    except Exception:
+        return None
+    qp2 = q.reshape(kp, n)
+    s2 = s_full.reshape(int(np.prod(pre)) * n_groups, n)
+    return qp2, s2, k, n, gsize
+
+
+def int4_matmul(x: jax.Array, qt, out_dtype=jnp.bfloat16,
+                interpret: bool = False) -> Optional[jax.Array]:
+    """y[..., N] = x[..., K] @ dequant(qt), nibble-unpacked in VMEM.
+
+    Returns None when the kernel doesn't apply (layout, alignment,
+    batch size, or platform) — the caller falls back to the XLA
+    dequant path.
+    """
+    import os
+    if os.environ.get("OME_INT4_KERNEL_INTERPRET"):
+        interpret = True  # tests: run the kernel path on CPU
+    if not interpret and jax.default_backend() != "tpu":
+        return None
+    flat = flatten_qtensor(qt)
+    if flat is None:
+        return None
+    qp2, s2, k, n, gsize = flat
+    if x.shape[-1] != k:
+        return None
+    bk = 8 * gsize                      # sublane-aligned scale slices
+    bn = min(512, n)
+    if k % bk or n % bn or bn % 128:
+        return None
+    lead = x.shape[:-1]
+    m = int(np.prod(lead)) if lead else 1
+    if m > MAX_M:
+        return None                     # prefill: stay on the XLA path
+    x2 = x.reshape(m, k)
+    pad = (-m) % 8
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    y = _mm4(x2.astype(jnp.bfloat16), qp2, s2, gsize, bk, bn,
+             out_dtype, interpret)
+    if pad:
+        y = y[:m]
+    return y.reshape(*lead, n)

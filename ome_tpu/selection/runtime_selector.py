@@ -190,10 +190,17 @@ class Matcher:
                 if (model.model_architecture or "").lower() != \
                         entry.model_architecture.lower():
                     continue
-            if entry.quantization:
-                got = model.quantization.value if model.quantization else ""
-                if got.lower() != entry.quantization.lower():
-                    continue
+            # quantization matches STRICTLY both ways (matcher.go:
+            # 204-212): a quantized model needs an entry declaring the
+            # same quant, and a plain entry serves only unquantized
+            # models — an fp8 checkpoint must never route to an engine
+            # that can only load full-precision safetensors
+            got = model.quantization.value if model.quantization else ""
+            want = entry.quantization or ""
+            if bool(got) != bool(want):
+                continue
+            if want and got.lower() != want.lower():
+                continue
             if best is None or (entry.priority or 0) > (best.priority or 0):
                 best = entry
         return best is not None, best

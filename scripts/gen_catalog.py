@@ -354,6 +354,137 @@ MODELS = [
     ("mixedbread", "mxbai-embed-large-v1",
      "mixedbread-ai/mxbai-embed-large-v1",
      "BertModel", "335M", 512, EMBED, None),
+    # -- round-3 breadth: DeepSeek/MLA family (served natively) ---------
+    ("deepseek", "deepseek-v2", "deepseek-ai/DeepSeek-V2",
+     "DeepseekV2ForCausalLM", "236B", 131072, CHAT, None),
+    ("deepseek", "deepseek-v2-chat", "deepseek-ai/DeepSeek-V2-Chat",
+     "DeepseekV2ForCausalLM", "236B", 131072, CHAT, None),
+    ("deepseek", "deepseek-v2-lite", "deepseek-ai/DeepSeek-V2-Lite",
+     "DeepseekV2ForCausalLM", "15.7B", 32768, CHAT, None),
+    ("deepseek", "deepseek-v2-lite-chat",
+     "deepseek-ai/DeepSeek-V2-Lite-Chat",
+     "DeepseekV2ForCausalLM", "15.7B", 32768, CHAT, None),
+    ("deepseek", "deepseek-coder-v2-lite-instruct",
+     "deepseek-ai/DeepSeek-Coder-V2-Lite-Instruct",
+     "DeepseekV2ForCausalLM", "15.7B", 131072, CHAT, None),
+    ("deepseek", "deepseek-v3-0324", "deepseek-ai/DeepSeek-V3-0324",
+     "DeepseekV3ForCausalLM", "685B", 131072, CHAT, None),
+    ("deepseek", "deepseek-r1-0528", "deepseek-ai/DeepSeek-R1-0528",
+     "DeepseekV3ForCausalLM", "685B", 131072, CHAT, None),
+    ("deepseek", "deepseek-coder-33b-instruct",
+     "deepseek-ai/deepseek-coder-33b-instruct",
+     "LlamaForCausalLM", "33.3B", 16384, CHAT, None),
+    ("deepseek", "deepseek-math-7b-instruct",
+     "deepseek-ai/deepseek-math-7b-instruct",
+     "LlamaForCausalLM", "6.91B", 4096, CHAT, None),
+    ("moonshotai", "kimi-k2-base", "moonshotai/Kimi-K2-Base",
+     "DeepseekV3ForCausalLM", "1.03T", 131072, CHAT, None),
+    # -- qwen breadth ---------------------------------------------------
+    ("qwen", "qwen2-7b-instruct", "Qwen/Qwen2-7B-Instruct",
+     "Qwen2ForCausalLM", "7.62B", 131072, CHAT, None),
+    ("qwen", "qwen2-72b-instruct", "Qwen/Qwen2-72B-Instruct",
+     "Qwen2ForCausalLM", "72.7B", 131072, CHAT, None),
+    ("qwen", "qwen2-57b-a14b-instruct", "Qwen/Qwen2-57B-A14B-Instruct",
+     "Qwen2MoeForCausalLM", "57.4B", 65536, CHAT, None),
+    ("qwen", "qwen3-235b-a22b-instruct-2507",
+     "Qwen/Qwen3-235B-A22B-Instruct-2507",
+     "Qwen3MoeForCausalLM", "235B", 262144, CHAT, None),
+    ("qwen", "qwen3-coder-480b-a35b-instruct",
+     "Qwen/Qwen3-Coder-480B-A35B-Instruct",
+     "Qwen3MoeForCausalLM", "480B", 262144, CHAT, None),
+    ("qwen", "qwen2-5-32b-instruct-gptq-int4",
+     "Qwen/Qwen2.5-32B-Instruct-GPTQ-Int4",
+     "Qwen2ForCausalLM", "32.8B", 131072, CHAT, "int4"),
+    # -- meta breadth ---------------------------------------------------
+    ("meta", "llama-guard-3-8b", "meta-llama/Llama-Guard-3-8B",
+     "LlamaForCausalLM", "8.03B", 131072, CHAT, None),
+    ("meta", "codellama-7b-instruct", "codellama/CodeLlama-7b-Instruct-hf",
+     "LlamaForCausalLM", "6.74B", 16384, CHAT, None),
+    ("meta", "codellama-13b-instruct",
+     "codellama/CodeLlama-13b-Instruct-hf",
+     "LlamaForCausalLM", "13B", 16384, CHAT, None),
+    # -- mistral breadth ------------------------------------------------
+    ("mistralai", "codestral-22b-v0-1", "mistralai/Codestral-22B-v0.1",
+     "MistralForCausalLM", "22.2B", 32768, CHAT, None),
+    ("mistralai", "mistral-7b-v0-1", "mistralai/Mistral-7B-v0.1",
+     "MistralForCausalLM", "7.24B", 32768, CHAT, None),
+    ("mistralai", "magistral-small-2506",
+     "mistralai/Magistral-Small-2506",
+     "MistralForCausalLM", "23.6B", 40960, CHAT, None),
+    # -- google ---------------------------------------------------------
+    ("google", "gemma-7b-it", "google/gemma-7b-it",
+     "GemmaForCausalLM", "8.54B", 8192, CHAT, None),
+    ("google", "gemma-2b-it", "google/gemma-2b-it",
+     "GemmaForCausalLM", "2.51B", 8192, CHAT, None),
+    # -- microsoft ------------------------------------------------------
+    ("microsoft", "phi-4-mini-instruct", "microsoft/Phi-4-mini-instruct",
+     "Phi3ForCausalLM", "3.84B", 131072, CHAT, None),
+    ("microsoft", "phi-2", "microsoft/phi-2",
+     "PhiForCausalLM", "2.78B", 2048, CHAT, None),
+    # -- cohere ---------------------------------------------------------
+    ("cohere", "aya-expanse-32b", "CohereForAI/aya-expanse-32b",
+     "CohereForCausalLM", "32.3B", 131072, CHAT, None),
+    ("cohere", "command-r7b-12-2024", "CohereForAI/c4ai-command-r7b-12-2024",
+     "Cohere2ForCausalLM", "8.03B", 131072, CHAT, None),
+    ("cohere", "command-a-03-2025", "CohereForAI/c4ai-command-a-03-2025",
+     "Cohere2ForCausalLM", "111B", 262144, CHAT, None),
+    # -- more vendors ---------------------------------------------------
+    ("01-ai", "yi-coder-9b-chat", "01-ai/Yi-Coder-9B-Chat",
+     "LlamaForCausalLM", "8.83B", 131072, CHAT, None),
+    ("tii", "falcon3-7b-instruct", "tiiuae/Falcon3-7B-Instruct",
+     "LlamaForCausalLM", "7.46B", 32768, CHAT, None),
+    ("tii", "falcon-180b-chat", "tiiuae/falcon-180B-chat",
+     "FalconForCausalLM", "180B", 2048, CHAT, None),
+    ("ibm", "granite-3-1-3b-a800m-instruct",
+     "ibm-granite/granite-3.1-3b-a800m-instruct",
+     "GraniteMoeForCausalLM", "3.3B", 131072, CHAT, None),
+    ("ibm", "granite-20b-code-instruct",
+     "ibm-granite/granite-20b-code-instruct-8k",
+     "GPTBigCodeForCausalLM", "20.1B", 8192, CHAT, None),
+    ("allenai", "olmoe-1b-7b-0924-instruct",
+     "allenai/OLMoE-1B-7B-0924-Instruct",
+     "OlmoeForCausalLM", "6.92B", 4096, CHAT, None),
+    ("zhipu", "glm-4-32b-0414", "THUDM/GLM-4-32B-0414",
+     "Glm4ForCausalLM", "32.6B", 32768, CHAT, None),
+    ("zhipu", "glm-z1-9b-0414", "THUDM/GLM-Z1-9B-0414",
+     "Glm4ForCausalLM", "9.4B", 32768, CHAT, None),
+    ("nvidia", "llama-3-3-nemotron-super-49b-v1",
+     "nvidia/Llama-3_3-Nemotron-Super-49B-v1",
+     "LlamaForCausalLM", "49.9B", 131072, CHAT, None),
+    ("ai21", "jamba-1-5-large", "ai21labs/AI21-Jamba-1.5-Large",
+     "JambaForCausalLM", "398B", 262144, CHAT, None),
+    ("lg", "exaone-3-5-32b-instruct",
+     "LGAI-EXAONE/EXAONE-3.5-32B-Instruct",
+     "ExaoneForCausalLM", "32B", 32768, CHAT, None),
+    ("upstage", "solar-10-7b-instruct",
+     "upstage/SOLAR-10.7B-Instruct-v1.0",
+     "LlamaForCausalLM", "10.7B", 4096, CHAT, None),
+    ("nousresearch", "hermes-3-llama-3-1-8b",
+     "NousResearch/Hermes-3-Llama-3.1-8B",
+     "LlamaForCausalLM", "8.03B", 131072, CHAT, None),
+    ("huggingface", "zephyr-7b-beta", "HuggingFaceH4/zephyr-7b-beta",
+     "MistralForCausalLM", "7.24B", 32768, CHAT, None),
+    ("stabilityai", "stablelm-2-1-6b-chat",
+     "stabilityai/stablelm-2-1_6b-chat",
+     "StableLmForCausalLM", "1.64B", 4096, CHAT, None),
+    # -- quantized checkpoints ------------------------------------------
+    ("neuralmagic", "llama-3-1-8b-instruct-w8a8",
+     "neuralmagic/Meta-Llama-3.1-8B-Instruct-quantized.w8a8",
+     "LlamaForCausalLM", "8.03B", 131072, CHAT, "int8"),
+    ("neuralmagic", "llama-3-1-70b-instruct-fp8",
+     "neuralmagic/Meta-Llama-3.1-70B-Instruct-FP8",
+     "LlamaForCausalLM", "70.6B", 131072, CHAT, "fp8"),
+    ("mistralai", "mixtral-8x7b-instruct-awq",
+     "TheBloke/Mixtral-8x7B-Instruct-v0.1-AWQ",
+     "MixtralForCausalLM", "46.7B", 32768, CHAT, "int4"),
+    # -- embeddings breadth ---------------------------------------------
+    ("snowflake", "arctic-embed-l", "Snowflake/snowflake-arctic-embed-l",
+     "BertModel", "335M", 512, EMBED, None),
+    ("salesforce", "sfr-embedding-mistral",
+     "Salesforce/SFR-Embedding-Mistral",
+     "MistralModel", "7.11B", 32768, EMBED, None),
+    ("qwen", "qwen3-embedding-0-6b", "Qwen/Qwen3-Embedding-0.6B",
+     "Qwen3Model", "595M", 32768, EMBED, None),
 ]
 
 
@@ -460,6 +591,8 @@ def runtime_docs():
         "metadata": {"name": "vllm-tpu-llama-70b"},
         "spec": {
             "supportedModelFormats": [fmt("LlamaForCausalLM", prio=5),
+                                      fmt("LlamaForCausalLM",
+                                          quant="fp8", prio=4),
                                       fmt("Qwen2ForCausalLM", prio=4),
                                       fmt("Qwen3ForCausalLM", prio=4)],
             "modelSizeRange": {"min": "30B", "max": "110B"},
@@ -595,7 +728,8 @@ def runtime_docs():
         "metadata": {"name": "ome-engine-embeddings"},
         "spec": {
             "supportedModelFormats": [fmt("MistralModel", prio=2),
-                                      fmt("Qwen2Model", prio=2)],
+                                      fmt("Qwen2Model", prio=2),
+                                      fmt("Qwen3Model", prio=2)],
             "modelSizeRange": {"min": "10M", "max": "10B"},
             "protocolVersions": ["openAI"],
             "engineConfig": {"runner": {
@@ -786,7 +920,8 @@ def extra_runtime_docs():
     yield "runtimes/vllm/vllm-tpu-int4-rt.yaml", _csr(
         "vllm-tpu-int4",
         [fmt(a, quant="int4", prio=4) for a in
-         ("LlamaForCausalLM", "Qwen2ForCausalLM")],
+         ("LlamaForCausalLM", "Qwen2ForCausalLM",
+          "MixtralForCausalLM")],
         "1B", "110B",
         {"runner": _tpu_runner(
             vllm, ["--model", "$(MODEL_PATH)", "--quantization", "awq",
@@ -800,7 +935,7 @@ def extra_runtime_docs():
         "vllm-tpu-embeddings",
         [fmt(a, prio=1) for a in
          ("MistralModel", "XLMRobertaModel", "BertModel", "Qwen2Model",
-          "NomicBertModel")],
+          "Qwen3Model", "NomicBertModel")],
         "10M", "10B",
         {"runner": _tpu_runner(
             vllm, ["--model", "$(MODEL_PATH)", "--task", "embed",
@@ -1100,7 +1235,8 @@ def family_runtime_docs():
     # ---- embeddings on v6e --------------------------------------------
     yield "runtimes/ome/ome-engine-embeddings-v6e-rt.yaml", _csr(
         "ome-engine-embeddings-v6e",
-        [fmt("MistralModel", prio=3), fmt("Qwen2Model", prio=3)],
+        [fmt("MistralModel", prio=3), fmt("Qwen2Model", prio=3),
+         fmt("Qwen3Model", prio=3)],
         "10M", "10B",
         {"runner": _tpu_runner(
             ome, ["--model-dir", "$(MODEL_PATH)", "--task", "embed",

@@ -105,6 +105,20 @@ class TestCatalogRouting:
         assert self._select(catalog, "e5-mistral-7b-instruct") == \
             "ome-engine-embeddings"
 
+    def test_quantized_models_route_to_quant_declaring_runtimes(
+            self, catalog):
+        """Strict two-way quantization matching (matcher.go:204-212):
+        an fp8/awq/w8a8 checkpoint must never land on a runtime that
+        only loads full-precision safetensors."""
+        cases = {
+            "llama-3-1-70b-instruct-fp8": "vllm-tpu-llama-70b",
+            "mixtral-8x7b-instruct-awq": "vllm-tpu-int4",
+            "llama-3-1-8b-instruct-w8a8": "ome-engine-int8",
+            "llama-3-1-8b-instruct-awq-int4": "ome-engine-int4",
+        }
+        for model, runtime in cases.items():
+            assert self._select(catalog, model) == runtime, model
+
     def test_crd_files_cover_all_kinds(self):
         names = os.listdir(os.path.join(CONFIG, "crd"))
         for plural in ("inferenceservices", "basemodels",
