@@ -263,10 +263,43 @@ class InferenceEngine:
                                lengths=new_cache.index,
                                tokens=toks), toks
 
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _decode_masked(params, state: DecodeState, temperature,
+                           top_k, top_p, key, mask,
+                           ) -> Tuple[DecodeState, jax.Array]:
+            """Decode with a [B, V] allowed-token mask (structured
+            outputs / JSON mode — engine/structured.py). Separate
+            program so unconstrained batches never pay the mask
+            transfer."""
+            cache = llama.KVCache(k=state.k, v=state.v, index=state.lengths)
+            logits, new_cache = llama.forward(
+                params, cfg_, state.tokens[:, None], cache=cache)
+            masked = jnp.where(mask, logits[:, -1], -jnp.inf)
+            toks = sample(masked, key, temperature, top_k, top_p)
+            return DecodeState(k=new_cache.k, v=new_cache.v,
+                               lengths=new_cache.index,
+                               tokens=toks), toks
+
+        @functools.partial(jax.jit, static_argnames=("bucket",))
+        def _prefill_masked(params, padded, true_len, temperature,
+                            top_k, top_p, key, mask, bucket: int):
+            """Bucketed prefill whose FIRST sampled token honors the
+            structured-output mask."""
+            cache = llama.KVCache.create(cfg_, 1, bucket)
+            logits, new_cache = llama.forward(params, cfg_, padded,
+                                              cache=cache)
+            last = jnp.take_along_axis(
+                logits, (true_len - 1)[:, None, None], axis=1)[:, 0]
+            last = jnp.where(mask, last, -jnp.inf)
+            tok = sample(last, key, temperature, top_k, top_p)
+            return tok[0], new_cache.k, new_cache.v
+
         self._prefill_fn = _prefill
+        self._prefill_masked_fn = _prefill_masked
         self._prefill_suffix_fn = _prefill_suffix
         self._insert_fn = _insert
         self._decode_fn = _decode
+        self._decode_masked_fn = _decode_masked
         self._step = 0
         self._root_key = jax.random.PRNGKey(0)
         # prefill (admission thread) and decode (scheduler thread) both
@@ -294,12 +327,16 @@ class InferenceEngine:
     # -- ops -----------------------------------------------------------
 
     def prefill(self, prompt_ids: List[int], temperature: float = 0.0,
-                top_k: int = 0, top_p: float = 1.0):
+                top_k: int = 0, top_p: float = 1.0,
+                first_mask: Optional[np.ndarray] = None):
         """Returns (first_token:int, kv pair, true_len, bucket).
 
         With a prefix cache enabled, a prompt whose leading tokens were
         prefetched by an earlier request runs only its suffix through
-        the model (chunked prefill atop the cached KV)."""
+        the model (chunked prefill atop the cached KV). `first_mask`
+        ([V] bool) constrains the first sampled token (structured
+        outputs) and bypasses the prefix-cache suffix path (one shape
+        fewer to compile; constrained prompts still seed the cache)."""
         # leave room for one generated token; cap at the largest bucket
         max_prompt = min(self.max_seq - 1, self.prefill_buckets[-1])
         ids = prompt_ids[-max_prompt:]
@@ -325,7 +362,8 @@ class InferenceEngine:
                                        self.prefill_buckets)
                     <= self.prefill_buckets[-1])
 
-        hit = self.prefix_cache.match(ids, usable=_usable)
+        hit = None if first_mask is not None \
+            else self.prefix_cache.match(ids, usable=_usable)
         if hit is not None:
             pk, pv, plen, _pbucket = hit
             plen = _pow2_keep(plen)  # discard the ragged tail blocks
@@ -346,9 +384,17 @@ class InferenceEngine:
             bucket = _bucketize(len(ids), self.prefill_buckets)
             padded = np.asarray(
                 [ids + [0] * (bucket - len(ids))], np.int32)
-            tok, k, v = self._prefill_fn(
-                self.params, padded, np.asarray([len(ids)], np.int32),
-                *sampling, key, bucket=bucket)
+            if first_mask is not None:
+                tok, k, v = self._prefill_masked_fn(
+                    self.params, padded,
+                    np.asarray([len(ids)], np.int32), *sampling, key,
+                    np.asarray(first_mask, bool)[None, :],
+                    bucket=bucket)
+            else:
+                tok, k, v = self._prefill_fn(
+                    self.params, padded,
+                    np.asarray([len(ids)], np.int32), *sampling, key,
+                    bucket=bucket)
         self.prefix_cache.put(ids, k, v, len(ids), bucket)
         # multi-host: int() on an array spanning non-addressable
         # devices raises; fetch the local replica instead
@@ -363,9 +409,18 @@ class InferenceEngine:
             np.asarray(token, np.int32), bucket=bucket)
 
     def decode(self, state: DecodeState, temperature, top_k, top_p,
+               mask: Optional[np.ndarray] = None,
                ) -> Tuple[DecodeState, jax.Array]:
-        """One decode step for ALL slots. Sampling params: [B] arrays."""
+        """One decode step for ALL slots. Sampling params: [B] arrays.
+        `mask` ([B, V] bool) routes through the masked program
+        (structured outputs); None keeps the maskless one."""
         key = self._next_key()
+        if mask is not None:
+            return self._decode_masked_fn(
+                self.params, state, np.asarray(temperature, np.float32),
+                np.asarray(top_k, np.int32),
+                np.asarray(top_p, np.float32), key,
+                np.asarray(mask, bool))
         return self._decode_fn(self.params, state,
                                np.asarray(temperature, np.float32),
                                np.asarray(top_k, np.int32),

@@ -44,6 +44,9 @@ class Request:
     top_k: int = 0
     top_p: float = 1.0
     stop_ids: Sequence[int] = ()
+    # structured outputs: a TokenMasker (engine/structured.py)
+    # constrains sampling to valid continuations of its grammar
+    masker: Optional[object] = None
     id: int = field(default_factory=lambda: next(_ids))
     created: float = field(default_factory=time.monotonic)
     # results
@@ -202,9 +205,7 @@ class Scheduler:
                 self._free_slots.release()
                 continue
             try:
-                tok, kv, true_len, bucket = self.engine.prefill(
-                    req.prompt_ids, req.temperature, req.top_k,
-                    req.top_p)
+                tok, kv, true_len, bucket = self._prefill_req(req)
             except Exception as e:  # noqa: BLE001
                 import logging
                 # engines that fetch prefill remotely (PD decode
@@ -275,8 +276,7 @@ class Scheduler:
             except queue.Empty:
                 break
             try:
-                tok, kv, true_len, bucket = self.engine.prefill(
-                    req.prompt_ids, req.temperature, req.top_k, req.top_p)
+                tok, kv, true_len, bucket = self._prefill_req(req)
                 self.state = self.engine.insert(
                     self.state, kv, slot, true_len, tok, bucket)
             except Exception:
@@ -303,8 +303,14 @@ class Scheduler:
     def _decode(self) -> bool:
         if not any(r is not None for r in self.slots):
             return False
-        self.state, toks = self.engine.decode(
-            self.state, self._temp, self._top_k, self._top_p)
+        mask = self._build_mask()
+        if mask is not None:
+            self.state, toks = self.engine.decode(
+                self.state, self._temp, self._top_k, self._top_p,
+                mask=mask)
+        else:  # engine wrappers/fakes need no mask kwarg in their API
+            self.state, toks = self.engine.decode(
+                self.state, self._temp, self._top_k, self._top_p)
         self._inc("decode_steps_total")
         host_toks = np.asarray(toks)
         for slot, req in enumerate(self.slots):
@@ -316,9 +322,43 @@ class Scheduler:
             self._maybe_finish(slot, tok)
         return True
 
+    def _prefill_req(self, req: Request):
+        """Engine prefill for one request; constrained requests pass
+        the grammar mask for their FIRST sampled token."""
+        if req.masker is not None:
+            fm = req.masker.mask(self.engine.cfg.vocab_size)
+            return self.engine.prefill(
+                req.prompt_ids, req.temperature, req.top_k, req.top_p,
+                first_mask=fm)
+        return self.engine.prefill(req.prompt_ids, req.temperature,
+                                   req.top_k, req.top_p)
+
+    def _build_mask(self):
+        """[B, V] allowed-token mask when any slot is constrained
+        (structured outputs); None otherwise so the maskless compiled
+        program keeps running."""
+        if not any(r is not None and r.masker is not None
+                   for r in self.slots):
+            return None
+        V = self.engine.cfg.vocab_size
+        mask = np.ones((self.engine.max_slots, V), dtype=bool)
+        for slot, r in enumerate(self.slots):
+            if r is not None and r.masker is not None:
+                remaining = r.max_new_tokens - len(r.output_ids)
+                # switch to close-out masks before the budget can
+                # strand an open string/container (valid JSON even at
+                # finish_reason=length)
+                closing = remaining <= r.masker.closing_distance() + 4
+                mask[slot] = r.masker.mask(V, closing=closing)
+        return mask
+
     def _maybe_finish(self, slot: int, tok: int):
         req = self.slots[slot]
-        if tok in req.stop_ids:
+        if req.masker is not None:
+            req.masker.feed(tok)
+        if req.masker is not None and req.masker.done():
+            reason = "stop"  # the grammar accepted a complete value
+        elif tok in req.stop_ids:
             reason = "stop"
         elif len(req.output_ids) >= req.max_new_tokens:
             reason = "length"

@@ -267,7 +267,12 @@ def main(argv=None) -> int:
     name = args.model_name or args.model_dir.rstrip("/").rsplit("/", 1)[-1]
     server = EngineServer(scheduler, tokenizer=tok, model_name=name,
                           host=args.host, port=args.port,
-                          embedder=embedder, pd_prefill=pd_prefill)
+                          embedder=embedder, pd_prefill=pd_prefill,
+                          # masks are host-built per step: multi-host
+                          # followers can't replay them, and PD decode
+                          # nodes can't constrain the remote first token
+                          structured=(dist is None and
+                                      args.disaggregation_mode == "none"))
     log.info("serving %s on %s:%d (%s)", name, args.host, server.port,
              "embeddings" if embedder else
              f"slots={scheduler.engine.max_slots}")

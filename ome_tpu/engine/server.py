@@ -24,12 +24,16 @@ from .tokenizer import load_tokenizer
 class EngineServer:
     def __init__(self, scheduler: Scheduler, tokenizer=None,
                  model_name: str = "ome-model", host: str = "127.0.0.1",
-                 port: int = 0, embedder=None, pd_prefill=None):
+                 port: int = 0, embedder=None, pd_prefill=None,
+                 structured: bool = True):
         self.scheduler = scheduler
         self.tokenizer = tokenizer or load_tokenizer()
         self.model_name = model_name
         self.embedder = embedder  # engine/embed.py EmbeddingEngine
         self.pd_prefill = pd_prefill  # engine/pd.py prefill-node handler
+        # structured outputs need host-built masks each step; multi-host
+        # leaders and PD decode nodes disable them (serve.py)
+        self.structured = structured
         self.started_at = time.time()
         outer = self
 
@@ -147,12 +151,33 @@ class EngineServer:
                     prompt = payload.get("prompt", "")
                     if isinstance(prompt, list):
                         prompt = "".join(prompt)
+                masker = None
+                rf = payload.get("response_format") or {}
+                if rf:
+                    kind = rf.get("type")
+                    if kind not in ("json_object", "text", None):
+                        return self._json(400, {
+                            "error": f"response_format type {kind!r} "
+                                     "is not supported (json_object "
+                                     "and text are)"})
+                    if kind == "json_object":
+                        if not outer.structured:
+                            return self._json(400, {
+                                "error": "structured outputs are not "
+                                         "available on this node "
+                                         "(multi-host leader or PD "
+                                         "decode role)"})
+                        from .structured import TokenMasker
+                        # OpenAI json_object means a JSON OBJECT, not
+                        # any value — root must open with '{'
+                        masker = TokenMasker(tok, object_root=True)
                 req = Request(
                     prompt_ids=tok.encode(prompt),
                     max_new_tokens=int(payload.get("max_tokens", 64)),
                     temperature=float(payload.get("temperature", 0.0)),
                     top_k=int(payload.get("top_k", 0)),
                     top_p=float(payload.get("top_p", 1.0)),
+                    masker=masker,
                     stop_ids=[tok.eos_id] if tok.eos_id is not None else [])
                 try:
                     outer.scheduler.submit(req)

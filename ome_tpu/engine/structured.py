@@ -1,0 +1,409 @@
+"""Structured outputs: grammar-constrained decoding (JSON mode).
+
+The reference serves structured output through SGLang's constrained
+decoding (xgrammar/outlines compile grammars to token-level FSMs on
+GPU); this is the TPU-native redesign for the in-repo engine:
+
+  * a BYTE-level pushdown automaton accepts exactly the JSON grammar
+    (objects/arrays/strings with escapes/numbers/literals + bounded
+    whitespace). Byte-level beats token-level as the source of truth:
+    it is tokenizer-independent, and the engine's hermetic
+    ByteTokenizer maps one token to one byte, so masks there are exact
+    set lookups.
+  * per decode step, a constrained slot's allowed-token mask is
+    computed HOST-side (first-byte prefilter from the automaton, then
+    full byte-walk per surviving token via a one-time token->bytes
+    table) and shipped to the device, where a masked sampling variant
+    adds -inf to forbidden logits. Unconstrained batches keep the
+    maskless compiled program — zero cost when the feature is off.
+  * EOS becomes legal exactly when the automaton has accepted a
+    complete JSON value; max_new_tokens still bounds pathological
+    grammars.
+
+Scope: `response_format {"type": "json_object"}` (any complete JSON
+value, object-rooted when `object_root`). Schema-conditioned grammars
+(`json_schema`) compile to the same mask interface and are recorded as
+future work — the automaton is the extension point.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# -- byte-level JSON pushdown automaton ------------------------------------
+
+WS = frozenset(b" \t\n\r")
+DIGITS = frozenset(b"0123456789")
+HEX = frozenset(b"0123456789abcdefABCDEF")
+
+# modes (top of an explicit stack; the stack nests containers)
+VALUE = "value"            # expecting a value
+OBJ_KEY_OR_END = "obj0"    # '{' seen: '"' or '}'
+OBJ_KEY = "objk"           # after ',': a '"' key must follow
+OBJ_COLON = "objc"         # key done: ':'
+OBJ_COMMA_OR_END = "obje"  # value done: ',' or '}'
+ARR_VAL_OR_END = "arr0"    # '[' seen: value or ']'
+ARR_COMMA_OR_END = "arre"  # value done: ',' or ']'
+STR = "str"                # inside a string
+STR_ESC = "esc"            # after backslash
+STR_HEX = "hex"            # inside \uXXXX (digits remaining in aux)
+NUM = "num"                # inside a number (aux = sub-state)
+LIT = "lit"                # inside true/false/null (aux = rest)
+DONE = "done"
+
+_NUM_START = frozenset(b"-0123456789")
+_LITERALS = {ord("t"): b"rue", ord("f"): b"alse", ord("n"): b"ull"}
+
+
+class JsonAutomaton:
+    """One request's constrained-decoding state. Immutable transitions
+    via advance() mutating internal stack — copy() before speculative
+    walks."""
+
+    def __init__(self, object_root: bool = False):
+        # stack of (mode, aux); bottom sentinel handles the root value
+        self.stack: List[Tuple[str, object]] = [
+            (OBJ_KEY_OR_END, None)] if object_root else []
+        if object_root:
+            self.stack = [(VALUE, "root_obj")]
+        else:
+            self.stack = [(VALUE, None)]
+        self.complete = False
+
+    def copy(self) -> "JsonAutomaton":
+        a = JsonAutomaton.__new__(JsonAutomaton)
+        a.stack = list(self.stack)
+        a.complete = self.complete
+        return a
+
+    # -- transitions ---------------------------------------------------
+
+    def advance(self, b: int) -> bool:
+        """Consume one byte; False if it is not a legal continuation."""
+        if not self.stack:
+            # after the root value closed: only trailing whitespace
+            return b in WS
+        mode, aux = self.stack[-1]
+
+        if mode == STR:
+            if b == 0x22:                       # closing quote
+                self.stack.pop()
+                self._value_done()
+                return True
+            if b == 0x5C:                       # backslash
+                self.stack[-1] = (STR_ESC, aux)
+                return True
+            return 0x20 <= b <= 0x10FFFF and b != 0x22
+        if mode == STR_ESC:
+            if b in b'"\\/bfnrt':
+                self.stack[-1] = (STR, aux)
+                return True
+            if b == ord("u"):
+                self.stack[-1] = (STR_HEX, 4)
+                return True
+            return False
+        if mode == STR_HEX:
+            if b in HEX:
+                left = aux - 1
+                self.stack[-1] = (STR, None) if left == 0 \
+                    else (STR_HEX, left)
+                return True
+            return False
+        if mode == NUM:
+            return self._advance_number(b, aux)
+        if mode == LIT:
+            rest: bytes = aux
+            if rest and b == rest[0]:
+                if len(rest) == 1:
+                    self.stack.pop()
+                    self._value_done()
+                else:
+                    self.stack[-1] = (LIT, rest[1:])
+                return True
+            return False
+
+        if b in WS:
+            return True
+
+        if mode == VALUE:
+            root_obj = aux == "root_obj"
+            if b == 0x7B:                       # {
+                self.stack[-1] = (OBJ_KEY_OR_END, None)
+                return True
+            if root_obj:
+                return False                    # object-rooted mode
+            if b == 0x5B:                       # [
+                self.stack[-1] = (ARR_VAL_OR_END, None)
+                return True
+            if b == 0x22:
+                self.stack[-1] = (STR, "value")
+                return True
+            if b in _NUM_START:
+                self.stack[-1] = (NUM, "int-first" if b != ord("0")
+                                  else "int-zero")
+                if b == ord("-"):
+                    self.stack[-1] = (NUM, "neg")
+                return True
+            if b in _LITERALS:
+                self.stack[-1] = (LIT, _LITERALS[b])
+                return True
+            return False
+        if mode == OBJ_KEY_OR_END:
+            if b == 0x7D:                       # }
+                self.stack.pop()
+                self._value_done()
+                return True
+            if b == 0x22:
+                self.stack[-1] = (OBJ_COLON, None)
+                self.stack.append((STR, "key"))
+                return True
+            return False
+        if mode == OBJ_KEY:
+            if b == 0x22:
+                self.stack[-1] = (OBJ_COLON, None)
+                self.stack.append((STR, "key"))
+                return True
+            return False
+        if mode == OBJ_COLON:
+            if b == 0x3A:                       # :
+                self.stack[-1] = (OBJ_COMMA_OR_END, None)
+                self.stack.append((VALUE, None))
+                return True
+            return False
+        if mode == OBJ_COMMA_OR_END:
+            if b == 0x2C:                       # ,
+                self.stack[-1] = (OBJ_KEY, None)
+                return True
+            if b == 0x7D:
+                self.stack.pop()
+                self._value_done()
+                return True
+            return False
+        if mode == ARR_VAL_OR_END:
+            if b == 0x5D:                       # ]
+                self.stack.pop()
+                self._value_done()
+                return True
+            self.stack[-1] = (ARR_COMMA_OR_END, None)
+            self.stack.append((VALUE, None))
+            return self.advance(b)
+        if mode == ARR_COMMA_OR_END:
+            if b == 0x2C:
+                self.stack.append((VALUE, None))
+                return True
+            if b == 0x5D:
+                self.stack.pop()
+                self._value_done()
+                return True
+            return False
+        return False
+
+    def _advance_number(self, b: int, sub: str) -> bool:
+        def to(new):
+            self.stack[-1] = (NUM, new)
+            return True
+
+        if sub == "neg":
+            if b == ord("0"):
+                return to("int-zero")
+            if b in DIGITS:
+                return to("int-first")
+            return False
+        if sub in ("int-first", "int"):
+            if b in DIGITS:
+                return to("int")
+            return self._number_tail(b)
+        if sub == "int-zero":
+            return self._number_tail(b)
+        if sub == "frac0":
+            return to("frac") if b in DIGITS else False
+        if sub == "frac":
+            if b in DIGITS:
+                return True
+            return self._number_tail(b, allow_frac=False)
+        if sub == "exp0":
+            if b in b"+-":
+                return to("exp1")
+            return to("exp") if b in DIGITS else False
+        if sub == "exp1":
+            return to("exp") if b in DIGITS else False
+        if sub == "exp":
+            return True if b in DIGITS else self._number_end(b)
+        return False
+
+    def _number_tail(self, b: int, allow_frac: bool = True) -> bool:
+        if allow_frac and b == ord("."):
+            self.stack[-1] = (NUM, "frac0")
+            return True
+        if b in b"eE":
+            self.stack[-1] = (NUM, "exp0")
+            return True
+        return self._number_end(b)
+
+    def _number_end(self, b: int) -> bool:
+        # the number is complete; the byte belongs to the ENCLOSING
+        # context — pop and re-dispatch
+        self.stack.pop()
+        self._value_done()
+        return self.advance(b)
+
+    def _number_can_end(self) -> bool:
+        if not self.stack or self.stack[-1][0] != NUM:
+            return False
+        return self.stack[-1][1] in ("int", "int-first", "int-zero",
+                                     "frac", "exp")
+
+    def _value_done(self):
+        if not self.stack:
+            self.complete = True
+
+    # -- queries -------------------------------------------------------
+
+    def is_complete(self) -> bool:
+        """A full JSON value has been emitted (EOS is legal). Numbers
+        complete implicitly: `12` is complete even though `123` could
+        continue."""
+        if self.complete and (not self.stack):
+            return True
+        # a bare root number/"value finished" case: stack holds a
+        # completable number at the root
+        if len(self.stack) == 1 and self._number_can_end():
+            return True
+        return False
+
+    def accepts(self, data: bytes) -> bool:
+        """Would this byte string be a legal continuation? (Pure — works
+        on a copy.)"""
+        a = self.copy()
+        for b in data:
+            if not a.advance(b):
+                return False
+        return True
+
+    def closing_bytes(self) -> frozenset:
+        """Bytes on the MINIMAL completion path from this state — the
+        close-out mask near the token budget: close strings, close
+        containers, finish literals/escapes; open nothing new."""
+        if not self.stack:
+            return frozenset()
+        mode, aux = self.stack[-1]
+        if mode == STR:
+            return frozenset((0x22,))
+        if mode == STR_ESC:
+            return frozenset(b'"\\/bfnrt')
+        if mode == STR_HEX:
+            return frozenset(b"0123456789abcdef")
+        if mode == LIT:
+            return frozenset((aux[0],))
+        if mode == NUM:
+            if self._number_can_end():
+                # the closer belongs to the enclosing context
+                a = self.copy()
+                a.stack.pop()
+                a._value_done()
+                return a.closing_bytes()
+            return frozenset(b"0123456789")
+        if mode == VALUE:
+            return frozenset((0x7B,)) if aux == "root_obj" \
+                else frozenset((ord("0"),))
+        if mode in (OBJ_KEY_OR_END, OBJ_COMMA_OR_END):
+            return frozenset((0x7D,))
+        if mode == OBJ_KEY:
+            return frozenset((0x22,))
+        if mode == OBJ_COLON:
+            return frozenset((0x3A,))
+        if mode in (ARR_VAL_OR_END, ARR_COMMA_OR_END):
+            return frozenset((0x5D,))
+        return frozenset()
+
+    def accepts_closing(self, data: bytes) -> bool:
+        """Legal continuation where EVERY byte stays on the minimal
+        completion path."""
+        a = self.copy()
+        for b in data:
+            if b not in a.closing_bytes() or not a.advance(b):
+                return False
+        return True
+
+    def closing_distance(self) -> int:
+        """Upper bound on bytes needed to complete from here (the
+        scheduler's budget margin)."""
+        n = 0
+        for mode, aux in self.stack:
+            if mode in (STR, STR_ESC):
+                n += 3
+            elif mode == STR_HEX:
+                n += 5
+            elif mode == LIT:
+                n += len(aux) if isinstance(aux, bytes) else 4
+            elif mode == VALUE:
+                n += 2  # "{}" worst case (object root)
+            elif mode == OBJ_COLON:
+                n += 2
+            else:
+                n += 2
+        return n
+
+
+class TokenMasker:
+    """Tokenizer-aware mask builder over a JsonAutomaton.
+
+    One token->bytes table per tokenizer (built lazily, shared across
+    requests); per step: first-byte prefilter, then a full byte-walk of
+    surviving tokens.
+    """
+
+    _tables: Dict[int, list] = {}  # id(tokenizer) -> per-token bytes
+
+    def __init__(self, tokenizer, object_root: bool = False):
+        self.tok = tokenizer
+        self.automaton = JsonAutomaton(object_root=object_root)
+        self.table = self._token_table(tokenizer)
+        self.eos_id = getattr(tokenizer, "eos_id", None)
+
+    @classmethod
+    def _token_table(cls, tok) -> list:
+        key = id(tok)
+        if key not in cls._tables:
+            table = []
+            for i in range(tok.vocab_size):
+                try:
+                    table.append(tok.decode([i]).encode("utf-8"))
+                except Exception:
+                    table.append(b"")
+            cls._tables[key] = table
+        return cls._tables[key]
+
+    def feed(self, token_id: int) -> None:
+        """Advance past an emitted token (its bytes were validated by
+        the mask, but be tolerant of forced tokens)."""
+        for b in self.table[token_id]:
+            if not self.automaton.advance(b):
+                break
+
+    def mask(self, vocab_size: int,
+             closing: bool = False) -> np.ndarray:
+        """Boolean [vocab_size]: which tokens keep the output valid.
+        `closing` restricts to the minimal completion path — the
+        scheduler sets it when the remaining token budget approaches
+        the closing distance, so budget exhaustion cannot strand an
+        unterminated string or open container."""
+        m = np.zeros(vocab_size, dtype=bool)
+        a = self.automaton
+        ok = a.accepts_closing if closing else a.accepts
+        for i, data in enumerate(self.table):
+            if data and ok(data):
+                m[i] = True
+        if self.eos_id is not None and a.is_complete():
+            m[self.eos_id] = True
+        if not m.any() and self.eos_id is not None:
+            m[self.eos_id] = True  # dead end: finish rather than hang
+        return m
+
+    def closing_distance(self) -> int:
+        return self.automaton.closing_distance()
+
+    def done(self) -> bool:
+        return self.automaton.is_complete()

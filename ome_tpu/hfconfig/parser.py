@@ -200,14 +200,16 @@ def _vlm(model_type: str, text_key: str = "text_config") -> FamilyHandler:
 for _t in ("llama", "mistral", "qwen2", "qwen3", "gemma", "gemma2",
            "phi", "phi3", "stablelm", "internlm2", "baichuan", "yi",
            "olmo", "olmo2", "granite", "starcoder2", "gpt_neox", "mpt",
-           "falcon", "exaone", "nemotron", "glm", "chatglm", "smollm"):
+           "falcon", "exaone", "nemotron", "glm", "glm4", "chatglm",
+           "smollm", "gpt_bigcode"):
     register(FamilyHandler(_t))
 register(FamilyHandler("gpt2", context_keys=("n_positions", "n_ctx")))
 register(FamilyHandler("gemma3_text"))
 register(FamilyHandler("cohere"))   # command-r
 register(FamilyHandler("cohere2"))
 for _t in ("mixtral", "qwen2_moe", "qwen3_moe", "phimoe", "dbrx",
-           "jamba", "olmoe", "arctic", "gpt_oss", "grok-1", "minimax"):
+           "jamba", "olmoe", "arctic", "gpt_oss", "grok-1", "minimax",
+           "granitemoe"):
     register(FamilyHandler(_t, params=moe_params))
 for _t in ("deepseek", "deepseek_v2", "deepseek_v3", "kimi_k2",
            "minicpm3"):

@@ -1,0 +1,194 @@
+"""Structured outputs (engine/structured.py): the JSON byte automaton,
+token masking, and the e2e guarantee — a RANDOM-weights model forced
+through the grammar emits syntactically valid JSON, every time. This is
+the constrained-decoding capability the reference gets from SGLang's
+xgrammar, redesigned as host-built masks + a masked sampling program."""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ome_tpu.engine import InferenceEngine, Request, Scheduler
+from ome_tpu.engine.server import EngineServer
+from ome_tpu.engine.structured import JsonAutomaton, TokenMasker
+from ome_tpu.engine.tokenizer import ByteTokenizer
+from ome_tpu.models import llama
+from ome_tpu.models.config import tiny_test
+
+
+class TestJsonAutomaton:
+    def accepts_full(self, text: str) -> bool:
+        a = JsonAutomaton()
+        for b in text.encode():
+            if not a.advance(b):
+                return False
+        return a.is_complete()
+
+    @pytest.mark.parametrize("text", [
+        '{}', '[]', '"hi"', 'true', 'false', 'null', '0', '-1', '3.5',
+        '1e9', '-0.25E-3', '{"a": 1}', '{"a": [1, 2, {"b": null}]}',
+        '[{"x": "y\\n"}, -2.5e+2, true]', '  {"a"  : 1 }  ',
+        '"\\u00e9"', '{"nested": {"deep": [[[]]]}}',
+    ])
+    def test_accepts_valid_json(self, text):
+        json.loads(text)  # sanity: python agrees it's valid
+        assert self.accepts_full(text), text
+
+    @pytest.mark.parametrize("text", [
+        '{', '{"a"}', '{"a": }', '[1,]', '{,}', '01', '+1', '1.',
+        '"unterminated', "{'a': 1}", 'tru', '{"a": 1,}', '[1 2]',
+        '"\\x41"', '--1', '1e', 'nullx',
+    ])
+    def test_rejects_invalid_json(self, text):
+        a = JsonAutomaton()
+        ok = True
+        for b in text.encode():
+            if not a.advance(b):
+                ok = False
+                break
+        assert not (ok and a.is_complete()), text
+
+    def test_number_completes_implicitly(self):
+        a = JsonAutomaton()
+        for b in b"12":
+            assert a.advance(b)
+        assert a.is_complete()      # "12" is a complete value
+        assert a.advance(ord("3"))  # ...but may also continue
+
+    def test_object_root_mode(self):
+        a = JsonAutomaton(object_root=True)
+        assert not a.advance(ord("["))
+        a = JsonAutomaton(object_root=True)
+        assert a.advance(ord("{"))
+
+    def test_trailing_bytes_after_root_rejected(self):
+        a = JsonAutomaton()
+        for b in b'{"a": 1}':
+            assert a.advance(b)
+        assert a.is_complete()
+        assert a.advance(ord(" "))       # whitespace ok
+        assert not a.advance(ord("x"))   # junk is not
+
+
+class TestTokenMasker:
+    def test_mask_tracks_grammar(self):
+        tok = ByteTokenizer()
+        m = TokenMasker(tok)
+        V = 300
+        mask = m.mask(V)
+        # at the start: '{' '[' '"' digits '-' 't' 'f' 'n' + whitespace
+        assert mask[ord("{") + 3]        # byte tokens are offset by 3
+        assert mask[ord("[") + 3]
+        assert not mask[ord("}") + 3]
+        assert not mask[tok.eos_id]      # nothing emitted yet
+        m.feed(ord("{") + 3)
+        mask = m.mask(V)
+        assert mask[ord('"') + 3] and mask[ord("}") + 3]
+        assert not mask[ord("[") + 3]
+        m.feed(ord("}") + 3)
+        assert m.done()
+        assert m.mask(V)[tok.eos_id]
+
+
+def test_random_model_forced_to_valid_json():
+    """The whole point: ANY model — here random weights — emits
+    parseable JSON under the grammar mask, greedy or sampled."""
+    cfg = tiny_test().replace(dtype=jnp.float32, max_seq_len=128)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(params, cfg, max_slots=2,
+                             prefill_buckets=[16])
+    tok = ByteTokenizer()
+    sched = Scheduler(engine)
+    for temperature in (0.0, 0.9):
+        req = sched.submit(Request(
+            prompt_ids=tok.encode("emit some json:"),
+            max_new_tokens=48, temperature=temperature,
+            masker=TokenMasker(tok),
+            stop_ids=[tok.eos_id]))
+        while not req.done.is_set():
+            sched.step()
+        text = tok.decode(req.output_ids)
+        json.loads(text)  # must parse — the grammar guaranteed it
+        assert req.finish_reason in ("stop", "length")
+
+
+def test_tight_budget_still_closes_valid_json():
+    """Close-out masks: even a tiny max_tokens budget must yield a
+    complete, parseable JSON object — the masker switches to the
+    minimal completion path before the budget can strand an open
+    string or container."""
+    cfg = tiny_test().replace(dtype=jnp.float32, max_seq_len=128)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(params, cfg, max_slots=2,
+                             prefill_buckets=[16])
+    tok = ByteTokenizer()
+    sched = Scheduler(engine)
+    for budget in (10, 16, 25):
+        req = sched.submit(Request(
+            prompt_ids=tok.encode("json:"),
+            max_new_tokens=budget, temperature=0.9,
+            masker=TokenMasker(tok, object_root=True),
+            stop_ids=[tok.eos_id]))
+        while not req.done.is_set():
+            sched.step()
+        text = tok.decode(req.output_ids)
+        parsed = json.loads(text)
+        assert isinstance(parsed, dict), text
+
+
+def test_http_response_format_json_object():
+    cfg = tiny_test().replace(dtype=jnp.float32, max_seq_len=128)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(params, cfg, max_slots=2,
+                             prefill_buckets=[16])
+    srv = EngineServer(Scheduler(engine), model_name="m")
+    srv.start()
+    try:
+        body = json.dumps({
+            "model": "m", "prompt": "json please",
+            "max_tokens": 40, "temperature": 0,
+            "response_format": {"type": "json_object"}}).encode()
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(r, timeout=300) as resp:
+            out = json.loads(resp.read())
+        json.loads(out["choices"][0]["text"])  # valid JSON text
+        # unsupported schema type is rejected loudly
+        bad = json.dumps({"model": "m", "prompt": "x",
+                          "response_format": {"type": "json_schema"}}
+                         ).encode()
+        r2 = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions", data=bad,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(r2, timeout=60)
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
+
+
+def test_structured_disabled_surface_rejects():
+    cfg = tiny_test().replace(dtype=jnp.float32, max_seq_len=64)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(params, cfg, max_slots=2,
+                             prefill_buckets=[16])
+    srv = EngineServer(Scheduler(engine), model_name="m",
+                       structured=False)
+    srv.start()
+    try:
+        body = json.dumps({"model": "m", "prompt": "x",
+                           "response_format": {"type": "json_object"}}
+                          ).encode()
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(r, timeout=60)
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
