@@ -49,7 +49,8 @@ def _cmd_replica(args) -> int:
 def _cmd_serving_agent(args) -> int:
     from .serving_agent import ServingAgent
     agent = ServingAgent(args.info_file, args.adapters_dir,
-                         poll_interval=args.poll_interval)
+                         poll_interval=args.poll_interval,
+                         engine_url=args.engine_url)
     if args.once:
         agent.sync()
         return 0
@@ -110,6 +111,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--info-file", required=True)
     s.add_argument("--adapters-dir", required=True)
     s.add_argument("--poll-interval", type=float, default=2.0)
+    s.add_argument("--engine-url", default=None,
+                   help="co-located engine base URL; staged adapters "
+                        "hot-load via POST /v1/adapters (multi-LoRA)")
     s.add_argument("--once", action="store_true",
                    help="sync once and exit")
     s.set_defaults(fn=_cmd_serving_agent)

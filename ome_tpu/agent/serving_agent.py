@@ -54,13 +54,19 @@ class ServingAgent:
                  hub: Optional[HubClient] = None,
                  endpoints: Optional[Dict[str, str]] = None,
                  poll_interval: float = 2.0,
-                 on_change: Optional[Callable[[str], None]] = None):
+                 on_change: Optional[Callable[[str], None]] = None,
+                 engine_url: Optional[str] = None):
         self.info_file = info_file
         self.adapters_dir = adapters_dir
         self.hub = hub or HubClient()
         self.endpoints = endpoints or {}
         self.poll_interval = poll_interval
         self.on_change = on_change
+        # engine hot-load hook: after staging adapter <name> at
+        # <adapters_dir>/<name>, POST it to the co-located engine's
+        # /v1/adapters (DELETE on unload) so multi-LoRA slots track
+        # the FineTunedWeight attachment without a restart
+        self.engine_url = engine_url.rstrip("/") if engine_url else None
         self.loaded: Dict[str, AdapterInfo] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -123,6 +129,7 @@ class ServingAgent:
                     # would fail with EXDEV
                     shutil.move(f, dst)
         log.info("adapter %s loaded from %s", info.name, info.storage_uri)
+        self._notify_engine("load", info.name, target)
         if self.on_change:
             self.on_change(info.name)
 
@@ -131,8 +138,35 @@ class ServingAgent:
                       ignore_errors=True)
         self.loaded.pop(name, None)
         log.info("adapter %s unloaded", name)
+        self._notify_engine("unload", name, None)
         if self.on_change:
             self.on_change(name)
+
+    def _notify_engine(self, action: str, name: str,
+                       path: Optional[str]):
+        if not self.engine_url:
+            return
+        import urllib.error
+        import urllib.request
+        try:
+            if action == "load":
+                req = urllib.request.Request(
+                    self.engine_url + "/v1/adapters",
+                    data=json.dumps({"name": name,
+                                     "path": path}).encode(),
+                    headers={"Content-Type": "application/json"})
+            else:
+                req = urllib.request.Request(
+                    self.engine_url + f"/v1/adapters/{name}",
+                    method="DELETE")
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                resp.read()
+            log.info("engine %s adapter %s ok", action, name)
+        except (urllib.error.URLError, OSError) as e:
+            # staging succeeded; the engine can still pick the adapter
+            # up on restart — don't fail the sync loop
+            log.warning("engine %s adapter %s failed: %s", action,
+                        name, e)
 
     # -- watch loop ----------------------------------------------------
 

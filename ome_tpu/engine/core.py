@@ -45,6 +45,15 @@ class DecodeState:
     v: jax.Array        # [L, B, Smax, K, Dh]
     lengths: jax.Array  # [B] int32 — valid kv rows / next write index
     tokens: jax.Array   # [B] int32 — last sampled token per slot
+    # [B] int32 — LoRA adapter slot per sequence (0 = base model);
+    # selects the per-slot low-rank delta inside the decode matmuls
+    adapters: jax.Array = None
+
+
+class UnknownAdapterError(ValueError):
+    """Request names a LoRA adapter the engine doesn't have loaded —
+    a PER-REQUEST error (e.g. racing a hot unload), never a scheduler
+    fault."""
 
 
 def _bucketize(n: int, buckets: List[int]) -> int:
@@ -183,7 +192,8 @@ class InferenceEngine:
     def __init__(self, params: Params, cfg: ModelConfig,
                  max_slots: int = 8, max_seq: Optional[int] = None,
                  prefill_buckets: Optional[List[int]] = None,
-                 prefix_cache_bytes: int = 0):
+                 prefix_cache_bytes: int = 0,
+                 lora_slots: int = 0, lora_rank: int = 16):
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -197,14 +207,42 @@ class InferenceEngine:
         self.prefill_buckets = prefill_buckets
         self.prefix_cache = PrefixCache(prefix_cache_bytes)
 
+        # multi-LoRA serving: preallocate `lora_slots` zeroed factor
+        # stacks as extra scanned layer leaves ([L, slots+1, r, K]).
+        # Slot 0 is the all-zero base; register_adapter hot-writes a
+        # slot IN PLACE of the zeros — shapes never change, so no
+        # recompilation on adapter load (the punica idea, TPU-shaped).
+        self.lora_slots = lora_slots
+        self.lora_rank = lora_rank
+        self._lora_names: Dict[str, int] = {}
+        import threading as _threading
+        self._lora_lock = _threading.Lock()
+        if lora_slots > 0:
+            if cfg.is_moe and cfg.first_k_dense:
+                raise ValueError("multi-LoRA does not support "
+                                 "first_k_dense models yet")
+            from ..models.lora import _target_dims
+            layers = dict(params["layers"])
+            n, r, L = lora_slots + 1, lora_rank, cfg.num_layers
+            for leaf, (K, N) in _target_dims(cfg).items():
+                if leaf not in layers:
+                    continue  # MoE models: attention targets only
+                layers[leaf + "_lora_a"] = jnp.zeros((L, n, r, K),
+                                                     cfg.dtype)
+                layers[leaf + "_lora_b"] = jnp.zeros((L, n, r, N),
+                                                     cfg.dtype)
+            self.params = dict(params, layers=layers)
+
         cfg_ = cfg
 
         @functools.partial(jax.jit, static_argnames=("bucket",))
         def _prefill(params, padded: jax.Array, true_len: jax.Array,
-                     temperature, top_k, top_p, key, bucket: int):
+                     temperature, top_k, top_p, key, adapter,
+                     bucket: int):
             cache = llama.KVCache.create(cfg_, 1, bucket)
             logits, new_cache = llama.forward(params, cfg_, padded,
-                                              cache=cache)
+                                              cache=cache,
+                                              adapter_ids=adapter)
             # last REAL token's logits (right padding occupies the tail)
             last = jnp.take_along_axis(
                 logits, (true_len - 1)[:, None, None], axis=1)[:, 0]
@@ -236,12 +274,16 @@ class InferenceEngine:
             last = jnp.take_along_axis(
                 logits, (suffix_len - 1)[:, None, None], axis=1)[:, 0]
             tok = sample(last, key, temperature, top_k, top_p)
+            # (suffix prefill stays base-model-only: adapter requests
+            # bypass the prefix cache — their KV depends on the
+            # adapter, so shared-prefix reuse would be wrong)
             return tok[0], new_cache.k, new_cache.v
 
         @functools.partial(jax.jit, donate_argnums=(0,),
                            static_argnames=("bucket",))
         def _insert(state: DecodeState, kv_k, kv_v, slot: jax.Array,
-                    true_len: jax.Array, token: jax.Array, bucket: int):
+                    true_len: jax.Array, token: jax.Array,
+                    adapter: jax.Array, bucket: int):
             keep = min(bucket, self.max_seq)
             k = lax.dynamic_update_slice(
                 state.k, kv_k[:, :, :keep], (0, slot, 0, 0, 0))
@@ -250,18 +292,21 @@ class InferenceEngine:
             return DecodeState(
                 k=k, v=v,
                 lengths=state.lengths.at[slot].set(true_len),
-                tokens=state.tokens.at[slot].set(token))
+                tokens=state.tokens.at[slot].set(token),
+                adapters=state.adapters.at[slot].set(adapter))
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def _decode(params, state: DecodeState, temperature, top_k, top_p,
                     key) -> Tuple[DecodeState, jax.Array]:
             cache = llama.KVCache(k=state.k, v=state.v, index=state.lengths)
             logits, new_cache = llama.forward(
-                params, cfg_, state.tokens[:, None], cache=cache)
+                params, cfg_, state.tokens[:, None], cache=cache,
+                adapter_ids=state.adapters)
             toks = sample(logits[:, -1], key, temperature, top_k, top_p)
             return DecodeState(k=new_cache.k, v=new_cache.v,
                                lengths=new_cache.index,
-                               tokens=toks), toks
+                               tokens=toks,
+                               adapters=state.adapters), toks
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def _decode_masked(params, state: DecodeState, temperature,
@@ -273,21 +318,25 @@ class InferenceEngine:
             transfer."""
             cache = llama.KVCache(k=state.k, v=state.v, index=state.lengths)
             logits, new_cache = llama.forward(
-                params, cfg_, state.tokens[:, None], cache=cache)
+                params, cfg_, state.tokens[:, None], cache=cache,
+                adapter_ids=state.adapters)
             masked = jnp.where(mask, logits[:, -1], -jnp.inf)
             toks = sample(masked, key, temperature, top_k, top_p)
             return DecodeState(k=new_cache.k, v=new_cache.v,
                                lengths=new_cache.index,
-                               tokens=toks), toks
+                               tokens=toks,
+                               adapters=state.adapters), toks
 
         @functools.partial(jax.jit, static_argnames=("bucket",))
         def _prefill_masked(params, padded, true_len, temperature,
-                            top_k, top_p, key, mask, bucket: int):
+                            top_k, top_p, key, mask, adapter,
+                            bucket: int):
             """Bucketed prefill whose FIRST sampled token honors the
             structured-output mask."""
             cache = llama.KVCache.create(cfg_, 1, bucket)
             logits, new_cache = llama.forward(params, cfg_, padded,
-                                              cache=cache)
+                                              cache=cache,
+                                              adapter_ids=adapter)
             last = jnp.take_along_axis(
                 logits, (true_len - 1)[:, None, None], axis=1)[:, 0]
             last = jnp.where(mask, last, -jnp.inf)
@@ -322,13 +371,77 @@ class InferenceEngine:
             k=jnp.zeros(base + (cfg.kv_cache_k_dim,), cfg.dtype),
             v=jnp.zeros(base + (cfg.kv_cache_v_dim,), cfg.dtype),
             lengths=jnp.zeros((B,), jnp.int32),
-            tokens=jnp.zeros((B,), jnp.int32))
+            tokens=jnp.zeros((B,), jnp.int32),
+            adapters=jnp.zeros((B,), jnp.int32))
+
+    # -- multi-LoRA registry -------------------------------------------
+
+    @property
+    def adapter_names(self) -> List[str]:
+        return sorted(self._lora_names)
+
+    def adapter_id(self, name: Optional[str]) -> int:
+        """Resolve an adapter name to its slot id (0/None = base)."""
+        if not name:
+            return 0
+        try:
+            return self._lora_names[name]
+        except KeyError:
+            raise UnknownAdapterError(
+                f"unknown adapter {name!r} (loaded: "
+                f"{self.adapter_names or 'none'})")
+
+    def register_adapter(self, name: str, adapter_dir: str) -> int:
+        """Load a PEFT adapter dir into a free LoRA slot (hot, no
+        recompilation: writes into the preallocated factor stacks).
+        Re-registering a name overwrites its slot (adapter update)."""
+        if self.lora_slots <= 0:
+            raise ValueError("engine started without LoRA slots "
+                             "(--lora-slots)")
+        from ..models.lora import load_adapter_matrices
+        mats = load_adapter_matrices(adapter_dir, self.cfg,
+                                     rank_pad=self.lora_rank)
+        with self._lora_lock:
+            idx = self._lora_names.get(name)
+            if idx is None:
+                used = set(self._lora_names.values())
+                free = [i for i in range(1, self.lora_slots + 1)
+                        if i not in used]
+                if not free:
+                    raise ValueError(
+                        f"all {self.lora_slots} LoRA slots in use")
+                idx = free[0]
+            layers = dict(self.params["layers"])
+            for leaf, (A, B) in mats.items():
+                ka, kb = leaf + "_lora_a", leaf + "_lora_b"
+                if ka not in layers:
+                    raise ValueError(f"model has no target {leaf}")
+                layers[ka] = layers[ka].at[:, idx].set(
+                    A.astype(self.cfg.dtype))
+                layers[kb] = layers[kb].at[:, idx].set(
+                    B.astype(self.cfg.dtype))
+            # atomic reference swap: in-flight steps keep the old tree
+            self.params = dict(self.params, layers=layers)
+            self._lora_names[name] = idx
+        return idx
+
+    def unregister_adapter(self, name: str) -> None:
+        with self._lora_lock:
+            idx = self._lora_names.pop(name, None)
+            if idx is None:
+                return
+            layers = dict(self.params["layers"])
+            for key in list(layers):
+                if key.endswith("_lora_a") or key.endswith("_lora_b"):
+                    layers[key] = layers[key].at[:, idx].set(0.0)
+            self.params = dict(self.params, layers=layers)
 
     # -- ops -----------------------------------------------------------
 
     def prefill(self, prompt_ids: List[int], temperature: float = 0.0,
                 top_k: int = 0, top_p: float = 1.0,
-                first_mask: Optional[np.ndarray] = None):
+                first_mask: Optional[np.ndarray] = None,
+                adapter: Optional[str] = None):
         """Returns (first_token:int, kv pair, true_len, bucket).
 
         With a prefix cache enabled, a prompt whose leading tokens were
@@ -362,7 +475,11 @@ class InferenceEngine:
                                        self.prefill_buckets)
                     <= self.prefill_buckets[-1])
 
-        hit = None if first_mask is not None \
+        aid = self.adapter_id(adapter)
+        # adapter prefills bypass the prefix cache entirely: cached KV
+        # was computed with (some) adapter's projections, so sharing
+        # across adapters — or with the base — would be silently wrong
+        hit = None if (first_mask is not None or aid != 0) \
             else self.prefix_cache.match(ids, usable=_usable)
         if hit is not None:
             pk, pv, plen, _pbucket = hit
@@ -384,29 +501,34 @@ class InferenceEngine:
             bucket = _bucketize(len(ids), self.prefill_buckets)
             padded = np.asarray(
                 [ids + [0] * (bucket - len(ids))], np.int32)
+            aid_arr = np.asarray([aid], np.int32)
             if first_mask is not None:
                 tok, k, v = self._prefill_masked_fn(
                     self.params, padded,
                     np.asarray([len(ids)], np.int32), *sampling, key,
-                    np.asarray(first_mask, bool)[None, :],
+                    np.asarray(first_mask, bool)[None, :], aid_arr,
                     bucket=bucket)
             else:
                 tok, k, v = self._prefill_fn(
                     self.params, padded,
                     np.asarray([len(ids)], np.int32), *sampling, key,
-                    bucket=bucket)
-        self.prefix_cache.put(ids, k, v, len(ids), bucket)
+                    aid_arr, bucket=bucket)
+        if aid == 0:
+            self.prefix_cache.put(ids, k, v, len(ids), bucket)
         # multi-host: int() on an array spanning non-addressable
         # devices raises; fetch the local replica instead
         from .multihost import host_value
         return int(host_value(tok)), (k, v), len(ids), bucket
 
     def insert(self, state: DecodeState, kv, slot: int, true_len: int,
-               token: int, bucket: int) -> DecodeState:
+               token: int, bucket: int,
+               adapter: Optional[str] = None) -> DecodeState:
         return self._insert_fn(
             state, kv[0], kv[1], np.asarray(slot, np.int32),
             np.asarray(true_len, np.int32),
-            np.asarray(token, np.int32), bucket=bucket)
+            np.asarray(token, np.int32),
+            np.asarray(self.adapter_id(adapter), np.int32),
+            bucket=bucket)
 
     def decode(self, state: DecodeState, temperature, top_k, top_p,
                mask: Optional[np.ndarray] = None,

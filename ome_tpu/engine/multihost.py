@@ -196,11 +196,19 @@ class OpSubscriber:
 class ReplicatedEngine:
     """Wraps an InferenceEngine so every device-touching op is
     published to the followers before the leader runs it. Drop-in for
-    the Scheduler: same prefill/insert/decode surface."""
+    the Scheduler: same prefill/insert/decode surface.
+
+    All ops publish AND execute under one lock: the scheduler thread
+    drives prefill/insert/decode, but adapter registration arrives on
+    an HTTP handler thread — without the lock, two sendall()s could
+    interleave framed bytes, and the leader could apply a param swap
+    at a different op-stream position than its followers (divergent
+    SPMD state)."""
 
     def __init__(self, engine, publisher: OpPublisher):
         self._engine = engine
         self._pub = publisher
+        self._oplock = threading.Lock()
 
     def __getattr__(self, name):
         return getattr(self._engine, name)
@@ -209,54 +217,103 @@ class ReplicatedEngine:
         return self._engine.new_state()
 
     def prefill(self, prompt_ids, temperature: float = 0.0,
-                top_k: int = 0, top_p: float = 1.0):
-        blob_fn = getattr(self._engine, "prefill_blob", None)
-        if blob_fn is not None:
-            # PD decode group: the leader fetches the KV wire blob
-            # ONCE and ships the bytes to followers — a follower
-            # re-fetching could draw a different sampled token on the
-            # prefill node (its RNG advances per request)
-            import base64
-            blob = blob_fn(prompt_ids, temperature, top_k, top_p)
-            self._pub.send({"op": "prefill_blob",
-                            "blob": base64.b64encode(blob).decode()})
-            from .pd import deserialize_kv
-            token, k, v, true_len, bucket = deserialize_kv(blob)
-            return token, (k, v), true_len, bucket
-        self._pub.send({"op": "prefill", "ids": list(map(int, prompt_ids)),
-                        "temperature": float(temperature),
-                        "top_k": int(top_k), "top_p": float(top_p)})
-        return self._engine.prefill(prompt_ids, temperature, top_k, top_p)
+                top_k: int = 0, top_p: float = 1.0, first_mask=None,
+                adapter=None):
+        from .structured import pack_mask
+        kw = {}
+        if first_mask is not None:
+            kw["first_mask"] = first_mask
+        if adapter is not None:
+            kw["adapter"] = adapter
+        with self._oplock:
+            blob_fn = getattr(self._engine, "prefill_blob", None)
+            if blob_fn is not None:
+                # PD decode group: the leader fetches the KV wire blob
+                # ONCE and ships the bytes to followers — a follower
+                # re-fetching could draw a different sampled token on
+                # the prefill node (its RNG advances per request)
+                import base64
+                blob = blob_fn(prompt_ids, temperature, top_k, top_p,
+                               **kw)
+                self._pub.send({"op": "prefill_blob",
+                                "blob": base64.b64encode(blob).decode()})
+                from .pd import deserialize_kv
+                token, k, v, true_len, bucket = deserialize_kv(blob)
+                return token, (k, v), true_len, bucket
+            self._pub.send({"op": "prefill",
+                            "ids": list(map(int, prompt_ids)),
+                            "temperature": float(temperature),
+                            "top_k": int(top_k), "top_p": float(top_p),
+                            "first_mask": pack_mask(first_mask),
+                            "adapter": adapter})
+            return self._engine.prefill(prompt_ids, temperature, top_k,
+                                        top_p, **kw)
 
     def insert(self, state, kv, slot: int, true_len: int, token: int,
-               bucket: int):
-        self._pub.send({"op": "insert", "slot": int(slot),
-                        "true_len": int(true_len), "token": int(token),
-                        "bucket": int(bucket)})
-        return self._engine.insert(state, kv, slot, true_len, token,
-                                   bucket)
+               bucket: int, adapter=None):
+        with self._oplock:
+            self._pub.send({"op": "insert", "slot": int(slot),
+                            "true_len": int(true_len),
+                            "token": int(token),
+                            "bucket": int(bucket), "adapter": adapter})
+            kw = {} if adapter is None else {"adapter": adapter}
+            return self._engine.insert(state, kv, slot, true_len,
+                                       token, bucket, **kw)
 
-    def decode(self, state, temperature, top_k, top_p):
-        self._pub.send({"op": "decode",
-                        "temperature": np.asarray(temperature,
-                                                  np.float32).tolist(),
-                        "top_k": np.asarray(top_k, np.int32).tolist(),
-                        "top_p": np.asarray(top_p,
-                                            np.float32).tolist()})
-        state, toks = self._engine.decode(state, temperature, top_k,
-                                          top_p)
-        return state, host_value(toks)
+    def register_adapter(self, name: str, adapter_dir: str) -> int:
+        """Replicated hot adapter load: the staged dir must exist on
+        every host (shared PVC / serving-agent staging on each)."""
+        with self._oplock:
+            self._pub.send({"op": "register_adapter", "name": name,
+                            "path": adapter_dir})
+            return self._engine.register_adapter(name, adapter_dir)
+
+    def unregister_adapter(self, name: str) -> None:
+        with self._oplock:
+            self._pub.send({"op": "unregister_adapter", "name": name})
+            return self._engine.unregister_adapter(name)
+
+    def decode(self, state, temperature, top_k, top_p, mask=None):
+        from .structured import pack_mask
+        with self._oplock:
+            self._pub.send({"op": "decode",
+                            "temperature": np.asarray(
+                                temperature, np.float32).tolist(),
+                            "top_k": np.asarray(top_k,
+                                                np.int32).tolist(),
+                            "top_p": np.asarray(top_p,
+                                                np.float32).tolist(),
+                            # structured outputs: the leader's host-
+                            # built mask ships in the op (packbits
+                            # ~V/8 bytes per constrained slot) so
+                            # followers run the IDENTICAL masked
+                            # program — no recompute drift
+                            "mask": pack_mask(mask)})
+            if mask is not None:
+                state, toks = self._engine.decode(
+                    state, temperature, top_k, top_p, mask=mask)
+            else:
+                state, toks = self._engine.decode(state, temperature,
+                                                  top_k, top_p)
+            return state, host_value(toks)
 
 
-def follower_loop(engine, sub: OpSubscriber) -> int:
+def follower_loop(engine, sub: OpSubscriber,
+                  pd_export: bool = False) -> int:
     """Replay the leader's op stream against the local engine.
 
     Every value the replay needs beyond the op headers (prefill KV,
     sampled tokens) is recomputed locally — identical programs +
     identical inputs + shared RNG counters give identical results, so
     insert() can consume the follower's OWN last prefill output.
+    Structured-output masks arrive IN the ops (leader-built, packed) so
+    masked sampling is bit-identical across the group.
+    `pd_export`: this is a PD prefill-pool follower — after each
+    prefill replay, join the leader's process_allgather collective
+    (pd.gather_kv) that exports the KV to the wire.
     Returns an exit code: 0 on orderly stop, 1 on a dropped leader.
     """
+    from .structured import unpack_mask
     state = engine.new_state()
     last_prefill: Optional[Tuple] = None
     while True:
@@ -269,9 +326,18 @@ def follower_loop(engine, sub: OpSubscriber) -> int:
         if op == "stop":
             return 0
         if op == "prefill":
+            fm = unpack_mask(msg.get("first_mask"))
+            kwargs = {} if fm is None else {"first_mask": fm}
+            if msg.get("adapter") is not None:
+                kwargs["adapter"] = msg["adapter"]
             last_prefill = engine.prefill(
                 msg["ids"], msg["temperature"], msg["top_k"],
-                msg["top_p"])
+                msg["top_p"], **kwargs)
+            if pd_export:
+                from .pd import gather_kv
+                _, (k, v), _, _ = last_prefill
+                gather_kv(k)
+                gather_kv(v)
         elif op == "prefill_blob":
             # PD decode group: the leader shipped the prefill pool's
             # KV bytes; deserialize locally — no fetch, no compute
@@ -282,14 +348,23 @@ def follower_loop(engine, sub: OpSubscriber) -> int:
             last_prefill = (token, (k, v), true_len, bucket)
         elif op == "insert":
             tok, kv, _true_len, _bucket = last_prefill
+            ikw = {} if msg.get("adapter") is None \
+                else {"adapter": msg["adapter"]}
             state = engine.insert(state, kv, msg["slot"],
-                                  msg["true_len"], tok, msg["bucket"])
+                                  msg["true_len"], tok, msg["bucket"],
+                                  **ikw)
+        elif op == "register_adapter":
+            engine.register_adapter(msg["name"], msg["path"])
+        elif op == "unregister_adapter":
+            engine.unregister_adapter(msg["name"])
         elif op == "decode":
+            mask = unpack_mask(msg.get("mask"))
+            kwargs = {} if mask is None else {"mask": mask}
             state, _ = engine.decode(
                 state,
                 np.asarray(msg["temperature"], np.float32),
                 np.asarray(msg["top_k"], np.int32),
-                np.asarray(msg["top_p"], np.float32))
+                np.asarray(msg["top_p"], np.float32), **kwargs)
         else:
             log.error("unknown op %r from leader", op)
             return 1

@@ -50,6 +50,21 @@ class PDError(Exception):
     pass
 
 
+def gather_kv(x) -> np.ndarray:
+    """Bring a prefill KV plane fully to host, multi-host safe.
+
+    In a multi-host prefill pool the engine's arrays span
+    non-addressable devices, where np.asarray raises; process_allgather
+    reconstructs the GLOBAL value from every host's shards (a
+    collective — followers join it from follower_loop's pd_export
+    replay so the leader's gather can complete). Fully-addressable
+    arrays (single host, even tp-sharded) fetch directly."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 def serialize_kv(token: int, k, v, true_len: int, bucket: int) -> bytes:
     """Pack a prefill result for the wire: 4-byte LE header length +
     JSON header + k bytes + v bytes."""
@@ -119,14 +134,23 @@ class RemotePrefillEngine:
         return self._engine.new_state()
 
     def prefill_blob(self, prompt_ids, temperature: float = 0.0,
-                     top_k: int = 0, top_p: float = 1.0) -> bytes:
+                     top_k: int = 0, top_p: float = 1.0,
+                     first_mask=None, adapter=None) -> bytes:
         """The raw wire blob — multi-host leaders replicate it to
         followers verbatim (engine/multihost.py), so the whole decode
-        group inserts bit-identical KV from ONE fetch."""
+        group inserts bit-identical KV from ONE fetch. `first_mask`
+        rides along so the PREFILL node constrains the first sampled
+        token of a structured request (the decode node never re-draws
+        it); `adapter` (a LoRA adapter name registered on BOTH pools)
+        makes the prefill node compute the prefix with that adapter's
+        deltas."""
+        from .structured import pack_mask
         body = json.dumps({
             "ids": list(map(int, prompt_ids)),
             "temperature": float(temperature), "top_k": int(top_k),
             "top_p": float(top_p),
+            "first_mask": pack_mask(first_mask),
+            "adapter": adapter,
         }).encode()
         req = urllib.request.Request(
             self.peer_url + "/pd/prefill", data=body,
@@ -135,16 +159,25 @@ class RemotePrefillEngine:
             return resp.read()
 
     def prefill(self, prompt_ids, temperature: float = 0.0,
-                top_k: int = 0, top_p: float = 1.0):
-        data = self.prefill_blob(prompt_ids, temperature, top_k, top_p)
+                top_k: int = 0, top_p: float = 1.0, first_mask=None,
+                adapter=None):
+        data = self.prefill_blob(prompt_ids, temperature, top_k, top_p,
+                                 first_mask=first_mask, adapter=adapter)
         token, k, v, true_len, bucket = deserialize_kv(data)
         return token, (k, v), true_len, bucket
 
-    def insert(self, state, kv, slot, true_len, token, bucket):
+    def insert(self, state, kv, slot, true_len, token, bucket,
+               adapter=None):
+        kw = {} if adapter is None else {"adapter": adapter}
         return self._engine.insert(state, kv, slot, true_len, token,
-                                   bucket)
+                                   bucket, **kw)
 
-    def decode(self, state, temperature, top_k, top_p):
+    def decode(self, state, temperature, top_k, top_p, mask=None):
+        # decode runs on the LOCAL engine; the mask (structured
+        # outputs) applies to locally sampled tokens only
+        if mask is not None:
+            return self._engine.decode(state, temperature, top_k,
+                                       top_p, mask=mask)
         return self._engine.decode(state, temperature, top_k, top_p)
 
 
@@ -160,14 +193,24 @@ def make_pd_prefill_handler(engine):
     lock = threading.Lock()
 
     def handler(payload: dict) -> bytes:
+        from .structured import unpack_mask
         ids = payload["ids"]
         if not isinstance(ids, list) or not ids:
             raise PDError("ids must be a non-empty token list")
+        first_mask = unpack_mask(payload.get("first_mask"))
         with lock:
+            kwargs = {} if first_mask is None \
+                else {"first_mask": first_mask}
+            if payload.get("adapter") is not None:
+                kwargs["adapter"] = payload["adapter"]
             token, (k, v), true_len, bucket = engine.prefill(
                 ids, float(payload.get("temperature", 0.0)),
                 int(payload.get("top_k", 0)),
-                float(payload.get("top_p", 1.0)))
-        return serialize_kv(token, k, v, true_len, bucket)
+                float(payload.get("top_p", 1.0)), **kwargs)
+            # the gather collectives stay INSIDE the lock: followers
+            # replay prefill->gather(k)->gather(v) strictly serially,
+            # so a second thread's allgather must not interleave
+            return serialize_kv(token, gather_kv(k), gather_kv(v),
+                                true_len, bucket)
 
     return handler

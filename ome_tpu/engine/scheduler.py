@@ -47,6 +47,8 @@ class Request:
     # structured outputs: a TokenMasker (engine/structured.py)
     # constrains sampling to valid continuations of its grammar
     masker: Optional[object] = None
+    # multi-LoRA: adapter name (engine register_adapter); None = base
+    adapter: Optional[str] = None
     id: int = field(default_factory=lambda: next(_ids))
     created: float = field(default_factory=time.monotonic)
     # results
@@ -208,13 +210,19 @@ class Scheduler:
                 tok, kv, true_len, bucket = self._prefill_req(req)
             except Exception as e:  # noqa: BLE001
                 import logging
+
+                from .core import UnknownAdapterError
+
                 # engines that fetch prefill remotely (PD decode
                 # nodes) declare which errors are TRANSIENT — a peer
                 # restarting mid-rollout fails one request, not every
-                # in-flight stream on this node
-                transient = getattr(self.engine,
-                                    "transient_prefill_errors", ())
-                if transient and isinstance(e, transient):
+                # in-flight stream on this node. An unknown LoRA
+                # adapter (request racing a hot unload) is likewise
+                # that request's problem, never an engine fault.
+                transient = (UnknownAdapterError,) + tuple(
+                    getattr(self.engine, "transient_prefill_errors",
+                            ()))
+                if isinstance(e, transient):
                     logging.getLogger("ome.engine").warning(
                         "transient prefill failure for request %s: %s",
                         req.id, e)
@@ -251,8 +259,21 @@ class Scheduler:
             except queue.Empty:
                 break
             slot = self.slots.index(None)  # semaphore guarantees one
-            self.state = self.engine.insert(
-                self.state, kv, slot, true_len, tok, bucket)
+            ikw = {} if req.adapter is None else {"adapter": req.adapter}
+            try:
+                self.state = self.engine.insert(
+                    self.state, kv, slot, true_len, tok, bucket, **ikw)
+            except Exception as e:  # noqa: BLE001
+                from .core import UnknownAdapterError
+                if isinstance(e, UnknownAdapterError):
+                    # adapter hot-unloaded between prefill and insert:
+                    # this request fails, the node stays up
+                    req.finish("error")
+                    self._free_slots.release()
+                    continue
+                self.healthy = False
+                req.finish("error")
+                raise
             self.slots[slot] = req
             self._temp[slot] = req.temperature
             self._top_k[slot] = req.top_k
@@ -277,9 +298,16 @@ class Scheduler:
                 break
             try:
                 tok, kv, true_len, bucket = self._prefill_req(req)
+                ikw = {} if req.adapter is None \
+                    else {"adapter": req.adapter}
                 self.state = self.engine.insert(
-                    self.state, kv, slot, true_len, tok, bucket)
-            except Exception:
+                    self.state, kv, slot, true_len, tok, bucket, **ikw)
+            except Exception as e:
+                from .core import UnknownAdapterError
+                if isinstance(e, UnknownAdapterError):
+                    # racing a hot adapter unload fails ONE request
+                    req.finish("error")
+                    continue
                 # req is out of the queue but not yet slotted — _fail_all
                 # cannot see it, so fail it here before propagating.
                 # Health flips FIRST: a waiter woken by this failure must
@@ -325,13 +353,15 @@ class Scheduler:
     def _prefill_req(self, req: Request):
         """Engine prefill for one request; constrained requests pass
         the grammar mask for their FIRST sampled token."""
+        kw = {}
+        if req.adapter is not None:
+            kw["adapter"] = req.adapter
         if req.masker is not None:
-            fm = req.masker.mask(self.engine.cfg.vocab_size)
-            return self.engine.prefill(
-                req.prompt_ids, req.temperature, req.top_k, req.top_p,
-                first_mask=fm)
+            kw["first_mask"] = req.masker.mask(
+                self.engine.cfg.vocab_size,
+                remaining=req.max_new_tokens)
         return self.engine.prefill(req.prompt_ids, req.temperature,
-                                   req.top_k, req.top_p)
+                                   req.top_k, req.top_p, **kw)
 
     def _build_mask(self):
         """[B, V] allowed-token mask when any slot is constrained
@@ -347,9 +377,11 @@ class Scheduler:
                 remaining = r.max_new_tokens - len(r.output_ids)
                 # switch to close-out masks before the budget can
                 # strand an open string/container (valid JSON even at
-                # finish_reason=length)
+                # finish_reason=length); `remaining` additionally bans
+                # tokens whose completion cost overshoots the budget
                 closing = remaining <= r.masker.closing_distance() + 4
-                mask[slot] = r.masker.mask(V, closing=closing)
+                mask[slot] = r.masker.mask(V, closing=closing,
+                                           remaining=remaining)
         return mask
 
     def _maybe_finish(self, slot: int, tok: int):

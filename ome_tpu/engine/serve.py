@@ -48,9 +48,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="weight-only quantization at load time (int8 "
                         "halves decode HBM traffic; int4 groupwise "
                         "quarters it)")
-    p.add_argument("--adapter", default=None,
-                   help="PEFT LoRA adapter dir merged into the base "
-                        "weights at load (FineTunedWeight serving)")
+    p.add_argument("--adapter", action="append", default=None,
+                   help="LoRA serving (FineTunedWeight): a bare PEFT "
+                        "dir merges into the base weights at load; "
+                        "repeatable name=dir pairs serve MULTIPLE "
+                        "adapters concurrently (per-request routing "
+                        "by model id, hot add via POST /v1/adapters)")
+    p.add_argument("--lora-slots", type=int, default=None,
+                   help="preallocated hot-swappable LoRA adapter "
+                        "slots (default: number of name=dir adapters, "
+                        "min 4 when any are given)")
+    p.add_argument("--lora-rank", type=int, default=16,
+                   help="max adapter rank a LoRA slot holds")
     p.add_argument("--prefix-cache-mb", type=int, default=256,
                    help="HBM byte budget (MiB) for the radix prompt-"
                         "prefix KV cache (0 disables); prompts sharing "
@@ -101,12 +110,34 @@ def _load_params_cfg(args, dtype):
         return params, cfg
     params, cfg = checkpoint.load_params(args.model_dir, dtype=dtype,
                                          device_put=False)
-    if args.adapter:
+    merge_dir = _adapter_args(args)[0]
+    if merge_dir:
         from ..models.lora import merge_lora
-        merged = merge_lora(params, cfg, args.adapter)
-        log.info("merged %d LoRA deltas from %s", merged, args.adapter)
+        merged = merge_lora(params, cfg, merge_dir)
+        log.info("merged %d LoRA deltas from %s", merged, merge_dir)
     log.info("loaded checkpoint from %s", args.model_dir)
     return params, cfg
+
+
+def _adapter_args(args):
+    """--adapter forms -> (merge_dir | None, {name: dir}).
+
+    A single bare directory keeps the legacy merge-at-load behavior
+    (one adapter at full base speed); any name=dir entry switches to
+    multi-LoRA serving slots."""
+    entries = args.adapter or []
+    named = {}
+    bare = []
+    for e in entries:
+        if "=" in e:
+            name, _, path = e.partition("=")
+            named[name] = path
+        else:
+            bare.append(e)
+    if bare and (named or len(bare) > 1):
+        raise SystemExit("--adapter: use name=dir form when serving "
+                         "multiple adapters")
+    return (bare[0] if bare else None), named
 
 
 def load_engine(args, dist=None):
@@ -133,7 +164,14 @@ def load_engine(args, dist=None):
         log.info("quantized weights to %s (weight-only)",
                  args.quantization)
     max_seq = args.max_seq or min(cfg.max_seq_len, 8192)
+    _, named_adapters = _adapter_args(args)
+    lora_slots = args.lora_slots if args.lora_slots is not None else \
+        (max(4, len(named_adapters)) if named_adapters else 0)
     if args.tp > 1:
+        if lora_slots:
+            raise SystemExit("multi-LoRA serving is single-host tp=1 "
+                             "for now (adapter stacks are unsharded); "
+                             "use a merged --adapter dir with tp>1")
         # hand the host tree straight to shard_params: materializing it
         # on one device first would OOM exactly the models tp serves
         from .sharded import ShardedInferenceEngine
@@ -143,9 +181,15 @@ def load_engine(args, dist=None):
                                       prefix_cache_bytes=args.prefix_cache_mb << 20)
     import jax
     params = jax.tree.map(jnp.asarray, params)  # one transfer
-    return InferenceEngine(params, cfg, max_slots=args.max_slots,
-                           max_seq=max_seq,
-                           prefix_cache_bytes=args.prefix_cache_mb << 20)
+    engine = InferenceEngine(params, cfg, max_slots=args.max_slots,
+                             max_seq=max_seq,
+                             prefix_cache_bytes=args.prefix_cache_mb << 20,
+                             lora_slots=lora_slots,
+                             lora_rank=args.lora_rank)
+    for name, path in named_adapters.items():
+        engine.register_adapter(name, path)
+        log.info("registered LoRA adapter %r from %s", name, path)
+    return engine
 
 
 class _NullScheduler:
@@ -191,9 +235,10 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
     args = build_parser().parse_args(argv)
-    if args.adapter and args.random_weights:
-        log.error("--adapter requires a real checkpoint "
-                  "(incompatible with --random-weights)")
+    if _adapter_args(args)[0] and args.random_weights:
+        log.error("--adapter merge requires a real checkpoint "
+                  "(incompatible with --random-weights); name=dir "
+                  "multi-LoRA slots work with either")
         return 2
 
     # join the cross-host rendezvous FIRST (before any jax call) when
@@ -227,7 +272,9 @@ def main(argv=None) -> int:
         log.info("follower %d/%d replaying leader ops",
                  dist.process_id, dist.num_processes)
         try:
-            return multihost.follower_loop(engine, sub)
+            return multihost.follower_loop(
+                engine, sub,
+                pd_export=(args.disaggregation_mode == "prefill"))
         finally:
             sub.close()
 
@@ -268,11 +315,11 @@ def main(argv=None) -> int:
     server = EngineServer(scheduler, tokenizer=tok, model_name=name,
                           host=args.host, port=args.port,
                           embedder=embedder, pd_prefill=pd_prefill,
-                          # masks are host-built per step: multi-host
-                          # followers can't replay them, and PD decode
-                          # nodes can't constrain the remote first token
-                          structured=(dist is None and
-                                      args.disaggregation_mode == "none"))
+                          # structured outputs work in every generation
+                          # mode: masks ship inside the replicated op
+                          # stream (multi-host) and the first token's
+                          # mask rides the /pd/prefill request (PD)
+                          structured=embedder is None)
     log.info("serving %s on %s:%d (%s)", name, args.host, server.port,
              "embeddings" if embedder else
              f"slots={scheduler.engine.max_slots}")

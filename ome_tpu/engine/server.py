@@ -65,9 +65,16 @@ class EngineServer:
                         "uptime_s": round(
                             time.time() - outer.started_at, 1)})
                 elif self.path == "/v1/models":
-                    self._json(200, {"object": "list", "data": [{
-                        "id": outer.model_name, "object": "model",
-                        "owned_by": "ome-tpu"}]})
+                    data = [{"id": outer.model_name, "object": "model",
+                             "owned_by": "ome-tpu"}]
+                    # multi-LoRA: each adapter serves as its own model
+                    # id (the vLLM/SGLang convention the reference's
+                    # FineTunedWeight serving relies on)
+                    for name in outer._adapter_names():
+                        data.append({"id": name, "object": "model",
+                                     "owned_by": "ome-tpu",
+                                     "parent": outer.model_name})
+                    self._json(200, {"object": "list", "data": data})
                 elif self.path == "/metrics":
                     lines = []
                     for k, v in outer.scheduler.stats.items():
@@ -98,7 +105,40 @@ class EngineServer:
                     return self._embeddings(payload)
                 if self.path == "/pd/prefill":
                     return self._pd_prefill(payload)
+                if self.path == "/v1/adapters":
+                    return self._register_adapter(payload)
                 self._json(404, {"error": "not found"})
+
+            def do_DELETE(self):
+                if self.path.startswith("/v1/adapters/"):
+                    name = self.path.rsplit("/", 1)[-1]
+                    eng = getattr(outer.scheduler, "engine", None)
+                    if eng is None or not hasattr(eng,
+                                                  "unregister_adapter"):
+                        return self._json(400, {
+                            "error": "engine has no adapter support"})
+                    eng.unregister_adapter(name)
+                    return self._json(200, {"removed": name})
+                self._json(404, {"error": "not found"})
+
+            def _register_adapter(self, payload):
+                """Hot-load a staged PEFT adapter dir into a LoRA slot
+                (the serving-agent sidecar calls this after staging —
+                reference: serving_agent.go:42-80 fsnotify flow)."""
+                eng = getattr(outer.scheduler, "engine", None)
+                if eng is None or not hasattr(eng, "register_adapter"):
+                    return self._json(400, {
+                        "error": "engine has no adapter support"})
+                name = payload.get("name")
+                path = payload.get("path")
+                if not name or not path:
+                    return self._json(400, {
+                        "error": "need {name, path}"})
+                try:
+                    idx = eng.register_adapter(name, path)
+                except (ValueError, OSError) as e:
+                    return self._json(400, {"error": str(e)})
+                return self._json(200, {"name": name, "slot": idx})
 
             def _pd_prefill(self, payload):
                 if outer.pd_prefill is None:
@@ -155,29 +195,67 @@ class EngineServer:
                 rf = payload.get("response_format") or {}
                 if rf:
                     kind = rf.get("type")
-                    if kind not in ("json_object", "text", None):
+                    if kind not in ("json_object", "json_schema",
+                                    "text", None):
                         return self._json(400, {
                             "error": f"response_format type {kind!r} "
-                                     "is not supported (json_object "
-                                     "and text are)"})
-                    if kind == "json_object":
+                                     "is not supported (json_object, "
+                                     "json_schema and text are)"})
+                    if kind in ("json_object", "json_schema"):
                         if not outer.structured:
                             return self._json(400, {
                                 "error": "structured outputs are not "
                                          "available on this node "
-                                         "(multi-host leader or PD "
-                                         "decode role)"})
+                                         "(embeddings deployment)"})
                         from .structured import TokenMasker
-                        # OpenAI json_object means a JSON OBJECT, not
-                        # any value — root must open with '{'
-                        masker = TokenMasker(tok, object_root=True)
+                        if kind == "json_schema":
+                            from .schema import (SchemaAutomaton,
+                                                 SchemaError)
+                            spec = rf.get("json_schema") or {}
+                            if "schema" not in spec:
+                                # a missing schema must not silently
+                                # degrade to unconstrained output
+                                return self._json(400, {
+                                    "error": "response_format "
+                                             "json_schema requires "
+                                             "json_schema.schema"})
+                            try:
+                                auto = SchemaAutomaton(spec["schema"])
+                            except SchemaError as e:
+                                return self._json(400, {
+                                    "error": f"json_schema: {e}"})
+                            masker = TokenMasker(tok, automaton=auto)
+                        else:
+                            # OpenAI json_object means a JSON OBJECT,
+                            # not any value — root must open with '{'
+                            masker = TokenMasker(tok, object_root=True)
+                # multi-LoRA routing: a request whose model id names a
+                # registered adapter decodes with that adapter's
+                # deltas; an id matching NEITHER the base nor an
+                # adapter is an error, not a silent base fallback
+                adapter = None
+                mdl = payload.get("model")
+                if mdl and mdl != outer.model_name:
+                    names = outer._adapter_names()
+                    if mdl in names:
+                        adapter = mdl
+                    elif names:
+                        # with adapters loaded the model id ROUTES, so
+                        # an unknown id must 404 rather than silently
+                        # serving the base model; without adapters,
+                        # keep the permissive single-model behavior
+                        return self._json(404, {
+                            "error": f"model {mdl!r} not found "
+                                     f"(serving {outer.model_name}, "
+                                     "adapters: " + ", ".join(names)
+                                     + ")"})
                 req = Request(
                     prompt_ids=tok.encode(prompt),
                     max_new_tokens=int(payload.get("max_tokens", 64)),
                     temperature=float(payload.get("temperature", 0.0)),
                     top_k=int(payload.get("top_k", 0)),
                     top_p=float(payload.get("top_p", 1.0)),
-                    masker=masker,
+                    masker=masker, adapter=adapter,
                     stop_ids=[tok.eos_id] if tok.eos_id is not None else [])
                 try:
                     outer.scheduler.submit(req)
@@ -265,6 +343,10 @@ class EngineServer:
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def _adapter_names(self):
+        eng = getattr(self.scheduler, "engine", None)
+        return list(getattr(eng, "adapter_names", []) or [])
 
     def start(self):
         self.scheduler.start()

@@ -20,6 +20,7 @@ multinode.py) targets: the same engine, mesh spanning hosts.
 
 from __future__ import annotations
 
+from contextlib import nullcontext as _nullcontext
 from typing import List, Optional
 
 import jax
@@ -55,6 +56,26 @@ class ShardedInferenceEngine(InferenceEngine):
                          prefill_buckets=prefill_buckets,
                          prefix_cache_bytes=prefix_cache_bytes)
 
+    # tp-sharded weights must not hit the un-partitioned int4 Pallas
+    # kernel (GSPMD would replicate + all-gather the packed weight per
+    # step); the gate is a contextvar scoped around THIS engine's
+    # traces so tp=1 engines in the same process keep the fused path
+    def _no_int4_kernel(self):
+        from ..ops.int4_matmul import kernel_disabled
+        return kernel_disabled() if self.tp > 1 else _nullcontext()
+
+    def prefill(self, *a, **kw):
+        with self._no_int4_kernel():
+            return super().prefill(*a, **kw)
+
+    def insert(self, *a, **kw):
+        with self._no_int4_kernel():
+            return super().insert(*a, **kw)
+
+    def decode(self, *a, **kw):
+        with self._no_int4_kernel():
+            return super().decode(*a, **kw)
+
     def _kv_sharding(self) -> NamedSharding:
         # [L, B, S, K, Dh]: KV heads on tp. MLA caches ONE latent head
         # (kv_cache_heads == 1) — replicated; the latent cache is tiny
@@ -79,4 +100,5 @@ class ShardedInferenceEngine(InferenceEngine):
             v=jax.device_put(
                 jnp.zeros(base + (cfg.kv_cache_v_dim,), cfg.dtype), kv),
             lengths=jax.device_put(jnp.zeros((B,), jnp.int32), rep),
-            tokens=jax.device_put(jnp.zeros((B,), jnp.int32), rep))
+            tokens=jax.device_put(jnp.zeros((B,), jnp.int32), rep),
+            adapters=jax.device_put(jnp.zeros((B,), jnp.int32), rep))

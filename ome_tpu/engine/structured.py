@@ -28,9 +28,32 @@ future work — the automaton is the extension point.
 
 from __future__ import annotations
 
+import base64
+import re
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+
+def pack_mask(mask: Optional[np.ndarray]) -> Optional[dict]:
+    """Wire form of a boolean mask (np.packbits + base64): ~V/8 bytes
+    per row, small enough to ride the multi-host op stream and the PD
+    prefill request body."""
+    if mask is None:
+        return None
+    m = np.asarray(mask, bool)
+    return {"shape": list(m.shape),
+            "bits": base64.b64encode(np.packbits(m)).decode()}
+
+
+def unpack_mask(obj: Optional[dict]) -> Optional[np.ndarray]:
+    """Inverse of pack_mask (None passes through)."""
+    if not obj:
+        return None
+    shape = tuple(int(d) for d in obj["shape"])
+    n = int(np.prod(shape))
+    bits = np.frombuffer(base64.b64decode(obj["bits"]), np.uint8)
+    return np.unpackbits(bits, count=n).astype(bool).reshape(shape)
 
 # -- byte-level JSON pushdown automaton ------------------------------------
 
@@ -347,6 +370,86 @@ class JsonAutomaton:
         return n
 
 
+def _gpt2_uni2byte() -> Dict[str, int]:
+    """Inverse of GPT-2's bytes_to_unicode table: the fixed invertible
+    byte<->printable-char map every byte-level BPE vocab uses."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(0xA1, 0xAC + 1)) + list(range(0xAE, 0xFF + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
+
+
+_BYTE_FALLBACK = re.compile(r"<0x([0-9A-Fa-f]{2})>\Z")
+
+
+def _build_token_table(tok) -> list:
+    """Per-token raw BYTE sequences — the mask's source of truth.
+
+    `tok.decode([i])` is NOT it for real BPE vocabs: byte-fallback and
+    partial-UTF-8 pieces decode to U+FFFD, making masks approximate
+    (round-3 advisor finding). Instead, read the vocab's own byte
+    conventions via the underlying HF tokenizer when present:
+
+      * byte-level BPE (GPT-2/Llama-3/Qwen): token chars map through
+        the fixed bytes_to_unicode table — exact bytes for every token;
+      * SentencePiece: U+2581 is the space marker and `<0xHH>` pieces
+        are byte fallback — exact bytes for every piece;
+      * anything else falls back to decode(), with tokens that decode
+        to U+FFFD banned (b"" never passes the mask) — conservative:
+        the constrained output stays valid even if some exotic
+        multi-byte content is unreachable.
+
+    Special tokens (BOS/EOS/pad...) get b"" — EOS legality is handled
+    explicitly from the automaton's completion state, never via bytes.
+    """
+    inner = getattr(tok, "_tok", None)  # engine/tokenizer.HFTokenizer
+    n = tok.vocab_size
+    table: list = []
+    uni2byte = _gpt2_uni2byte()
+    if inner is not None:
+        try:
+            specials = set(getattr(inner, "all_special_ids", []) or [])
+            toks = inner.convert_ids_to_tokens(list(range(n)))
+        except Exception:
+            inner, toks, specials = None, None, set()
+    if inner is not None and toks is not None:
+        # classify the VOCAB once (per-token guessing is ambiguous:
+        # "Ã" is byte 0xC3 in a byte-level vocab but the letter A-tilde
+        # in a plain one). U+2581 ▁ can NEVER appear in a byte-level
+        # token (outside bytes_to_unicode's range) so its presence is
+        # decisive for SentencePiece; otherwise Ġ marks byte-level BPE
+        sample = [t for t in toks[:50000] if t is not None]
+        byte_level = (not any("▁" in t for t in sample)
+                      and any("Ġ" in t for t in sample))
+        for i, t in enumerate(toks):
+            if t is None or i in specials:
+                table.append(b"")
+                continue
+            m = _BYTE_FALLBACK.match(t)
+            if m:                       # sentencepiece byte fallback
+                table.append(bytes([int(m.group(1), 16)]))
+            elif byte_level and all(c in uni2byte for c in t):
+                table.append(bytes(uni2byte[c] for c in t))
+            elif byte_level:
+                table.append(b"")       # malformed for this vocab: ban
+            else:                       # sentencepiece/plain text piece
+                table.append(t.replace("▁", " ").encode("utf-8"))
+        return table
+    for i in range(n):
+        try:
+            s = tok.decode([i])
+            table.append(b"" if "�" in s else s.encode("utf-8"))
+        except Exception:
+            table.append(b"")
+    return table
+
+
 class TokenMasker:
     """Tokenizer-aware mask builder over a JsonAutomaton.
 
@@ -357,9 +460,13 @@ class TokenMasker:
 
     _tables: Dict[int, list] = {}  # id(tokenizer) -> per-token bytes
 
-    def __init__(self, tokenizer, object_root: bool = False):
+    def __init__(self, tokenizer, object_root: bool = False,
+                 automaton=None):
         self.tok = tokenizer
-        self.automaton = JsonAutomaton(object_root=object_root)
+        # `automaton`: any object with the JsonAutomaton query surface
+        # (e.g. schema.SchemaAutomaton for response_format json_schema)
+        self.automaton = automaton if automaton is not None \
+            else JsonAutomaton(object_root=object_root)
         self.table = self._token_table(tokenizer)
         self.eos_id = getattr(tokenizer, "eos_id", None)
 
@@ -367,13 +474,7 @@ class TokenMasker:
     def _token_table(cls, tok) -> list:
         key = id(tok)
         if key not in cls._tables:
-            table = []
-            for i in range(tok.vocab_size):
-                try:
-                    table.append(tok.decode([i]).encode("utf-8"))
-                except Exception:
-                    table.append(b"")
-            cls._tables[key] = table
+            cls._tables[key] = _build_token_table(tok)
         return cls._tables[key]
 
     def feed(self, token_id: int) -> None:
@@ -383,19 +484,39 @@ class TokenMasker:
             if not self.automaton.advance(b):
                 break
 
-    def mask(self, vocab_size: int,
-             closing: bool = False) -> np.ndarray:
+    def mask(self, vocab_size: int, closing: bool = False,
+             remaining: Optional[int] = None) -> np.ndarray:
         """Boolean [vocab_size]: which tokens keep the output valid.
+
         `closing` restricts to the minimal completion path — the
         scheduler sets it when the remaining token budget approaches
         the closing distance, so budget exhaustion cannot strand an
-        unterminated string or open container."""
+        unterminated string or open container.
+
+        `remaining` (token budget incl. this step) additionally bans
+        any token AFTER which the minimal completion would no longer
+        fit the budget — without it, a step just above the closing
+        threshold can open an optional subtree (an un-required object
+        key, a fresh array) whose completion cost overshoots the
+        budget before the closing switch can re-engage. Distances are
+        in bytes; every token covers >= 1 byte, so bytes upper-bound
+        tokens (conservative)."""
         m = np.zeros(vocab_size, dtype=bool)
         a = self.automaton
-        ok = a.accepts_closing if closing else a.accepts
-        for i, data in enumerate(self.table):
-            if data and ok(data):
-                m[i] = True
+        if closing:
+            for i, data in enumerate(self.table):
+                if data and a.accepts_closing(data):
+                    m[i] = True
+        else:
+            budget = None if remaining is None else remaining - 1
+            for i, data in enumerate(self.table):
+                if not data:
+                    continue
+                w = a.copy()
+                if all(w.advance(b) for b in data):
+                    if budget is None \
+                            or w.closing_distance() <= budget:
+                        m[i] = True
         if self.eos_id is not None and a.is_complete():
             m[self.eos_id] = True
         if not m.any() and self.eos_id is not None:

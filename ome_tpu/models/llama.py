@@ -268,12 +268,50 @@ def _activate(gate: jax.Array, cfg: Optional[ModelConfig]) -> jax.Array:
     return jax.nn.silu(gate)
 
 
+def _lora_delta(x: jax.Array, lp: Params, name: str,
+                adapter_ids: Optional[jax.Array], flatten: int = 1):
+    """Per-slot low-rank delta for the projection `name`.
+
+    lp[name+"_lora_a"]: [n_slots, r, K], lp[..._b]: [n_slots, r, N] —
+    per-layer slices of the engine's adapter stacks (scaling already
+    folded into B; slot 0 is all-zero = base model). adapter_ids: [B].
+    Returns [B, S, N] in x.dtype, or None when multi-LoRA is off.
+    """
+    a = lp.get(name + "_lora_a")
+    if a is None or adapter_ids is None:
+        return None
+    b = lp.get(name + "_lora_b")
+    import math
+    B = x.shape[0]
+    K = math.prod(x.shape[x.ndim - flatten:])
+    x2 = x.reshape(B, -1, K)
+    asel = jnp.take(a, adapter_ids, axis=0)          # [B, r, K]
+    bsel = jnp.take(b, adapter_ids, axis=0)          # [B, r, N]
+    h = jnp.einsum("bsk,brk->bsr", x2, asel.astype(x2.dtype))
+    return jnp.einsum("bsr,brn->bsn", h, bsel.astype(x2.dtype))
+
+
+def _proj_lora(x: jax.Array, lp: Params, name: str,
+               adapter_ids: Optional[jax.Array], dtype,
+               out_dims=None, flatten: int = 1):
+    """_proj + the slot's adapter delta (multi-LoRA serving)."""
+    y = _proj(x, lp[name], dtype, flatten=flatten)
+    d = _lora_delta(x, lp, name, adapter_ids, flatten=flatten)
+    if d is not None:
+        y = y + d.reshape(y.shape)
+    if out_dims:
+        y = y.reshape(*y.shape[:-1], *out_dims)
+    return y
+
+
 def dense_mlp(x: jax.Array, p: Params,
-              cfg: Optional[ModelConfig] = None) -> jax.Array:
+              cfg: Optional[ModelConfig] = None,
+              adapter_ids: Optional[jax.Array] = None) -> jax.Array:
     dt = cfg.dtype if cfg else None
-    gate = _proj(x, p["w_gate"], dt)
-    up = _proj(x, p["w_up"], dt)
-    return _proj(_activate(gate, cfg) * up, p["w_down"], dt)
+    gate = _proj_lora(x, p, "w_gate", adapter_ids, dt)
+    up = _proj_lora(x, p, "w_up", adapter_ids, dt)
+    return _proj_lora(_activate(gate, cfg) * up, p, "w_down",
+                      adapter_ids, dt)
 
 
 def _route(x: jax.Array, p: Params, cfg: ModelConfig):
@@ -318,6 +356,10 @@ def _route(x: jax.Array, p: Params, cfg: ModelConfig):
     if cfg.norm_topk_prob:
         weights = weights / (jnp.sum(weights, axis=-1, keepdims=True)
                              + 1e-20)
+        if cfg.router_scoring == "softmax_v2":
+            # HF DeepseekV2MoE applies routed_scaling_factor only in
+            # the non-normalized branch; V3 (sigmoid) scales always
+            return weights, idx
     return weights * cfg.routed_scaling_factor, idx
 
 
@@ -392,11 +434,14 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
            positions: jax.Array, kv_len: Optional[jax.Array],
            cache_kv: Optional[Tuple[jax.Array, jax.Array]],
            cache_index: Optional[jax.Array],
-           window=_WINDOW_FROM_CFG, moe: Optional[bool] = None):
+           window=_WINDOW_FROM_CFG, moe: Optional[bool] = None,
+           adapter_ids: Optional[jax.Array] = None):
     """One transformer block. cache_kv: ([B,Smax,K,Dh], [B,Smax,K,Dh]).
     `window` overrides cfg.sliding_window (the gemma2 pair-scan passes
     the per-layer value; None = global attention). `moe` overrides
-    cfg.is_moe (DeepSeek's first_k_dense leading dense layers)."""
+    cfg.is_moe (DeepSeek's first_k_dense leading dense layers).
+    `adapter_ids` ([B]) selects each slot's LoRA delta (multi-adapter
+    serving; None = no adapter stacks present)."""
     if window is _WINDOW_FROM_CFG:
         window = cfg.sliding_window
     uo = cfg.unit_offset_norm
@@ -407,14 +452,16 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
                                      cache_kv, cache_index)
     else:
         a, new_cache = _mha(h, lp, cfg, freqs, positions, kv_len,
-                            cache_kv, cache_index, window, uo)
+                            cache_kv, cache_index, window, uo,
+                            adapter_ids)
     if cfg.post_block_norms:
         a = rms_norm(a, lp["attn_post_norm"], cfg.rms_norm_eps, uo)
     x = x + a
 
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, uo)
     use_moe = cfg.is_moe if moe is None else moe
-    mlp_out = moe_mlp(h, lp, cfg) if use_moe else dense_mlp(h, lp, cfg)
+    mlp_out = moe_mlp(h, lp, cfg) if use_moe \
+        else dense_mlp(h, lp, cfg, adapter_ids)
     if cfg.post_block_norms:
         mlp_out = rms_norm(mlp_out, lp["mlp_post_norm"],
                            cfg.rms_norm_eps, uo)
@@ -423,14 +470,14 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
 
 def _mha(h: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
          positions: jax.Array, kv_len, cache_kv, cache_index, window,
-         uo: bool):
+         uo: bool, adapter_ids: Optional[jax.Array] = None):
     """Standard multi-head (GQA) attention on the pre-normed input."""
-    q = _proj(h, lp["wq"], cfg.dtype,
-              out_dims=(cfg.num_heads, cfg.head_dim))
-    k = _proj(h, lp["wk"], cfg.dtype,
-              out_dims=(cfg.num_kv_heads, cfg.head_dim))
-    v = _proj(h, lp["wv"], cfg.dtype,
-              out_dims=(cfg.num_kv_heads, cfg.head_dim))
+    q = _proj_lora(h, lp, "wq", adapter_ids, cfg.dtype,
+                   out_dims=(cfg.num_heads, cfg.head_dim))
+    k = _proj_lora(h, lp, "wk", adapter_ids, cfg.dtype,
+                   out_dims=(cfg.num_kv_heads, cfg.head_dim))
+    v = _proj_lora(h, lp, "wv", adapter_ids, cfg.dtype,
+                   out_dims=(cfg.num_kv_heads, cfg.head_dim))
     if cfg.attn_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -465,19 +512,22 @@ def _mha(h: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
     attn = attention(q, k_full, v_full, positions=positions, kv_len=kv_len,
                      sliding_window=window, scale=cfg.query_scale,
                      logit_softcap=cfg.attn_logit_softcap)
-    a = _proj(attn, lp["wo"], cfg.dtype, flatten=2)
+    a = _proj_lora(attn, lp, "wo", adapter_ids, cfg.dtype, flatten=2)
     return a, new_cache
 
 
 def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
             positions: Optional[jax.Array] = None,
             cache: Optional[KVCache] = None,
+            adapter_ids: Optional[jax.Array] = None,
             ) -> Tuple[jax.Array, Optional[KVCache]]:
     """Run the decoder.
 
     tokens: [B, S] int32. positions: [B, S] (defaults to arange).
     With `cache`, K/V are written at cache.index and attention spans the
     cache (serving decode/chunked prefill); without, plain causal prefill.
+    `adapter_ids` ([B] int32) selects each row's LoRA adapter slot when
+    the params carry multi-adapter factor stacks (engine/core.py).
     Returns (logits [B, S, vocab], updated cache or None).
     """
     B, S = tokens.shape
@@ -500,7 +550,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
     if cfg.alt_sliding_window:
         x, new_cache = _alt_window_scan(params, cfg, x, freqs, positions,
-                                        kv_len, cache)
+                                        kv_len, cache, adapter_ids)
     else:
         # DeepSeek first_k_dense: leading dense-MLP layers scan as
         # their own block; the cache's layer dim covers both blocks
@@ -510,7 +560,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
             def body(x, per_layer):
                 lp, layer_cache = per_layer
                 x, nc = _layer(x, lp, cfg, freqs, positions, kv_len,
-                               layer_cache, index, moe=moe)
+                               layer_cache, index, moe=moe,
+                               adapter_ids=adapter_ids)
                 return x, nc
 
             carry_cache = (ck, cv) if cache is not None else None
@@ -553,7 +604,8 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
 
 def _alt_window_scan(params: Params, cfg: ModelConfig, x: jax.Array,
-                     freqs, positions, kv_len, cache: Optional[KVCache]):
+                     freqs, positions, kv_len, cache: Optional[KVCache],
+                     adapter_ids: Optional[jax.Array] = None):
     """Scan over layer PAIRS: gemma2 alternates sliding-window (even
     layers) and global (odd layers) attention. The pair body keeps both
     window variants static — one compiled body, no dynamic masks."""
@@ -573,9 +625,10 @@ def _alt_window_scan(params: Params, cfg: ModelConfig, x: jax.Array,
         c0 = (c2[0][0], c2[1][0]) if c2 is not None else None
         c1 = (c2[0][1], c2[1][1]) if c2 is not None else None
         x, n0 = _layer(x, lp0, cfg, freqs, positions, kv_len, c0, index,
-                       window=cfg.sliding_window)
+                       window=cfg.sliding_window,
+                       adapter_ids=adapter_ids)
         x, n1 = _layer(x, lp1, cfg, freqs, positions, kv_len, c1, index,
-                       window=None)
+                       window=None, adapter_ids=adapter_ids)
         if n0 is None:
             return x, None
         return x, (jnp.stack([n0[0], n1[0]]), jnp.stack([n0[1], n1[1]]))

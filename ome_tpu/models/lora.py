@@ -1,14 +1,22 @@
-"""LoRA adapter loading: merge PEFT adapters into base weights.
+"""LoRA adapter loading: merged OR multi-adapter serving forms.
 
 The control plane already moves fine-tuned adapters (FineTunedWeight
 CRD, agent/serving_agent.py sidecar downloads); this is the engine
 side: read a PEFT-format adapter directory (adapter_config.json +
 adapter_model.safetensors with lora_A [r, in] / lora_B [out, r]
-pairs) and fold `W += (alpha/r) * B @ A` into the converted param
-tree before device upload. Merge-at-load serves ONE adapter at full
-base-model speed — the TPU-friendly choice for static shapes (the
-reference's runtimes likewise pass a merged or single-adapter path to
-their engines).
+pairs) and either
+
+  * `merge_lora`: fold `W += (alpha/r) * B @ A` into the converted
+    param tree before device upload — ONE adapter at full base-model
+    speed (`--adapter <dir>`), or
+  * `load_adapter_matrices`: return per-target stacked [L, r, K_in] /
+    [L, r, N_out] factor pairs (scaling folded into B, rank
+    zero-padded to the engine's slot rank) for MULTI-adapter serving:
+    the engine keeps per-adapter factor stacks as extra layer leaves
+    and applies per-slot low-rank deltas inside the decode matmuls
+    (engine/core.py register_adapter; reference analog:
+    internal/ome-agent/serving-agent/serving_agent.go:42-80 staging +
+    the engines' punica-style multi-LoRA batching).
 """
 
 from __future__ import annotations
@@ -45,12 +53,8 @@ _KEY_RE = re.compile(
     r"(\w+_proj)\.lora_(A|B)\.weight")
 
 
-def merge_lora(params: Dict[str, Any], cfg, adapter_dir: str) -> int:
-    """Fold the adapter into `params` (numpy tree, pre-device-put).
-
-    Returns the number of (layer, module) pairs merged. Raises on rank
-    mismatches or targets the model doesn't have.
-    """
+def _read_adapter(adapter_dir: str):
+    """Parse a PEFT dir -> (pairs {(layer, module): {A, B}}, scaling)."""
     with open(os.path.join(adapter_dir, "adapter_config.json")) as f:
         acfg = json.load(f)
     cfg_rank = acfg.get("r", 8)
@@ -74,10 +78,6 @@ def merge_lora(params: Dict[str, Any], cfg, adapter_dir: str) -> int:
             f"adapter carries weights this merge does not cover "
             f"(supported targets: {sorted(_TARGETS)}): "
             f"{unmatched[:5]}{'...' if len(unmatched) > 5 else ''}")
-
-    merged = 0
-    layers = params["layers"]
-    writable: set = set()  # stacked leaves copied once, not per layer
     for (layer, module), mats in sorted(pairs.items()):
         if "A" not in mats or "B" not in mats:
             raise ValueError(f"adapter incomplete for layer {layer} "
@@ -91,8 +91,71 @@ def merge_lora(params: Dict[str, Any], cfg, adapter_dir: str) -> int:
             raise ValueError(
                 f"layer {layer} {module}: tensor rank {rank} != "
                 f"adapter_config r={cfg_rank}")
-        # PEFT scaling: alpha/r, or alpha/sqrt(r) with rsLoRA
-        scaling = alpha / (rank ** 0.5 if rslora else rank)
+    if not pairs:
+        raise ValueError(f"no LoRA weights recognized in {adapter_dir}")
+    scaling = alpha / (cfg_rank ** 0.5 if rslora else cfg_rank)
+    return pairs, cfg_rank, alpha, scaling
+
+
+# multi-LoRA factor layout per target: flattened contraction width K
+# and output width N of the stacked leaf ([L, r, K] A / [L, r, N] B)
+def _target_dims(cfg) -> Dict[str, tuple]:
+    D, H, K, Dh, F = (cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads,
+                      cfg.head_dim, cfg.intermediate_size)
+    return {
+        "wq": (D, H * Dh), "wk": (D, K * Dh), "wv": (D, K * Dh),
+        "wo": (H * Dh, D),
+        "w_gate": (D, F), "w_up": (D, F), "w_down": (F, D),
+    }
+
+
+def load_adapter_matrices(adapter_dir: str, cfg,
+                          rank_pad: int) -> Dict[str, tuple]:
+    """PEFT dir -> {leaf: (A [L, rank_pad, K], B [L, rank_pad, N])}
+    float32, scaling folded into B, zero rows pad rank to `rank_pad`
+    (zero factors = no delta, so padding and untouched layers are
+    exact no-ops)."""
+    pairs, rank, _alpha, scaling = _read_adapter(adapter_dir)
+    if rank > rank_pad:
+        raise ValueError(f"adapter rank {rank} exceeds the engine's "
+                         f"LoRA slot rank {rank_pad} "
+                         f"(--lora-rank at startup)")
+    L = cfg.num_layers
+    dims = _target_dims(cfg)
+    out: Dict[str, list] = {}
+    for (layer, module), mats in sorted(pairs.items()):
+        leaf, _ = _TARGETS[module]
+        if leaf not in dims:
+            raise ValueError(f"unknown adapter target {module}")
+        if layer >= L:
+            raise ValueError(f"adapter layer {layer} out of range "
+                             f"(model has {L})")
+        Kd, Nd = dims[leaf]
+        if mats["A"].shape[1] != Kd or mats["B"].shape[0] != Nd:
+            raise ValueError(
+                f"layer {layer} {module}: adapter dims "
+                f"{mats['B'].shape[0]}x{mats['A'].shape[1]} != model "
+                f"{Nd}x{Kd}")
+        if leaf not in out:
+            out[leaf] = [np.zeros((L, rank_pad, Kd), np.float32),
+                         np.zeros((L, rank_pad, Nd), np.float32)]
+        out[leaf][0][layer, :rank] = mats["A"]
+        out[leaf][1][layer, :rank] = scaling * mats["B"].T
+    return {k: (a, b) for k, (a, b) in out.items()}
+
+
+def merge_lora(params: Dict[str, Any], cfg, adapter_dir: str) -> int:
+    """Fold the adapter into `params` (numpy tree, pre-device-put).
+
+    Returns the number of (layer, module) pairs merged. Raises on rank
+    mismatches or targets the model doesn't have.
+    """
+    pairs, rank, alpha, scaling = _read_adapter(adapter_dir)
+
+    merged = 0
+    layers = params["layers"]
+    writable: set = set()  # stacked leaves copied once, not per layer
+    for (layer, module), mats in sorted(pairs.items()):
         leaf_name, reshape = _TARGETS[module]
         if leaf_name not in layers:
             raise ValueError(f"model has no {leaf_name} for adapter "

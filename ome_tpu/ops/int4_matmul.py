@@ -37,6 +37,24 @@ from jax.experimental.pallas import tpu as pltpu
 
 MAX_M = 256
 
+# Per-context kernel gate: a tp>1 engine disables the un-partitioned
+# kernel around ITS traces only (contextvar — not a sticky process
+# global, so tp=1 engines in the same process keep the fused path).
+import contextlib
+from contextvars import ContextVar
+
+_kernel_enabled: ContextVar[bool] = ContextVar("ome_int4_kernel",
+                                               default=True)
+
+
+@contextlib.contextmanager
+def kernel_disabled():
+    token = _kernel_enabled.set(False)
+    try:
+        yield
+    finally:
+        _kernel_enabled.reset(token)
+
 
 def _kernel(x_ref, qp_ref, s_ref, o_ref, acc_ref, *, gsize: int,
             bk: int):
@@ -131,6 +149,15 @@ def int4_matmul(x: jax.Array, qt, out_dtype=jnp.bfloat16,
     if os.environ.get("OME_INT4_KERNEL_INTERPRET"):
         interpret = True  # tests: run the kernel path on CPU
     if not interpret and jax.default_backend() != "tpu":
+        return None
+    if not _kernel_enabled.get() and not interpret \
+            and not os.environ.get("OME_INT4_KERNEL_FORCE"):
+        # GSPMD-partitioned jits (tp>1 sharded serving) would have to
+        # replicate this un-partitioned custom call — all-gathering the
+        # packed weight every step, negating int4's HBM savings. Weight
+        # sharding isn't visible on tracers, so the sharded engine
+        # wraps its traces in kernel_disabled() and takes the XLA
+        # dequant path instead.
         return None
     flat = flatten_qtensor(qt)
     if flat is None:

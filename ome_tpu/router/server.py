@@ -15,11 +15,12 @@ router component — SURVEY.md §2.9 "PD disaggregation"):
     backend;
   * streaming passthrough: SSE bodies relay chunk-by-chunk.
 
-PD note: with PD-disaggregated engines the KV handoff happens inside
-the serving engines (vLLM/SGLang disaggregation protocols); the
-router's PD job is steering — prefill-heavy requests to the engine
-(prefill) pool, continuation traffic to decoders — which reduces to
-pool selection + affinity here.
+PD note: the KV handoff itself lives in the engines — decode nodes
+pull the prefix KV from the prefill pool over /pd/prefill
+(engine/pd.py wire format + RemotePrefillEngine); the router's PD job
+is steering — completions go to the DECODE pool (whose engines fetch
+prefill remotely), and cache-aware affinity keeps same-prefix traffic
+on the same prefill node so its radix prefix cache can hit.
 """
 
 from __future__ import annotations

@@ -111,3 +111,131 @@ def test_incomplete_adapter_rejected(tmp_path):
                                  device_put=False)
     with pytest.raises(ValueError, match="lora_B"):
         merge_lora(params, cfg, str(a))
+
+
+# -- multi-LoRA serving ----------------------------------------------------
+
+
+def _mk_named_adapter(tmp_path, name, seed, D=32, H=4, Dh=8, r=4,
+                      alpha=8.0):
+    a = tmp_path / name
+    a.mkdir()
+    (a / "adapter_config.json").write_text(json.dumps(
+        {"r": r, "lora_alpha": alpha,
+         "target_modules": ["q_proj", "o_proj", "up_proj"]}))
+    rng = np.random.RandomState(seed)
+    T = {}
+    for layer in (0, 1):
+        pre = f"base_model.model.model.layers.{layer}."
+        T[pre + "self_attn.q_proj.lora_A.weight"] = \
+            rng.randn(r, D).astype(np.float32) * 0.2
+        T[pre + "self_attn.q_proj.lora_B.weight"] = \
+            rng.randn(H * Dh, r).astype(np.float32) * 0.2
+        T[pre + "self_attn.o_proj.lora_A.weight"] = \
+            rng.randn(r, H * Dh).astype(np.float32) * 0.2
+        T[pre + "self_attn.o_proj.lora_B.weight"] = \
+            rng.randn(D, r).astype(np.float32) * 0.2
+        T[pre + "mlp.up_proj.lora_A.weight"] = \
+            rng.randn(r, D).astype(np.float32) * 0.2
+        T[pre + "mlp.up_proj.lora_B.weight"] = \
+            rng.randn(64, r).astype(np.float32) * 0.2
+    ck.save_safetensors(str(a / "adapter_model.safetensors"), T)
+    return str(a)
+
+
+def _greedy(engine, prompt, steps=8, adapter=None):
+    """Drive prefill+insert+decode directly; returns the token list."""
+    state = engine.new_state()
+    kw = {} if adapter is None else {"adapter": adapter}
+    tok, kv, tl, b = engine.prefill(prompt, **kw)
+    state = engine.insert(state, kv, 0, tl, tok, b, **kw)
+    out = [tok]
+    temp = np.zeros(engine.max_slots, np.float32)
+    top_k = np.zeros(engine.max_slots, np.int32)
+    top_p = np.ones(engine.max_slots, np.float32)
+    for _ in range(steps):
+        state, toks = engine.decode(state, temp, top_k, top_p)
+        out.append(int(np.asarray(toks)[0]))
+    return out
+
+
+def test_multi_lora_matches_merged_baselines(tmp_path):
+    """One engine serving base + 2 adapters concurrently must produce
+    EXACTLY the tokens of per-adapter merged engines (VERDICT r3 #5)."""
+    import jax
+
+    from ome_tpu.engine.core import InferenceEngine
+    base = _mk_base(tmp_path)
+    a1 = _mk_named_adapter(tmp_path, "a1", seed=11)
+    a2 = _mk_named_adapter(tmp_path, "a2", seed=22)
+
+    def merged_engine(adapter_dir=None):
+        params, cfg = ck.load_params(base, dtype=jnp.float32,
+                                     device_put=False)
+        if adapter_dir:
+            merge_lora(params, cfg, adapter_dir)
+        params = jax.tree.map(jnp.asarray, params)
+        return InferenceEngine(params, cfg, max_slots=4,
+                               max_seq=32, prefill_buckets=[8])
+
+    prompt = [5, 6, 7, 8]
+    want_base = _greedy(merged_engine(), prompt)
+    want_a1 = _greedy(merged_engine(a1), prompt)
+    want_a2 = _greedy(merged_engine(a2), prompt)
+    assert want_a1 != want_base or want_a2 != want_base
+
+    params, cfg = ck.load_params(base, dtype=jnp.float32,
+                                 device_put=False)
+    params = jax.tree.map(jnp.asarray, params)
+    eng = InferenceEngine(params, cfg, max_slots=4, max_seq=32,
+                          prefill_buckets=[8], lora_slots=3,
+                          lora_rank=8)
+    eng.register_adapter("a1", a1)
+    eng.register_adapter("a2", a2)
+    assert eng.adapter_names == ["a1", "a2"]
+
+    assert _greedy(eng, prompt) == want_base
+    assert _greedy(eng, prompt, adapter="a1") == want_a1
+    assert _greedy(eng, prompt, adapter="a2") == want_a2
+
+    # concurrent slots: all three in ONE decode batch, interleaved
+    state = eng.new_state()
+    reqs = [(None, want_base), ("a1", want_a1), ("a2", want_a2)]
+    for slot, (ad, _) in enumerate(reqs):
+        kw = {} if ad is None else {"adapter": ad}
+        tok, kv, tl, b = eng.prefill(prompt, **kw)
+        state = eng.insert(state, kv, slot, tl, tok, b, **kw)
+    outs = [[w[0]] for _, w in reqs]
+    temp = np.zeros(4, np.float32)
+    top_k = np.zeros(4, np.int32)
+    top_p = np.ones(4, np.float32)
+    for _ in range(8):
+        state, toks = eng.decode(state, temp, top_k, top_p)
+        for i in range(3):
+            outs[i].append(int(np.asarray(toks)[i]))
+    for (ad, want), got in zip(reqs, outs):
+        assert got == want, f"adapter {ad}: {got} != {want}"
+
+    # hot swap: unregister then register a DIFFERENT adapter under the
+    # same name — no recompilation (same shapes), new deltas apply
+    eng.unregister_adapter("a1")
+    with pytest.raises(ValueError, match="unknown adapter"):
+        eng.adapter_id("a1")
+    eng.register_adapter("a1", a2)  # a1 now points at a2's weights
+    assert _greedy(eng, prompt, adapter="a1") == want_a2
+
+
+def test_lora_rank_cap_enforced(tmp_path):
+    import jax
+
+    from ome_tpu.engine.core import InferenceEngine
+    base = _mk_base(tmp_path)
+    a1 = _mk_named_adapter(tmp_path, "big", seed=3, r=8)
+    params, cfg = ck.load_params(base, dtype=jnp.float32,
+                                 device_put=False)
+    params = jax.tree.map(jnp.asarray, params)
+    eng = InferenceEngine(params, cfg, max_slots=2, max_seq=32,
+                          prefill_buckets=[8], lora_slots=1,
+                          lora_rank=4)
+    with pytest.raises(ValueError, match="exceeds"):
+        eng.register_adapter("big", a1)

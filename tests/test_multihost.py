@@ -105,7 +105,7 @@ def test_replicated_engine_publishes_op_stream():
     assert pub.msgs[0]["ids"] == [1, 2, 3]
     assert pub.msgs[0]["temperature"] == 0.5
     assert pub.msgs[1] == {"op": "insert", "slot": 1, "true_len": 3,
-                           "token": 7, "bucket": 16}
+                           "token": 7, "bucket": 16, "adapter": None}
     assert pub.msgs[2]["temperature"] == [0.0, 0.0]
 
 

@@ -1,0 +1,242 @@
+"""JSON-Schema-constrained decoding (engine/schema.py).
+
+The automaton must accept exactly the schema-conforming byte strings,
+and a random model driven through the masked sampler must emit output
+that PARSES and VALIDATES against the schema — the reference gets this
+from xgrammar inside its SGLang runtime images (SURVEY.md L0).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ome_tpu.engine.core import InferenceEngine
+from ome_tpu.engine.schema import (SchemaAutomaton, SchemaError,
+                                   compile_schema)
+from ome_tpu.engine.scheduler import Request, Scheduler
+from ome_tpu.engine.structured import TokenMasker
+from ome_tpu.engine.tokenizer import ByteTokenizer
+from ome_tpu.models import llama
+from ome_tpu.models.config import tiny_test
+
+
+def accepts(schema, text: str) -> bool:
+    a = SchemaAutomaton(schema)
+    for b in text.encode():
+        if not a.advance(b):
+            return False
+    return a.is_complete()
+
+
+PERSON = {
+    "type": "object",
+    "properties": {
+        "name": {"type": "string"},
+        "age": {"type": "integer"},
+        "tags": {"type": "array", "items": {"type": "string"}},
+    },
+    "required": ["name", "age"],
+    "additionalProperties": False,
+}
+
+
+class TestAutomaton:
+    @pytest.mark.parametrize("text", [
+        '{"name":"bo","age":3}',
+        '{"age": 0, "name": ""}',                  # any key order
+        '{"name":"a","age":-2,"tags":["x","y"]}',
+        '{ "name" : "a" , "age" : 12 }',           # whitespace
+    ])
+    def test_accepts_conforming(self, text):
+        assert accepts(PERSON, text)
+        json.loads(text)  # sanity: also valid JSON
+
+    @pytest.mark.parametrize("text", [
+        '{"name":"bo"}',                   # missing required age
+        '{"name":"bo","age":3.5}',         # integer, not number
+        '{"name":1,"age":3}',              # wrong type
+        '{"name":"bo","age":3,"x":1}',     # additionalProperties false
+        '{"name":"bo","age":3,"tags":[1]}',  # item type
+        '["name"]',                        # not an object
+        '{"name":"bo","age":3',            # unterminated
+    ])
+    def test_rejects_nonconforming(self, text):
+        assert not accepts(PERSON, text)
+
+    def test_enum_and_const(self):
+        s = {"type": "object",
+             "properties": {"color": {"enum": ["red", "green"]},
+                            "v": {"const": 2}},
+             "required": ["color", "v"],
+             "additionalProperties": False}
+        assert accepts(s, '{"color":"red","v":2}')
+        assert accepts(s, '{"color":"green","v":2}')
+        assert not accepts(s, '{"color":"blue","v":2}')
+        assert not accepts(s, '{"color":"red","v":3}')
+        # numeric const terminates only at a delimiter: 2 vs 22
+        assert not accepts(s, '{"color":"red","v":22}')
+
+    def test_numeric_enum_prefix(self):
+        s = {"enum": [1, 12, 120]}
+        assert accepts(s, "1")
+        assert accepts(s, "12")
+        assert accepts(s, "120")
+        assert not accepts(s, "2")
+        assert not accepts(s, "1200")
+
+    def test_additional_properties_schema(self):
+        s = {"type": "object",
+             "additionalProperties": {"type": "integer"}}
+        assert accepts(s, '{"a":1,"b":2}')
+        assert not accepts(s, '{"a":"x"}')
+
+    def test_type_lists_and_null(self):
+        s = {"type": ["string", "null"]}
+        assert accepts(s, '"hi"')
+        assert accepts(s, "null")
+        assert not accepts(s, "3")
+
+    def test_nested_objects(self):
+        s = {"type": "object",
+             "properties": {
+                 "inner": {"type": "object",
+                           "properties": {"x": {"type": "number"}},
+                           "required": ["x"]}},
+             "required": ["inner"]}
+        assert accepts(s, '{"inner":{"x":1.5}}')
+        assert not accepts(s, '{"inner":{}}')
+
+    def test_unsupported_keywords_raise(self):
+        with pytest.raises(SchemaError):
+            compile_schema({"$ref": "#/defs/x"})
+        with pytest.raises(SchemaError):
+            compile_schema({"anyOf": [{"type": "string"}]})
+        with pytest.raises(SchemaError):
+            compile_schema({"enum": []})
+
+    def test_closing_distance_counts_required(self):
+        a = SchemaAutomaton(PERSON)
+        d0 = a.closing_distance()
+        # both required props (name:string, age:int) still to emit
+        assert d0 >= len('{"name":"","age":0}')
+        for b in b'{"name":"bo","age":3':
+            assert a.advance(b)
+        assert a.closing_distance() < d0
+
+    def test_closing_path_completes(self):
+        """Following closing_bytes greedily from any mid-state must
+        reach a complete conforming value."""
+        a = SchemaAutomaton(PERSON)
+        for b in b'{"na':
+            assert a.advance(b)
+        for _ in range(200):
+            if a.is_complete():
+                break
+            nxt = sorted(a.closing_bytes())
+            assert nxt, "no closing byte from this state"
+            assert a.advance(nxt[0])
+        assert a.is_complete()
+
+
+def test_random_model_forced_to_schema():
+    """A random-weights model under the schema mask emits output that
+    parses AND conforms: required keys present, right types."""
+    cfg = tiny_test().replace(dtype=jnp.float32, max_seq_len=160)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(params, cfg, max_slots=2,
+                             prefill_buckets=[16])
+    tok = ByteTokenizer()
+    sched = Scheduler(engine)
+    for temperature in (0.0, 0.9):
+        req = sched.submit(Request(
+            prompt_ids=tok.encode("emit a person:"),
+            max_new_tokens=96, temperature=temperature,
+            masker=TokenMasker(tok,
+                               automaton=SchemaAutomaton(PERSON)),
+            stop_ids=[tok.eos_id]))
+        while not req.done.is_set():
+            sched.step()
+        text = tok.decode(req.output_ids)
+        obj = json.loads(text)
+        assert isinstance(obj, dict), text
+        assert isinstance(obj["name"], str)
+        assert isinstance(obj["age"], int)
+        assert set(obj) <= {"name", "age", "tags"}
+
+
+def test_schema_tight_budget_closes_conforming():
+    """Close-out masking must land a conforming object (required keys
+    emitted) even under a small token budget."""
+    cfg = tiny_test().replace(dtype=jnp.float32, max_seq_len=160)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(params, cfg, max_slots=2,
+                             prefill_buckets=[16])
+    tok = ByteTokenizer()
+    sched = Scheduler(engine)
+    req = sched.submit(Request(
+        prompt_ids=tok.encode("person:"),
+        max_new_tokens=30, temperature=0.9,
+        masker=TokenMasker(tok, automaton=SchemaAutomaton(PERSON)),
+        stop_ids=[tok.eos_id]))
+    while not req.done.is_set():
+        sched.step()
+    obj = json.loads(tok.decode(req.output_ids))
+    assert isinstance(obj["name"], str)
+    assert isinstance(obj["age"], int)
+
+
+def test_http_json_schema_response_format():
+    import urllib.request
+
+    from ome_tpu.engine.server import EngineServer
+    cfg = tiny_test().replace(dtype=jnp.float32, max_seq_len=160)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(params, cfg, max_slots=2,
+                             prefill_buckets=[16])
+    srv = EngineServer(Scheduler(engine), model_name="m")
+    srv.start()
+    try:
+        body = json.dumps({
+            "model": "m", "prompt": "person json",
+            "max_tokens": 80, "temperature": 0,
+            "response_format": {
+                "type": "json_schema",
+                "json_schema": {"name": "person", "schema": PERSON}},
+        }).encode()
+        r = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(r, timeout=300) as resp:
+            out = json.loads(resp.read())
+        obj = json.loads(out["choices"][0]["text"])
+        assert isinstance(obj["name"], str)
+        assert isinstance(obj["age"], int)
+        # unsupported keyword -> 400, not silent under-constraining
+        import urllib.error
+        bad = json.dumps({
+            "model": "m", "prompt": "x",
+            "response_format": {
+                "type": "json_schema",
+                "json_schema": {"schema": {"anyOf": []}}}}).encode()
+        r2 = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions", data=bad,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(r2, timeout=60)
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
+
+
+def test_mask_pack_roundtrip():
+    import numpy as np
+
+    from ome_tpu.engine.structured import pack_mask, unpack_mask
+    assert pack_mask(None) is None
+    assert unpack_mask(None) is None
+    m = np.random.default_rng(0).random((3, 259)) > 0.5
+    got = unpack_mask(pack_mask(m))
+    assert got.dtype == bool and got.shape == m.shape
+    assert (got == m).all()

@@ -158,9 +158,9 @@ def test_http_response_format_json_object():
         with urllib.request.urlopen(r, timeout=300) as resp:
             out = json.loads(resp.read())
         json.loads(out["choices"][0]["text"])  # valid JSON text
-        # unsupported schema type is rejected loudly
+        # unsupported response_format types are rejected loudly
         bad = json.dumps({"model": "m", "prompt": "x",
-                          "response_format": {"type": "json_schema"}}
+                          "response_format": {"type": "grammar"}}
                          ).encode()
         r2 = urllib.request.Request(
             f"http://127.0.0.1:{srv.port}/v1/completions", data=bad,
