@@ -2,8 +2,8 @@
 """Driver benchmark: sustained decode throughput of the flagship model.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
-context keys (int8/int4 throughput, measured HBM bandwidth, roofline
-fractions).
+context keys (int8/int4 throughput, a measured per-step decode time
+breakdown, prefill MFU, and measured-vs-spec rooflines).
 
 The reference (bcfre/ome) publishes no hardware numbers (BASELINE.md) —
 its headline metric is BenchmarkJob *output tokens/sec* against a served
@@ -11,23 +11,28 @@ InferenceService (SURVEY.md §6). This bench measures the same quantity
 at the layer we own end-to-end on one chip: batched autoregressive
 decode tokens/sec of the flagship Llama-class model with a KV cache.
 
-Robustness (round-2 review): every timing is best-of-N trials, so a
-single noisy-bandwidth window on the shared/tunneled chip cannot sink
-the headline; the quantized paths ship in the parsed JSON, not just
-stderr; and the measured-bandwidth anchor is a dedicated HBM
-copy microbenchmark (read+write streams, best-of-N) rather than a
-reduction sum.
-
-`vs_baseline` is the fraction of the chip's spec HBM-bandwidth roofline
-(decode is bandwidth-bound: every generated token must stream all
-weights + the KV cache once), so 1.0 == perfect memory-bound decode.
-It is kept spec-anchored for round-over-round comparability;
-`vs_measured_roofline` reports the same fraction against the measured
-copy bandwidth (the environment's real ceiling).
+Round-4 structure (measured ablations, scripts/perf_lab.py):
+  * decode runs UNROLLED over layers with per-layer cache planes and
+    lax.scan over MULTISTEP tokens per dispatch — vs round 3's
+    scan-over-layers/one-step-per-dispatch shape this avoids the
+    full-cache stacked-ys rewrite (~1.2 ms/step) and amortizes the
+    ~1.6 ms axon host-dispatch latency (bf16 3,003 -> ~4,200 tok/s).
+  * the per-step breakdown is MEASURED, not modeled: host dispatch
+    (empty jit), weights+sampling floor (attention ablated), and the
+    attention/KV remainder — persisted in the parsed JSON so the gap
+    between quantized modes is attributable (round-3 verdict #1).
+  * the achievable-bandwidth anchor is the weights floor itself (a
+    weights-shaped stream through the real matmuls), replacing the
+    copy microbenchmark that under-read 20x on the tunnel (verdict #2);
+    vs_baseline stays spec-anchored for round-over-round comparability,
+    vs_achievable reports against the measured ceiling.
+  * prefill reports tokens/sec AND MFU against the chip's bf16 peak
+    (verdict #3).
 """
 
 from __future__ import annotations
 
+import functools
 import json
 import sys
 import time
@@ -35,6 +40,7 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 
 def sync(x):
@@ -44,14 +50,17 @@ def sync(x):
     jax.block_until_ready(x)
     return np.asarray(jax.device_get(x))
 
-# Per-chip HBM bandwidth (GB/s) by TPU generation; CPU fallback uses a
-# nominal DDR figure so the ratio stays defined in dev environments.
+# Per-chip HBM bandwidth (GB/s) and bf16 peak (TFLOP/s) by generation;
+# CPU fallback keeps the ratios defined in dev environments.
 HBM_GBPS = {"v5 lite": 819.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1640.0,
             "v4": 1228.0, "cpu": 50.0}
+PEAK_TFLOPS = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0,
+               "v6e": 918.0, "v4": 275.0, "cpu": 0.2}
 
 BATCH = 32
 PREFILL = 128
 DECODE_STEPS = 128
+MULTISTEP = 8
 CACHE_LEN = PREFILL + DECODE_STEPS
 TRIALS = 3
 
@@ -60,58 +69,44 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def device_bandwidth() -> float:
+def _lookup(table) -> float:
     d = jax.devices()[0]
     kind = getattr(d, "device_kind", d.platform).lower()
-    for key, bw in HBM_GBPS.items():
+    for key, val in table.items():
         if key in kind:
-            return bw
-    return HBM_GBPS["cpu" if d.platform == "cpu" else "v5e"]
+            return val
+    return table["cpu" if d.platform == "cpu" else "v5e"]
 
 
-def copy_bandwidth() -> float:
-    """Best-of-N HBM copy bandwidth (GB/s): y = x + 1 over a 1 GB
-    buffer streams 1 GB read + 1 GB write. A dedicated copy benchmark
-    (not a reduction) is the conventional STREAM anchor; best-of-N
-    because the tunneled chip's effective bandwidth swings run-to-run.
-
-    Caveat (measured, round 3): on the axon tunnel EVERY standalone
-    streaming probe tried — XLA elementwise copy, matvec weight read,
-    a Pallas DMA copy kernel — reads 10-20 GB/s while the model's own
-    decode sustains ~400 GB/s over the same HBM, i.e. the harness
-    penalizes single giant ops, not the chip. The caller therefore
-    anchors the measured roofline at max(this probe, decode-effective
-    bandwidth) so the instrument can't under-read the ceiling."""
-    n = int(1e9)
-    x = jnp.ones((n,), jnp.int8)
-    f = jax.jit(lambda x: x + jnp.int8(1))
-    first = jax.jit(lambda y: y.ravel()[0])
-    y = f(x)
-    # block_until_ready lies on axon; a jitted scalar extract + fetch
-    # is the only true sync (an eager y[:1] slice fetches the buffer)
-    np.asarray(jax.device_get(first(y)))
+def dispatch_ms() -> float:
+    """Per-call host-dispatch (enqueue) cost: N CHAINED empty calls,
+    ONE sync. On the axon tunnel the enqueue costs ~1.6 ms and is
+    serialized with execution, while the final result FETCH can add up
+    to ~200 ms of polling latency depending on session health — so
+    every timing in this bench divides one fetch across many chained
+    dispatches instead of syncing per call."""
+    f = jax.jit(lambda t: t + 1)
+    t = jnp.zeros((32, 1), jnp.int32)
+    sync(f(t))
+    n = 64
     best = float("inf")
-    for _ in range(5):
+    for _ in range(3):
+        x = t
         t0 = time.perf_counter()
-        y = f(x)
-        np.asarray(jax.device_get(first(y)))
+        for _ in range(n):
+            x = f(x)
+        sync(x)
         best = min(best, time.perf_counter() - t0)
-    return 2 * n / best / 1e9
-
-
-def best_of(trials: int, run) -> float:
-    """Min wall-time over `trials` runs of `run()` (run syncs itself)."""
-    best = float("inf")
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        run()
-        best = min(best, time.perf_counter() - t0)
-    return best
+    return best / n * 1000
 
 
 def main() -> None:
     from ome_tpu.models import config as cfgs
     from ome_tpu.models import llama
+    from ome_tpu.models.llama import (_layer, _proj, _rope_frequencies,
+                                      dense_mlp, rms_norm)
+    from ome_tpu.models.quant import QTensor, quantize_params, \
+        quantized_bytes
 
     # ~1.9B-parameter dense Llama-class config: big enough that decode is
     # genuinely HBM-bound, small enough to fit one v5e chip (16G HBM)
@@ -125,18 +120,10 @@ def main() -> None:
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     n_params = llama.param_count(params)
     log(f"bench: params={n_params/1e9:.2f}B")
+    disp_ms = None  # measured after the first mode's compile+warmup
 
-    # NOTE: measured on the axon-tunneled chip, buffer donation and
-    # multi-step lax.scan/unrolled decode are all SLOWER than a plain
-    # python dispatch loop (donation ~-20%, scan ~-60%); keep the
-    # simple form the backend executes best.
     @jax.jit
     def prefill(params, tokens, cache):
-        logits, cache = llama.forward(params, cfg, tokens, cache=cache)
-        return jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32), cache
-
-    @jax.jit
-    def decode(params, tokens, cache):
         logits, cache = llama.forward(params, cfg, tokens, cache=cache)
         return jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32), cache
 
@@ -144,94 +131,220 @@ def main() -> None:
         jax.random.PRNGKey(1), (BATCH, PREFILL), 0, cfg.vocab_size,
         dtype=jnp.int32)
 
-    def decode_toks_per_s(p, label: str) -> float:
-        """Compile + warm up, then best-of-TRIALS decode throughput.
-        Each trial restarts from a fresh prefilled cache so every trial
-        times the identical program state (no write index past
-        CACHE_LEN)."""
+    def split_layers(p):
+        per = [jax.tree.map(lambda a: a[l], p["layers"])
+               for l in range(cfg.num_layers)]
+        top = {k: v for k, v in p.items() if k != "layers"}
+        return per, top
+
+    def head_logits(top, x):
+        x = rms_norm(x, top["final_norm"], cfg.rms_norm_eps)
+        head = top.get("lm_head")
+        head = head.dequant(cfg.dtype) if isinstance(head, QTensor) \
+            else head
+        return jnp.einsum("bsd,dv->bsv", x, head,
+                          preferred_element_type=jnp.float32)
+
+    def embed(top, tok):
+        emb = top["embed"]
+        return emb.take(tok, cfg.dtype) if isinstance(emb, QTensor) \
+            else jnp.take(emb, tok, axis=0).astype(cfg.dtype)
+
+    def one_step(per, top, tok, ks, vs, index):
+        """Unrolled decode step over per-layer cache planes."""
+        B = tok.shape[0]
+        x = embed(top, tok)
+        freqs = _rope_frequencies(cfg)
+        positions = jnp.broadcast_to(index[None, None], (B, 1))
+        kv_len = jnp.broadcast_to(index + 1, (B,))
+        nks, nvs = [], []
+        for l in range(cfg.num_layers):
+            x, nc = _layer(x, per[l], cfg, freqs, positions, kv_len,
+                           (ks[l], vs[l]), index)
+            nks.append(nc[0])
+            nvs.append(nc[1])
+        tok = jnp.argmax(head_logits(top, x), axis=-1).astype(jnp.int32)
+        return tok, nks, nvs, index + 1
+
+    @jax.jit
+    def decode_k(per, top, tok, ks, vs, index):
+        def body(carry, _):
+            tok, ks, vs, index = carry
+            return one_step(per, top, tok, *(ks, vs), index), None
+
+        (tok, ks, vs, index), _ = lax.scan(
+            body, (tok, ks, vs, index), None, length=MULTISTEP)
+        return tok, ks, vs, index
+
+    @jax.jit
+    def noattn_step(p, tok):
+        """All weight matmuls + sampling, NO KV traffic: the
+        weights-shaped bandwidth floor (and the achievable anchor).
+        Scans the STACKED layer tree — per-layer arg lists would add
+        ~8 ms/dispatch of host arg marshaling (~300 buffers) and
+        swamp the measurement."""
+        x = embed(p, tok)
+
+        def body(x, lp):
+            h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            q = _proj(h, lp["wq"], cfg.dtype,
+                      out_dims=(cfg.num_heads, cfg.head_dim))
+            k = _proj(h, lp["wk"], cfg.dtype,
+                      out_dims=(cfg.num_kv_heads, cfg.head_dim))
+            v = _proj(h, lp["wv"], cfg.dtype,
+                      out_dims=(cfg.num_kv_heads, cfg.head_dim))
+            a = _proj(q + 0 * (k.sum() + v.sum()), lp["wo"], cfg.dtype,
+                      flatten=2)
+            x = x + a
+            h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+            return x + dense_mlp(h, lp, cfg), None
+
+        x, _ = lax.scan(body, x, p["layers"])
+        return jnp.argmax(head_logits(p, x), axis=-1).astype(jnp.int32)
+
+    def mode_bytes(p) -> int:
+        return quantized_bytes(p)
+
+    def run_mode(p, label: str):
+        """-> (tok/s, step_ms, weights_ms, attn_ms)."""
+        nonlocal disp_ms
+        per, top = split_layers(p)
         t0 = time.perf_counter()
         tok, cache = prefill(p, prompt,
                              llama.KVCache.create(cfg, BATCH, CACHE_LEN))
-        tok, cache = decode(p, tok, cache)  # compile decode too
-        sync(tok)
+        ks = [cache.k[l] for l in range(cfg.num_layers)]
+        vs = [cache.v[l] for l in range(cfg.num_layers)]
+        index = cache.index
+        st = decode_k(per, top, tok, ks, vs, index)  # compile
+        sync(st[0])
         log(f"bench: [{label}] prefill(batch={BATCH}, len={PREFILL}) "
             f"+ compile {time.perf_counter()-t0:.1f}s")
-        steps = DECODE_STEPS - 1
+        if disp_ms is None:
+            disp_ms = dispatch_ms()
+            log(f"bench: dispatch floor {disp_ms:.2f} ms")
+
+        n_disp = (DECODE_STEPS - 1) // MULTISTEP
+        steps = n_disp * MULTISTEP
         best = float("inf")
         for _ in range(TRIALS):
             tok, cache = prefill(
                 p, prompt, llama.KVCache.create(cfg, BATCH, CACHE_LEN))
-            tok, cache = decode(p, tok, cache)  # warm, not timed
-            sync(tok)
+            ks = [cache.k[l] for l in range(cfg.num_layers)]
+            vs = [cache.v[l] for l in range(cfg.num_layers)]
+            st = (tok, ks, vs, cache.index)
+            st = decode_k(per, top, *st)  # warm, not timed
+            sync(st[0])
             t0 = time.perf_counter()
-            for _ in range(steps):
-                tok, cache = decode(p, tok, cache)
-            sync(tok)
+            for _ in range(n_disp - 1):
+                st = decode_k(per, top, *st)
+            sync(st[0])
             best = min(best, time.perf_counter() - t0)
-        tps = BATCH * steps / best
-        log(f"bench: [{label}] decode {steps} steps x batch {BATCH}: "
-            f"best-of-{TRIALS} {best:.2f}s -> {tps:.1f} tok/s")
-        return tps
+        step_ms = best / ((n_disp - 1) * MULTISTEP) * 1000
+        tps = BATCH / (step_ms / 1000)
 
-    # -- bf16 headline + steady-state prefill (TTFT proxy) -------------
-    toks_per_s = decode_toks_per_s(params, "bf16")
+        # weights+sampling floor: CHAINED calls (each output feeds the
+        # next input) + one sync, the same dispatch pattern as the
+        # decode loop, so the two are directly comparable
+        sync(noattn_step(p, tok))
+        wbest = float("inf")
+        for _ in range(TRIALS):
+            tok2 = tok
+            t0 = time.perf_counter()
+            for _ in range(16):
+                tok2 = noattn_step(p, tok2)
+            sync(tok2)
+            wbest = min(wbest, (time.perf_counter() - t0) / 16)
+        weights_ms = max(wbest * 1000 - disp_ms, 0.0)
+        attn_ms = max(step_ms - weights_ms - disp_ms / MULTISTEP, 0.0)
+        log(f"bench: [{label}] decode {steps} x batch {BATCH}: best-of-"
+            f"{TRIALS} {step_ms:.2f} ms/step -> {tps:.1f} tok/s "
+            f"(weights {weights_ms:.2f} + attn/kv {attn_ms:.2f} + "
+            f"dispatch {disp_ms/MULTISTEP:.2f})")
+        return tps, step_ms, weights_ms, attn_ms
 
+    # -- bf16 headline --------------------------------------------------
+    bf16_tps, bf16_step, bf16_w, bf16_attn = run_mode(params, "bf16")
+
+    # -- steady-state prefill (TTFT proxy) + MFU ------------------------
     cache2 = llama.KVCache.create(cfg, BATCH, CACHE_LEN)
     prompt2 = jax.random.randint(jax.random.PRNGKey(2), (BATCH, PREFILL),
                                  0, cfg.vocab_size, dtype=jnp.int32)
-
-    def run_prefill():
-        t, _ = prefill(params, prompt2, cache2)
+    sync(prefill(params, prompt2, cache2)[0])
+    pbest = float("inf")
+    for _ in range(TRIALS):
+        # 4 chained prefill dispatches, ONE sync: amortizes the
+        # tunnel's result-fetch latency out of the per-call number
+        t0 = time.perf_counter()
+        for _ in range(4):
+            t, _ = prefill(params, prompt2, cache2)
         sync(t)
-
-    ttft = best_of(TRIALS, run_prefill)
-    log(f"bench: steady prefill {ttft*1000:.0f} ms "
-        f"({BATCH*PREFILL/ttft:.0f} prefill tok/s)")
+        pbest = min(pbest, (time.perf_counter() - t0) / 4)
+    T = BATCH * PREFILL
+    pf_flops = 2 * n_params * T + 2 * cfg.num_layers * BATCH * (
+        PREFILL ** 2) * cfg.num_heads * cfg.head_dim
+    peak = _lookup(PEAK_TFLOPS) * 1e12
+    mfu = pf_flops / pbest / peak
+    log(f"bench: steady prefill {pbest*1000:.0f} ms "
+        f"({T/pbest:.0f} prefill tok/s, MFU {100*mfu:.1f}%)")
     del cache2, prompt2
 
     # -- quantized serving paths (engine --quantization int8/int4) -----
-    from ome_tpu.models.quant import quantize_params, quantized_bytes
     q8 = quantize_params(params, mode="int8")
-    int8_tps = decode_toks_per_s(q8, "int8")
-    q8_bytes = quantized_bytes(q8)
+    q8_bytes = mode_bytes(q8)
+    int8_tps, int8_step, int8_w, int8_attn = run_mode(q8, "int8")
     del q8
     q4 = quantize_params(params, mode="int4")
-    int4_tps = decode_toks_per_s(q4, "int4")
-    q4_bytes = quantized_bytes(q4)
+    q4_bytes = mode_bytes(q4)
+    int4_tps, int4_step, int4_w, int4_attn = run_mode(q4, "int4")
     del q4
     log(f"bench: int8 {int8_tps:.1f} tok/s "
-        f"({100*int8_tps/toks_per_s-100:+.0f}% vs bf16, "
+        f"({100*int8_tps/bf16_tps-100:+.0f}% vs bf16, "
         f"{q8_bytes/1e9:.2f} GB weights) | int4 {int4_tps:.1f} tok/s "
-        f"({100*int4_tps/toks_per_s-100:+.0f}%, {q4_bytes/1e9:.2f} GB)")
+        f"({100*int4_tps/bf16_tps-100:+.0f}%, {q4_bytes/1e9:.2f} GB)")
 
     # -- rooflines ------------------------------------------------------
     # Per decode step the chip must read all weights once (amortized
     # across the batch) + each sequence's KV cache.
-    bw_spec = device_bandwidth()
-    bw_copy = copy_bandwidth()
+    bw_spec = _lookup(HBM_GBPS)
+    bf16_bytes = n_params * 2
+    # the achievable anchor IS the weights floor: a weights-shaped
+    # stream through the real matmul graph, not a synthetic probe
+    bw_ach = bf16_bytes / (max(bf16_w, 1e-3) / 1000) / 1e9
     kv_bytes = (cfg.num_layers * CACHE_LEN * cfg.num_kv_heads * cfg.head_dim
                 * 2 * 2)  # k+v, bf16, per sequence
-    step_bytes = n_params * 2 + BATCH * kv_bytes
-    eff_gbps = step_bytes * toks_per_s / BATCH / 1e9
+    step_bytes = bf16_bytes + BATCH * kv_bytes
+    eff_gbps = step_bytes * bf16_tps / BATCH / 1e9
     roof_spec = bw_spec * 1e9 / step_bytes * BATCH
-    vs = toks_per_s / roof_spec
+    roof_ach = bw_ach * 1e9 / step_bytes * BATCH
+    vs = bf16_tps / roof_spec
+    vs_ach = bf16_tps / roof_ach
 
-    log(f"bench: decode effective {eff_gbps:.0f} GB/s | HBM copy "
-        f"microbench {bw_copy:.0f} GB/s (best-of-5; under-reads on the "
-        f"tunnel — see copy_bandwidth) | spec {bw_spec:.0f}")
-    log(f"bench: roofline vs spec: {roof_spec:.0f} tok/s -> "
-        f"{100*vs:.1f}%")
+    log(f"bench: decode effective {eff_gbps:.0f} GB/s | achievable "
+        f"(weights-stream anchor) {bw_ach:.0f} GB/s | spec {bw_spec:.0f}")
+    log(f"bench: roofline vs spec {100*vs:.1f}% | vs achievable "
+        f"{100*vs_ach:.1f}%")
     print(json.dumps({
         "metric": "decode_tokens_per_sec_1.9B_bf16_batch32",
-        "value": round(toks_per_s, 1),
+        "value": round(bf16_tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(vs, 3),
+        "vs_achievable": round(vs_ach, 3),
         "best_of": TRIALS,
         "int8_tokens_per_sec": round(int8_tps, 1),
         "int4_tokens_per_sec": round(int4_tps, 1),
-        "prefill_ms_batch32x128": round(ttft * 1000, 1),
-        "hbm_copy_gbps": round(bw_copy, 1),
+        "prefill_ms_batch32x128": round(pbest * 1000, 1),
+        "prefill_mfu": round(mfu, 3),
+        "dispatch_ms": round(disp_ms, 2),
+        "achievable_gbps": round(bw_ach, 1),
         "decode_effective_gbps": round(eff_gbps, 1),
+        "decode_ms_breakdown": {
+            m: {"step": round(s, 2), "weights_sampling": round(w, 2),
+                "attn_kv": round(a, 2),
+                "dispatch": round(disp_ms / MULTISTEP, 2)}
+            for m, (s, w, a) in {
+                "bf16": (bf16_step, bf16_w, bf16_attn),
+                "int8": (int8_step, int8_w, int8_attn),
+                "int4": (int4_step, int4_w, int4_attn)}.items()},
     }))
 
 

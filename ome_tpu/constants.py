@@ -26,6 +26,23 @@ MODEL_STATUS_UPDATING = "Updating"
 MODEL_STATUS_FAILED = "Failed"
 MODEL_STATUS_DELETED = "Deleted"
 
+# -- gang scheduling (cmd/manager/main.go:90,223-225 analog) ---------------
+# Multi-host TPU slices are the canonical gang workload: all hosts of a
+# group must schedule together or the ICI mesh never forms. Kueue keys
+# are upstream's well-known labels; Volcano's are annotations.
+
+KUEUE_QUEUE_LABEL = "kueue.x-k8s.io/queue-name"
+KUEUE_PRIORITY_CLASS_LABEL = "kueue.x-k8s.io/priority-class"
+VOLCANO_QUEUE_ANNOTATION = "scheduling.volcano.sh/queue-name"
+VOLCANO_GROUP_ANNOTATION = "scheduling.volcano.sh/group-name"
+VOLCANO_SCHEDULER_NAME = "volcano"
+# isvc-level override: which gang scheduler stamps the group
+# ("kueue" default when the AcceleratorClass carries a queue;
+#  "volcano" switches to PodGroup annotations; "none" disables)
+GANG_SCHEDULER_ANNOTATION = f"scheduling.{GROUP}/gang-scheduler"
+GANG_QUEUE_ANNOTATION = f"scheduling.{GROUP}/queue-name"
+GANG_PRIORITY_ANNOTATION = f"scheduling.{GROUP}/priority-class"
+
 # -- annotations ------------------------------------------------------------
 
 DEPLOYMENT_MODE_ANNOTATION = f"serving.{GROUP}/deployment-mode"

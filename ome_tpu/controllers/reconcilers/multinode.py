@@ -58,6 +58,33 @@ def _apply_rendezvous_env(pod: PodSpec, lws_name: str, namespace: str,
         c.set_env(constants.JAX_PROCESS_ID_ENV, "$(LWS_WORKER_INDEX)")
 
 
+def gang_scheduling(isvc: v1.InferenceService, plan: ComponentPlan):
+    """-> (labels, annotations, scheduler_name | None) to stamp on the
+    LWS and its pod templates (cmd/manager/main.go:90,223-225 analog).
+
+    The queue comes from the isvc annotation override or the selected
+    AcceleratorClass's queue_name; the scheduler flavor defaults to
+    Kueue labels (the LWS integration upstream) and flips to Volcano
+    PodGroup annotations + schedulerName via the isvc annotation."""
+    ann = isvc.metadata.annotations or {}
+    flavor = ann.get(constants.GANG_SCHEDULER_ANNOTATION, "kueue")
+    queue = ann.get(constants.GANG_QUEUE_ANNOTATION)
+    if queue is None and plan.accelerator is not None:
+        queue = plan.accelerator.accelerator.spec.queue_name
+    if not queue or flavor == "none":
+        return {}, {}, None
+    if flavor == "volcano":
+        group = f"{plan.name}-gang"
+        return {}, {constants.VOLCANO_QUEUE_ANNOTATION: queue,
+                    constants.VOLCANO_GROUP_ANNOTATION: group}, \
+            constants.VOLCANO_SCHEDULER_NAME
+    labels = {constants.KUEUE_QUEUE_LABEL: queue}
+    prio = ann.get(constants.GANG_PRIORITY_ANNOTATION)
+    if prio:
+        labels[constants.KUEUE_PRIORITY_CLASS_LABEL] = prio
+    return labels, {}, None
+
+
 def build_lws(isvc: v1.InferenceService, plan: ComponentPlan,
               ) -> LeaderWorkerSet:
     size = plan.worker_size + 1  # hosts in the slice (lws size = leader+N)
@@ -69,19 +96,27 @@ def build_lws(isvc: v1.InferenceService, plan: ComponentPlan,
     worker_pod.subdomain = plan.name
     _apply_rendezvous_env(leader_pod, plan.name, namespace, size, True)
     _apply_rendezvous_env(worker_pod, plan.name, namespace, size, False)
+    g_labels, g_ann, sched_name = gang_scheduling(isvc, plan)
+    if sched_name:
+        leader_pod.scheduler_name = sched_name
+        worker_pod.scheduler_name = sched_name
+    pod_labels = {**plan.labels, **g_labels}
+    pod_ann = {**plan.annotations, **g_ann}
 
+    meta = child_meta(isvc, plan.name, {**plan.labels, **g_labels},
+                      {**plan.annotations, **g_ann})
     return LeaderWorkerSet(
-        metadata=child_meta(isvc, plan.name, plan.labels, plan.annotations),
+        metadata=meta,
         spec=LeaderWorkerSetSpec(
             replicas=plan.replicas,
             leader_worker_template=LeaderWorkerTemplate(
                 leader_template=PodTemplateSpec(
-                    metadata=ObjectMeta(labels=dict(plan.labels),
-                                        annotations=dict(plan.annotations)),
+                    metadata=ObjectMeta(labels=dict(pod_labels),
+                                        annotations=dict(pod_ann)),
                     spec=leader_pod),
                 worker_template=PodTemplateSpec(
-                    metadata=ObjectMeta(labels=dict(plan.labels),
-                                        annotations=dict(plan.annotations)),
+                    metadata=ObjectMeta(labels=dict(pod_labels),
+                                        annotations=dict(pod_ann)),
                     spec=worker_pod),
                 size=size,
                 restart_policy="RecreateGroupOnPodRestart"),

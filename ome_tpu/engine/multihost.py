@@ -298,6 +298,14 @@ class ReplicatedEngine:
             return state, host_value(toks)
 
 
+def _unknown_adapter(e: Exception) -> bool:
+    try:
+        from .core import UnknownAdapterError
+    except Exception:  # pragma: no cover
+        return False
+    return isinstance(e, UnknownAdapterError)
+
+
 def follower_loop(engine, sub: OpSubscriber,
                   pd_export: bool = False) -> int:
     """Replay the leader's op stream against the local engine.
@@ -330,9 +338,18 @@ def follower_loop(engine, sub: OpSubscriber,
             kwargs = {} if fm is None else {"first_mask": fm}
             if msg.get("adapter") is not None:
                 kwargs["adapter"] = msg["adapter"]
-            last_prefill = engine.prefill(
-                msg["ids"], msg["temperature"], msg["top_k"],
-                msg["top_p"], **kwargs)
+            try:
+                last_prefill = engine.prefill(
+                    msg["ids"], msg["temperature"], msg["top_k"],
+                    msg["top_p"], **kwargs)
+            except Exception as e:
+                if not _unknown_adapter(e):
+                    raise
+                # the leader hit the IDENTICAL per-request error before
+                # any device op ran on either side (it publishes, then
+                # executes) — skip in lockstep instead of dying
+                last_prefill = None
+                continue
             if pd_export:
                 from .pd import gather_kv
                 _, (k, v), _, _ = last_prefill
@@ -347,12 +364,18 @@ def follower_loop(engine, sub: OpSubscriber,
                 base64.b64decode(msg["blob"]))
             last_prefill = (token, (k, v), true_len, bucket)
         elif op == "insert":
+            if last_prefill is None:
+                continue  # its prefill failed in lockstep (adapter)
             tok, kv, _true_len, _bucket = last_prefill
             ikw = {} if msg.get("adapter") is None \
                 else {"adapter": msg["adapter"]}
-            state = engine.insert(state, kv, msg["slot"],
-                                  msg["true_len"], tok, msg["bucket"],
-                                  **ikw)
+            try:
+                state = engine.insert(state, kv, msg["slot"],
+                                      msg["true_len"], tok,
+                                      msg["bucket"], **ikw)
+            except Exception as e:
+                if not _unknown_adapter(e):
+                    raise
         elif op == "register_adapter":
             engine.register_adapter(msg["name"], msg["path"])
         elif op == "unregister_adapter":

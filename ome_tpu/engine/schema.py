@@ -428,6 +428,13 @@ class SchemaAutomaton:
         if b in WS:
             return True
         if b == 0x2C:
+            # a comma commits to ANOTHER key: reject it when none is
+            # admissible (all declared props seen, additional
+            # properties off) — otherwise the automaton dead-ends one
+            # byte later and the masker is forced into invalid EOS
+            if node.additional is False \
+                    and all(k in seen for k in node.props):
+                return False
             self.stack[-1] = ("objk", node, seen)
             return True
         if b == 0x7D:
@@ -535,10 +542,13 @@ class SchemaAutomaton:
             _, node, seen, cands, buf = frame
             missing = [k for k in cands if k in node.required]
             pool = missing or list(cands)
-            cont = [k for k in pool if len(k) > len(buf)]
-            if cont:
-                best = min(cont, key=len)
-                return frozenset((best[len(buf)],))
+            if pool:
+                # same cheapest-total criterion as closing_distance so
+                # the greedy close-out never exceeds the estimate
+                best = min(pool, key=lambda k: len(k)
+                           + node.props.get(k, ANY).min_len)
+                if len(best) > len(buf):
+                    return frozenset((best[len(buf)],))
             return frozenset((0x22,))
         if kind == "colon":
             return frozenset((0x3A,))
@@ -577,30 +587,58 @@ class SchemaAutomaton:
                 n += 2
             elif kind in ("obj0", "objk", "obje"):
                 _, node, seen = frame
-                n += 1
+                n += 1  # closing '}'
                 for k in node.required - seen:
                     kn = node.props.get(k, ANY)
                     n += len(k) + 4 + kn.min_len
+                if kind == "objk" and not (node.required - seen):
+                    # after a comma SOME key+value must still follow
+                    n += self._min_any_entry(node, seen)
             elif kind == "key":
                 _, node, seen, cands, buf = frame
-                pool = [k for k in cands if len(k) >= len(buf)]
-                kl = min((len(k) for k in pool), default=len(buf))
-                n += (kl - len(buf)) + 2
-                # the value for this key still has to be emitted
-                n += 2
-                for k in node.required - seen:
-                    if k != (min(pool, key=len) if pool else None):
-                        kn = node.props.get(k, ANY)
-                        n += len(k) + 4 + kn.min_len
+                missing = node.required - seen
+                # finish the CURRENT key along its cheapest completable
+                # candidate (required candidates first — finishing one
+                # retires its obligation), then its value's true
+                # minimal bytes, the other missing entries, and '}'
+                req_pool = [k for k in cands if k in missing]
+                pool = req_pool or list(cands)
+                if pool:
+                    tgt = min(pool, key=lambda k: len(k)
+                              + node.props.get(k, ANY).min_len)
+                    vmin = node.props.get(tgt, ANY).min_len
+                    n += (len(tgt) - len(buf)) + 2 + vmin
+                    rest = missing - {tgt}
+                else:  # free-form key: close the quote, emit a value
+                    ap = node.additional
+                    vmin = ap.min_len if isinstance(ap, Node) else 1
+                    n += 2 + vmin
+                    rest = missing
+                for k in rest:
+                    kn = node.props.get(k, ANY)
+                    n += len(k) + 4 + kn.min_len
+                n += 1  # closing '}'
             elif kind == "colon":
                 _, node, seen, vnode = frame
                 n += 1 + vnode.min_len
                 for k in node.required - seen:
                     kn = node.props.get(k, ANY)
                     n += len(k) + 4 + kn.min_len
+                n += 1  # closing '}'
             elif kind in ("arr0", "arre"):
                 n += 1
         return n
+
+    @staticmethod
+    def _min_any_entry(node: Node, seen: frozenset) -> int:
+        """Min bytes of one more `"key":value` entry in this object."""
+        opts = [len(k) + 3 + node.props.get(k, ANY).min_len
+                for k in node.props if k not in seen]
+        if isinstance(node.additional, Node):
+            opts.append(3 + node.additional.min_len)
+        elif node.additional:
+            opts.append(4)
+        return min(opts, default=4)
 
 
 def _min_opener(node: Node) -> int:

@@ -43,11 +43,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(tests / dry runs)")
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel size over the local mesh")
-    p.add_argument("--quantization", choices=("none", "int8", "int4"),
+    p.add_argument("--quantization",
+                   choices=("none", "int8", "int4", "fp8"),
                    default="none",
                    help="weight-only quantization at load time (int8 "
                         "halves decode HBM traffic; int4 groupwise "
-                        "quarters it)")
+                        "quarters it; fp8 = float8_e4m3 per-channel, "
+                        "v6e-targeted)")
     p.add_argument("--adapter", action="append", default=None,
                    help="LoRA serving (FineTunedWeight): a bare PEFT "
                         "dir merges into the base weights at load; "
@@ -158,7 +160,7 @@ def load_engine(args, dist=None):
         # single-device serving uses the ragged grouped-GEMM dispatch;
         # tp>1 keeps the dense path (shardable through plain GSPMD)
         cfg = cfg.replace(moe_impl="ragged")
-    if args.quantization in ("int8", "int4"):
+    if args.quantization in ("int8", "int4", "fp8"):
         from ..models.quant import quantize_params
         params = quantize_params(params, mode=args.quantization)
         log.info("quantized weights to %s (weight-only)",

@@ -9,12 +9,16 @@ drops 2x/4x. This is the runtime analog of the reference catalog's
 int4/fp8 model-format entries (model.go:262-268) for checkpoints that
 ship full precision.
 
-int4 packing is TPU-deliberate: two nibbles per int8 byte, paired
-*within a scale group* as [first half | second half] along the packing
-axis, so dequant is two arithmetic shifts + ONE concatenate — no
-stride-2 interleave, which XLA:TPU cannot fuse into the matmul read
-(measured 1.8x slower than the concat layout on v5e). Scales are
-per-(group x output-channel), GPTQ-style.
+int4 packing is TPU-deliberate: two nibbles per int8 byte, paired as
+[first half | second half] of the WHOLE packing axis (byte j holds
+rows j and K/2+j), so dequant is two arithmetic shifts + ONE
+concatenate — no stride-2 interleave, which XLA:TPU cannot fuse into
+the matmul read (measured 1.8x slower on v5e) — and the fused Pallas
+kernel (ops/int4_matmul.py) reads each half's matching x slice and
+scale rows as CONTIGUOUS blocks (group-interleaved pairing forced a
+strided in-kernel shuffle that crashed or starved Mosaic). Scales are
+per-(group x output-channel), GPTQ-style, groups contiguous along the
+axis.
 
 QTensor is a registered pytree (scan/jit/shard-friendly), dequantized
 at use by models/llama.py's weight accessor `_w`.
@@ -83,22 +87,24 @@ jax.tree_util.register_dataclass(
 
 
 def _unpack4(q: jax.Array, s: jax.Array, axis: int) -> jax.Array:
-    """Dequantize concat-packed int4: q [..., K/2, ...] -> f32 [..., K, ...].
+    """Dequantize half-packed int4: q [..., K/2, ...] -> f32 [..., K, ...].
 
-    s has n_groups at `axis`; each group's first half lives in the low
-    nibbles, second half in the high nibbles of the same bytes.
+    Byte j holds original rows j (low nibble) and K/2+j (high nibble),
+    so unpack is one concatenate of the two nibble planes along the
+    axis; s has n_groups contiguous groups along the axis.
     """
     axis = axis % q.ndim
     n_groups = s.shape[axis]
-    half = q.shape[axis] // n_groups              # (K / n_groups) / 2
     pre, post = q.shape[:axis], q.shape[axis + 1:]
-    qr = q.reshape(pre + (n_groups, half) + post)
-    lo = jnp.left_shift(qr, 4) >> 4               # sign-extended nibble
-    hi = qr >> 4                                  # arithmetic shift
-    grouped = jnp.concatenate([lo, hi], axis=axis + 1).astype(jnp.float32)
+    lo = jnp.left_shift(q, 4) >> 4                # sign-extended nibble
+    hi = q >> 4                                   # arithmetic shift
+    full = jnp.concatenate([lo, hi], axis=axis).astype(jnp.float32)
+    K = 2 * q.shape[axis]
+    gsize = K // n_groups
+    fr = full.reshape(pre + (n_groups, gsize) + post)
     sr = s.reshape(s.shape[:axis] + (n_groups, 1) + s.shape[axis + 1:])
-    out = grouped * sr
-    return out.reshape(pre + (2 * q.shape[axis],) + post)
+    out = fr * sr
+    return out.reshape(pre + (K,) + post)
 
 
 def quantize_tensor(w: jax.Array, contract_axes) -> QTensor:
@@ -110,6 +116,20 @@ def quantize_tensor(w: jax.Array, contract_axes) -> QTensor:
                    keepdims=True)
     s = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, s=s, bits=8)
+
+
+def quantize_tensor_fp8(w: jax.Array, contract_axes) -> QTensor:
+    """Per-output-channel scaled float8_e4m3: same byte footprint as
+    int8 but a floating 4-bit mantissa — the v6e-native weight format
+    (v6e converts fp8 in the MXU datapath; on v5e it lowers to the
+    same convert+scale XLA fuses for int8). Scale to the e4m3 max so
+    the channel's range uses the format's full span."""
+    w32 = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=tuple(contract_axes),
+                   keepdims=True)
+    s = jnp.maximum(amax, 1e-8) / 448.0  # e4m3 finite max
+    q = (w32 / s).astype(jnp.float8_e4m3fn)
     return QTensor(q=q, s=s, bits=8)
 
 
@@ -136,9 +156,9 @@ def quantize_tensor_int4(w: jax.Array, contract_axes,
     amax = jnp.max(jnp.abs(wg), axis=(axis + 1,) + other, keepdims=True)
     s = jnp.maximum(amax, 1e-8) / 7.0
     qg = jnp.clip(jnp.round(wg / s), -7, 7).astype(jnp.int8)
-    lo, hi = jnp.split(qg, 2, axis=axis + 1)      # halves of each group
-    packed = ((hi << 4) | (lo & 0x0F)).reshape(
-        pre + (K // 2,) + post)
+    qfull = qg.reshape(pre + (K,) + post)
+    lo, hi = jnp.split(qfull, 2, axis=axis)       # halves of the AXIS
+    packed = (hi << 4) | (lo & 0x0F)              # [., K/2, .]
     s = jnp.squeeze(s, axis=axis + 1)             # [., n_groups, .(1s)]
     return QTensor(q=packed, s=s, bits=4, axis=axis - w32.ndim)
 
@@ -171,18 +191,26 @@ def quantize_params(params: Dict[str, Any], mode: str = "int8",
     precision (tiny, and routing is precision-sensitive).
 
     mode="int8": per-output-channel symmetric int8 everywhere.
+    mode="fp8": per-output-channel scaled float8_e4m3 everywhere —
+    the catalog's fp8 model-format analog (model.go:262-268) for
+    full-precision checkpoints, v6e-targeted (same bytes as int8;
+    v6e's MXU consumes fp8 natively).
     mode="int4": groupwise int4 for the layer matmuls; embed/lm_head
     stay int8 (their error feeds every position — the GPTQ convention
     of keeping embeddings at higher precision), and so do the
     down-projections (w_down/ws_down): their packing axis F is the
     tp-sharded row dim (parallel/sharding._LAYER_RULES), and nibble
     pairs spanning device shards would force GSPMD to all-gather the
-    weight every step — worse than the bytes saved.
+    weight every step — worse than the bytes saved. wo also stays
+    int8: its pack axis (Dh) sits under the H head dim, so the
+    half-packed flattened layout the fused kernel streams can't stay
+    contiguous for it.
     """
-    if mode not in ("int8", "int4"):
+    if mode not in ("int8", "int4", "fp8"):
         raise ValueError(f"unknown quantization mode {mode!r}")
     int4 = mode == "int4"
-    _INT8_ONLY = {"w_down", "ws_down"}
+    base_q = quantize_tensor_fp8 if mode == "fp8" else quantize_tensor
+    _INT8_ONLY = {"w_down", "ws_down", "wo"}
     log = logging.getLogger("ome.models.quant")
 
     def q_layer(k: str, v):
@@ -195,14 +223,14 @@ def quantize_params(params: Dict[str, Any], mode: str = "int8",
             except ValueError as e:
                 log.info("int4: %s falls back to int8 (%s)", k, e)
                 return quantize_tensor(v, axes)
-        return quantize_tensor(v, axes)
+        return base_q(v, axes)
 
     out: Dict[str, Any] = {}
     for name, leaf in params.items():
         if name in ("layers", "dense_layers"):
             out[name] = {k: q_layer(k, v) for k, v in leaf.items()}
         elif name in _TOP_CONTRACT:
-            out[name] = quantize_tensor(leaf, _TOP_CONTRACT[name])
+            out[name] = base_q(leaf, _TOP_CONTRACT[name])
         else:
             out[name] = leaf
     return out

@@ -62,9 +62,20 @@ def _decode_block_range(lo, hi, bs):
     return first, last
 
 
-def _decode_kernel(lim_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
-                   acc_ref, *, bs: int, scale: float,
-                   softcap: Optional[float]):
+def _decode_kernel(lim_ref, q_ref, k_ref, v_ref, *refs, bs: int,
+                   scale: float, softcap: Optional[float],
+                   quantized: bool = False):
+    if quantized:
+        # int8 KV cache: per-(row, head) f32 scales ([K, bs] blocks —
+        # S minor keeps the plane lane-aligned) ride as two extra
+        # inputs. K/V convert to bf16 UNSCALED for the MXU dots; the
+        # scales multiply the small [K*G, bs] logits/probs tiles
+        # instead of the [bs, K, D] value blocks (128x fewer
+        # multiplies), so HBM streams 1 byte/element + a tiny plane
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_ref, l_ref, acc_ref = refs
     s = pl.program_id(1)
     ns = pl.num_programs(1)
 
@@ -85,6 +96,8 @@ def _decode_kernel(lim_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
     def _():
         q = q_ref[0]            # [K, G, D]
         k = k_ref[0]            # [bs, K, D]
+        if quantized:
+            k = k.astype(q.dtype)   # raw int8 values; scale on logits
         K, G, D = q.shape
         # per-KV-head 2D dots (Mosaic's matmul wants batch dims aligned;
         # K is small and static, so unroll): [G,D] x [bs,D]^T -> [G,bs]
@@ -92,6 +105,10 @@ def _decode_kernel(lim_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
             [lax.dot_general(q[kh], k[:, kh, :], (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
              for kh in range(K)], axis=0)                   # [K*G, bs]
+        if quantized:
+            sk = ks_ref[0]                                  # [K, bs]
+            logits = (logits.reshape(K, G, bs)
+                      * sk[:, None, :]).reshape(K * G, bs)
         logits = logits * scale
         if softcap:
             logits = jnp.tanh(logits / softcap) * softcap
@@ -105,8 +122,13 @@ def _decode_kernel(lim_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
         p = jnp.exp(logits - m_new)
         p = jnp.where(valid, p, 0.0)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
-        pb = p.astype(v_ref.dtype)
         v_blk = v_ref[0]                                    # [bs, K, D]
+        if quantized:
+            v_blk = v_blk.astype(q.dtype)  # raw; fold scales into p
+            sv = vs_ref[0]                                  # [K, bs]
+            p = (p.reshape(K, G, bs) * sv[:, None, :]).reshape(
+                K * G, bs)
+        pb = p.astype(v_blk.dtype)
         pv = jnp.concatenate(
             [lax.dot_general(pb[kh * G:(kh + 1) * G], v_blk[:, kh, :],
                              (((1,), (0,)), ((), ())),
@@ -123,7 +145,8 @@ def _decode_kernel(lim_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
         o_ref[0] = (acc_ref[:] / l).reshape(K, G, D).astype(o_ref.dtype)
 
 
-def _flash_decode(q, k, v, lo, hi, scale, softcap, interpret):
+def _flash_decode(q, k, v, lo, hi, scale, softcap, interpret,
+                  k_scale=None, v_scale=None):
     B, _, H, D = q.shape
     S, K = k.shape[1], k.shape[2]
     G = H // K
@@ -131,6 +154,7 @@ def _flash_decode(q, k, v, lo, hi, scale, softcap, interpret):
     if bs is None or H < 8 or D % 128 != 0:
         return None
     ns = S // bs
+    quantized = k_scale is not None
     limits = jnp.stack(
         [lo.astype(jnp.int32), hi.astype(jnp.int32)], axis=1)  # [B, 2]
     qh = q.reshape(B, K, G, D)
@@ -143,14 +167,26 @@ def _flash_decode(q, k, v, lo, hi, scale, softcap, interpret):
         first, last = _decode_block_range(lim[b, 0], lim[b, 1], bs)
         return (b, jnp.minimum(first + s, last), 0, 0)
 
+    def sc_index(b, s, lim):
+        first, last = _decode_block_range(lim[b, 0], lim[b, 1], bs)
+        return (b, 0, jnp.minimum(first + s, last))
+
+    in_specs = [
+        pl.BlockSpec((1, K, G, D), lambda b, s, lim: (b, 0, 0, 0)),
+        pl.BlockSpec((1, bs, K, D), kv_index),
+        pl.BlockSpec((1, bs, K, D), kv_index),
+    ]
+    args = [limits, qh, k, v]
+    if quantized:
+        # scales are [B, K, S] — S minor so each [K, bs] block is
+        # lane-aligned (K=8 minor would DMA 8-lane vectors)
+        in_specs += [pl.BlockSpec((1, K, bs), sc_index),
+                     pl.BlockSpec((1, K, bs), sc_index)]
+        args += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, ns),
-        in_specs=[
-            pl.BlockSpec((1, K, G, D), lambda b, s, lim: (b, 0, 0, 0)),
-            pl.BlockSpec((1, bs, K, D), kv_index),
-            pl.BlockSpec((1, bs, K, D), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, K, G, D), lambda b, s, lim: (b, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((H, 128), jnp.float32),
@@ -160,12 +196,57 @@ def _flash_decode(q, k, v, lo, hi, scale, softcap, interpret):
     )
     out = pl.pallas_call(
         functools.partial(_decode_kernel, bs=bs, scale=scale,
-                          softcap=softcap),
+                          softcap=softcap, quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, K, G, D), q.dtype),
         interpret=interpret,
-    )(limits, qh, k, v)
+    )(*args)
     return out.reshape(B, 1, H, D)
+
+
+def quantize_kv_block(x: jax.Array):
+    """Per-(row, head) symmetric int8 for a KV slab [B, S, K, D] ->
+    (int8 values [B, S, K, D], f32 scales [B, K, S]). One scale per
+    token-head tracks each token's dynamic range (activation stats
+    vary token to token far more than channel to channel); scales are
+    stored S-minor so the decode kernel's [K, bs] scale blocks are
+    lane-aligned."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)  # [B,S,K]
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, jnp.swapaxes(s, -1, -2)
+
+
+def flash_decode_quantized(q: jax.Array, kq: jax.Array, vq: jax.Array,
+                           k_scale: jax.Array, v_scale: jax.Array,
+                           positions: jax.Array,
+                           kv_len: Optional[jax.Array] = None,
+                           sliding_window: Optional[int] = None,
+                           scale: Optional[float] = None,
+                           logit_softcap: Optional[float] = None,
+                           interpret: bool = False):
+    """Decode attention over an int8 KV cache (quantize_kv_block
+    layout). q: [B, 1, H, D] bf16; kq/vq: [B, S, K, D] int8; scales
+    [B, K, S] f32. Returns [B, 1, H, D] or None if shapes uncovered.
+
+    This is the serving engine's --kv-cache-dtype int8 path: the KV
+    read is the second-largest term in the decode step's HBM budget
+    after the weights (bench.py breakdown), and int8 halves it.
+    """
+    B, Sq, H, D = q.shape
+    assert Sq == 1
+    scale = scale if scale is not None else D ** -0.5
+    pos = positions[:, 0]
+    if kv_len is None:
+        kv_hi = jnp.full((B,), kq.shape[1], jnp.int32)
+    else:
+        kv_hi = jnp.broadcast_to(kv_len, (B,)).astype(jnp.int32)
+    hi = jnp.minimum(pos + 1, kv_hi)
+    lo = jnp.maximum(pos - sliding_window + 1, 0) if sliding_window \
+        else jnp.zeros_like(pos)
+    return _flash_decode(q, kq, vq, lo, hi, scale, logit_softcap,
+                         interpret, k_scale=k_scale, v_scale=v_scale)
 
 
 # -- prefill kernel --------------------------------------------------------

@@ -74,6 +74,10 @@ TEXTGEN = ["TEXT_GENERATION"]
 CHAT = ["TEXT_GENERATION", "CHAT"]
 EMBED = ["TEXT_EMBEDDINGS"]
 VISION = ["TEXT_GENERATION", "CHAT", "IMAGE_TEXT_TO_TEXT"]
+RERANK = ["TEXT_RERANK"]
+REWARD = ["REWARD_SCORING"]
+IMGGEN = ["IMAGE_GENERATION"]
+VEMBED = ["TEXT_EMBEDDINGS", "IMAGE_TEXT_TO_EMBEDDING"]
 
 MODELS = [
     # vendor, name, repo, arch, params, ctx, caps, quant
@@ -450,7 +454,7 @@ MODELS = [
      "Glm4ForCausalLM", "9.4B", 32768, CHAT, None),
     ("nvidia", "llama-3-3-nemotron-super-49b-v1",
      "nvidia/Llama-3_3-Nemotron-Super-49B-v1",
-     "LlamaForCausalLM", "49.9B", 131072, CHAT, None),
+     "DeciLMForCausalLM", "49.9B", 131072, CHAT, None),
     ("ai21", "jamba-1-5-large", "ai21labs/AI21-Jamba-1.5-Large",
      "JambaForCausalLM", "398B", 262144, CHAT, None),
     ("lg", "exaone-3-5-32b-instruct",
@@ -485,6 +489,310 @@ MODELS = [
      "MistralModel", "7.11B", 32768, EMBED, None),
     ("qwen", "qwen3-embedding-0-6b", "Qwen/Qwen3-Embedding-0.6B",
      "Qwen3Model", "595M", 32768, EMBED, None),
+]
+
+# Round-4 breadth: closes the gap to the reference's 206-model catalog
+# (/root/reference/config/models — every hf:// repo it ships that the
+# table above lacked). Facts (architecture/params/context) are public
+# model metadata; capabilities mirror the reference's entries.
+MODELS += [
+    # -- meta / llama heritage ------------------------------------------
+    ("meta", "llama-2-7b", "meta-llama/Llama-2-7b-hf",
+     "LlamaForCausalLM", "6.74B", 4096, TEXTGEN, None),
+    ("meta", "llama-2-13b", "meta-llama/Llama-2-13b-hf",
+     "LlamaForCausalLM", "13.0B", 4096, TEXTGEN, None),
+    ("meta", "llama-2-70b", "meta-llama/Llama-2-70b-hf",
+     "LlamaForCausalLM", "69.0B", 4096, TEXTGEN, None),
+    ("meta", "llama-3-1-70b-instruct-meta",
+     "meta-llama/Meta-Llama-3.1-70B-Instruct",
+     "LlamaForCausalLM", "70.6B", 131072, CHAT, None),
+    ("meta", "llama-4-maverick-17b-128e-instruct-fp8",
+     "meta-llama/Llama-4-Maverick-17B-128E-Instruct-FP8",
+     "Llama4ForConditionalGeneration", "402B", 1048576, VISION, "fp8"),
+    ("meta", "llama-3-3-70b-instruct-fp8-dynamic",
+     "RedHatAI/Llama-3.3-70B-Instruct-FP8-dynamic",
+     "LlamaForCausalLM", "70.6B", 131072, CHAT, "fp8"),
+    ("meta", "llama-3-2-90b-vision-instruct-fp8",
+     "RedHatAI/Llama-3.2-90B-Vision-Instruct-FP8-dynamic",
+     "MllamaForConditionalGeneration", "88.6B", 131072, VISION, "fp8"),
+    ("unsloth", "unsloth-llama-3-2-11b-vision-instruct",
+     "unsloth/Llama-3.2-11B-Vision-Instruct",
+     "MllamaForConditionalGeneration", "10.7B", 131072, VISION, None),
+    ("nousresearch", "hermes-2-pro-llama-3-8b",
+     "NousResearch/Hermes-2-Pro-Llama-3-8B",
+     "LlamaForCausalLM", "8.03B", 8192, CHAT, None),
+    ("lmsys", "vicuna-7b-v1-5", "lmsys/vicuna-7b-v1.5",
+     "LlamaForCausalLM", "6.74B", 4096, CHAT, None),
+    ("lmsys", "vicuna-13b-v1-5", "lmsys/vicuna-13b-v1.5",
+     "LlamaForCausalLM", "13.0B", 4096, CHAT, None),
+    ("salesforce", "xgen-7b-8k-inst", "Salesforce/xgen-7b-8k-inst",
+     "LlamaForCausalLM", "6.71B", 8192, CHAT, None),
+    # -- qwen heritage + breadth ----------------------------------------
+    ("qwen", "qwen-7b-chat", "Qwen/Qwen-7B-Chat",
+     "QWenLMHeadModel", "7.72B", 8192, CHAT, None),
+    ("qwen", "qwen-vl", "Qwen/Qwen-VL",
+     "QWenLMHeadModel", "9.6B", 8192, VISION, None),
+    ("qwen", "qwen-vl-chat", "Qwen/Qwen-VL-Chat",
+     "QWenLMHeadModel", "9.6B", 8192, VISION, None),
+    ("qwen", "qwen1-5-7b-chat", "Qwen/Qwen1.5-7B-Chat",
+     "Qwen2ForCausalLM", "7.72B", 32768, CHAT, None),
+    ("qwen", "qwen1-5-32b-chat", "Qwen/Qwen1.5-32B-Chat",
+     "Qwen2ForCausalLM", "32.5B", 32768, CHAT, None),
+    ("qwen", "qwen1-5-72b-chat", "Qwen/Qwen1.5-72B-Chat",
+     "Qwen2ForCausalLM", "72.3B", 32768, CHAT, None),
+    ("qwen", "qwen1-5-110b-chat", "Qwen/Qwen1.5-110B-Chat",
+     "Qwen2ForCausalLM", "111B", 32768, CHAT, None),
+    ("qwen", "qwen2-5-0-5b", "Qwen/Qwen2.5-0.5B",
+     "Qwen2ForCausalLM", "494M", 32768, TEXTGEN, None),
+    ("qwen", "qwen2-5-1-5b", "Qwen/Qwen2.5-1.5B",
+     "Qwen2ForCausalLM", "1.54B", 32768, TEXTGEN, None),
+    ("qwen", "qwen2-5-3b", "Qwen/Qwen2.5-3B",
+     "Qwen2ForCausalLM", "3.09B", 32768, TEXTGEN, None),
+    ("qwen", "qwen2-5-7b", "Qwen/Qwen2.5-7B",
+     "Qwen2ForCausalLM", "7.62B", 131072, TEXTGEN, None),
+    ("qwen", "qwen2-5-14b", "Qwen/Qwen2.5-14B",
+     "Qwen2ForCausalLM", "14.8B", 131072, TEXTGEN, None),
+    ("qwen", "qwen2-5-32b", "Qwen/Qwen2.5-32B",
+     "Qwen2ForCausalLM", "32.8B", 131072, TEXTGEN, None),
+    ("qwen", "qwen2-5-72b", "Qwen/Qwen2.5-72B",
+     "Qwen2ForCausalLM", "72.7B", 131072, TEXTGEN, None),
+    ("qwen", "qwen2-vl-2b-instruct", "Qwen/Qwen2-VL-2B-Instruct",
+     "Qwen2VLForConditionalGeneration", "2.21B", 32768, VISION, None),
+    ("qwen", "qwen2-vl-7b-instruct", "Qwen/Qwen2-VL-7B-Instruct",
+     "Qwen2VLForConditionalGeneration", "8.29B", 32768, VISION, None),
+    ("qwen", "qwen2-vl-72b-instruct", "Qwen/Qwen2-VL-72B-Instruct",
+     "Qwen2VLForConditionalGeneration", "73.4B", 32768, VISION, None),
+    ("qwen", "qwen2-5-math-rm-72b", "Qwen/Qwen2.5-Math-RM-72B",
+     "Qwen2ForRewardModel", "72.7B", 4096, REWARD, None),
+    ("qwen", "qwen3-embedding-4b", "Qwen/Qwen3-Embedding-4B",
+     "Qwen3Model", "4.02B", 32768, EMBED, None),
+    ("qwen", "qwen3-embedding-8b", "Qwen/Qwen3-Embedding-8B",
+     "Qwen3Model", "7.57B", 32768, EMBED, None),
+    ("qwen", "qwen3-next-80b-a3b-instruct",
+     "Qwen/Qwen3-Next-80B-A3B-Instruct",
+     "Qwen3NextForCausalLM", "80.0B", 262144, CHAT, None),
+    ("qwen", "qwen3-vl-235b-a22b-instruct",
+     "Qwen/Qwen3-VL-235B-A22B-Instruct",
+     "Qwen3VLMoeForConditionalGeneration", "235B", 262144, VISION,
+     None),
+    ("qwen", "qwen-image", "Qwen/Qwen-Image",
+     "QwenImagePipeline", "20.0B", 1024, IMGGEN, None),
+    ("qwen", "qwen-image-edit", "Qwen/Qwen-Image-Edit",
+     "QwenImagePipeline", "20.0B", 1024, IMGGEN, None),
+    ("qwen", "qwen-image-edit-2511", "Qwen/Qwen-Image-Edit-2511",
+     "QwenImagePipeline", "20.0B", 1024, IMGGEN, None),
+    ("alibaba-nlp", "gme-qwen2-vl-2b-instruct",
+     "Alibaba-NLP/gme-Qwen2-VL-2B-Instruct",
+     "Qwen2VLForConditionalGeneration", "2.21B", 32768, VEMBED, None),
+    ("jason9693", "qwen2-5-1-5b-apeach",
+     "jason9693/Qwen2.5-1.5B-apeach",
+     "Qwen2ForSequenceClassification", "1.54B", 32768, REWARD, None),
+    # -- deepseek breadth -----------------------------------------------
+    ("deepseek", "deepseek-r1-zero", "deepseek-ai/DeepSeek-R1-Zero",
+     "DeepseekV3ForCausalLM", "685B", 163840, TEXTGEN, "fp8"),
+    ("deepseek", "deepseek-coder-7b-instruct-v1-5",
+     "deepseek-ai/deepseek-coder-7b-instruct-v1.5",
+     "LlamaForCausalLM", "6.91B", 4096, CHAT, None),
+    ("deepseek", "deepseek-vl2", "deepseek-ai/deepseek-vl2",
+     "DeepseekVLV2ForCausalLM", "27.4B", 4096, VISION, None),
+    ("deepseek", "janus-pro-7b", "deepseek-ai/Janus-Pro-7B",
+     "MultiModalityCausalLM", "7.42B", 4096, VISION, None),
+    # -- google gemma heritage ------------------------------------------
+    ("google", "gemma-2b", "google/gemma-2b",
+     "GemmaForCausalLM", "2.51B", 8192, TEXTGEN, None),
+    ("google", "gemma-7b", "google/gemma-7b",
+     "GemmaForCausalLM", "8.54B", 8192, TEXTGEN, None),
+    ("google", "gemma-2-2b", "google/gemma-2-2b",
+     "Gemma2ForCausalLM", "2.61B", 8192, TEXTGEN, None),
+    ("google", "gemma-2-9b", "google/gemma-2-9b",
+     "Gemma2ForCausalLM", "9.24B", 8192, TEXTGEN, None),
+    ("google", "gemma-2-27b", "google/gemma-2-27b",
+     "Gemma2ForCausalLM", "27.2B", 8192, TEXTGEN, None),
+    # -- microsoft phi heritage -----------------------------------------
+    ("microsoft", "phi-1-5", "microsoft/phi-1_5",
+     "PhiForCausalLM", "1.42B", 2048, TEXTGEN, None),
+    ("microsoft", "phi-3-mini-128k-instruct",
+     "microsoft/Phi-3-mini-128k-instruct",
+     "Phi3ForCausalLM", "3.82B", 131072, CHAT, None),
+    ("microsoft", "phi-3-small-8k-instruct",
+     "microsoft/Phi-3-small-8k-instruct",
+     "Phi3SmallForCausalLM", "7.39B", 8192, CHAT, None),
+    ("microsoft", "phi-3-medium-4k-instruct",
+     "microsoft/Phi-3-medium-4k-instruct",
+     "Phi3ForCausalLM", "14.0B", 4096, CHAT, None),
+    ("microsoft", "phi-3-vision-128k-instruct",
+     "microsoft/Phi-3-vision-128k-instruct",
+     "Phi3VForCausalLM", "4.15B", 131072, VISION, None),
+    ("microsoft", "phi-4-multimodal-instruct",
+     "microsoft/Phi-4-multimodal-instruct",
+     "Phi4MMForCausalLM", "5.57B", 131072, VISION, None),
+    # -- mistral heritage -----------------------------------------------
+    ("mistralai", "mistral-7b-instruct-v0-2",
+     "mistralai/Mistral-7B-Instruct-v0.2",
+     "MistralForCausalLM", "7.24B", 32768, CHAT, None),
+    ("mistralai", "mistral-small-3-1-24b-instruct-2503",
+     "mistralai/Mistral-Small-3.1-24B-Instruct-2503",
+     "Mistral3ForConditionalGeneration", "24.0B", 131072, VISION,
+     None),
+    ("mistralai", "mixtral-8x7b-v0-1", "mistralai/Mixtral-8x7B-v0.1",
+     "MixtralForCausalLM", "46.7B", 32768, TEXTGEN, None),
+    ("mistralai", "mixtral-8x22b-v0-1", "mistralai/Mixtral-8x22B-v0.1",
+     "MixtralForCausalLM", "141B", 65536, TEXTGEN, None),
+    # -- nvidia nemotron family (70b/49b rows exist above) --------------
+    ("nvidia", "llama-3-1-nemotron-nano-8b-v1",
+     "nvidia/Llama-3.1-Nemotron-Nano-8B-v1",
+     "LlamaForCausalLM", "8.03B", 131072, CHAT, None),
+    ("nvidia", "nemotron-nano-9b-v2",
+     "nvidia/NVIDIA-Nemotron-Nano-9B-v2",
+     "NemotronHForCausalLM", "8.89B", 131072, CHAT, None),
+    ("nvidia", "nemotron-3-nano-30b-a3b-bf16",
+     "nvidia/NVIDIA-Nemotron-3-Nano-30B-A3B-BF16",
+     "NemotronHForCausalLM", "31.6B", 131072, CHAT, None),
+    ("nvidia", "nemotron-3-nano-30b-a3b-base-bf16",
+     "nvidia/NVIDIA-Nemotron-3-Nano-30B-A3B-Base-BF16",
+     "NemotronHForCausalLM", "31.6B", 131072, TEXTGEN, None),
+    ("nvidia", "nemotron-3-nano-30b-a3b-fp8",
+     "nvidia/NVIDIA-Nemotron-3-Nano-30B-A3B-FP8",
+     "NemotronHForCausalLM", "31.6B", 131072, CHAT, "fp8"),
+    ("nvidia", "nemotron-nano-12b-v2-vl-bf16",
+     "nvidia/NVIDIA-Nemotron-Nano-12B-v2-VL-BF16",
+     "NemotronH_Nano_VL_V2", "12.7B", 131072, VISION, None),
+    ("nvidia", "nemotron-nano-12b-v2-vl-fp8",
+     "nvidia/NVIDIA-Nemotron-Nano-12B-v2-VL-FP8",
+     "NemotronH_Nano_VL_V2", "12.7B", 131072, VISION, "fp8"),
+    ("jet-ai", "jet-nemotron-2b", "jet-ai/Jet-Nemotron-2B",
+     "JetNemotronForCausalLM", "2.17B", 65536, TEXTGEN, None),
+    # -- legacy / community dense families ------------------------------
+    ("eleutherai", "gpt-j-6b", "EleutherAI/gpt-j-6b",
+     "GPTJForCausalLM", "6.05B", 2048, TEXTGEN, None),
+    ("databricks", "dolly-v2-12b", "databricks/dolly-v2-12b",
+     "GPTNeoXForCausalLM", "11.9B", 2048, TEXTGEN, None),
+    ("bigscience", "bloomz-7b1", "bigscience/bloomz-7b1",
+     "BloomForCausalLM", "7.07B", 2048, TEXTGEN, None),
+    ("mosaicml", "mpt-7b", "mosaicml/mpt-7b",
+     "MPTForCausalLM", "6.65B", 2048, TEXTGEN, None),
+    ("bigcode", "starcoder2-7b", "bigcode/starcoder2-7b",
+     "Starcoder2ForCausalLM", "7.17B", 16384, TEXTGEN, None),
+    ("adept", "persimmon-8b-chat", "adept/persimmon-8b-chat",
+     "PersimmonForCausalLM", "9.3B", 16384, CHAT, None),
+    ("stabilityai", "stablelm-tuned-alpha-7b",
+     "stabilityai/stablelm-tuned-alpha-7b",
+     "GPTNeoXForCausalLM", "7.87B", 4096, CHAT, None),
+    ("stabilityai", "stablelm-2-12b-chat",
+     "stabilityai/stablelm-2-12b-chat",
+     "StableLmForCausalLM", "12.1B", 4096, CHAT, None),
+    ("thudm", "chatglm2-6b", "THUDM/chatglm2-6b",
+     "ChatGLMModel", "6.24B", 32768, CHAT, None),
+    ("zhipuai", "glm-4-9b-chat-hf", "zai-org/glm-4-9b-chat-hf",
+     "GlmForCausalLM", "9.4B", 131072, CHAT, None),
+    ("baichuan", "baichuan2-7b-chat", "baichuan-inc/Baichuan2-7B-Chat",
+     "BaichuanForCausalLM", "7.51B", 4096, CHAT, None),
+    ("baichuan", "baichuan2-13b-chat",
+     "baichuan-inc/Baichuan2-13B-Chat",
+     "BaichuanForCausalLM", "13.9B", 4096, CHAT, None),
+    ("internlm", "internlm2-7b", "internlm/internlm2-7b",
+     "InternLM2ForCausalLM", "7.74B", 32768, TEXTGEN, None),
+    ("internlm", "internlm2-20b", "internlm/internlm2-20b",
+     "InternLM2ForCausalLM", "19.9B", 32768, TEXTGEN, None),
+    ("internlm", "internlm2-7b-reward", "internlm/internlm2-7b-reward",
+     "InternLM2ForRewardModel", "7.74B", 32768, REWARD, None),
+    ("orionstar", "orion-14b-base", "OrionStarAI/Orion-14B-Base",
+     "OrionForCausalLM", "14.5B", 4096, TEXTGEN, None),
+    ("cofeai", "tele-flm", "CofeAI/Tele-FLM",
+     "TeleFLMForCausalLM", "52.9B", 4096, TEXTGEN, None),
+    ("huggingface", "smollm-135m", "HuggingFaceTB/SmolLM-135M",
+     "LlamaForCausalLM", "135M", 2048, TEXTGEN, None),
+    ("huggingface", "smollm-360m", "HuggingFaceTB/SmolLM-360M",
+     "LlamaForCausalLM", "362M", 2048, TEXTGEN, None),
+    ("huggingface", "smollm-1-7b", "HuggingFaceTB/SmolLM-1.7B",
+     "LlamaForCausalLM", "1.71B", 2048, TEXTGEN, None),
+    ("arcee-ai", "afm-4-5b-base", "arcee-ai/AFM-4.5B-Base",
+     "ArceeForCausalLM", "4.5B", 65536, TEXTGEN, None),
+    ("xiaomi", "mimo-7b-rl", "XiaomiMiMo/MiMo-7B-RL",
+     "MiMoForCausalLM", "7.61B", 32768, CHAT, None),
+    ("xiaomi", "mimo-vl-7b-rl", "XiaomiMiMo/MiMo-VL-7B-RL",
+     "Qwen2_5_VLForConditionalGeneration", "8.31B", 32768, VISION,
+     None),
+    ("skywork", "skywork-or1-7b-preview",
+     "Skywork/Skywork-OR1-7B-Preview",
+     "Qwen2ForCausalLM", "7.62B", 32768, CHAT, None),
+    ("skywork", "skywork-reward-llama-3-1-8b-v0-2",
+     "Skywork/Skywork-Reward-Llama-3.1-8B-v0.2",
+     "LlamaForSequenceClassification", "7.5B", 131072, REWARD, None),
+    ("skywork", "skywork-reward-gemma-2-27b-v0-2",
+     "Skywork/Skywork-Reward-Gemma-2-27B-v0.2",
+     "Gemma2ForSequenceClassification", "27.2B", 8192, REWARD, None),
+    # -- MoE breadth -----------------------------------------------------
+    ("allenai", "olmoe-1b-7b-0924", "allenai/OLMoE-1B-7B-0924",
+     "OlmoeForCausalLM", "6.92B", 4096, TEXTGEN, None),
+    ("ibm-granite", "granite-3-0-2b-instruct",
+     "ibm-granite/granite-3.0-2b-instruct",
+     "GraniteForCausalLM", "2.63B", 4096, CHAT, None),
+    ("ibm-granite", "granite-3-0-8b-instruct",
+     "ibm-granite/granite-3.0-8b-instruct",
+     "GraniteForCausalLM", "8.17B", 4096, CHAT, None),
+    ("ibm-granite", "granite-3-0-3b-a800m-instruct",
+     "ibm-granite/granite-3.0-3b-a800m-instruct",
+     "GraniteMoeForCausalLM", "3.37B", 4096, CHAT, None),
+    ("baidu", "ernie-4-5-21b-a3b-pt", "baidu/ERNIE-4.5-21B-A3B-PT",
+     "Ernie4_5_MoeForCausalLM", "21.8B", 131072, CHAT, None),
+    ("inclusionai", "ling-lite", "inclusionAI/Ling-lite",
+     "BailingMoeForCausalLM", "16.8B", 16384, CHAT, None),
+    ("inclusionai", "ling-plus", "inclusionAI/Ling-plus",
+     "BailingMoeForCausalLM", "290B", 16384, CHAT, None),
+    ("xverse", "xverse-moe-a36b", "xverse/XVERSE-MoE-A36B",
+     "XverseMoeForCausalLM", "255B", 8192, TEXTGEN, None),
+    ("minimax", "minimax-m2", "minimax/MiniMax-M2",
+     "MiniMaxM2ForCausalLM", "229B", 196608, CHAT, None),
+    ("xai-org", "grok-1", "xai-org/grok-1",
+     "Grok1ForCausalLM", "314B", 8192, TEXTGEN, None),
+    ("xai-org", "grok-2", "xai-org/grok-2",
+     "Grok2ForCausalLM", "270B", 131072, TEXTGEN, None),
+    # -- vision-language breadth ----------------------------------------
+    ("liuhaotian", "llava-v1-5-7b", "liuhaotian/llava-v1.5-7b",
+     "LlavaLlamaForCausalLM", "7.06B", 4096, VISION, None),
+    ("liuhaotian", "llava-v1-5-13b", "liuhaotian/llava-v1.5-13b",
+     "LlavaLlamaForCausalLM", "13.4B", 4096, VISION, None),
+    ("liuhaotian", "llava-v1-6-vicuna-7b",
+     "liuhaotian/llava-v1.6-vicuna-7b",
+     "LlavaLlamaForCausalLM", "7.57B", 4096, VISION, None),
+    ("liuhaotian", "llava-v1-6-vicuna-13b",
+     "liuhaotian/llava-v1.6-vicuna-13b",
+     "LlavaLlamaForCausalLM", "13.4B", 4096, VISION, None),
+    ("lmms-lab", "llava-next-8b", "lmms-lab/llava-next-8b",
+     "LlavaLlamaForCausalLM", "8.36B", 8192, VISION, None),
+    ("lmms-lab", "llava-next-72b", "lmms-lab/llava-next-72b",
+     "LlavaQwenForCausalLM", "72.7B", 32768, VISION, None),
+    ("lmms-lab", "llava-onevision-qwen2-7b-ov",
+     "lmms-lab/llava-onevision-qwen2-7b-ov",
+     "LlavaQwenForCausalLM", "8.03B", 32768, VISION, None),
+    ("opengvlab", "internvl2-5-8b", "OpenGVLab/InternVL2_5-8B",
+     "InternVLChatModel", "8.08B", 32768, VISION, None),
+    ("efficient-large-model", "nvila-8b",
+     "Efficient-Large-Model/NVILA-8B",
+     "LlavaLlamaModel", "8.49B", 32768, VISION, None),
+    ("openbmb", "minicpm-2b-sft-bf16", "openbmb/MiniCPM-2B-sft-bf16",
+     "MiniCPMForCausalLM", "2.72B", 4096, CHAT, None),
+    ("openbmb", "minicpm3-4b", "openbmb/MiniCPM3-4B",
+     "MiniCPM3ForCausalLM", "4.07B", 32768, CHAT, None),
+    ("openbmb", "minicpm-v-2-6", "openbmb/MiniCPM-V-2_6",
+     "MiniCPMV", "8.1B", 32768, VISION, None),
+    ("moonshotai", "kimi-vl-a3b-instruct",
+     "moonshotai/Kimi-VL-A3B-Instruct",
+     "KimiVLForConditionalGeneration", "16.4B", 131072, VISION, None),
+    ("rednote-hilab", "dots-ocr", "rednote-hilab/dots.ocr",
+     "DotsOCRForCausalLM", "3.0B", 32768, VISION, None),
+    ("rednote-hilab", "dots-vlm1-inst", "rednote-hilab/dots.vlm1.inst",
+     "DotsVLMForCausalLM", "28.0B", 65536, VISION, None),
+    ("zai-org", "glm-4-5v", "zai-org/GLM-4.5V",
+     "Glm4vMoeForConditionalGeneration", "106B", 65536, VISION, None),
+    # -- embeddings / rerank / scoring ----------------------------------
+    ("baai", "bge-reranker-v2-m3", "BAAI/bge-reranker-v2-m3",
+     "XLMRobertaForSequenceClassification", "568M", 8192, RERANK,
+     None),
+    ("openai", "clip-vit-large-patch14-336",
+     "openai/clip-vit-large-patch14-336",
+     "CLIPModel", "428M", 77, VEMBED, None),
 ]
 
 
@@ -1244,6 +1552,297 @@ def family_runtime_docs():
         {"acceleratorClasses": ["tpu-v6e"], "minChips": 1})
 
 
+# -- round-4 breadth runtimes ----------------------------------------------
+# One tuned entry per family, mirroring the reference's per-model
+# config/runtimes/srt/<vendor>/ files (~188 YAMLs): each row is
+# (name, [(arch, quant, prio), ...], size_min, size_max, chips,
+#  accel_classes, topology, tp, workers, extra_args).
+# All ride the vLLM-TPU image — these are families the in-repo engine
+# does not implement natively; the operator's job is to route them to
+# a tuned external runtime, exactly the reference's posture.
+
+BREADTH_RUNTIMES = [
+    # --- legacy dense families (1 chip v5e) ----------------------------
+    ("vllm-tpu-qwen-legacy",
+     [("QWenLMHeadModel", None, 4)], "1B", "12B",
+     1, ["tpu-v5e", "tpu-v6e"], None, 1, 0,
+     ["--max-model-len", "8192", "--trust-remote-code"]),
+    ("vllm-tpu-legacy-small",
+     [("GPTJForCausalLM", None, 4), ("GPTNeoXForCausalLM", None, 4),
+      ("BloomForCausalLM", None, 4), ("MPTForCausalLM", None, 4),
+      ("PersimmonForCausalLM", None, 4),
+      ("StableLmForCausalLM", None, 4), ("PhiForCausalLM", None, 4),
+      ("Starcoder2ForCausalLM", None, 4),
+      ("ArceeForCausalLM", None, 4), ("MiMoForCausalLM", None, 4)],
+     "100M", "16B", 1, ["tpu-v5e", "tpu-v6e"], None, 1, 0,
+     ["--max-model-len", "4096"]),
+    ("vllm-tpu-legacy-mid",
+     [("OrionForCausalLM", None, 4), ("TeleFLMForCausalLM", None, 4)],
+     "12B", "60B", 4, ["tpu-v5p"], "2x2x1", 4, 0,
+     ["--max-model-len", "4096", "--trust-remote-code"]),
+    ("vllm-tpu-gemma1",
+     [("GemmaForCausalLM", None, 4)], "1B", "10B",
+     1, ["tpu-v5e", "tpu-v6e"], None, 1, 0,
+     ["--max-model-len", "8192"]),
+    ("vllm-tpu-phi3-small",
+     [("Phi3SmallForCausalLM", None, 4)], "5B", "9B",
+     1, ["tpu-v5e", "tpu-v6e"], None, 1, 0,
+     ["--max-model-len", "8192", "--trust-remote-code"]),
+    ("vllm-tpu-glm",
+     [("GlmForCausalLM", None, 4), ("ChatGLMModel", None, 4)],
+     "1B", "12B", 1, ["tpu-v5e", "tpu-v6e"], None, 1, 0,
+     ["--max-model-len", "32768", "--trust-remote-code"]),
+    ("vllm-tpu-baichuan",
+     [("BaichuanForCausalLM", None, 4)], "1B", "15B",
+     1, ["tpu-v5e", "tpu-v6e"], None, 1, 0,
+     ["--max-model-len", "4096", "--trust-remote-code"]),
+    ("vllm-tpu-internlm2",
+     [("InternLM2ForCausalLM", None, 4)], "1B", "25B",
+     4, ["tpu-v5e", "tpu-v5p"], "2x2", 4, 0,
+     ["--max-model-len", "32768", "--trust-remote-code"]),
+    ("vllm-tpu-dense-xl",
+     [("Qwen2ForCausalLM", None, 7)], "80B", "160B",
+     4, ["tpu-v5p"], "2x2x2", 8, 1,
+     ["--max-model-len", "32768"]),
+    ("vllm-tpu-deci",
+     [("DeciLMForCausalLM", None, 4)], "30B", "60B",
+     8, ["tpu-v5p"], "2x2x2", 8, 0,
+     ["--max-model-len", "65536", "--trust-remote-code"]),
+    # --- hybrid (attention+mamba) families -----------------------------
+    ("vllm-tpu-nemotron-h",
+     [("NemotronHForCausalLM", None, 4),
+      ("NemotronHForCausalLM", "fp8", 4),
+      ("NemotronH_Nano_VL_V2", None, 4),
+      ("NemotronH_Nano_VL_V2", "fp8", 4),
+      ("JetNemotronForCausalLM", None, 4)],
+     "1B", "40B", 4, ["tpu-v5e", "tpu-v6e"], "2x2", 4, 0,
+     ["--max-model-len", "131072", "--trust-remote-code"]),
+    ("vllm-tpu-qwen3-next",
+     [("Qwen3NextForCausalLM", None, 4)], "60B", "90B",
+     8, ["tpu-v5p"], "2x2x2", 8, 0,
+     ["--max-model-len", "262144"]),
+    # --- MoE families ---------------------------------------------------
+    ("vllm-tpu-olmoe",
+     [("OlmoeForCausalLM", None, 4)], "3B", "10B",
+     1, ["tpu-v5e", "tpu-v6e"], None, 1, 0,
+     ["--max-model-len", "4096"]),
+    ("vllm-tpu-granite",
+     [("GraniteForCausalLM", None, 4),
+      ("GraniteMoeForCausalLM", None, 4),
+      ("GPTBigCodeForCausalLM", None, 4)],
+     "1B", "25B", 1, ["tpu-v5e", "tpu-v6e"], None, 1, 0,
+     ["--max-model-len", "8192"]),
+    ("vllm-tpu-ernie-moe",
+     [("Ernie4_5_MoeForCausalLM", None, 4)], "15B", "30B",
+     4, ["tpu-v5e", "tpu-v6e"], "2x2", 4, 0,
+     ["--max-model-len", "131072", "--enable-expert-parallel"]),
+    ("vllm-tpu-bailing",
+     [("BailingMoeForCausalLM", None, 4)], "10B", "40B",
+     4, ["tpu-v5e", "tpu-v6e"], "2x2", 4, 0,
+     ["--max-model-len", "16384", "--trust-remote-code"]),
+    ("vllm-tpu-bailing-plus",
+     [("BailingMoeForCausalLM", None, 5)], "200B", "350B",
+     4, ["tpu-v5p"], "2x4x4", 32, 7,
+     ["--max-model-len", "16384", "--trust-remote-code",
+      "--enable-expert-parallel"]),
+    ("vllm-tpu-xverse-moe",
+     [("XverseMoeForCausalLM", None, 4)], "200B", "300B",
+     4, ["tpu-v5p"], "2x4x4", 32, 7,
+     ["--max-model-len", "8192", "--trust-remote-code",
+      "--enable-expert-parallel"]),
+    ("vllm-tpu-minimax",
+     [("MiniMaxM2ForCausalLM", None, 4)], "180B", "280B",
+     4, ["tpu-v5p"], "2x4x4", 32, 7,
+     ["--max-model-len", "196608", "--enable-expert-parallel"]),
+    ("vllm-tpu-grok",
+     [("Grok1ForCausalLM", None, 4), ("Grok2ForCausalLM", None, 4)],
+     "200B", "350B", 4, ["tpu-v5p"], "2x4x4", 32, 7,
+     ["--max-model-len", "8192", "--trust-remote-code",
+      "--enable-expert-parallel"]),
+    # --- vision-language families --------------------------------------
+    ("vllm-tpu-qwen2-vl",
+     [("Qwen2VLForConditionalGeneration", None, 4),
+      ("Qwen2_5_VLForConditionalGeneration", None, 4)],
+     "1B", "16B", 4, ["tpu-v5e", "tpu-v6e"], "2x2", 4, 0,
+     ["--max-model-len", "32768"]),
+    ("vllm-tpu-qwen2-vl-72b",
+     [("Qwen2VLForConditionalGeneration", None, 5)], "60B", "90B",
+     8, ["tpu-v5p"], "2x2x2", 8, 0,
+     ["--max-model-len", "32768"]),
+    ("vllm-tpu-qwen3-vl-moe",
+     [("Qwen3VLMoeForConditionalGeneration", None, 4)],
+     "180B", "280B", 4, ["tpu-v5p"], "2x4x4", 32, 7,
+     ["--max-model-len", "262144", "--enable-expert-parallel"]),
+    ("vllm-tpu-llava",
+     [("LlavaLlamaForCausalLM", None, 4),
+      ("LlavaQwenForCausalLM", None, 4),
+      ("LlavaLlamaModel", None, 4)],
+     "1B", "16B", 4, ["tpu-v5e", "tpu-v6e"], "2x2", 4, 0,
+     ["--max-model-len", "8192", "--trust-remote-code"]),
+    ("vllm-tpu-llava-72b",
+     [("LlavaQwenForCausalLM", None, 5)], "60B", "90B",
+     8, ["tpu-v5p"], "2x2x2", 8, 0,
+     ["--max-model-len", "32768", "--trust-remote-code"]),
+    ("vllm-tpu-internvl",
+     [("InternVLChatModel", None, 4)], "1B", "30B",
+     4, ["tpu-v5e", "tpu-v6e"], "2x2", 4, 0,
+     ["--max-model-len", "32768", "--trust-remote-code"]),
+    ("vllm-tpu-minicpm",
+     [("MiniCPMForCausalLM", None, 4), ("MiniCPM3ForCausalLM", None, 4),
+      ("MiniCPMV", None, 4)],
+     "1B", "10B", 1, ["tpu-v5e", "tpu-v6e"], None, 1, 0,
+     ["--max-model-len", "32768", "--trust-remote-code"]),
+    ("vllm-tpu-phi-vision",
+     [("Phi3VForCausalLM", None, 4), ("Phi4MMForCausalLM", None, 4)],
+     "1B", "8B", 1, ["tpu-v5e", "tpu-v6e"], None, 1, 0,
+     ["--max-model-len", "131072", "--trust-remote-code"]),
+    ("vllm-tpu-mllama",
+     [("MllamaForConditionalGeneration", None, 4),
+      ("MllamaForConditionalGeneration", "fp8", 4)],
+     "8B", "100B", 8, ["tpu-v5p"], "2x2x2", 8, 0,
+     ["--max-model-len", "131072"]),
+    ("vllm-tpu-deepseek-vl",
+     [("DeepseekVLV2ForCausalLM", None, 4),
+      ("MultiModalityCausalLM", None, 4)],
+     "5B", "30B", 4, ["tpu-v5e", "tpu-v5p"], "2x2", 4, 0,
+     ["--max-model-len", "4096", "--trust-remote-code"]),
+    ("vllm-tpu-kimi-vl",
+     [("KimiVLForConditionalGeneration", None, 4)], "10B", "20B",
+     4, ["tpu-v5e", "tpu-v6e"], "2x2", 4, 0,
+     ["--max-model-len", "131072", "--trust-remote-code"]),
+    ("vllm-tpu-dots",
+     [("DotsOCRForCausalLM", None, 4), ("DotsVLMForCausalLM", None, 4)],
+     "1B", "30B", 4, ["tpu-v5e", "tpu-v5p"], "2x2", 4, 0,
+     ["--max-model-len", "32768", "--trust-remote-code"]),
+    ("vllm-tpu-glm-v",
+     [("Glm4vMoeForConditionalGeneration", None, 4)], "90B", "120B",
+     8, ["tpu-v5p"], "2x2x2", 8, 0,
+     ["--max-model-len", "65536", "--enable-expert-parallel"]),
+    ("vllm-tpu-llama4-maverick",
+     [("Llama4ForConditionalGeneration", "fp8", 4),
+      ("Llama4ForConditionalGeneration", None, 4)],
+     "350B", "450B", 4, ["tpu-v5p"], "4x4x4", 64, 15,
+     ["--max-model-len", "1048576", "--enable-expert-parallel"]),
+    ("vllm-tpu-mistral3-vision",
+     [("Mistral3ForConditionalGeneration", None, 4)], "16B", "30B",
+     4, ["tpu-v5e", "tpu-v6e"], "2x2", 4, 0,
+     ["--max-model-len", "131072"]),
+    # --- scoring / rerank / multimodal embeddings ----------------------
+    ("vllm-tpu-scoring",
+     [("Qwen2ForRewardModel", None, 4),
+      ("Qwen2ForSequenceClassification", None, 4),
+      ("InternLM2ForRewardModel", None, 4),
+      ("LlamaForSequenceClassification", None, 4),
+      ("Gemma2ForSequenceClassification", None, 4)],
+     "1B", "80B", 4, ["tpu-v5e", "tpu-v5p"], "2x2", 4, 0,
+     ["--max-model-len", "8192", "--task", "reward",
+      "--trust-remote-code"]),
+    ("vllm-tpu-rerank",
+     [("XLMRobertaForSequenceClassification", None, 4)],
+     "10M", "5B", 1, ["tpu-v5e", "tpu-v6e"], None, 1, 0,
+     ["--max-model-len", "8192", "--task", "score"]),
+    ("vllm-tpu-clip",
+     [("CLIPModel", None, 4)], "10M", "5B",
+     1, ["tpu-v5e", "tpu-v6e"], None, 1, 0,
+     ["--task", "embed"]),
+    # --- coverage for families the pre-round-4 catalog shipped models
+    # for but no runtime claimed (exposed by the every-model-routes
+    # test): cohere, exaone, falcon, gemma3-text, glm4, gpt-oss,
+    # jamba, llama4-scout, mistral-large, moonlight-MLA, olmo2,
+    # qwen2.5-vl-72b, qwen3-coder ----------------------------------------
+    ("vllm-tpu-cohere",
+     [("CohereForCausalLM", None, 4), ("Cohere2ForCausalLM", None, 4)],
+     "5B", "60B", 4, ["tpu-v5e", "tpu-v5p"], "2x2", 4, 0,
+     ["--max-model-len", "131072"]),
+    ("vllm-tpu-cohere-large",
+     [("CohereForCausalLM", None, 5), ("Cohere2ForCausalLM", None, 5)],
+     "60B", "120B", 8, ["tpu-v5p"], "2x2x2", 8, 0,
+     ["--max-model-len", "131072"]),
+    ("vllm-tpu-exaone",
+     [("ExaoneForCausalLM", None, 4)], "5B", "40B",
+     4, ["tpu-v5e", "tpu-v6e"], "2x2", 4, 0,
+     ["--max-model-len", "32768", "--trust-remote-code"]),
+    ("vllm-tpu-falcon",
+     [("FalconForCausalLM", None, 4)], "5B", "50B",
+     4, ["tpu-v5e", "tpu-v5p"], "2x2", 4, 0,
+     ["--max-model-len", "2048"]),
+    ("vllm-tpu-falcon-180b",
+     [("FalconForCausalLM", None, 5)], "150B", "200B",
+     4, ["tpu-v5p"], "2x4x4", 32, 7,
+     ["--max-model-len", "2048"]),
+    ("vllm-tpu-gemma3-text",
+     [("Gemma3ForCausalLM", None, 4)], "500M", "5B",
+     1, ["tpu-v5e", "tpu-v6e"], None, 1, 0,
+     ["--max-model-len", "32768"]),
+    ("vllm-tpu-glm4",
+     [("Glm4ForCausalLM", None, 4)], "5B", "40B",
+     4, ["tpu-v5e", "tpu-v5p"], "2x2", 4, 0,
+     ["--max-model-len", "32768"]),
+    ("vllm-tpu-gpt-oss",
+     [("GptOssForCausalLM", None, 4)], "15B", "30B",
+     4, ["tpu-v5e", "tpu-v6e"], "2x2", 4, 0,
+     ["--max-model-len", "131072"]),
+    ("vllm-tpu-gpt-oss-120b",
+     [("GptOssForCausalLM", None, 5)], "100B", "140B",
+     8, ["tpu-v5p"], "2x2x2", 8, 0,
+     ["--max-model-len", "131072", "--enable-expert-parallel"]),
+    ("vllm-tpu-jamba",
+     [("JambaForCausalLM", None, 4)], "40B", "60B",
+     8, ["tpu-v5p"], "2x2x2", 8, 0,
+     ["--max-model-len", "262144"]),
+    ("vllm-tpu-jamba-large",
+     [("JambaForCausalLM", None, 5)], "350B", "450B",
+     4, ["tpu-v5p"], "2x4x4", 32, 7,
+     ["--max-model-len", "262144", "--enable-expert-parallel"]),
+    ("vllm-tpu-llama4-scout",
+     [("Llama4ForConditionalGeneration", None, 5)], "80B", "150B",
+     8, ["tpu-v5p"], "2x2x2", 8, 1,
+     ["--max-model-len", "1048576", "--enable-expert-parallel"]),
+    ("vllm-tpu-mistral-large",
+     [("MistralForCausalLM", None, 8)], "110B", "140B",
+     8, ["tpu-v5p"], "2x2x2", 8, 1,
+     ["--max-model-len", "131072"]),
+    ("vllm-tpu-moonlight",
+     [("DeepseekV3ForCausalLM", None, 12)], "10B", "30B",
+     4, ["tpu-v5e", "tpu-v6e"], "2x2", 4, 0,
+     ["--max-model-len", "8192", "--trust-remote-code"]),
+    ("vllm-tpu-olmo2",
+     [("Olmo2ForCausalLM", None, 4)], "5B", "20B",
+     1, ["tpu-v5e", "tpu-v6e"], None, 1, 0,
+     ["--max-model-len", "4096"]),
+    ("vllm-tpu-qwen2-5-vl-72b",
+     [("Qwen2_5_VLForConditionalGeneration", None, 5)], "60B", "90B",
+     8, ["tpu-v5p"], "2x2x2", 8, 0,
+     ["--max-model-len", "32768"]),
+    ("vllm-tpu-qwen3-coder",
+     [("Qwen3MoeForCausalLM", None, 12)], "400B", "520B",
+     4, ["tpu-v5p"], "4x4x4", 64, 15,
+     ["--max-model-len", "262144", "--enable-expert-parallel"]),
+]
+
+
+def breadth_runtime_docs():
+    vllm = "vllm/vllm-tpu:latest"
+    for (name, archs, smin, smax, chips, accels, topo, tp, workers,
+         extra) in BREADTH_RUNTIMES:
+        args = ["--model", "$(MODEL_PATH)",
+                "--tensor-parallel-size", str(tp), *extra,
+                "--port", "8080"]
+        engine = {"runner": _tpu_runner(vllm, args, chips)}
+        if workers:
+            engine["workerSize"] = workers
+        accel = {"acceleratorClasses": list(accels),
+                 "minChips": chips * (workers + 1) if workers
+                 else max(chips, tp)}
+        if topo:
+            accel["topologies"] = [topo]
+        accel_cfgs = [{"acceleratorClass": accels[0],
+                       "parallelism": {"tensorParallelSize": tp}}]
+        yield f"runtimes/vllm/{name}-rt.yaml", _csr(
+            name, [fmt(a, quant=q, prio=p) for a, q, p in archs],
+            smin, smax, engine, accel, accel_cfgs=accel_cfgs)
+
+
 def supported_models_md() -> str:
     lines = [
         "# Supported models",
@@ -1265,7 +1864,8 @@ def supported_models_md() -> str:
 def main():
     count = 0
     for rel, doc in (*accelerator_docs(), *model_docs(), *runtime_docs(),
-                     *extra_runtime_docs(), *family_runtime_docs()):
+                     *extra_runtime_docs(), *family_runtime_docs(),
+                     *breadth_runtime_docs()):
         path = os.path.join(ROOT, "config", rel)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "w") as f:

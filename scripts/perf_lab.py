@@ -47,13 +47,18 @@ def make_cfg():
         rope_theta=500000.0, max_seq_len=CACHE_LEN)
 
 
-def time_loop(step_fn, state, steps=STEPS, trials=3):
-    """state -> state; returns best ms/step."""
-    st = step_fn(state)   # compile + warm
+def time_loop(step_fn, state, steps=STEPS, trials=3, fresh=False):
+    """state -> state; returns best ms/step. `fresh=True` deep-copies
+    the initial state per trial — required for donate variants, whose
+    warmup call deletes the original buffers."""
+    def start():
+        return jax.tree.map(jnp.copy, state) if fresh else state
+
+    st = step_fn(start())   # compile + warm
     sync(jax.tree.leaves(st)[0])
     best = float("inf")
     for _ in range(trials):
-        st = state
+        st = start()
         t0 = time.perf_counter()
         for _ in range(steps):
             st = step_fn(st)
@@ -219,7 +224,7 @@ def run_inplace(cfg, quant, donate=True):
 
     tag = "inplace" if donate else "unrolled-nodon"
     report(f"{tag}/{quant or 'bf16'}",
-           time_loop(step, (tok, ks, vs, index)))
+           time_loop(step, (tok, ks, vs, index), fresh=donate))
 
 
 def run_multistep(cfg, quant, k_steps=8, donate=False):
@@ -251,7 +256,8 @@ def run_multistep(cfg, quant, k_steps=8, donate=False):
         tok, ks, vs, index = decode_k(per, top, tok, ks, vs, index)
         return tok, ks, vs, index
 
-    ms = time_loop(step, (tok, ks, vs, index), steps=STEPS // k_steps)
+    ms = time_loop(step, (tok, ks, vs, index), steps=STEPS // k_steps,
+                   fresh=donate)
     report(f"multistep{k_steps}/{quant or 'bf16'}", ms / k_steps)
 
 
@@ -300,7 +306,185 @@ def run_stacked(cfg, quant, donate=True):
         return decode(per, top, tok, k, v, index)
 
     tag = "stacked" if donate else "stacked-nodon"
-    report(f"{tag}/{quant or 'bf16'}", time_loop(step, (tok, k, v, index)))
+    report(f"{tag}/{quant or 'bf16'}",
+           time_loop(step, (tok, k, v, index), fresh=donate))
+
+
+def _unrolled_q8kv_step(cfg, per, top, tok, kq, vq, ksc, vsc, index):
+    """Unrolled decode step over an INT8 KV cache (per-layer plane
+    lists + per-token-head scales), attention via the quantized flash
+    decode kernel."""
+    from ome_tpu.models.llama import (_proj, _rope_frequencies,
+                                      apply_rope, dense_mlp, rms_norm)
+    from ome_tpu.models.quant import QTensor
+    from ome_tpu.ops.flash import (flash_decode_quantized,
+                                   quantize_kv_block)
+    B = tok.shape[0]
+    emb = top["embed"]
+    x = emb.take(tok, cfg.dtype) if isinstance(emb, QTensor) \
+        else jnp.take(emb, tok, axis=0).astype(cfg.dtype)
+    freqs = _rope_frequencies(cfg)
+    positions = jnp.broadcast_to(index[None, None], (B, 1))
+    kv_len = jnp.broadcast_to(index + 1, (B,))
+    from jax import lax
+    nkq, nvq, nks, nvs = [], [], [], []
+    for l in range(cfg.num_layers):
+        lp = per[l]
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = _proj(h, lp["wq"], cfg.dtype,
+                  out_dims=(cfg.num_heads, cfg.head_dim))
+        k = _proj(h, lp["wk"], cfg.dtype,
+                  out_dims=(cfg.num_kv_heads, cfg.head_dim))
+        v = _proj(h, lp["wv"], cfg.dtype,
+                  out_dims=(cfg.num_kv_heads, cfg.head_dim))
+        q = apply_rope(q, positions, freqs)
+        k = apply_rope(k, positions, freqs)
+        kq8, ks8 = quantize_kv_block(k)   # [B,1,K,D], [B,K,1]
+        vq8, vs8 = quantize_kv_block(v)
+        upd = jax.vmap(lambda c, u, i: lax.dynamic_update_slice(
+            c, u, (i, 0, 0)))
+        upd_s = jax.vmap(lambda c, u, i: lax.dynamic_update_slice(
+            c, u, (0, i)))                # scale planes are [K, S]
+        idx = index * jnp.ones((B,), jnp.int32)
+        ck = upd(kq[l], kq8, idx)
+        cv = upd(vq[l], vq8, idx)
+        cks = upd_s(ksc[l], ks8, idx)
+        cvs = upd_s(vsc[l], vs8, idx)
+        attn = flash_decode_quantized(q, ck, cv, cks, cvs,
+                                      positions=positions,
+                                      kv_len=kv_len,
+                                      scale=cfg.query_scale)
+        a = _proj(attn, lp["wo"], cfg.dtype, flatten=2)
+        x = x + a
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + dense_mlp(h, lp, cfg)
+        nkq.append(ck)
+        nvq.append(cv)
+        nks.append(cks)
+        nvs.append(cvs)
+    x = rms_norm(x, top["final_norm"], cfg.rms_norm_eps)
+    head = top.get("lm_head")
+    head = head.dequant(cfg.dtype) if isinstance(head, QTensor) else head
+    logits = jnp.einsum("bsd,dv->bsv", x, head,
+                        preferred_element_type=jnp.float32)
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return tok, nkq, nvq, nks, nvs, index + 1
+
+
+def run_multistep_q8kv(cfg, quant, k_steps=8):
+    from jax import lax
+    params, tok, cache = prep(cfg, quant)
+    per, top = _split_layers(params, cfg.num_layers)
+    from ome_tpu.ops.flash import quantize_kv_block
+    kq, vq, ksc, vsc = [], [], [], []
+    for l in range(cfg.num_layers):
+        q8, s8 = quantize_kv_block(cache.k[l])
+        kq.append(q8)
+        ksc.append(s8)
+        q8, s8 = quantize_kv_block(cache.v[l])
+        vq.append(q8)
+        vsc.append(s8)
+    index = cache.index
+
+    def one(per, top, carry, _):
+        tok, kq, vq, ksc, vsc, index = carry
+        out = _unrolled_q8kv_step(cfg, per, top, tok, kq, vq, ksc, vsc,
+                                  index)
+        return out, out[0]
+
+    import functools
+
+    @jax.jit
+    def decode_k(per, top, tok, kq, vq, ksc, vsc, index):
+        carry, _ = lax.scan(functools.partial(one, per, top),
+                            (tok, kq, vq, ksc, vsc, index), None,
+                            length=k_steps)
+        return carry
+
+    def step(st):
+        return decode_k(per, top, *st)
+
+    ms = time_loop(step, (tok, kq, vq, ksc, vsc, index),
+                   steps=STEPS // k_steps)
+    report(f"q8kv-multistep{k_steps}/{quant or 'bf16'}", ms / k_steps)
+
+
+def run_attnbench(cfg, quant):
+    """Isolate decode attention: 24 chained flash-decode calls (one
+    per layer) per step, bf16 cache vs int8 cache."""
+    from ome_tpu.ops.flash import (flash_attention,
+                                   flash_decode_quantized,
+                                   quantize_kv_block)
+    B, S, K, H, D = BATCH, CACHE_LEN, cfg.num_kv_heads, cfg.num_heads, \
+        cfg.head_dim
+    L = cfg.num_layers
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, 1, H, D), jnp.bfloat16)
+    k = jax.random.normal(key, (B, S, K, D), jnp.bfloat16)
+    v = jax.random.normal(key, (B, S, K, D), jnp.bfloat16)
+    lengths = jnp.full((B,), S, jnp.int32)
+    positions = (lengths - 1)[:, None]
+    kq, ks = quantize_kv_block(k)
+    vq, vs = quantize_kv_block(v)
+
+    @jax.jit
+    def plain(q, k, v):
+        out = q
+        for _ in range(L):
+            out = flash_attention(out.reshape(B, 1, H, D), k, v,
+                                  positions=positions, kv_len=lengths)
+        return out
+
+    @jax.jit
+    def quant(q, kq, vq, ks, vs):
+        out = q
+        for _ in range(L):
+            out = flash_decode_quantized(out.reshape(B, 1, H, D), kq,
+                                         vq, ks, vs,
+                                         positions=positions,
+                                         kv_len=lengths)
+        return out
+
+    report("attn-bf16", time_loop(lambda t: plain(t, k, v), q,
+                                  steps=32))
+    report("attn-int8kv", time_loop(lambda t: quant(t, kq, vq, ks, vs),
+                                    q, steps=32))
+
+
+def run_prefill_bench(cfg, quant):
+    """Prefill throughput + MFU: Pallas flash vs XLA attention (the
+    trace reads OME_ATTN_BACKEND, so each backend gets a fresh jit)."""
+    import os
+    params, _, _ = prep(cfg, quant)
+    prompt = jax.random.randint(jax.random.PRNGKey(2),
+                                (BATCH, PREFILL), 0, cfg.vocab_size,
+                                dtype=jnp.int32)
+    n_params = llama.param_count(params)
+    T = BATCH * PREFILL
+    # matmul flops + causal attention flops
+    flops = 2 * n_params * T + 2 * cfg.num_layers * BATCH * (
+        PREFILL ** 2) * cfg.num_heads * cfg.head_dim
+    for backend in ("pallas", "xla"):
+        os.environ["OME_ATTN_BACKEND"] = backend
+
+        def fwd(params, tokens):
+            cache = llama.KVCache.create(cfg, BATCH, CACHE_LEN)
+            logits, c = llama.forward(params, cfg, tokens, cache=cache)
+            return jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+
+        f = jax.jit(fwd)
+        sync(f(params, prompt))  # compile
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            sync(f(params, prompt))  # ONE synced prefill per timing
+            best = min(best, time.perf_counter() - t0)
+        ms = best * 1000
+        tps = T / (ms / 1000)
+        mfu = flops / (ms / 1000) / 197e12
+        print(f"lab: prefill/{backend:7s} {ms:7.2f} ms   "
+              f"{tps:8.0f} tok/s   MFU {100*mfu:.1f}%", flush=True)
+    os.environ.pop("OME_ATTN_BACKEND", None)
 
 
 VARIANTS = {
@@ -316,6 +500,9 @@ VARIANTS = {
     "multistep4": lambda cfg, q: run_multistep(cfg, q, k_steps=4),
     "multistep16": lambda cfg, q: run_multistep(cfg, q, k_steps=16),
     "multistep-don": lambda cfg, q: run_multistep(cfg, q, donate=True),
+    "q8kv": run_multistep_q8kv,
+    "attnbench": run_attnbench,
+    "prefill": run_prefill_bench,
 }
 
 

@@ -345,6 +345,61 @@ class TestMultiNodeReconcile:
         worker = tmpl.worker_template.spec.containers[0]
         assert worker.get_env(constants.TPU_WORKER_HOSTNAMES_ENV) == hostnames
 
+    def test_kueue_gang_labels_from_accelerator_queue(self, world):
+        """AcceleratorClass.queue_name stamps kueue.x-k8s.io/queue-name
+        on the LWS and BOTH pod templates (gang scheduling for the
+        slice group — cmd/manager/main.go:90,223-225 analog)."""
+        client, mgr = world
+        ac = client.get(v1.AcceleratorClass, "tpu-v5e")
+        ac.spec.queue_name = "tpu-queue"
+        client.update(ac)
+        isvc = make_isvc(leader=v1.LeaderSpec(), worker=v1.WorkerSpec())
+        isvc.spec.accelerator_selector = v1.AcceleratorSelector(
+            accelerator_class="tpu-v5e", topology="4x4")
+        isvc.metadata.annotations[
+            constants.GANG_PRIORITY_ANNOTATION] = "high"
+        client.create(isvc)
+        reconcile(client, mgr)
+        lws = client.get(LeaderWorkerSet, "svc-engine", "default")
+        assert lws.metadata.labels[
+            constants.KUEUE_QUEUE_LABEL] == "tpu-queue"
+        assert lws.metadata.labels[
+            constants.KUEUE_PRIORITY_CLASS_LABEL] == "high"
+        for tmpl in (lws.spec.leader_worker_template.leader_template,
+                     lws.spec.leader_worker_template.worker_template):
+            assert tmpl.metadata.labels[
+                constants.KUEUE_QUEUE_LABEL] == "tpu-queue"
+            assert tmpl.spec.scheduler_name is None
+
+    def test_volcano_gang_annotations(self, world):
+        client, mgr = world
+        isvc = make_isvc(leader=v1.LeaderSpec(), worker=v1.WorkerSpec())
+        isvc.spec.accelerator_selector = v1.AcceleratorSelector(
+            accelerator_class="tpu-v5e", topology="4x4")
+        isvc.metadata.annotations.update({
+            constants.GANG_SCHEDULER_ANNOTATION: "volcano",
+            constants.GANG_QUEUE_ANNOTATION: "tpu-volcano-q"})
+        client.create(isvc)
+        reconcile(client, mgr)
+        lws = client.get(LeaderWorkerSet, "svc-engine", "default")
+        assert lws.metadata.annotations[
+            constants.VOLCANO_QUEUE_ANNOTATION] == "tpu-volcano-q"
+        for tmpl in (lws.spec.leader_worker_template.leader_template,
+                     lws.spec.leader_worker_template.worker_template):
+            assert tmpl.metadata.annotations[
+                constants.VOLCANO_GROUP_ANNOTATION] == "svc-engine-gang"
+            assert tmpl.spec.scheduler_name == "volcano"
+
+    def test_no_gang_labels_without_queue(self, world):
+        client, mgr = world
+        isvc = make_isvc(leader=v1.LeaderSpec(), worker=v1.WorkerSpec())
+        isvc.spec.accelerator_selector = v1.AcceleratorSelector(
+            accelerator_class="tpu-v5e", topology="4x4")
+        client.create(isvc)
+        reconcile(client, mgr)
+        lws = client.get(LeaderWorkerSet, "svc-engine", "default")
+        assert constants.KUEUE_QUEUE_LABEL not in lws.metadata.labels
+
     def test_istio_sidecar_stamped_when_injected(self, world):
         from ome_tpu.core.k8s import IstioSidecar
         client, mgr = world

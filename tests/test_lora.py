@@ -239,3 +239,27 @@ def test_lora_rank_cap_enforced(tmp_path):
                           lora_rank=4)
     with pytest.raises(ValueError, match="exceeds"):
         eng.register_adapter("big", a1)
+
+
+def test_unknown_adapter_fails_request_not_scheduler(tmp_path):
+    """A request naming an unloaded adapter (racing a hot unload) must
+    fail alone — the scheduler stays healthy and keeps serving."""
+    import jax
+
+    from ome_tpu.engine.core import InferenceEngine
+    from ome_tpu.engine.scheduler import Request, Scheduler
+    base = _mk_base(tmp_path)
+    params, cfg = ck.load_params(base, dtype=jnp.float32,
+                                 device_put=False)
+    params = jax.tree.map(jnp.asarray, params)
+    eng = InferenceEngine(params, cfg, max_slots=2, max_seq=32,
+                          prefill_buckets=[8], lora_slots=1)
+    sched = Scheduler(eng)
+    bad = sched.submit(Request(prompt_ids=[1, 2, 3], max_new_tokens=4,
+                               adapter="ghost"))
+    ok = sched.submit(Request(prompt_ids=[1, 2, 3], max_new_tokens=4))
+    while not (bad.done.is_set() and ok.done.is_set()):
+        sched.step()
+    assert bad.finish_reason == "error"
+    assert ok.finish_reason in ("stop", "length")
+    assert sched.healthy

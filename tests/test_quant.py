@@ -193,3 +193,31 @@ def test_qtensor_is_scan_compatible():
     np.testing.assert_allclose(
         np.asarray(total),
         np.asarray(qt.dequant(jnp.float32).sum()), rtol=1e-5)
+
+
+def test_fp8_roundtrip_and_forward():
+    """fp8 (float8_e4m3 per-channel) mode: dequant error bounded by the
+    4-bit mantissa, forward stays close to full precision, bytes match
+    int8 (model.go:262-268 fp8 analog; v6e-targeted)."""
+    from ome_tpu.models.quant import (QTensor, quantize_tensor_fp8,
+                                      quantized_bytes)
+    rng = np.random.default_rng(5)
+    w = jnp.asarray(rng.standard_normal((256, 128)), jnp.float32)
+    qt = quantize_tensor_fp8(w, (0,))
+    assert qt.q.dtype == jnp.float8_e4m3fn
+    err = np.abs(np.asarray(qt.dequant(jnp.float32)) - np.asarray(w))
+    # e4m3: 3 mantissa bits -> relative step 2^-3; scaled per channel
+    assert err.max() < np.abs(w).max() * 0.08
+
+    cfg = tiny_test().replace(dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    qp = quantize_params(params, mode="fp8")
+    toks = jnp.asarray([[1, 5, 9, 13]], jnp.int32)
+    ref, _ = llama.forward(params, cfg, toks)
+    got, _ = llama.forward(qp, cfg, toks)
+    ref_p = jax.nn.softmax(np.asarray(ref)[0, -1])
+    got_p = jax.nn.softmax(np.asarray(got)[0, -1])
+    assert np.abs(np.asarray(ref_p) - np.asarray(got_p)).max() < 0.15
+    # same byte footprint as int8 weights
+    q8 = quantize_params(params, mode="int8")
+    assert quantized_bytes(qp) == quantized_bytes(q8)

@@ -100,10 +100,13 @@ class TestEndToEnd:
             out = ask()
             assert out["usage"]["completion_tokens"] == 3
 
-            # kill one backend: requests still succeed via failover
+            # kill one backend: requests still succeed via failover.
+            # TWO asks so round-robin provably lands on the dead
+            # backend once (one ask could go straight to the healthy
+            # one and leave the failure undiscovered until the probe)
             router.backends[0].url = "http://127.0.0.1:9"  # dead port
-            out = ask()
-            assert out["usage"]["completion_tokens"] == 3
+            assert ask()["usage"]["completion_tokens"] == 3
+            assert ask()["usage"]["completion_tokens"] == 3
             assert not router.backends[0].healthy
         finally:
             rs.stop()
