@@ -21,11 +21,18 @@ Round-4 structure (measured ablations, scripts/perf_lab.py):
     (empty jit), weights+sampling floor (attention ablated), and the
     attention/KV remainder — persisted in the parsed JSON so the gap
     between quantized modes is attributable (round-3 verdict #1).
-  * the achievable-bandwidth anchor is the weights floor itself (a
-    weights-shaped stream through the real matmuls), replacing the
-    copy microbenchmark that under-read 20x on the tunnel (verdict #2);
-    vs_baseline stays spec-anchored for round-over-round comparability,
-    vs_achievable reports against the measured ceiling.
+  * round-5 (verdict #1): the floor is `floor_k` — decode_k itself
+    with ONLY the KV-cache read ablated (same unrolled layers, same
+    8-step scan, same per-layer cache planes and writes, same chained
+    dispatch loop) — so weights + attn_kv + dispatch ≈ step by
+    construction and the achievable anchor (weights bytes / floor
+    time) sits ABOVE the decode-effective bandwidth, where a credible
+    ceiling must be. Round 4's floor used a different dispatch shape
+    (stacked-layer scan, 1 step/dispatch) whose ~8 ms of host arg
+    marshaling landed in weights_ms, pushing the "floor" above the
+    full step and clamping attn_kv to 0.
+  * vs_baseline stays spec-anchored for round-over-round
+    comparability, vs_achievable reports against the measured ceiling.
   * prefill reports tokens/sec AND MFU against the chip's bf16 peak
     (verdict #3).
 """
@@ -104,7 +111,8 @@ def main() -> None:
     from ome_tpu.models import config as cfgs
     from ome_tpu.models import llama
     from ome_tpu.models.llama import (_layer, _proj, _rope_frequencies,
-                                      dense_mlp, rms_norm)
+                                      apply_rope, attention, dense_mlp,
+                                      rms_norm)
     from ome_tpu.models.quant import QTensor, quantize_params, \
         quantized_bytes
 
@@ -176,16 +184,29 @@ def main() -> None:
             body, (tok, ks, vs, index), None, length=MULTISTEP)
         return tok, ks, vs, index
 
-    @jax.jit
-    def noattn_step(p, tok):
-        """All weight matmuls + sampling, NO KV traffic: the
-        weights-shaped bandwidth floor (and the achievable anchor).
-        Scans the STACKED layer tree — per-layer arg lists would add
-        ~8 ms/dispatch of host arg marshaling (~300 buffers) and
-        swamp the measurement."""
-        x = embed(p, tok)
+    def one_step_floor(per, top, tok, ks, vs, index):
+        """`one_step` with ONLY the KV-cache attention READ ablated.
 
-        def body(x, lp):
+        Same per-layer weight projections, same RoPE, same cache-plane
+        writes, same sampling head, same carry structure — so `floor_k`
+        below compiles to the IDENTICAL dispatch shape as `decode_k`
+        (same ~300 buffers in/out, same 8-step scan, same jit-boundary
+        cache copy), and `step - floor` isolates exactly the KV-cache
+        stream + attention compute. Attention here runs over just the
+        freshly written single token (the `cache_kv=None` shape of
+        llama._mha), so q/k/v stay live and nothing is DCE'd.
+
+        Round-4 verdict #1: the old floor scanned the STACKED layer
+        tree with one step per dispatch, a different dispatch shape
+        whose ~8 ms/call of host arg-marshaling landed in `weights_ms`
+        and pushed the floor ABOVE the full step."""
+        B = tok.shape[0]
+        x = embed(top, tok)
+        freqs = _rope_frequencies(cfg)
+        positions = jnp.broadcast_to(index[None, None], (B, 1))
+        nks, nvs = [], []
+        for l in range(cfg.num_layers):
+            lp = per[l]
             h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
             q = _proj(h, lp["wq"], cfg.dtype,
                       out_dims=(cfg.num_heads, cfg.head_dim))
@@ -193,14 +214,31 @@ def main() -> None:
                       out_dims=(cfg.num_kv_heads, cfg.head_dim))
             v = _proj(h, lp["wv"], cfg.dtype,
                       out_dims=(cfg.num_kv_heads, cfg.head_dim))
-            a = _proj(q + 0 * (k.sum() + v.sum()), lp["wo"], cfg.dtype,
-                      flatten=2)
+            q = apply_rope(q, positions, freqs)
+            k = apply_rope(k, positions, freqs)
+            nks.append(lax.dynamic_update_slice(
+                ks[l], k.astype(ks[l].dtype), (0, index, 0, 0)))
+            nvs.append(lax.dynamic_update_slice(
+                vs[l], v.astype(vs[l].dtype), (0, index, 0, 0)))
+            # single-key softmax: no cache read; XLA backend — the
+            # flash-decode kernel's grid assumes a real cache length
+            attn = attention(q, k, v, backend="xla")
+            a = _proj(attn, lp["wo"], cfg.dtype, flatten=2)
             x = x + a
             h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-            return x + dense_mlp(h, lp, cfg), None
+            x = x + dense_mlp(h, lp, cfg)
+        tok = jnp.argmax(head_logits(top, x), axis=-1).astype(jnp.int32)
+        return tok, nks, nvs, index + 1
 
-        x, _ = lax.scan(body, x, p["layers"])
-        return jnp.argmax(head_logits(p, x), axis=-1).astype(jnp.int32)
+    @jax.jit
+    def floor_k(per, top, tok, ks, vs, index):
+        def body(carry, _):
+            tok, ks, vs, index = carry
+            return one_step_floor(per, top, tok, ks, vs, index), None
+
+        (tok, ks, vs, index), _ = lax.scan(
+            body, (tok, ks, vs, index), None, length=MULTISTEP)
+        return tok, ks, vs, index
 
     def mode_bytes(p) -> int:
         return quantized_bytes(p)
@@ -242,20 +280,27 @@ def main() -> None:
         step_ms = best / ((n_disp - 1) * MULTISTEP) * 1000
         tps = BATCH / (step_ms / 1000)
 
-        # weights+sampling floor: CHAINED calls (each output feeds the
-        # next input) + one sync, the same dispatch pattern as the
-        # decode loop, so the two are directly comparable
-        sync(noattn_step(p, tok))
-        wbest = float("inf")
+        # weights+sampling floor: floor_k is decode_k with only the
+        # KV-cache read ablated, measured over the SAME chained
+        # dispatch loop — floor and full step share an identical
+        # dispatch shape, so step - floor isolates attention/KV
+        fbest = float("inf")
         for _ in range(TRIALS):
-            tok2 = tok
+            tok2, cache2 = prefill(
+                p, prompt, llama.KVCache.create(cfg, BATCH, CACHE_LEN))
+            ks2 = [cache2.k[l] for l in range(cfg.num_layers)]
+            vs2 = [cache2.v[l] for l in range(cfg.num_layers)]
+            st2 = (tok2, ks2, vs2, cache2.index)
+            st2 = floor_k(per, top, *st2)  # warm/compile, not timed
+            sync(st2[0])
             t0 = time.perf_counter()
-            for _ in range(16):
-                tok2 = noattn_step(p, tok2)
-            sync(tok2)
-            wbest = min(wbest, (time.perf_counter() - t0) / 16)
-        weights_ms = max(wbest * 1000 - disp_ms, 0.0)
-        attn_ms = max(step_ms - weights_ms - disp_ms / MULTISTEP, 0.0)
+            for _ in range(n_disp - 1):
+                st2 = floor_k(per, top, *st2)
+            sync(st2[0])
+            fbest = min(fbest, time.perf_counter() - t0)
+        floor_ms = fbest / ((n_disp - 1) * MULTISTEP) * 1000
+        weights_ms = max(floor_ms - disp_ms / MULTISTEP, 0.0)
+        attn_ms = max(step_ms - floor_ms, 0.0)
         log(f"bench: [{label}] decode {steps} x batch {BATCH}: best-of-"
             f"{TRIALS} {step_ms:.2f} ms/step -> {tps:.1f} tok/s "
             f"(weights {weights_ms:.2f} + attn/kv {attn_ms:.2f} + "
@@ -311,9 +356,19 @@ def main() -> None:
     # stream through the real matmul graph, not a synthetic probe
     bw_ach = bf16_bytes / (max(bf16_w, 1e-3) / 1000) / 1e9
     kv_bytes = (cfg.num_layers * CACHE_LEN * cfg.num_kv_heads * cfg.head_dim
-                * 2 * 2)  # k+v, bf16, per sequence
-    step_bytes = bf16_bytes + BATCH * kv_bytes
-    eff_gbps = step_bytes * bf16_tps / BATCH / 1e9
+                * 2 * 2)  # k+v, bf16, per sequence, full capacity
+    # TRUE bytes moved: the flash-decode kernel DMA-clamps K/V reads to
+    # the valid rows (ops/flash.py BlockSpec index clamp), so the
+    # effective-bandwidth number uses the AVERAGE valid KV length over
+    # the timed window — not cache capacity (round-4 verdict: the
+    # anchor must sit at or above what decode itself sustains)
+    t_lo = PREFILL + MULTISTEP          # first timed step (post-warm)
+    t_hi = PREFILL + MULTISTEP * ((DECODE_STEPS - 1) // MULTISTEP)
+    avg_kv = (t_lo + t_hi) / 2
+    kv_bytes_true = kv_bytes * avg_kv / CACHE_LEN
+    step_bytes = bf16_bytes + BATCH * kv_bytes  # capacity (vs_baseline)
+    eff_gbps = (bf16_bytes + BATCH * kv_bytes_true) \
+        * bf16_tps / BATCH / 1e9
     roof_spec = bw_spec * 1e9 / step_bytes * BATCH
     roof_ach = bw_ach * 1e9 / step_bytes * BATCH
     vs = bf16_tps / roof_spec

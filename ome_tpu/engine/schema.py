@@ -3,24 +3,43 @@
 Extends the structured-output stack (engine/structured.py) from "any
 JSON value" to "a JSON value conforming to this schema". The reference
 serves this through SGLang/xgrammar's schema->grammar compiler
-(SURVEY.md L0); here the schema compiles to a tree of nodes and the
+(SURVEY.md L0); here the schema compiles to a graph of nodes and the
 automaton walks it byte-by-byte with an explicit frame stack, exposing
 the same interface as JsonAutomaton (advance / accepts / closing_bytes
 / closing_distance / is_complete), so TokenMasker works unchanged.
 
-Supported (VERDICT r3 #4 minimum and a bit more): `type` (object,
-array, string, number, integer, boolean, null — single or list),
-`properties` + `required` + `additionalProperties` (bool or schema),
-`items`, `enum` / `const` (scalar values). Unknown keywords are
-ignored; `$ref`, `anyOf`/`oneOf`, string patterns and numeric ranges
-are out of scope and raise SchemaError so the API can 400 instead of
-silently under-constraining.
+Supported: `type` (single or list), `properties` + `required` +
+`additionalProperties` (bool or schema), `items`, `enum` / `const`
+(scalar values), and — round-5 (VERDICT r4 #4) —
+  * `$ref` ("#", "#/$defs/...", any in-document JSON pointer) with
+    recursion: nodes form a cyclic graph and min-completion lengths
+    are solved as a fixpoint; schemas with NO finite value (recursion
+    without a base case) raise SchemaError;
+  * `anyOf` / `oneOf`: the automaton becomes a small NFA — each
+    deterministic stack is a thread, and entering a union value forks
+    one thread per admissible alternative (oneOf is treated as anyOf:
+    the emitted value conforms to at least one branch);
+  * `pattern` on strings: regex -> byte NFA (engine/repattern.py)
+    with precomputed distance-to-accept so the close-out path stays
+    minimal; escapes are not emitted inside pattern strings
+    (narrower, never wider);
+  * `minimum` / `maximum` / `exclusiveMinimum` / `exclusiveMaximum`
+    on INTEGER types: every digit keeps the number completable within
+    the bounds. Bounds on non-integer `number` raise SchemaError
+    (float bounds cannot be enforced byte-wise without
+    under-constraining).
+
+Unknown keywords are ignored; `allOf`, `not`, `patternProperties`,
+`if`/`then`/`else`, `multipleOf` raise SchemaError so the API can 400
+instead of silently under-constraining.
 """
 
 from __future__ import annotations
 
 import json
 from typing import Dict, List, Optional, Tuple
+
+from .repattern import PatternError, Regex
 
 WS = frozenset(b" \t\n\r")
 DIGITS = frozenset(b"0123456789")
@@ -30,8 +49,18 @@ _NUM_START = frozenset(b"-0123456789")
 _ALL_TYPES = frozenset(
     ("object", "array", "string", "number", "integer", "boolean",
      "null"))
-_UNSUPPORTED = ("$ref", "anyOf", "oneOf", "allOf", "not", "pattern",
-                "patternProperties", "if", "then", "else")
+_UNSUPPORTED = ("allOf", "not", "patternProperties", "if", "then",
+                "else", "multipleOf", "propertyNames",
+                "dependentSchemas", "unevaluatedProperties")
+_BOUND_KWS = ("minimum", "maximum", "exclusiveMinimum",
+              "exclusiveMaximum")
+_CONSTRAINT_KWS = ("type", "properties", "required",
+                   "additionalProperties", "items", "enum", "const",
+                   "pattern", "anyOf", "oneOf") + _BOUND_KWS
+_MAX_UNION = 32
+_INF = 10 ** 9
+_BIG_BOUND = 10 ** 18
+_MAX_THREADS = 256
 
 
 class SchemaError(ValueError):
@@ -39,10 +68,11 @@ class SchemaError(ValueError):
 
 
 class Node:
-    """One compiled schema node (schemas are trees — no $ref)."""
+    """One compiled schema node. Nodes form a GRAPH ($ref cycles)."""
 
     __slots__ = ("types", "enum", "enum_open_ended", "props",
-                 "required", "additional", "items", "min_len")
+                 "required", "additional", "items", "min_len", "alts",
+                 "pattern", "lo", "hi", "short_lit")
 
     def __init__(self):
         self.types = _ALL_TYPES
@@ -52,68 +82,312 @@ class Node:
         self.required: frozenset = frozenset()
         self.additional = True         # bool | Node
         self.items: Optional["Node"] = None
-        self.min_len = 0
+        self.min_len = _INF
+        self.alts: Optional[Tuple["Node", ...]] = None  # anyOf/oneOf
+        self.pattern: Optional[Regex] = None            # string only
+        self.lo: Optional[int] = None                   # integer only
+        self.hi: Optional[int] = None
+        self.short_lit = ""        # shortest in-range integer literal
 
 
 ANY = Node()
-ANY.min_len = 1  # "0"
+ANY.min_len = 1  # "0" — matches _openers' cheapest branch (r4 advisor:
+#                  the estimate and the greedy close-out must agree)
 
 
 def compile_schema(schema) -> Node:
-    if schema is True or schema == {}:
-        return ANY
-    if schema is False:
-        raise SchemaError("schema `false` accepts nothing")
-    if not isinstance(schema, dict):
-        raise SchemaError(f"schema must be an object, got "
-                          f"{type(schema).__name__}")
-    for kw in _UNSUPPORTED:
-        if kw in schema:
-            raise SchemaError(f"unsupported schema keyword {kw!r}")
-    n = Node()
-    t = schema.get("type")
-    if t is not None:
-        types = frozenset([t] if isinstance(t, str) else t)
-        bad = types - _ALL_TYPES
-        if bad:
-            raise SchemaError(f"unknown type(s) {sorted(bad)}")
-        n.types = types
-    if "const" in schema:
-        n.enum = _literals([schema["const"]])
-    elif "enum" in schema:
-        if not schema["enum"]:
-            raise SchemaError("empty enum accepts nothing")
-        n.enum = _literals(schema["enum"])
-    if n.enum is not None:
-        n.enum_open_ended = any(_open_ended(c) for c in n.enum)
-        n.min_len = min(len(c) for c in n.enum)
+    return _Compiler(schema).run()
+
+
+class _Compiler:
+    """Two-phase compile: build the (possibly cyclic) node graph, then
+    solve min-completion lengths as a decreasing fixpoint."""
+
+    def __init__(self, root_schema):
+        self.root_schema = root_schema
+        self.memo: Dict[str, Node] = {}   # $ref pointer -> node
+        self.nodes: List[Node] = []
+
+    def run(self) -> Node:
+        root = self.compile(self.root_schema)
+        self._solve_min_lens()
+        if root.min_len >= _INF:
+            raise SchemaError(
+                "schema admits no finite value (recursion without a "
+                "base case)")
+        for n in self.nodes:
+            if n.min_len >= _INF:
+                raise SchemaError(
+                    "schema contains an unsatisfiable subtree "
+                    "(unbounded recursion)")
+        return root
+
+    def _new(self) -> Node:
+        n = Node()
+        self.nodes.append(n)
         return n
-    if "properties" in schema or "required" in schema \
-            or "additionalProperties" in schema:
-        n.types = n.types & frozenset(("object",)) \
-            if t is not None else frozenset(("object",))
-        if not n.types:
-            raise SchemaError("properties on a non-object type")
-    n.props = {k.encode("utf-8"): compile_schema(v)
-               for k, v in (schema.get("properties") or {}).items()}
-    req = schema.get("required") or []
-    n.required = frozenset(k.encode("utf-8") for k in req)
-    unknown_req = n.required - set(n.props)
-    if unknown_req:
-        # required keys without declared schemas: declare them as ANY
-        for k in unknown_req:
-            n.props[k] = ANY
-    ap = schema.get("additionalProperties", True)
-    if isinstance(ap, dict):
-        n.additional = compile_schema(ap)
-    else:
-        n.additional = ANY if ap else False
-    if "items" in schema:
+
+    # -- graph construction --------------------------------------------
+
+    def compile(self, schema, depth: int = 0) -> Node:
+        if depth > 64:
+            raise SchemaError("schema nesting too deep")
+        if schema is True or schema == {}:
+            return ANY
+        if schema is False:
+            raise SchemaError("schema `false` accepts nothing")
+        if not isinstance(schema, dict):
+            raise SchemaError(f"schema must be an object, got "
+                              f"{type(schema).__name__}")
+        for kw in _UNSUPPORTED:
+            if kw in schema:
+                raise SchemaError(f"unsupported schema keyword {kw!r}")
+        if "$ref" in schema:
+            clash = [k for k in _CONSTRAINT_KWS if k in schema]
+            if clash:
+                # draft 2019+ applies siblings IN ADDITION to the ref;
+                # ignoring them would silently under-constrain
+                raise SchemaError(
+                    f"$ref combined with {clash[0]!r} is not supported")
+            return self._compile_ref(schema["$ref"], depth)
+        if "anyOf" in schema or "oneOf" in schema:
+            return self._compile_union(schema, depth)
+        n = self._new()
+        t = schema.get("type")
+        if t is not None:
+            types = frozenset([t] if isinstance(t, str) else t)
+            bad = types - _ALL_TYPES
+            if bad:
+                raise SchemaError(f"unknown type(s) {sorted(bad)}")
+            n.types = types
+        if "const" in schema or "enum" in schema:
+            clash = [k for k in _CONSTRAINT_KWS
+                     if k in schema and k not in ("const", "enum",
+                                                  "type")]
+            if clash:
+                # e.g. const 5 + minimum 10: enforcing only the enum
+                # would emit non-conforming output
+                raise SchemaError(f"enum/const combined with "
+                                  f"{clash[0]!r} is not supported")
+        if "const" in schema:
+            n.enum = _literals([schema["const"]])
+        elif "enum" in schema:
+            if not schema["enum"]:
+                raise SchemaError("empty enum accepts nothing")
+            n.enum = _literals(schema["enum"])
+        if n.enum is not None:
+            if t is not None:
+                # honor a sibling `type` by filtering candidates
+                keep = tuple(c for c in n.enum
+                             if _literal_types(c) & n.types)
+                if not keep:
+                    raise SchemaError(
+                        "enum/const has no candidate matching `type`")
+                n.enum = keep
+            n.enum_open_ended = any(_open_ended(c) for c in n.enum)
+            return n
+        return self._compile_typed(n, schema, t, depth)
+
+    def _compile_ref(self, ptr, depth: int) -> Node:
+        if not isinstance(ptr, str) or not ptr.startswith("#"):
+            raise SchemaError(
+                f"only in-document $ref is supported, got {ptr!r}")
+        if ptr in self.memo:
+            return self.memo[ptr]
+        target = self._resolve(ptr)
+        placeholder = self._new()
+        placeholder.types = frozenset()  # accept-nothing until filled
+        self.memo[ptr] = placeholder
+        real = self.compile(target, depth + 1)
+        if real is placeholder:
+            raise SchemaError(f"circular $ref {ptr!r} with no "
+                              f"intervening schema")
+        for slot in Node.__slots__:
+            setattr(placeholder, slot, getattr(real, slot))
+        placeholder.min_len = _INF  # solved by the fixpoint
+        return placeholder
+
+    def _resolve(self, ptr: str):
+        doc = self.root_schema
+        if ptr in ("#", "#/"):
+            return doc
+        if not ptr.startswith("#/"):
+            raise SchemaError(f"unsupported $ref pointer {ptr!r}")
+        for raw in ptr[2:].split("/"):
+            key = raw.replace("~1", "/").replace("~0", "~")
+            if isinstance(doc, list):
+                try:
+                    doc = doc[int(key)]
+                except (ValueError, IndexError):
+                    raise SchemaError(f"$ref {ptr!r} does not resolve")
+            elif isinstance(doc, dict) and key in doc:
+                doc = doc[key]
+            else:
+                raise SchemaError(f"$ref {ptr!r} does not resolve")
+        return doc
+
+    def _compile_union(self, schema, depth: int) -> Node:
+        kw = "anyOf" if "anyOf" in schema else "oneOf"
+        clash = [k for k in _CONSTRAINT_KWS
+                 if k in schema and k != kw]
+        if clash:
+            raise SchemaError(
+                f"{kw} combined with {clash[0]!r} is not supported")
+        subs = schema[kw]
+        if not isinstance(subs, list) or not subs:
+            raise SchemaError(f"empty {kw} accepts nothing")
+        if len(subs) > _MAX_UNION:
+            # keeps the runtime thread fan-out far below _MAX_THREADS
+            # so alternatives are never silently dropped mid-decode
+            raise SchemaError(f"{kw} with more than {_MAX_UNION} "
+                              f"alternatives is not supported")
+        n = self._new()
+        n.alts = tuple(self.compile(s, depth + 1) for s in subs)
+        return n
+
+    def _compile_typed(self, n: Node, schema, t, depth: int) -> Node:
+        has_obj = any(k in schema for k in
+                      ("properties", "required", "additionalProperties"))
+        has_arr = "items" in schema
+        has_pat = "pattern" in schema
+        has_bnd = any(k in schema for k in _BOUND_KWS)
         if t is None:
-            n.types = frozenset(("array",))
-        n.items = compile_schema(schema["items"])
-    n.min_len = _min_len(n)
-    return n
+            groups = sum((has_obj, has_arr, has_pat, has_bnd))
+            if groups > 1:
+                # e.g. properties + items with no type: refusing beats
+                # silently dropping one constraint (r4 advisor)
+                raise SchemaError(
+                    "ambiguous schema: multiple type-specific keyword "
+                    "groups without an explicit `type`")
+            if has_obj:
+                n.types = frozenset(("object",))
+            elif has_arr:
+                n.types = frozenset(("array",))
+            elif has_pat:
+                n.types = frozenset(("string",))
+            elif has_bnd:
+                n.types = frozenset(("integer",))
+        types = n.types
+        if has_obj and "object" not in types:
+            raise SchemaError("properties on a non-object type")
+        if has_arr and "array" not in types:
+            raise SchemaError("items on a non-array type")
+        if has_pat and "string" not in types:
+            raise SchemaError("pattern on a non-string type")
+        if has_bnd and ("integer" not in types or "number" in types):
+            raise SchemaError(
+                "numeric bounds are supported for `integer` only "
+                "(float bounds cannot be enforced byte-wise)")
+
+        branches: List[Node] = []
+        constrained = set()
+        if has_obj and "object" in types:
+            constrained.add("object")
+            branches.append(self._object_node(schema, depth))
+        if has_arr and "array" in types:
+            constrained.add("array")
+            b = self._new()
+            b.types = frozenset(("array",))
+            b.items = self.compile(schema["items"], depth + 1)
+            branches.append(b)
+        if has_pat and "string" in types:
+            constrained.add("string")
+            b = self._new()
+            b.types = frozenset(("string",))
+            try:
+                b.pattern = Regex(schema["pattern"])
+            except PatternError as e:
+                raise SchemaError(f"pattern: {e}") from e
+            branches.append(b)
+        if has_bnd and "integer" in types:
+            constrained.add("integer")
+            branches.append(self._bounded_int_node(schema))
+        plain = types - constrained
+        if not constrained:
+            return n  # no type-specific constraints: single plain node
+        if plain:
+            b = self._new()
+            b.types = frozenset(plain)
+            branches.append(b)
+        if len(branches) == 1:
+            # n was registered but unused; make it an alias
+            for slot in Node.__slots__:
+                setattr(n, slot, getattr(branches[0], slot))
+            return branches[0]
+        n.types = frozenset()
+        n.alts = tuple(branches)
+        return n
+
+    def _object_node(self, schema, depth: int) -> Node:
+        b = self._new()
+        b.types = frozenset(("object",))
+        b.props = {k.encode("utf-8"): self.compile(v, depth + 1)
+                   for k, v in (schema.get("properties") or {}).items()}
+        req = schema.get("required") or []
+        b.required = frozenset(k.encode("utf-8") for k in req)
+        for k in b.required - set(b.props):
+            # required keys without declared schemas: declare as ANY
+            b.props[k] = ANY
+        ap = schema.get("additionalProperties", True)
+        if isinstance(ap, dict):
+            b.additional = self.compile(ap, depth + 1)
+        else:
+            b.additional = ANY if ap else False
+        return b
+
+    def _bounded_int_node(self, schema) -> Node:
+        lo, hi = -_BIG_BOUND, _BIG_BOUND
+        if "minimum" in schema:
+            lo = _ceil_int(schema["minimum"])
+        if "maximum" in schema:
+            hi = _floor_int(schema["maximum"])
+        em = schema.get("exclusiveMinimum")
+        if em is not None:
+            if isinstance(em, bool):  # draft-4 style modifier
+                if em and "minimum" in schema:
+                    lo = _floor_int(schema["minimum"]) + 1
+            else:
+                lo = max(lo, _floor_int(em) + 1)
+        ex = schema.get("exclusiveMaximum")
+        if ex is not None:
+            if isinstance(ex, bool):
+                if ex and "maximum" in schema:
+                    hi = _ceil_int(schema["maximum"]) - 1
+            else:
+                hi = min(hi, _ceil_int(ex) - 1)
+        if abs(lo) > _BIG_BOUND or abs(hi) > _BIG_BOUND:
+            raise SchemaError("integer bounds beyond +-1e18")
+        if lo > hi:
+            raise SchemaError(f"empty integer range [{lo}, {hi}]")
+        b = self._new()
+        b.types = frozenset(("integer",))
+        b.lo, b.hi = lo, hi
+        target = 0 if lo <= 0 <= hi else (lo if lo > 0 else hi)
+        b.short_lit = str(target)
+        return b
+
+    # -- min-completion fixpoint ---------------------------------------
+
+    def _solve_min_lens(self) -> None:
+        for _ in range(len(self.nodes) + 2):
+            changed = False
+            for n in self.nodes:
+                m = _node_min(n)
+                if m < n.min_len:
+                    n.min_len = m
+                    changed = True
+            if not changed:
+                return
+
+
+def _ceil_int(v) -> int:
+    import math
+    return int(math.ceil(v))
+
+
+def _floor_int(v) -> int:
+    import math
+    return int(math.floor(v))
 
 
 def _literals(values) -> Tuple[bytes, ...]:
@@ -134,58 +408,155 @@ def _open_ended(lit: bytes) -> bool:
     return lit[:1] not in (b'"', b"t", b"f", b"n")
 
 
-def _min_len(n: Node, depth: int = 0) -> int:
-    """Length of the shortest value conforming to the node — the
-    closing-distance budget for unentered subtrees."""
-    if depth > 32:
-        return 2
-    if n.enum is not None:
-        return min(len(c) for c in n.enum)
+def _literal_types(lit: bytes) -> frozenset:
+    """JSON types an encoded literal can satisfy."""
+    c = lit[:1]
+    if c == b'"':
+        return frozenset(("string",))
+    if c in (b"t", b"f"):
+        return frozenset(("boolean",))
+    if c == b"n":
+        return frozenset(("null",))
+    if any(x in lit for x in (b".", b"e", b"E")):
+        return frozenset(("number",))
+    return frozenset(("number", "integer"))
+
+
+def _openers(n: Node) -> List[Tuple[int, int]]:
+    """(closing length, opening byte) per admissible type branch —
+    shared by min_len and the greedy close-out so the two agree."""
+    out: List[Tuple[int, int]] = []
     t = n.types
-    if "null" in t:
-        return 4
-    if "boolean" in t:
-        return 4  # true
     if "number" in t or "integer" in t:
-        return 1
+        if n.lo is not None:
+            out.append((len(n.short_lit), ord(n.short_lit[0])))
+        else:
+            out.append((1, ord("0")))
     if "string" in t:
-        return 2
+        if n.pattern is not None:
+            d = n.pattern.min_dist(n.pattern.start_set) + 2
+        else:
+            d = 2
+        out.append((d, 0x22))
     if "array" in t:
-        return 2
+        out.append((2, 0x5B))
+    if "boolean" in t:
+        out.append((4, ord("t")))
+    if "null" in t:
+        out.append((4, ord("n")))
     if "object" in t:
         total = 2
         for k in n.required:
-            kn = n.props.get(k, ANY)
-            total += len(k) + 3 + _min_len(kn, depth + 1) + 1
-        return total
-    return 2
+            total += len(k) + 4 + n.props.get(k, ANY).min_len
+        out.append((min(total, _INF), 0x7B))
+    return out
+
+
+def _node_min(n: Node) -> int:
+    if n.alts is not None:
+        return min(a.min_len for a in n.alts)
+    if n.enum is not None:
+        return min(len(c) for c in n.enum)
+    return min((length for length, _ in _openers(n)), default=_INF)
+
+
+def _min_opener(node: Node) -> int:
+    if node.alts is not None:
+        return _min_opener(min(node.alts, key=lambda a: a.min_len))
+    if node.enum is not None:
+        return min(node.enum, key=len)[0]
+    return min(_openers(node))[1]
+
+
+# -- bounded-integer byte math --------------------------------------------
+
+
+def _int_can_end(s: str, lo: int, hi: int) -> bool:
+    if s in ("", "-"):
+        return False
+    return lo <= int(s) <= hi
+
+
+def _int_completable(s: str, lo: int, hi: int) -> bool:
+    """Some digit extension (possibly none) of prefix `s` parses to an
+    integer in [lo, hi] under JSON's no-leading-zero grammar."""
+    if s == "-":
+        return lo <= 0
+    v = int(s)
+    if s in ("0", "-0"):
+        return lo <= 0 <= hi
+    neg = s.startswith("-")
+    for k in range(0, 25):
+        scale = 10 ** k
+        if neg:
+            a, b = v * scale - (scale - 1), v * scale
+        else:
+            a, b = v * scale, v * scale + (scale - 1)
+        if max(a, lo) <= min(b, hi):
+            return True
+        if (not neg and a > hi) or (neg and b < lo):
+            return False
+    return False
+
+
+def _int_shortest_tail(s: str, lo: int, hi: int) -> Optional[str]:
+    """Shortest digit suffix completing prefix `s` to an in-range
+    integer ("" when s already is one); None when impossible."""
+    if s == "-":
+        best: Optional[str] = None
+        if lo <= 0 <= hi:
+            best = "0"  # "-0" parses to 0
+        for d in "123456789":
+            tail = _int_shortest_tail("-" + d, lo, hi)
+            if tail is not None:
+                cand = d + tail
+                if best is None or len(cand) < len(best):
+                    best = cand
+        return best
+    v = int(s)
+    if s in ("0", "-0"):
+        return "" if lo <= 0 <= hi else None
+    neg = s.startswith("-")
+    for k in range(0, 25):
+        scale = 10 ** k
+        if neg:
+            a, b = v * scale - (scale - 1), v * scale
+        else:
+            a, b = v * scale, v * scale + (scale - 1)
+        lo2, hi2 = max(a, lo), min(b, hi)
+        if lo2 <= hi2:
+            tgt = hi2 if neg else lo2  # keeps repr prefix == s
+            return str(tgt)[len(s):]
+        if (not neg and a > hi) or (neg and b < lo):
+            return None
+    return None
 
 
 # -- frames ---------------------------------------------------------------
 # Every frame is an immutable tuple ("kind", ...); copy() is a list copy.
 # VAL expects a value for a node; STR/ESC/HEX/NUM/LIT mirror
-# JsonAutomaton; LITSET matches one of several literal encodings;
-# OBJ0/OBJK/KEY/KEYF/COLON/OBJE and ARR0/ARRE are the containers.
+# JsonAutomaton; LITSET matches one of several literal encodings; PSTR
+# is a pattern-constrained string; BNUM a bounds-constrained integer;
+# OBJ0/OBJK/KEY/COLON/OBJE and ARR0/ARRE are the containers.
 
 
-class SchemaAutomaton:
-    """Byte automaton accepting exactly the schema's language.
+class _Thread:
+    """One deterministic stack. anyOf/oneOf forks threads: when a
+    union value is entered, `forks` carries the surviving alternative
+    threads back to the owning SchemaAutomaton."""
 
-    Interface-compatible with structured.JsonAutomaton so TokenMasker
-    drives either. cite: reference delegates this to xgrammar inside
-    SGLang images (config/runtimes/srt/*.yaml --grammar-backend).
-    """
+    __slots__ = ("stack", "complete", "forks")
 
-    def __init__(self, schema=None, _root: Optional[Node] = None):
-        root = _root if _root is not None else compile_schema(schema)
-        self.stack: List[tuple] = [("val", root)]
-        self.complete = False
+    def __init__(self, stack, complete=False):
+        self.stack: List[tuple] = stack
+        self.complete = complete
+        self.forks: Optional[List["_Thread"]] = None
 
-    def copy(self) -> "SchemaAutomaton":
-        a = SchemaAutomaton.__new__(SchemaAutomaton)
-        a.stack = list(self.stack)
-        a.complete = self.complete
-        return a
+    def copy(self) -> "_Thread":
+        return _Thread(list(self.stack), self.complete)
+
+    def key(self):
+        return (tuple(self.stack), self.complete)
 
     # -- helpers -------------------------------------------------------
 
@@ -210,6 +581,16 @@ class SchemaAutomaton:
 
     def _adv_val(self, frame, b: int) -> bool:
         node: Node = frame[1]
+        if node.alts is not None:
+            forks: List[_Thread] = []
+            for alt in node.alts:
+                c = self.copy()
+                c.stack[-1] = ("val", alt)
+                if c.advance(b):
+                    forks.extend(c.forks if c.forks else [c])
+                    c.forks = None
+            self.forks = forks
+            return bool(forks)
         if b in WS:
             return True
         if node.enum is not None:
@@ -226,7 +607,21 @@ class SchemaAutomaton:
             self.stack[-1] = ("arr0", node.items or ANY)
             return True
         if b == 0x22 and "string" in t:
-            self.stack[-1] = ("str",)
+            if node.pattern is not None:
+                self.stack[-1] = ("pstr", node.pattern,
+                                  node.pattern.start_set)
+            else:
+                self.stack[-1] = ("str",)
+            return True
+        if b in _NUM_START and node.lo is not None and "integer" in t:
+            s = chr(b)
+            if b == ord("-"):
+                ok = node.lo <= 0
+            else:
+                ok = _int_completable(s, node.lo, node.hi)
+            if not ok:
+                return False
+            self.stack[-1] = ("bnum", node, s)
             return True
         if b in _NUM_START and ("number" in t or "integer" in t):
             int_only = "number" not in t
@@ -295,6 +690,22 @@ class SchemaAutomaton:
             return True
         return False
 
+    def _adv_pstr(self, frame, b: int) -> bool:
+        _, rx, states = frame
+        if b == 0x22:
+            if rx.accepting(states):
+                self.stack.pop()
+                self._value_done()
+                return True
+            return False
+        if b == 0x5C:
+            return False  # no escapes inside pattern strings
+        ns = rx.advance(states, b)
+        if not ns:
+            return False
+        self.stack[-1] = ("pstr", rx, ns)
+        return True
+
     def _adv_lit(self, frame, b: int) -> bool:
         rest: bytes = frame[1]
         if rest and b == rest[0]:
@@ -356,6 +767,20 @@ class SchemaAutomaton:
     def _num_can_end(self, frame) -> bool:
         return frame[1] in ("int", "int-first", "int-zero", "frac",
                             "exp")
+
+    def _adv_bnum(self, frame, b: int) -> bool:
+        _, node, s = frame
+        if b in DIGITS and s not in ("0", "-0"):
+            ns = s + chr(b)
+            if _int_completable(ns, node.lo, node.hi):
+                self.stack[-1] = ("bnum", node, ns)
+                return True
+            # fall through: maybe b ends the number at a delimiter? no
+            # — a digit is never a delimiter
+            return False
+        if _int_can_end(s, node.lo, node.hi):
+            return self._pop_and_redispatch(b)
+        return False
 
     # -- object frames -------------------------------------------------
 
@@ -471,7 +896,7 @@ class SchemaAutomaton:
             return True
         return False
 
-    # -- queries (TokenMasker interface) -------------------------------
+    # -- queries -------------------------------------------------------
 
     def is_complete(self) -> bool:
         if self.complete and not self.stack:
@@ -480,17 +905,12 @@ class SchemaAutomaton:
             f = self.stack[0]
             if f[0] == "num" and self._num_can_end(f):
                 return True
+            if f[0] == "bnum" and _int_can_end(f[2], f[1].lo, f[1].hi):
+                return True
             if f[0] == "litset" and any(
                     len(c) == f[2] and _open_ended(c) for c in f[1]):
                 return True
         return False
-
-    def accepts(self, data: bytes) -> bool:
-        a = self.copy()
-        for b in data:
-            if not a.advance(b):
-                return False
-        return True
 
     def closing_bytes(self) -> frozenset:
         """Bytes on a minimal completion path from this state."""
@@ -508,10 +928,7 @@ class SchemaAutomaton:
             _, cands, pos = frame
             done = [c for c in cands if len(c) == pos]
             if done:
-                a = self.copy()
-                a.stack.pop()
-                a._value_done()
-                return a.closing_bytes()
+                return self._popped_closing()
             best = min((c for c in cands if len(c) > pos), key=len)
             return frozenset((best[pos],))
         if kind == "str":
@@ -520,15 +937,25 @@ class SchemaAutomaton:
             return frozenset(b'"\\/bfnrt')
         if kind == "hex":
             return frozenset(b"0123456789abcdef")
+        if kind == "pstr":
+            _, rx, states = frame
+            if rx.accepting(states):
+                return frozenset((0x22,))
+            return frozenset((rx.closing_byte(states),))
         if kind == "lit":
             return frozenset((frame[1][0],))
         if kind == "num":
             if self._num_can_end(frame):
-                a = self.copy()
-                a.stack.pop()
-                a._value_done()
-                return a.closing_bytes()
+                return self._popped_closing()
             return frozenset(b"0123456789")
+        if kind == "bnum":
+            _, node, s = frame
+            tail = _int_shortest_tail(s, node.lo, node.hi)
+            if tail == "":
+                return self._popped_closing()
+            if tail is None:  # unreachable: advance() keeps s viable
+                return frozenset(b"0123456789")
+            return frozenset((ord(tail[0]),))
         if kind in ("obj0", "objk"):
             _, node, seen = frame
             missing = node.required - seen
@@ -561,12 +988,11 @@ class SchemaAutomaton:
             return frozenset((0x5D,))
         return frozenset()
 
-    def accepts_closing(self, data: bytes) -> bool:
-        a = self.copy()
-        for b in data:
-            if b not in a.closing_bytes() or not a.advance(b):
-                return False
-        return True
+    def _popped_closing(self) -> frozenset:
+        c = self.copy()
+        c.stack.pop()
+        c._value_done()
+        return c.closing_bytes()
 
     def closing_distance(self) -> int:
         n = 0
@@ -581,10 +1007,17 @@ class SchemaAutomaton:
                 n += 3
             elif kind == "hex":
                 n += 5
+            elif kind == "pstr":
+                _, rx, states = frame
+                n += rx.min_dist(states) + 1
             elif kind == "lit":
                 n += len(frame[1])
             elif kind == "num":
                 n += 2
+            elif kind == "bnum":
+                _, node, s = frame
+                tail = _int_shortest_tail(s, node.lo, node.hi)
+                n += len(tail) if tail is not None else 2
             elif kind in ("obj0", "objk", "obje"):
                 _, node, seen = frame
                 n += 1  # closing '}'
@@ -641,16 +1074,76 @@ class SchemaAutomaton:
         return min(opts, default=4)
 
 
-def _min_opener(node: Node) -> int:
-    t = node.types
-    if "null" in t:
-        return ord("n")
-    if "boolean" in t:
-        return ord("t")
-    if "number" in t or "integer" in t:
-        return ord("0")
-    if "string" in t:
-        return 0x22
-    if "array" in t:
-        return 0x5B
-    return 0x7B
+class SchemaAutomaton:
+    """Byte automaton accepting exactly the schema's language.
+
+    Interface-compatible with structured.JsonAutomaton so TokenMasker
+    drives either. Internally an NFA of deterministic `_Thread`s:
+    anyOf/oneOf values fork threads, each byte advances all of them,
+    and queries aggregate (any complete / min closing distance / the
+    best thread's closing path). cite: reference delegates all of this
+    to xgrammar inside SGLang images (config/runtimes/srt/*.yaml
+    --grammar-backend).
+    """
+
+    def __init__(self, schema=None, _root: Optional[Node] = None):
+        root = _root if _root is not None else compile_schema(schema)
+        self.threads: List[_Thread] = [_Thread([("val", root)])]
+
+    def copy(self) -> "SchemaAutomaton":
+        a = SchemaAutomaton.__new__(SchemaAutomaton)
+        a.threads = [t.copy() for t in self.threads]
+        return a
+
+    def advance(self, b: int) -> bool:
+        survivors: List[_Thread] = []
+        seen = set()
+        for t in self.threads:
+            c = t.copy()
+            if c.advance(b):
+                for s in (c.forks if c.forks else [c]):
+                    k = s.key()
+                    if k not in seen:
+                        seen.add(k)
+                        survivors.append(s)
+                c.forks = None
+        if not survivors:
+            return False
+        if len(survivors) > _MAX_THREADS:
+            # only reachable via deeply NESTED unions (single unions
+            # are capped at _MAX_UNION alternatives at compile time);
+            # dropping the tail narrows the emittable language but
+            # never widens it — log so it's not silent
+            import logging
+            logging.getLogger(__name__).warning(
+                "schema NFA exceeded %d threads; pruning alternatives",
+                _MAX_THREADS)
+            survivors = survivors[:_MAX_THREADS]
+        self.threads = survivors
+        return True
+
+    def is_complete(self) -> bool:
+        return any(t.is_complete() for t in self.threads)
+
+    def accepts(self, data: bytes) -> bool:
+        a = self.copy()
+        for b in data:
+            if not a.advance(b):
+                return False
+        return True
+
+    def _best_thread(self) -> _Thread:
+        return min(self.threads, key=lambda t: t.closing_distance())
+
+    def closing_bytes(self) -> frozenset:
+        return self._best_thread().closing_bytes()
+
+    def accepts_closing(self, data: bytes) -> bool:
+        a = self.copy()
+        for b in data:
+            if b not in a.closing_bytes() or not a.advance(b):
+                return False
+        return True
+
+    def closing_distance(self) -> int:
+        return min(t.closing_distance() for t in self.threads)
