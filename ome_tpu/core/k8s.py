@@ -381,6 +381,76 @@ class RoleBinding(Resource):
     subjects: list = field(default_factory=list)
 
 
+@dataclass
+class ClusterRole(Resource):
+    KIND: ClassVar[str] = "ClusterRole"
+    API_VERSION: ClassVar[str] = "rbac.authorization.k8s.io/v1"
+    NAMESPACED: ClassVar[bool] = False
+    rules: list = field(default_factory=list)
+
+
+@dataclass
+class ClusterRoleBinding(Resource):
+    KIND: ClassVar[str] = "ClusterRoleBinding"
+    API_VERSION: ClassVar[str] = "rbac.authorization.k8s.io/v1"
+    NAMESPACED: ClassVar[bool] = False
+    role_ref: dict = field(default_factory=dict)
+    subjects: list = field(default_factory=list)
+
+
+# --------------------------------------------------------------------------
+# cluster scaffolding + admission registration (chart-installed stack)
+
+
+@dataclass
+class Namespace(Resource):
+    KIND: ClassVar[str] = "Namespace"
+    API_VERSION: ClassVar[str] = "v1"
+    NAMESPACED: ClassVar[bool] = False
+
+
+@dataclass
+class DaemonSet(Resource):
+    KIND: ClassVar[str] = "DaemonSet"
+    API_VERSION: ClassVar[str] = "apps/v1"
+    spec: dict = field(default_factory=dict)
+    status: dict = field(default_factory=dict)
+
+
+@dataclass
+class MutatingWebhookConfiguration(Resource):
+    KIND: ClassVar[str] = "MutatingWebhookConfiguration"
+    API_VERSION: ClassVar[str] = "admissionregistration.k8s.io/v1"
+    NAMESPACED: ClassVar[bool] = False
+    webhooks: list = field(default_factory=list)
+
+
+@dataclass
+class ValidatingWebhookConfiguration(Resource):
+    KIND: ClassVar[str] = "ValidatingWebhookConfiguration"
+    API_VERSION: ClassVar[str] = "admissionregistration.k8s.io/v1"
+    NAMESPACED: ClassVar[bool] = False
+    webhooks: list = field(default_factory=list)
+
+
+@dataclass
+class Certificate(Resource):
+    """cert-manager.io Certificate (webhook serving cert)."""
+
+    KIND: ClassVar[str] = "Certificate"
+    API_VERSION: ClassVar[str] = "cert-manager.io/v1"
+    spec: dict = field(default_factory=dict)
+    status: dict = field(default_factory=dict)
+
+
+@dataclass
+class Issuer(Resource):
+    KIND: ClassVar[str] = "Issuer"
+    API_VERSION: ClassVar[str] = "cert-manager.io/v1"
+    spec: dict = field(default_factory=dict)
+    status: dict = field(default_factory=dict)
+
+
 # --------------------------------------------------------------------------
 # coordination.k8s.io (leader election)
 

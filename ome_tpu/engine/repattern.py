@@ -220,6 +220,32 @@ class _Parser:
         return ("cls", cls)
 
 
+def _toplevel_alternation(pat: str) -> bool:
+    """True when an unescaped '|' sits at group-depth 0 outside a
+    character class."""
+    depth = 0
+    in_class = False
+    i = 0
+    while i < len(pat):
+        c = pat[i]
+        if c == "\\":
+            i += 2
+            continue
+        if in_class:
+            if c == "]":
+                in_class = False
+        elif c == "[":
+            in_class = True
+        elif c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+        elif c == "|" and depth == 0:
+            return True
+        i += 1
+    return False
+
+
 class Regex:
     """Compiled byte NFA with per-state shortest-distance-to-accept.
 
@@ -233,6 +259,13 @@ class Regex:
         pat = pattern
         anchored_l = pat.startswith("^")
         anchored_r = pat.endswith("$") and not pat.endswith("\\$")
+        if (anchored_l or anchored_r) and _toplevel_alternation(pat):
+            # '^a|b$' means (^a)|(b$) under regex precedence; stripping
+            # the anchors here would silently compile '^(a|b)$' — a
+            # narrower language. Refuse instead of under-serving.
+            raise PatternError(
+                "anchors with a top-level alternation are ambiguous; "
+                "group the alternation: ^(?:a|b)$")
         if anchored_l:
             pat = pat[1:]
         if anchored_r:

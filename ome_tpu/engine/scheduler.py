@@ -22,6 +22,7 @@ ops must be published from one thread in execution order.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import queue
 import threading
@@ -97,6 +98,11 @@ class Scheduler:
         self._top_k = np.zeros(B, np.int32)
         self._top_p = np.ones(B, np.float32)
         self._true_len = np.zeros(B, np.int32)  # admitted prompt len/slot
+        # paged-KV backpressure: requests bounced by KVPoolExhausted
+        # and preempted mid-stream sequences re-enter HERE, ahead of
+        # new arrivals (their generated tokens ride along as prompt)
+        self._requeue: "collections.deque[Request]" = \
+            collections.deque()
         self._thread: Optional[threading.Thread] = None
         self._admit_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -106,6 +112,7 @@ class Scheduler:
             "requests_total": 0, "tokens_generated_total": 0,
             "prefill_total": 0, "decode_steps_total": 0,
             "queue_depth": 0, "active_slots": 0,
+            "preemptions_total": 0,
         }
 
     def _inc(self, key: str, by: float = 1):
@@ -148,8 +155,21 @@ class Scheduler:
             self._admit_thread.join(timeout=10)
         self._fail_all("shutdown")
 
+    def _next_pending(self) -> Request:
+        """Requeued (bounced / preempted) requests go first; raises
+        queue.Empty like pending.get_nowait()."""
+        try:
+            return self._requeue.popleft()
+        except IndexError:
+            return self.pending.get_nowait()
+
     def _fail_all(self, reason: str):
         with self._lock:
+            while True:
+                try:
+                    self._requeue.popleft().finish(reason)
+                except IndexError:
+                    break
             while True:
                 try:
                     self.pending.get_nowait().finish(reason)
@@ -165,6 +185,9 @@ class Scheduler:
             for slot, r in enumerate(self.slots):
                 if r is not None:
                     self.slots[slot] = None
+                    free = getattr(self.engine, "free_slot", None)
+                    if free is not None:
+                        free(slot)
                     r.finish(reason)
                     if self.overlap:
                         self._free_slots.release()
@@ -202,8 +225,15 @@ class Scheduler:
             if not self._free_slots.acquire(timeout=0.05):
                 continue
             try:
-                req = self.pending.get(timeout=0.05)
-            except queue.Empty:
+                req = self._requeue.popleft()
+            except IndexError:
+                try:
+                    req = self.pending.get(timeout=0.05)
+                except queue.Empty:
+                    self._free_slots.release()
+                    continue
+            if not self._fits_pool(req):
+                req.finish("error")
                 self._free_slots.release()
                 continue
             try:
@@ -264,7 +294,14 @@ class Scheduler:
                 self.state = self.engine.insert(
                     self.state, kv, slot, true_len, tok, bucket, **ikw)
             except Exception as e:  # noqa: BLE001
-                from .core import UnknownAdapterError
+                from .core import KVPoolExhausted, UnknownAdapterError
+                if isinstance(e, KVPoolExhausted):
+                    # paged-KV backpressure: requeue until running
+                    # streams free blocks (prefilled KV is dropped —
+                    # the request re-prefills on its next turn)
+                    self._requeue.appendleft(req)
+                    self._free_slots.release()
+                    continue
                 if isinstance(e, UnknownAdapterError):
                     # adapter hot-unloaded between prefill and insert:
                     # this request fails, the node stays up
@@ -293,9 +330,12 @@ class Scheduler:
             if limit is not None and admitted >= limit:
                 break
             try:
-                req = self.pending.get_nowait()
+                req = self._next_pending()
             except queue.Empty:
                 break
+            if not self._fits_pool(req):
+                req.finish("error")
+                continue
             try:
                 tok, kv, true_len, bucket = self._prefill_req(req)
                 ikw = {} if req.adapter is None \
@@ -303,7 +343,12 @@ class Scheduler:
                 self.state = self.engine.insert(
                     self.state, kv, slot, true_len, tok, bucket, **ikw)
             except Exception as e:
-                from .core import UnknownAdapterError
+                from .core import KVPoolExhausted, UnknownAdapterError
+                if isinstance(e, KVPoolExhausted):
+                    # paged-KV backpressure: retry next step, after
+                    # running streams have freed blocks
+                    self._requeue.appendleft(req)
+                    break
                 if isinstance(e, UnknownAdapterError):
                     # racing a hot adapter unload fails ONE request
                     req.finish("error")
@@ -340,6 +385,23 @@ class Scheduler:
             self.state, toks = self.engine.decode(
                 self.state, self._temp, self._top_k, self._top_p)
         self._inc("decode_steps_total")
+        # paged-KV pool pressure may have evicted sequences BEFORE this
+        # step ran — their sampled token is garbage (their new KV row
+        # went to the trash block), so requeue them without emitting:
+        # generated-so-far tokens ride along as prompt and decoding
+        # resumes after a re-prefill (vLLM recompute preemption)
+        take = getattr(self.engine, "take_preempted", None)
+        for slot in (take() if take is not None else ()):
+            req = self.slots[slot]
+            if req is None:
+                continue
+            self.slots[slot] = None
+            self._temp[slot] = 0.0
+            req.prompt_ids = list(req.prompt_ids) + list(req.output_ids)
+            self._requeue.appendleft(req)
+            self._inc("preemptions_total")
+            if self.overlap:
+                self._free_slots.release()
         host_toks = np.asarray(toks)
         for slot, req in enumerate(self.slots):
             if req is None:
@@ -349,6 +411,18 @@ class Scheduler:
             self._inc("tokens_generated_total")
             self._maybe_finish(slot, tok)
         return True
+
+    def _fits_pool(self, req: Request) -> bool:
+        """Paged KV only: a request whose worst-case footprint exceeds
+        the whole pool can never finish — preempting it would livelock
+        (it is always its own cheapest victim), so reject upfront."""
+        kvb = getattr(self.engine, "kv_block", 0)
+        if not kvb:
+            return True
+        usable = (self.engine.kv_blocks - 1) * kvb
+        worst = min(min(len(req.prompt_ids), self.engine.max_seq)
+                    + req.max_new_tokens + 1, self.engine.max_seq)
+        return worst <= usable
 
     def _prefill_req(self, req: Request):
         """Engine prefill for one request; constrained requests pass
@@ -403,6 +477,9 @@ class Scheduler:
             return
         self.slots[slot] = None
         self._temp[slot] = 0.0
+        free = getattr(self.engine, "free_slot", None)
+        if free is not None:  # paged engines reclaim the KV blocks
+            free(slot)
         req.finish(reason)
         if self.overlap:
             self._free_slots.release()

@@ -460,9 +460,13 @@ def _node_min(n: Node) -> int:
     return min((length for length, _ in _openers(n)), default=_INF)
 
 
-def _min_opener(node: Node) -> int:
+def _min_opener(node: Node, _seen=None) -> int:
     if node.alts is not None:
-        return _min_opener(min(node.alts, key=lambda a: a.min_len))
+        seen = _seen if _seen is not None else set()
+        seen.add(id(node))
+        cands = [a for a in node.alts if id(a) not in seen]
+        best = min(cands, key=lambda a: a.min_len)
+        return _min_opener(best, seen)
     if node.enum is not None:
         return min(node.enum, key=len)[0]
     return min(_openers(node))[1]
@@ -579,16 +583,30 @@ class _Thread:
         handler = getattr(self, "_adv_" + kind)
         return handler(frame, b)
 
-    def _adv_val(self, frame, b: int) -> bool:
+    def _adv_val(self, frame, b: int, _seen=None) -> bool:
         node: Node = frame[1]
         if node.alts is not None:
+            # `_seen` guards epsilon cycles: a $ref loop that passes
+            # only through anyOf/oneOf (X = null | X) adds no language
+            # beyond its acyclic branches, so a union node already
+            # being expanded for THIS byte is skipped — by the union
+            # fixpoint this is exact, not an approximation
+            seen = _seen if _seen is not None else set()
+            if id(node) in seen:
+                return False
+            seen.add(id(node))
             forks: List[_Thread] = []
             for alt in node.alts:
                 c = self.copy()
                 c.stack[-1] = ("val", alt)
-                if c.advance(b):
+                if alt.alts is not None:
+                    ok = c._adv_val(c.stack[-1], b, seen)
+                else:
+                    ok = c.advance(b)
+                if ok:
                     forks.extend(c.forks if c.forks else [c])
                     c.forks = None
+            seen.discard(id(node))
             self.forks = forks
             return bool(forks)
         if b in WS:

@@ -67,6 +67,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "prefix KV cache (0 disables); prompts sharing "
                         "cached leading token blocks prefill only "
                         "their suffix")
+    p.add_argument("--kv-block", type=int, default=0,
+                   help="paged KV cache block size in tokens (0 = "
+                        "dense per-slot cache); pool-allocated HBM "
+                        "sized by tokens in flight, not "
+                        "slots x max-seq (GQA models)")
+    p.add_argument("--kv-blocks", type=int, default=None,
+                   help="paged KV pool size in blocks (default: "
+                        "dense-equivalent capacity)")
     p.add_argument("--control-port", type=int, default=None,
                    help="leader->follower op-replication port for "
                         "multi-host serving (default: engine/multihost "
@@ -187,7 +195,9 @@ def load_engine(args, dist=None):
                              max_seq=max_seq,
                              prefix_cache_bytes=args.prefix_cache_mb << 20,
                              lora_slots=lora_slots,
-                             lora_rank=args.lora_rank)
+                             lora_rank=args.lora_rank,
+                             kv_block=args.kv_block,
+                             kv_blocks=args.kv_blocks)
     for name, path in named_adapters.items():
         engine.register_adapter(name, path)
         log.info("registered LoRA adapter %r from %s", name, path)

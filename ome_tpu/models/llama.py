@@ -99,6 +99,40 @@ class KVCache:
                    index=jnp.zeros((), jnp.int32))
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVCache:
+    """Block-pool KV cache for the serving engine's paged decode.
+
+    k, v: [L, N, block, K, Dh] POOLS of N fixed-size blocks shared by
+    every decode slot; `table`: [B, M] int32 block table mapping each
+    slot's sequence block j to a pool block id (0 is the reserved
+    trash block — unallocated entries point there and kv_len masking
+    makes it unreachable for reads); `index`: [B] per-slot lengths.
+    HBM is sized by total tokens in flight (N * block) instead of
+    B * S_max — the vLLM/SGLang PagedAttention idea with TPU-static
+    shapes (ops/paged.py).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    index: jax.Array
+    table: jax.Array
+
+    @classmethod
+    def create(cls, cfg: ModelConfig, batch: int, n_blocks: int,
+               block: int, max_blocks: int,
+               dtype=None) -> "PagedKVCache":
+        dtype = dtype or cfg.dtype
+        K, Dk, Dv = (cfg.kv_cache_heads, cfg.kv_cache_k_dim,
+                     cfg.kv_cache_v_dim)
+        L = cfg.num_layers
+        return cls(k=jnp.zeros((L, n_blocks, block, K, Dk), dtype),
+                   v=jnp.zeros((L, n_blocks, block, K, Dv), dtype),
+                   index=jnp.zeros((batch,), jnp.int32),
+                   table=jnp.zeros((batch, max_blocks), jnp.int32))
+
+
 # -- init ------------------------------------------------------------------
 
 
@@ -468,10 +502,11 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
     return x + mlp_out, new_cache
 
 
-def _mha(h: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
-         positions: jax.Array, kv_len, cache_kv, cache_index, window,
-         uo: bool, adapter_ids: Optional[jax.Array] = None):
-    """Standard multi-head (GQA) attention on the pre-normed input."""
+def _qkv(h: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
+         positions: jax.Array, uo: bool,
+         adapter_ids: Optional[jax.Array] = None):
+    """Projected + biased + normed + roped q/k/v — shared between the
+    dense (_mha) and paged (forward_paged) attention paths."""
     q = _proj_lora(h, lp, "wq", adapter_ids, cfg.dtype,
                    out_dims=(cfg.num_heads, cfg.head_dim))
     k = _proj_lora(h, lp, "wk", adapter_ids, cfg.dtype,
@@ -487,6 +522,14 @@ def _mha(h: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps, uo)
     q = apply_rope(q, positions, freqs)
     k = apply_rope(k, positions, freqs)
+    return q, k, v
+
+
+def _mha(h: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
+         positions: jax.Array, kv_len, cache_kv, cache_index, window,
+         uo: bool, adapter_ids: Optional[jax.Array] = None):
+    """Standard multi-head (GQA) attention on the pre-normed input."""
+    q, k, v = _qkv(h, lp, cfg, freqs, positions, uo, adapter_ids)
 
     if cache_kv is not None:
         ck, cv = cache_kv
@@ -586,6 +629,75 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
         else:
             new_cache = None
 
+    return _final_logits(params, cfg, x), new_cache
+
+
+def forward_paged(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                  cache: PagedKVCache,
+                  adapter_ids: Optional[jax.Array] = None,
+                  ) -> Tuple[jax.Array, PagedKVCache]:
+    """Single-token decode over a paged (block-pool) KV cache.
+
+    tokens: [B, 1]. Each slot writes its new K/V row into pool block
+    `table[b, index[b] // block]` at offset `index[b] % block`, then
+    attends over its block chain (ops/paged.py). Standard GQA models
+    only — MLA, MoE, and sliding-window variants keep the dense path
+    (the engine guards). cite: vLLM PagedAttention, which the
+    reference consumes via its SGLang/vLLM runtimes (SURVEY.md L0,
+    /root/reference/config/runtimes/srt/*); here it is in-repo and
+    TPU-static.
+    """
+    from ..ops.paged import paged_attention
+    B, S = tokens.shape
+    assert S == 1, "forward_paged is decode-only"
+    bs = cache.k.shape[2]
+    M = cache.table.shape[1]
+    positions = cache.index[:, None]
+    kv_len = cache.index + 1
+    emb = params["embed"]
+    x = emb.take(tokens, cfg.dtype) if isinstance(emb, QTensor) \
+        else jnp.take(emb, tokens, axis=0).astype(cfg.dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)
+    freqs = _rope_frequencies(cfg)
+    uo = cfg.unit_offset_norm
+    rows = jnp.arange(B)
+    # clamp keeps a finished slot whose length outgrew its table row
+    # in-bounds; its row points at the trash block by then
+    blk = cache.table[rows, jnp.minimum(cache.index // bs, M - 1)]
+    off = cache.index % bs
+
+    def body(x, per):
+        lp, kp, vp = per
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, uo)
+        q, k, v = _qkv(h, lp, cfg, freqs, positions, uo, adapter_ids)
+        kp = kp.at[blk, off].set(k[:, 0].astype(kp.dtype))
+        vp = vp.at[blk, off].set(v[:, 0].astype(vp.dtype))
+        attn = paged_attention(q, kp, vp, cache.table, kv_len,
+                               scale=cfg.query_scale,
+                               logit_softcap=cfg.attn_logit_softcap)
+        a = _proj_lora(attn, lp, "wo", adapter_ids, cfg.dtype,
+                       flatten=2)
+        if cfg.post_block_norms:
+            a = rms_norm(a, lp["attn_post_norm"], cfg.rms_norm_eps, uo)
+        x = x + a
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, uo)
+        mlp_out = dense_mlp(h, lp, cfg, adapter_ids)
+        if cfg.post_block_norms:
+            mlp_out = rms_norm(mlp_out, lp["mlp_post_norm"],
+                               cfg.rms_norm_eps, uo)
+        return x + mlp_out, (kp, vp)
+
+    x, (nk, nv) = lax.scan(body, x,
+                           (params["layers"], cache.k, cache.v))
+    new_cache = PagedKVCache(k=nk, v=nv, index=cache.index + 1,
+                             table=cache.table)
+    return _final_logits(params, cfg, x), new_cache
+
+
+def _final_logits(params: Params, cfg: ModelConfig,
+                  x: jax.Array) -> jax.Array:
+    """Final norm + LM head — shared by forward and forward_paged."""
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
                  cfg.unit_offset_norm)
     head = params.get("lm_head")
@@ -600,7 +712,7 @@ def forward(params: Params, cfg: ModelConfig, tokens: jax.Array,
     if cfg.final_logit_softcap:
         logits = jnp.tanh(logits / cfg.final_logit_softcap) \
             * cfg.final_logit_softcap
-    return logits, new_cache
+    return logits
 
 
 def _alt_window_scan(params: Params, cfg: ModelConfig, x: jax.Array,

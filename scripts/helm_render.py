@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Minimal helm-template renderer for the chart tests.
+
+The environment has no `helm` binary, so the rendered-manifest test
+(tests/test_charts.py) renders the charts with this renderer instead.
+It supports exactly the template subset the repo's charts use —
+`{{ .Values.a.b }}` substitution, `{{- if <path> }} ... {{- end }}`
+blocks (nested), and `| toYaml | nindent N` — and rejects anything
+else, so chart authors stay inside the verified subset. Operators use
+real helm; this is the test harness's stand-in.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+from typing import Any
+
+import yaml
+
+_IF = re.compile(r"^\{\{-?\s*if\s+(.+?)\s*-?\}\}$")
+_WITH = re.compile(r"^\{\{-?\s*with\s+(.+?)\s*-?\}\}$")
+_END = re.compile(r"^\{\{-?\s*end\s*-?\}\}$")
+_EXPR = re.compile(r"\{\{-?\s*(.+?)\s*-?\}\}")
+
+
+def _resolve(path: str, values: dict, dot: Any = None) -> Any:
+    if path == ".":
+        return dot
+    if not path.startswith(".Values"):
+        raise ValueError(f"unsupported template reference {path!r}")
+    cur: Any = values
+    for part in path.split(".")[2:]:
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _eval(expr: str, values: dict, dot: Any = None) -> str:
+    parts = [p.strip() for p in expr.split("|")]
+    val = _resolve(parts[0], values, dot)
+    for fn in parts[1:]:
+        if fn == "toYaml":
+            val = yaml.safe_dump(val, default_flow_style=False).rstrip()
+        elif fn.startswith("nindent"):
+            n = int(fn.split()[1])
+            pad = " " * n
+            val = "\n" + "\n".join(
+                pad + line for line in str(val).splitlines())
+        else:
+            raise ValueError(f"unsupported template function {fn!r}")
+    if val is None:
+        raise ValueError(f"template path {parts[0]!r} not in values")
+    if isinstance(val, bool):
+        return "true" if val else "false"
+    return str(val)
+
+
+def render(text: str, values: dict) -> str:
+    out = []
+    stack = [(True, None)]  # (emitting, dot-context)
+    for line in text.splitlines():
+        s = line.strip()
+        m = _IF.match(s)
+        if m:
+            emit, dot = stack[-1]
+            cond = m.group(1)
+            if cond.startswith("or "):
+                truth = any(bool(_resolve(p, values, dot))
+                            for p in cond[3:].split())
+            else:
+                truth = bool(_resolve(cond, values, dot))
+            stack.append((emit and truth, dot))
+            continue
+        m = _WITH.match(s)
+        if m:
+            emit, dot = stack[-1]
+            val = _resolve(m.group(1), values, dot)
+            stack.append((emit and bool(val), val))
+            continue
+        if _END.match(s):
+            if len(stack) == 1:
+                raise ValueError("unbalanced {{ end }}")
+            stack.pop()
+            continue
+        emit, dot = stack[-1]
+        if not emit:
+            continue
+        out.append(_EXPR.sub(
+            lambda m: _eval(m.group(1), values, dot), line))
+    if len(stack) != 1:
+        raise ValueError("unbalanced {{ if }}")
+    return "\n".join(out) + "\n"
+
+
+def render_chart(chart_dir: str | Path) -> list:
+    """Render every template with the chart's default values; returns
+    the parsed (non-empty) manifest documents."""
+    chart = Path(chart_dir)
+    values = yaml.safe_load((chart / "values.yaml").read_text()) \
+        if (chart / "values.yaml").exists() else {}
+    docs = []
+    for tpl in sorted((chart / "templates").rglob("*.yaml")):
+        rendered = render(tpl.read_text(), values or {})
+        for doc in yaml.safe_load_all(rendered):
+            if doc:
+                docs.append(doc)
+    return docs
+
+
+if __name__ == "__main__":
+    for d in render_chart(sys.argv[1]):
+        print("---")
+        print(yaml.safe_dump(d, default_flow_style=False))

@@ -1,0 +1,208 @@
+"""Paged (block) KV cache: ops/paged.py + engine integration.
+
+The round-4 verdict's #2 structural item: the dense decode cache
+allocates worst-case [L, B, Smax, K, D] HBM per slot; the paged pool
+allocates by tokens in flight. These tests pin:
+
+  * numerics: the XLA paged path is exactly the dense computation on
+    gathered blocks; the Pallas kernel (interpret mode) agrees within
+    the platform's reduced-precision matmul noise;
+  * the engine serves TOKEN-IDENTICAL outputs dense vs paged across
+    mixed lengths, slot reuse, and block-boundary growth;
+  * 2x the slot count fits the SAME cache HBM budget with mixed-length
+    sequences (the capacity win);
+  * pool exhaustion fails fast with a sizing hint;
+  * structured outputs ride the paged masked-decode program.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ome_tpu.engine.core import InferenceEngine
+from ome_tpu.engine.scheduler import Request, Scheduler
+from ome_tpu.engine.tokenizer import ByteTokenizer
+from ome_tpu.models import llama
+from ome_tpu.models.config import tiny_test
+from ome_tpu.ops.attention import attention
+from ome_tpu.ops.paged import paged_attention_xla, paged_flash_decode
+
+CFG = tiny_test().replace(dtype=jnp.float32, max_seq_len=128)
+
+
+def _pool(rng, B, H, K, D, bs, M, N):
+    q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((N, bs, K, D)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((N, bs, K, D)), jnp.float32)
+    ids = rng.permutation(N)[:B * M].reshape(B, M)
+    return q, kp, vp, jnp.asarray(ids, jnp.int32)
+
+
+class TestPagedAttentionNumerics:
+    def test_xla_matches_dense_gather(self):
+        rng = np.random.default_rng(0)
+        B, H, K, D, bs, M, N = 4, 16, 8, 128, 128, 4, 32
+        q, kp, vp, table = _pool(rng, B, H, K, D, bs, M, N)
+        kv_len = jnp.asarray([5, 128, 200, 512], jnp.int32)
+        out = paged_attention_xla(q, kp, vp, table, kv_len)
+        kg = jnp.take(kp, table, axis=0).reshape(B, M * bs, K, D)
+        vg = jnp.take(vp, table, axis=0).reshape(B, M * bs, K, D)
+        ref = attention(q, kg, vg, positions=(kv_len - 1)[:, None],
+                        kv_len=kv_len, backend="xla")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6)
+
+    def test_pallas_kernel_matches_xla(self):
+        rng = np.random.default_rng(1)
+        B, H, K, D, bs, M, N = 4, 16, 8, 128, 128, 4, 32
+        q, kp, vp, table = _pool(rng, B, H, K, D, bs, M, N)
+        kv_len = jnp.asarray([1, 100, 256, 512], jnp.int32)
+        out = paged_flash_decode(q, kp, vp, table, kv_len,
+                                 interpret=True)
+        ref = paged_attention_xla(q, kp, vp, table, kv_len)
+        # platform note: this CPU build's default f32 matmul is
+        # reduced-precision, so block partitioning differences show up
+        # at ~1e-2 — the same kernels on TPU agree with XLA at bf16
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-2)
+
+    def test_kernel_uncovered_shapes_return_none(self):
+        rng = np.random.default_rng(2)
+        q, kp, vp, table = _pool(rng, 2, 4, 2, 64, 16, 2, 8)
+        assert paged_flash_decode(
+            q, kp, vp, table, jnp.asarray([3, 9], jnp.int32),
+            interpret=True) is None
+
+
+def _run(engine, prompts, max_new=24, temperature=0.0, maskers=None):
+    tok = ByteTokenizer()
+    sched = Scheduler(engine)
+    reqs = []
+    for i, p in enumerate(prompts):
+        kw = {}
+        if maskers:
+            kw["masker"] = maskers[i]
+        reqs.append(sched.submit(Request(
+            prompt_ids=tok.encode(p), max_new_tokens=max_new,
+            temperature=temperature, stop_ids=[tok.eos_id], **kw)))
+    while not all(r.done.is_set() for r in reqs):
+        sched.step()
+    return [r.output_ids for r in reqs]
+
+
+PROMPTS = ["hello world", "a", "the quick brown fox jumps over",
+           "xyzzy plugh abc", "short", "another prompt here",
+           "yet more text", "z"]
+
+
+def test_paged_tokens_identical_to_dense():
+    """Greedy tokens byte-exact vs the dense path, incl. slot reuse
+    (8 requests through 4 slots) and growth across block boundaries
+    (24 new tokens cross the 16-token block repeatedly)."""
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    dense = InferenceEngine(params, CFG, max_slots=4,
+                            prefill_buckets=[16, 32])
+    paged = InferenceEngine(params, CFG, max_slots=4,
+                            prefill_buckets=[16, 32], kv_block=16)
+    out_d = _run(dense, PROMPTS)
+    out_p = _run(paged, PROMPTS)
+    assert out_d == out_p
+    # every block returned to the pool after the last request
+    assert paged.kv_pool_stats["kv_blocks_free"] == \
+        paged.kv_blocks - 1
+
+
+def test_double_slots_same_hbm_budget():
+    """The capacity win: dense 4 slots x 128 rows = 512 cache rows;
+    the paged pool with the SAME 512-row budget serves 8 slots of
+    mixed-length sequences concurrently."""
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    rows_budget = 4 * CFG.max_seq_len  # dense HBM budget, in rows
+    paged = InferenceEngine(params, CFG, max_slots=8,
+                            prefill_buckets=[16, 32], kv_block=16,
+                            kv_blocks=rows_budget // 16 + 1)
+    k_bytes = paged.new_state().k.nbytes
+    dense_bytes = InferenceEngine(
+        params, CFG, max_slots=4,
+        prefill_buckets=[16, 32]).new_state().k.nbytes
+    assert k_bytes <= dense_bytes + paged.kv_block * 16 * 1024
+    out = _run(paged, PROMPTS, max_new=20)  # 8 concurrent slots
+    assert all(len(o) == 20 for o in out)
+
+
+def test_pool_pressure_preempts_and_recovers():
+    """An undersized pool (tokens in flight < sum of worst cases) is a
+    NORMAL condition: requests are requeued / preempted with their
+    progress carried as prompt, and all finish — no node outage."""
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    # each request worst-case: ~16 prompt + 25 new + 1 = 42 rows = 3
+    # blocks; pool of 4 usable blocks fits ONE such stream at a time
+    paged = InferenceEngine(params, CFG, max_slots=4,
+                            prefill_buckets=[16], kv_block=16,
+                            kv_blocks=5)
+    tok = ByteTokenizer()
+    sched = Scheduler(paged)
+    reqs = [sched.submit(Request(prompt_ids=tok.encode(p)[:16],
+                                 max_new_tokens=25, temperature=0.0,
+                                 stop_ids=[tok.eos_id]))
+            for p in PROMPTS[:4]]
+    for _ in range(2000):
+        if all(r.done.is_set() for r in reqs):
+            break
+        sched.step()
+    assert all(r.done.is_set() for r in reqs)
+    assert all(len(r.output_ids) == 25 for r in reqs), \
+        [len(r.output_ids) for r in reqs]
+    assert all(r.finish_reason in ("stop", "length") for r in reqs)
+    # pool fully reclaimed
+    assert paged.kv_pool_stats["kv_blocks_free"] == paged.kv_blocks - 1
+
+
+def test_impossible_request_rejected_upfront():
+    """A request whose worst case exceeds the whole pool would
+    livelock (always its own cheapest victim): reject at admission."""
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    paged = InferenceEngine(params, CFG, max_slots=2,
+                            prefill_buckets=[16], kv_block=16,
+                            kv_blocks=3)  # 2 usable blocks = 32 rows
+    tok = ByteTokenizer()
+    sched = Scheduler(paged)
+    req = sched.submit(Request(prompt_ids=tok.encode("hi"),
+                               max_new_tokens=100, temperature=0.0,
+                               stop_ids=[tok.eos_id]))
+    for _ in range(50):
+        if req.done.is_set():
+            break
+        sched.step()
+    assert req.done.is_set()
+    assert req.finish_reason == "error"
+
+
+def test_paged_structured_outputs():
+    """The masked decode program has a paged variant: a schema-
+    constrained request over the paged engine emits conforming JSON."""
+    from ome_tpu.engine.schema import SchemaAutomaton
+    from ome_tpu.engine.structured import TokenMasker
+    cfg = tiny_test().replace(dtype=jnp.float32, max_seq_len=160)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    paged = InferenceEngine(params, cfg, max_slots=2,
+                            prefill_buckets=[16], kv_block=16)
+    tok = ByteTokenizer()
+    schema = {"type": "object",
+              "properties": {"n": {"type": "integer"}},
+              "required": ["n"], "additionalProperties": False}
+    out = _run(paged, ["emit json"], max_new=40, temperature=0.9,
+               maskers=[TokenMasker(tok,
+                                    automaton=SchemaAutomaton(schema))])
+    obj = json.loads(tok.decode(out[0]))
+    assert isinstance(obj["n"], int)
+
+
+def test_paged_rejects_unsupported_models():
+    cfg = tiny_test().replace(dtype=jnp.float32, sliding_window=8)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    with pytest.raises(ValueError, match="paged KV"):
+        InferenceEngine(params, cfg, max_slots=2, kv_block=16)
