@@ -347,11 +347,15 @@ def main() -> None:
         f"{q8_bytes/1e9:.2f} GB weights) | int4 {int4_tps:.1f} tok/s "
         f"({100*int4_tps/bf16_tps-100:+.0f}%, {q4_bytes/1e9:.2f} GB)")
 
-    # -- paged-KV decode, batch 64 (serving engine --kv-block path) -----
-    # Pool sized to the same rows as dense batch-64 (capacity parity);
-    # the paged win is structural (slots scale with tokens in flight,
-    # tests/test_paged_kv.py) — this line shows its throughput at 2x
-    # the headline batch with block-table attention (r4 verdict #2).
+    # -- paged-KV decode, batch 64 (r4 verdict #2 bench line) -----------
+    # Measures the paged KERNEL PATH (ops/paged.py block-table
+    # attention + pool scatter) in this bench's unrolled+multistep
+    # harness — the shape that amortizes the tunnel dispatch — at 2x
+    # the headline batch. The serving engine's compiled program
+    # (llama.forward_paged: scan over layers, token-exactness in
+    # tests/test_paged_kv.py) shares the kernels but not the unroll;
+    # this number bounds what that program reaches as its dispatch
+    # amortization improves. Pool sized to dense-equivalent rows.
     def bench_paged(p) -> float:
         from ome_tpu.ops.paged import paged_attention
         PB, bs = 64, 128

@@ -215,10 +215,13 @@ class InferenceEngine:
         self.kv_block = int(kv_block)
         if self.kv_block:
             if (cfg.mla or cfg.is_moe or cfg.first_k_dense
-                    or cfg.sliding_window or cfg.alt_sliding_window):
+                    or cfg.sliding_window or cfg.alt_sliding_window
+                    or cfg.norm_type != "rmsnorm" or cfg.parallel_block
+                    or cfg.attn_sinks):
                 raise ValueError(
-                    "paged KV supports standard GQA models; MLA/MoE/"
-                    "sliding-window models use the dense cache")
+                    "paged KV supports standard rmsnorm GQA models; "
+                    "MLA/MoE/sliding-window/parallel-block/layernorm/"
+                    "sink models use the dense cache")
             if jax.devices()[0].platform == "tpu" and (
                     self.kv_block % 128 or cfg.head_dim % 128
                     or cfg.num_heads < 8):

@@ -98,6 +98,9 @@ class Scheduler:
         self._top_k = np.zeros(B, np.int32)
         self._top_p = np.ones(B, np.float32)
         self._true_len = np.zeros(B, np.int32)  # admitted prompt len/slot
+        # outputs already present at admission (preempted resumes):
+        # capacity accounting must not count them twice
+        self._base_out = np.zeros(B, np.int64)
         # paged-KV backpressure: requests bounced by KVPoolExhausted
         # and preempted mid-stream sequences re-enter HERE, ahead of
         # new arrivals (their generated tokens ride along as prompt)
@@ -236,6 +239,12 @@ class Scheduler:
                 req.finish("error")
                 self._free_slots.release()
                 continue
+            if not self._pool_ready(req):
+                # saturated pool: back off instead of re-prefilling
+                self._requeue.appendleft(req)
+                self._free_slots.release()
+                time.sleep(0.01)
+                continue
             try:
                 tok, kv, true_len, bucket = self._prefill_req(req)
             except Exception as e:  # noqa: BLE001
@@ -316,6 +325,7 @@ class Scheduler:
             self._top_k[slot] = req.top_k
             self._top_p[slot] = req.top_p
             self._true_len[slot] = true_len
+            self._base_out[slot] = len(req.output_ids)
             req.emit(tok)
             self._maybe_finish(slot, tok)
             did = True
@@ -336,6 +346,11 @@ class Scheduler:
             if not self._fits_pool(req):
                 req.finish("error")
                 continue
+            if not self._pool_ready(req):
+                # pool saturated: retry next step WITHOUT burning a
+                # prefill forward that insert would just bounce
+                self._requeue.appendleft(req)
+                break
             try:
                 tok, kv, true_len, bucket = self._prefill_req(req)
                 ikw = {} if req.adapter is None \
@@ -366,6 +381,7 @@ class Scheduler:
             self._top_k[slot] = req.top_k
             self._top_p[slot] = req.top_p
             self._true_len[slot] = true_len
+            self._base_out[slot] = len(req.output_ids)
             self._inc("prefill_total")
             req.emit(tok)
             self._maybe_finish(slot, tok)
@@ -415,14 +431,31 @@ class Scheduler:
     def _fits_pool(self, req: Request) -> bool:
         """Paged KV only: a request whose worst-case footprint exceeds
         the whole pool can never finish — preempting it would livelock
-        (it is always its own cheapest victim), so reject upfront."""
+        (it is always its own cheapest victim), so reject upfront.
+        A preempted request's generated tokens already moved into
+        prompt_ids, so the remaining-output term shrinks by what was
+        produced (no double count)."""
         kvb = getattr(self.engine, "kv_block", 0)
         if not kvb:
             return True
         usable = (self.engine.kv_blocks - 1) * kvb
+        remaining = max(req.max_new_tokens - len(req.output_ids), 0)
         worst = min(min(len(req.prompt_ids), self.engine.max_seq)
-                    + req.max_new_tokens + 1, self.engine.max_seq)
+                    + remaining + 1, self.engine.max_seq)
         return worst <= usable
+
+    def _pool_ready(self, req: Request) -> bool:
+        """Cheap pre-prefill check: enough free blocks for this
+        request's PROMPT — avoids re-running a full prefill forward on
+        every retry while the pool is saturated (the insert would just
+        bounce with KVPoolExhausted again)."""
+        kvb = getattr(self.engine, "kv_block", 0)
+        if not kvb:
+            return True
+        need = min(-(-(min(len(req.prompt_ids), self.engine.max_seq)
+                       + 1) // kvb), self.engine.max_blocks)
+        stats = self.engine.kv_pool_stats
+        return stats["kv_blocks_free"] >= need
 
     def _prefill_req(self, req: Request):
         """Engine prefill for one request; constrained requests pass
@@ -468,10 +501,13 @@ class Scheduler:
             reason = "stop"
         elif len(req.output_ids) >= req.max_new_tokens:
             reason = "length"
-        elif (int(self._true_len[slot]) + len(req.output_ids)
+        elif (int(self._true_len[slot])
+              + len(req.output_ids) - int(self._base_out[slot])
               >= self.engine.max_seq):
             # cache capacity: the slot was admitted with the (possibly
-            # truncated) true_len rows, +1 row per generated token
+            # truncated) true_len rows, +1 row per token generated
+            # SINCE admission (a resumed request's earlier outputs are
+            # already inside true_len)
             reason = "length"
         else:
             return

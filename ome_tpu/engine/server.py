@@ -190,7 +190,11 @@ class EngineServer:
                 else:
                     prompt = payload.get("prompt", "")
                     if isinstance(prompt, list):
-                        prompt = "".join(prompt)
+                        if prompt and isinstance(prompt[0], int):
+                            # OpenAI allows pre-tokenized prompts
+                            prompt = list(map(int, prompt))
+                        else:
+                            prompt = "".join(prompt)
                 masker = None
                 rf = payload.get("response_format") or {}
                 if rf:
@@ -250,7 +254,8 @@ class EngineServer:
                                      "adapters: " + ", ".join(names)
                                      + ")"})
                 req = Request(
-                    prompt_ids=tok.encode(prompt),
+                    prompt_ids=prompt if isinstance(prompt, list)
+                    else tok.encode(prompt),
                     max_new_tokens=int(payload.get("max_tokens", 64)),
                     temperature=float(payload.get("temperature", 0.0)),
                     top_k=int(payload.get("top_k", 0)),

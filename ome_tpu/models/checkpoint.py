@@ -219,7 +219,11 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
         else:
             st, i = st_main, li - n_dense
         layer_is_moe = cfg.is_moe and li >= n_dense
+        layernorm = getattr(cfg, "norm_type", "rmsnorm") == "layernorm"
         st.put("attn_norm", i, take(p + "input_layernorm.weight"))
+        if layernorm:  # phimoe: torch LayerNorm biases ride along
+            st.put("attn_norm_bias", i,
+                   take(p + "input_layernorm.bias"))
         if getattr(cfg, "post_block_norms", False):
             # gemma2 block: post_attention_layernorm normalizes the
             # attention OUTPUT (pre-residual); the MLP pre-norm is
@@ -230,9 +234,14 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
                    take(p + "pre_feedforward_layernorm.weight"))
             st.put("mlp_post_norm", i,
                    take(p + "post_feedforward_layernorm.weight"))
+        elif getattr(cfg, "parallel_block", False):
+            pass  # command-r: one shared input norm feeds attn AND mlp
         else:
             st.put("mlp_norm", i,
                    take(p + "post_attention_layernorm.weight"))
+            if layernorm:
+                st.put("mlp_norm_bias", i,
+                       take(p + "post_attention_layernorm.bias"))
         if mla:
             qk = cfg.qk_nope_head_dim + cfg.qk_rope_head_dim
             r, vd = cfg.kv_lora_rank, cfg.v_head_dim
@@ -261,6 +270,15 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
             st.put("wo", i,
                    take(p + "self_attn.o_proj.weight").T.reshape(
                        H, vd, D))
+        elif p + "self_attn.qkv_proj.weight" in ckpt:
+            # phi3: fused qkv — rows are [H*Dh | K*Dh | K*Dh]
+            qkv = take(p + "self_attn.qkv_proj.weight")
+            st.put("wq", i, qkv[:H * Dh].T.reshape(D, H, Dh))
+            st.put("wk", i,
+                   qkv[H * Dh:(H + K) * Dh].T.reshape(D, K, Dh))
+            st.put("wv", i, qkv[(H + K) * Dh:].T.reshape(D, K, Dh))
+            st.put("wo", i,
+                   take(p + "self_attn.o_proj.weight").T.reshape(H, Dh, D))
         else:
             st.put("wq", i,
                    take(p + "self_attn.q_proj.weight").T.reshape(D, H, Dh))
@@ -277,10 +295,31 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
                    take(p + "self_attn.k_proj.bias").reshape(K, Dh))
             st.put("bv", i,
                    take(p + "self_attn.v_proj.bias").reshape(K, Dh))
+            if p + "self_attn.o_proj.bias" in ckpt:
+                st.put("bo", i, take(p + "self_attn.o_proj.bias"))
+        if getattr(cfg, "attn_sinks", False):
+            st.put("sinks", i, take(p + "self_attn.sinks"),
+                   dtype=np.dtype(np.float32))
         if cfg.qk_norm:
             st.put("q_norm", i, take(p + "self_attn.q_norm.weight"))
             st.put("k_norm", i, take(p + "self_attn.k_norm.weight"))
-        if layer_is_moe:
+        if layer_is_moe and p + "mlp.experts.gate_up_proj" in ckpt:
+            # gpt_oss: fused per-expert parameters, stored [in, out]
+            # already (bmm layout); gate/up are INTERLEAVED on the
+            # last dim, router is a biased linear
+            st.put("router", i, linear_in_out(p + "mlp.router.weight"))
+            st.put("router_b", i, take(p + "mlp.router.bias"),
+                   dtype=np.dtype(np.float32))
+            gu = take(p + "mlp.experts.gate_up_proj")    # [E, D, 2I]
+            st.put("we_gate", i, gu[..., ::2])
+            st.put("we_up", i, gu[..., 1::2])
+            gub = take(p + "mlp.experts.gate_up_proj_bias")  # [E, 2I]
+            st.put("we_gate_b", i, gub[..., ::2])
+            st.put("we_up_b", i, gub[..., 1::2])
+            st.put("we_down", i, take(p + "mlp.experts.down_proj"))
+            st.put("we_down_b", i,
+                   take(p + "mlp.experts.down_proj_bias"))
+        elif layer_is_moe:
             # router: mixtral block_sparse_moe.gate / qwen-moe+deepseek
             # mlp.gate
             for rn in ("block_sparse_moe.gate.weight", "mlp.gate.weight"):
@@ -321,6 +360,13 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
                         st.put("ws_down", i,
                                linear_in_out(p + sn + "down_proj.weight"))
                         break
+        elif p + "mlp.gate_up_proj.weight" in ckpt:
+            # phi3: fused gate|up rows (Phi3MLP chunks in halves)
+            guw = take(p + "mlp.gate_up_proj.weight")
+            half = guw.shape[0] // 2
+            st.put("w_gate", i, guw[:half].T)
+            st.put("w_up", i, guw[half:].T)
+            st.put("w_down", i, linear_in_out(p + "mlp.down_proj.weight"))
         else:
             st.put("w_gate", i, linear_in_out(p + "mlp.gate_proj.weight"))
             st.put("w_up", i, linear_in_out(p + "mlp.up_proj.weight"))
@@ -331,6 +377,8 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
         "final_norm": take("model.norm.weight").astype(np_dt),
         "layers": st_main.out,
     }
+    if getattr(cfg, "norm_type", "rmsnorm") == "layernorm":
+        params["final_norm_bias"] = take("model.norm.bias").astype(np_dt)
     if st_dense is not None:
         params["dense_layers"] = st_dense.out
     if not cfg.tie_word_embeddings:
@@ -339,6 +387,8 @@ def convert_llama(ckpt: Checkpoint, cfg, dtype=None) -> Dict[str, Any]:
                 "lm_head.weight").astype(np_dt)
         # some checkpoints omit lm_head despite tie=False in config:
         # fall back to tied embeddings (forward() handles the absence)
+    if getattr(cfg, "lm_head_bias", False) and "lm_head.bias" in ckpt:
+        params["lm_head_bias"] = take("lm_head.bias").astype(np.float32)
     return params
 
 
@@ -352,6 +402,11 @@ SUPPORTED_ARCHITECTURES = frozenset({
     # MLA family (models/mla.py): DeepSeek-V2/V3; Kimi-K2 ships the
     # DeepseekV3ForCausalLM architecture
     "DeepseekV2ForCausalLM", "DeepseekV3ForCausalLM",
+    # round 5 (r4 verdict #5): phi3 (fused qkv/gate_up), Phi-3.5-MoE
+    # (LayerNorm + sparsemixer), command-r (parallel block, interleaved
+    # rope, logit scale), gpt-oss (sinks, clamped-GLU biased experts)
+    "Phi3ForCausalLM", "PhimoeForCausalLM", "PhiMoEForCausalLM",
+    "CohereForCausalLM", "GptOssForCausalLM",
     # decoder embedding models (engine/embed.py): bare AutoModel
     # checkpoints whose tensors lack the "model." prefix
     "MistralModel", "Qwen2Model", "Qwen3Model",

@@ -265,6 +265,32 @@ def rms_norm(x: jax.Array, scale: jax.Array, eps: float,
     return (y * w).astype(x.dtype)
 
 
+def layer_norm(x: jax.Array, scale: jax.Array,
+               bias: Optional[jax.Array], eps: float) -> jax.Array:
+    """Mean-centered LayerNorm in fp32. bias=None is the command-r
+    (CohereLayerNorm) weight-only form; with bias it is torch
+    LayerNorm (phimoe)."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def block_norm(x: jax.Array, lp: Params, name: str,
+               cfg: ModelConfig) -> jax.Array:
+    """Per-block norm dispatched on cfg.norm_type; layernorm biases
+    ride as `name`_bias leaves."""
+    if cfg.norm_type == "rmsnorm":
+        return rms_norm(x, lp[name], cfg.rms_norm_eps,
+                        cfg.unit_offset_norm)
+    bias = lp.get(name + "_bias") if cfg.norm_type == "layernorm" \
+        else None
+    return layer_norm(x, lp[name], bias, cfg.rms_norm_eps)
+
+
 def _rope_frequencies(cfg: ModelConfig) -> jax.Array:
     half = cfg.head_dim // 2
     freqs = 1.0 / cfg.rope_theta ** (jnp.arange(half, dtype=jnp.float32) / half)
@@ -286,13 +312,23 @@ def _rope_frequencies(cfg: ModelConfig) -> jax.Array:
     return freqs
 
 
-def apply_rope(x: jax.Array, positions: jax.Array, freqs: jax.Array) -> jax.Array:
-    """Rotate-half RoPE (HF Llama convention). x: [B, S, N, Dh]."""
+def apply_rope(x: jax.Array, positions: jax.Array, freqs: jax.Array,
+               interleaved: bool = False) -> jax.Array:
+    """RoPE. x: [B, S, N, Dh]. Default is rotate-half (HF Llama
+    convention); `interleaved` pairs even/odd dims (command-r's
+    repeat_interleave convention)."""
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, half]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    xf = x.astype(jnp.float32)
+    if interleaved:
+        x1, x2 = xf[..., ::2], xf[..., 1::2]
+        out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                        axis=-1).reshape(x.shape)
+    else:
+        x1, x2 = jnp.split(xf, 2, axis=-1)
+        out = jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
 
 
@@ -364,8 +400,13 @@ def _route(x: jax.Array, p: Params, cfg: ModelConfig):
                                p["router"]).astype(jnp.float32)
     k = cfg.experts_per_token
     if cfg.router_scoring == "mixtral":
+        if cfg.moe_bias and "router_b" in p:
+            # gpt_oss router: logits carry a bias BEFORE selection
+            router_logits = router_logits + p["router_b"]
         weights, idx = lax.top_k(router_logits, k)
         return jax.nn.softmax(weights, axis=-1), idx  # [B,S,k] x2
+    if cfg.router_scoring == "sparsemixer":
+        return _route_sparsemixer(router_logits, cfg)
     if cfg.router_scoring == "sigmoid_v3":
         scores = jax.nn.sigmoid(router_logits)
         choice = scores + p["router_bias"] if "router_bias" in p \
@@ -397,6 +438,45 @@ def _route(x: jax.Array, p: Params, cfg: ModelConfig):
     return weights * cfg.routed_scaling_factor, idx
 
 
+def _route_sparsemixer(scores: jax.Array, cfg: ModelConfig):
+    """Phi-3.5-MoE inference-time sparsemixer (PhimoeSparseMoeBlock):
+    top-1 twice with a jitter-eps sparsity mask; each multiplier is
+    the pick's softmax weight over ITS masked logits (not normalized
+    across the two picks)."""
+    eps = cfg.router_jitter
+
+    def pick(masked_from: jax.Array):
+        # threshold mask uses the ORIGINAL scores in the numerator and
+        # |scores| clamped to the candidate max as the denominator
+        m = jnp.max(masked_from, axis=-1, keepdims=True)
+        idx = jnp.argmax(masked_from, axis=-1)
+        factor = jnp.maximum(jnp.abs(scores), m)
+        drop = (m - scores) / factor > 2 * eps
+        masked = jnp.where(drop, -jnp.inf, masked_from)
+        gates = jax.nn.softmax(masked, axis=-1)
+        w = jnp.take_along_axis(gates, idx[..., None], -1)[..., 0]
+        return w, idx
+
+    w1, i1 = pick(scores)
+    masked_scores = jnp.where(
+        jax.nn.one_hot(i1, scores.shape[-1], dtype=bool), -jnp.inf,
+        scores)
+    w2, i2 = pick(masked_scores)
+    return (jnp.stack([w1, w2], axis=-1),
+            jnp.stack([i1, i2], axis=-1).astype(jnp.int32))
+
+
+def _moe_act(gate: jax.Array, up: jax.Array,
+             cfg: ModelConfig) -> jax.Array:
+    if cfg.moe_activation == "gptoss_glu":
+        # GptOssExperts: clamped GLU — gate capped at +limit, up at
+        # +-limit, glu = gate * sigmoid(1.702 * gate), out = (up+1)*glu
+        gate = jnp.clip(gate, None, 7.0)
+        up = jnp.clip(up, -7.0, 7.0)
+        return (up + 1.0) * (gate * jax.nn.sigmoid(gate * 1.702))
+    return _activate(gate, cfg) * up
+
+
 def moe_mlp_dense(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     """Top-k MoE computing EVERY expert and mixing by router weight.
 
@@ -406,8 +486,15 @@ def moe_mlp_dense(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     weights, idx = _route(x, p, cfg)
     gate = jnp.einsum("bsd,edf->bsef", x, _w(p, "we_gate", cfg.dtype))
     up = jnp.einsum("bsd,edf->bsef", x, _w(p, "we_up", cfg.dtype))
-    expert_out = jnp.einsum("bsef,efd->bsed", jax.nn.silu(gate) * up,
+    if cfg.moe_bias:
+        gate = gate + p["we_gate_b"]
+        up = up + p["we_up_b"]
+    h = _moe_act(gate, up, cfg)
+    expert_out = jnp.einsum("bsef,efd->bsed", h,
                             _w(p, "we_down", cfg.dtype))  # [B,S,E,D]
+    if cfg.moe_bias:
+        # gpt_oss scales (out + down_bias) by the routing weight
+        expert_out = expert_out + p["we_down_b"][None, None]
     onehot = jax.nn.one_hot(idx, cfg.num_experts, dtype=weights.dtype)  # [B,S,k,E]
     mix = jnp.einsum("bske,bsk->bse", onehot, weights)  # [B,S,E]
     return jnp.einsum("bsed,bse->bsd", expert_out,
@@ -436,8 +523,15 @@ def moe_mlp_ragged(x: jax.Array, p: Params, cfg: ModelConfig) -> jax.Array:
     group_sizes = jnp.bincount(expert_ids, length=E).astype(jnp.int32)
     gate = lax.ragged_dot(xs, _w(p, "we_gate", cfg.dtype), group_sizes)
     up = lax.ragged_dot(xs, _w(p, "we_up", cfg.dtype), group_sizes)
-    h = jax.nn.silu(gate) * up  # same dtype flow as the dense path
+    if cfg.moe_bias:
+        gate = gate + jnp.take(p["we_gate_b"], expert_ids[order],
+                               axis=0)
+        up = up + jnp.take(p["we_up_b"], expert_ids[order], axis=0)
+    h = _moe_act(gate, up, cfg)  # same dtype flow as the dense path
     out_sorted = lax.ragged_dot(h, _w(p, "we_down", cfg.dtype), group_sizes)  # [T*k, D]
+    if cfg.moe_bias:
+        out_sorted = out_sorted + jnp.take(p["we_down_b"],
+                                           expert_ids[order], axis=0)
     w_sorted = jnp.take(weights.reshape(T * k), order, axis=0)
     contrib = out_sorted * w_sorted[:, None].astype(out_sorted.dtype)
     out = jnp.zeros((T, D), contrib.dtype).at[token_of].add(contrib)
@@ -479,7 +573,7 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
     if window is _WINDOW_FROM_CFG:
         window = cfg.sliding_window
     uo = cfg.unit_offset_norm
-    h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, uo)
+    h = block_norm(x, lp, "attn_norm", cfg)
     if cfg.mla:
         from .mla import mla_attention
         a, new_cache = mla_attention(h, lp, cfg, positions, kv_len,
@@ -488,12 +582,18 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
         a, new_cache = _mha(h, lp, cfg, freqs, positions, kv_len,
                             cache_kv, cache_index, window, uo,
                             adapter_ids)
+    use_moe = cfg.is_moe if moe is None else moe
+    if cfg.parallel_block:
+        # command-r: attention and MLP both read the SAME normed
+        # input and add into one residual (CohereDecoderLayer)
+        mlp_out = moe_mlp(h, lp, cfg) if use_moe \
+            else dense_mlp(h, lp, cfg, adapter_ids)
+        return x + a + mlp_out, new_cache
     if cfg.post_block_norms:
         a = rms_norm(a, lp["attn_post_norm"], cfg.rms_norm_eps, uo)
     x = x + a
 
-    h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps, uo)
-    use_moe = cfg.is_moe if moe is None else moe
+    h = block_norm(x, lp, "mlp_norm", cfg)
     mlp_out = moe_mlp(h, lp, cfg) if use_moe \
         else dense_mlp(h, lp, cfg, adapter_ids)
     if cfg.post_block_norms:
@@ -518,10 +618,15 @@ def _qkv(h: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
         k = k + lp["bk"]
         v = v + lp["bv"]
     if cfg.qk_norm:
-        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps, uo)
-        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps, uo)
-    q = apply_rope(q, positions, freqs)
-    k = apply_rope(k, positions, freqs)
+        if cfg.norm_type == "layernorm_nobias":
+            # command-r-plus: per-(head, dim) weighted LayerNorm
+            q = layer_norm(q, lp["q_norm"], None, cfg.rms_norm_eps)
+            k = layer_norm(k, lp["k_norm"], None, cfg.rms_norm_eps)
+        else:
+            q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps, uo)
+            k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps, uo)
+    q = apply_rope(q, positions, freqs, cfg.rope_interleaved)
+    k = apply_rope(k, positions, freqs, cfg.rope_interleaved)
     return q, k, v
 
 
@@ -554,8 +659,11 @@ def _mha(h: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
 
     attn = attention(q, k_full, v_full, positions=positions, kv_len=kv_len,
                      sliding_window=window, scale=cfg.query_scale,
-                     logit_softcap=cfg.attn_logit_softcap)
+                     logit_softcap=cfg.attn_logit_softcap,
+                     sinks=lp.get("sinks") if cfg.attn_sinks else None)
     a = _proj_lora(attn, lp, "wo", adapter_ids, cfg.dtype, flatten=2)
+    if "bo" in lp:  # phimoe/gpt_oss: o_proj carries a bias too
+        a = a + lp["bo"]
     return a, new_cache
 
 
@@ -698,8 +806,7 @@ def forward_paged(params: Params, cfg: ModelConfig, tokens: jax.Array,
 def _final_logits(params: Params, cfg: ModelConfig,
                   x: jax.Array) -> jax.Array:
     """Final norm + LM head — shared by forward and forward_paged."""
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
-                 cfg.unit_offset_norm)
+    x = block_norm(x, params, "final_norm", cfg)
     head = params.get("lm_head")
     if head is None:
         head = params["embed"]
@@ -709,6 +816,10 @@ def _final_logits(params: Params, cfg: ModelConfig,
         head = head.dequant(cfg.dtype)
     logits = jnp.einsum("bsd,dv->bsv", x, head,
                         preferred_element_type=jnp.float32)
+    if "lm_head_bias" in params:
+        logits = logits + params["lm_head_bias"]
+    if cfg.logit_scale is not None:
+        logits = logits * cfg.logit_scale
     if cfg.final_logit_softcap:
         logits = jnp.tanh(logits / cfg.final_logit_softcap) \
             * cfg.final_logit_softcap

@@ -48,11 +48,14 @@ def make_causal_mask(q_pos: jax.Array, kv_pos: jax.Array,
 def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                   mask: Optional[jax.Array] = None,
                   scale: Optional[float] = None,
-                  logit_softcap: Optional[float] = None) -> jax.Array:
+                  logit_softcap: Optional[float] = None,
+                  sinks: Optional[jax.Array] = None) -> jax.Array:
     """Reference GQA attention.
 
     q: [B, Sq, H, D]; k, v: [B, Skv, K, D] with H % K == 0.
     mask: [B, Sq, Skv] boolean (True = attend) or None for full causal-free.
+    sinks: [H] per-head learned sink logits (gpt_oss): a virtual extra
+    key whose probability mass is dropped after the softmax.
     Returns [B, Sq, H, D] in q.dtype.
     """
     B, Sq, H, D = q.shape
@@ -66,7 +69,14 @@ def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         logits = jnp.tanh(logits / logit_softcap) * logit_softcap
     if mask is not None:
         logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1)
+    if sinks is not None:
+        s = sinks.astype(jnp.float32).reshape(K, G)
+        col = jnp.broadcast_to(s[None, :, :, None, None],
+                               (B, K, G, Sq, 1))
+        aug = jnp.concatenate([logits, col], axis=-1)
+        probs = jax.nn.softmax(aug, axis=-1)[..., :-1]
+    else:
+        probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkgst,btkd->bskgd", probs.astype(v.dtype), v)
     return out.reshape(B, Sq, H, D)
 
@@ -77,7 +87,8 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
               sliding_window: Optional[int] = None,
               scale: Optional[float] = None,
               logit_softcap: Optional[float] = None,
-              backend: Optional[str] = None) -> jax.Array:
+              backend: Optional[str] = None,
+              sinks: Optional[jax.Array] = None) -> jax.Array:
     """Dispatching attention entry point used by all models.
 
     positions: [B, Sq] absolute query positions (contiguous per row);
@@ -85,9 +96,13 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
     kv_len: [B] valid KV rows for fixed-capacity caches.
     backend: None (auto), "xla", "pallas", or "pallas_interpret" (the
     Pallas kernels run interpreted on CPU — for numerics tests).
+    sinks: [H] gpt_oss attention-sink logits — handled by the XLA
+    path only (the flash kernels decline and fall back).
     """
     if backend is None:
         backend = os.environ.get("OME_ATTN_BACKEND")
+    if sinks is not None:
+        backend = "xla"
     if backend is None:
         if not _on_tpu():
             backend = "xla"
@@ -122,7 +137,7 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
             kv_pos[None, None, :] < kv_len[:, None, None],
             (q.shape[0], q.shape[1], k.shape[1]))
     return xla_attention(q, k, v, mask=mask, scale=scale,
-                         logit_softcap=logit_softcap)
+                         logit_softcap=logit_softcap, sinks=sinks)
 
 
 @functools.cache

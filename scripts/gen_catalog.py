@@ -833,7 +833,7 @@ def fmt(arch, quant=None, prio=1):
 
 DENSE_ARCHS = ["LlamaForCausalLM", "Qwen2ForCausalLM", "Qwen3ForCausalLM",
                "MistralForCausalLM", "Gemma2ForCausalLM",
-               "Phi3ForCausalLM"]
+               "Phi3ForCausalLM", "CohereForCausalLM"]
 MOE_ARCHS = ["MixtralForCausalLM", "Qwen3MoeForCausalLM"]
 
 
@@ -1119,18 +1119,49 @@ def extra_runtime_docs():
         {"acceleratorClasses": ["tpu-v5e", "tpu-v6e"],
          "minChips": 8, "topologies": ["2x4"]})
 
+    # command-r served NATIVELY (round 5: cohere parallel-block +
+    # interleaved rope + logit scale in models/llama.py, logit-parity
+    # tested in tests/test_new_archs.py) — prio above the vLLM
+    # alternates so aya-expanse/command-r flip to the in-repo engine
+    yield "runtimes/ome/ome-engine-commandr-rt.yaml", _csr(
+        "ome-engine-commandr",
+        [fmt("CohereForCausalLM", prio=8)],
+        "1B", "40B",
+        {"runner": _tpu_runner(
+            ome, ["--model-dir", "$(MODEL_PATH)", "--tp", "4",
+                  "--max-slots", "32", "--port", "8080"], 4)},
+        {"acceleratorClasses": ["tpu-v5e", "tpu-v5p", "tpu-v6e"],
+         "minChips": 4, "topologies": ["2x2", "2x2x1"]})
+    yield "runtimes/ome/ome-engine-commandr-plus-rt.yaml", _csr(
+        "ome-engine-commandr-plus",
+        [fmt("CohereForCausalLM", prio=8)],
+        "41B", "110B",
+        {"runner": _tpu_runner(
+            ome, ["--model-dir", "$(MODEL_PATH)", "--tp", "16",
+                  "--max-slots", "32", "--port", "8080"], 4),
+         "workerSize": 3},
+        {"acceleratorClasses": ["tpu-v5e", "tpu-v6e"],
+         "minChips": 16, "topologies": ["4x4"]})
+
     # MoE: in-repo ragged dispatch (single host) + vllm EP (multi-host)
+    # phimoe (both config.json spellings) and gpt-oss are served
+    # natively as of round 5 (sparsemixer routing, clamped-GLU biased
+    # experts, attention sinks — tests/test_new_archs.py)
     yield "runtimes/ome/ome-engine-moe-rt.yaml", _csr(
         "ome-engine-moe",
         [fmt(a, prio=2) for a in
          ("MixtralForCausalLM", "Qwen2MoeForCausalLM",
-          "Qwen3MoeForCausalLM")],
+          "Qwen3MoeForCausalLM")] +
+        [fmt(a, prio=4) for a in
+         ("PhiMoEForCausalLM", "PhimoeForCausalLM")] +
+        # 6: above the vllm-tpu-gpt-oss (4) / -120b (5) alternates
+        [fmt("GptOssForCausalLM", prio=6)],
         "10B", "150B",
         {"runner": _tpu_runner(
             ome, ["--model-dir", "$(MODEL_PATH)", "--tp", "8",
                   "--max-slots", "32", "--port", "8080"], 8)},
-        {"acceleratorClasses": ["tpu-v5p", "tpu-v6e"], "minChips": 8,
-         "topologies": ["2x2x2", "2x4"]})
+        {"acceleratorClasses": ["tpu-v5e", "tpu-v5p", "tpu-v6e"],
+         "minChips": 8, "topologies": ["2x2x2", "2x4"]})
     yield "runtimes/vllm/vllm-tpu-moe-mid-rt.yaml", _csr(
         "vllm-tpu-moe-mid",
         [fmt(a, prio=3) for a in

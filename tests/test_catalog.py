@@ -105,6 +105,23 @@ class TestCatalogRouting:
         assert self._select(catalog, "e5-mistral-7b-instruct") == \
             "ome-engine-embeddings"
 
+    def test_round5_archs_route_to_native_engine(self, catalog):
+        """r4 verdict #5: command-r / phimoe / gpt-oss flip from
+        external vLLM-TPU runtimes to the in-repo engine now that
+        models/llama.py executes them (tests/test_new_archs.py)."""
+        assert self._select(catalog, "command-r") == \
+            "ome-engine-commandr"
+        assert self._select(catalog, "aya-expanse-8b") == \
+            "ome-engine-commandr"
+        assert self._select(catalog, "command-r-plus") == \
+            "ome-engine-commandr-plus"
+        assert self._select(catalog, "gpt-oss-20b") == \
+            "ome-engine-moe"
+        assert self._select(catalog, "gpt-oss-120b", "tpu-v5p") == \
+            "ome-engine-moe"
+        assert self._select(catalog, "phi-3-5-moe-instruct",
+                            "tpu-v5p") == "ome-engine-moe"
+
     def test_quantized_models_route_to_quant_declaring_runtimes(
             self, catalog):
         """Strict two-way quantization matching (matcher.go:204-212):
