@@ -64,10 +64,15 @@ HBM_GBPS = {"v5 lite": 819.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1640.0,
 PEAK_TFLOPS = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0,
                "v6e": 918.0, "v4": 275.0, "cpu": 0.2}
 
+import os
+
 BATCH = 32
 PREFILL = 128
 DECODE_STEPS = 128
-MULTISTEP = 8
+# 8 amortizes the ~1.6 ms tunnel dispatch to 0.2 ms/step; 16 halves
+# that again at the cost of a bigger unrolled program (env knob for
+# perf experiments)
+MULTISTEP = int(os.environ.get("OME_BENCH_MULTISTEP", "8"))
 CACHE_LEN = PREFILL + DECODE_STEPS
 TRIALS = 3
 
@@ -367,7 +372,7 @@ def main() -> None:
             np.arange(PB * (CACHE_LEN // bs)).reshape(
                 PB, CACHE_LEN // bs) + 1, jnp.int32)
 
-        def one_step_paged(tok, ks, vs, index):
+        def one_step_paged(per, top, tok, ks, vs, index):
             x = embed(top, tok)
             freqs = _rope_frequencies(cfg)
             positions = index[:, None]
@@ -399,9 +404,9 @@ def main() -> None:
             return tok, nks, nvs, index + 1
 
         @jax.jit
-        def paged_k(tok, ks, vs, index):
+        def paged_k(per, top, tok, ks, vs, index):
             def body(carry, _):
-                return one_step_paged(*carry), None
+                return one_step_paged(per, top, *carry), None
 
             carry, _ = lax.scan(body, (tok, ks, vs, index), None,
                                 length=MULTISTEP)
@@ -418,11 +423,11 @@ def main() -> None:
         best = float("inf")
         for _ in range(2):
             st = (tok0, ks, vs, index0)
-            st = paged_k(*st)  # compile/warm
+            st = paged_k(per, top, *st)  # compile/warm
             sync(st[0])
             t0 = time.perf_counter()
             for _ in range(n_disp - 1):
-                st = paged_k(*st)
+                st = paged_k(per, top, *st)
             sync(st[0])
             best = min(best, time.perf_counter() - t0)
         step_ms = best / ((n_disp - 1) * MULTISTEP) * 1000

@@ -263,6 +263,11 @@ class InferenceEngine:
         self.lora_slots = lora_slots
         self.lora_rank = lora_rank
         self._lora_names: Dict[str, int] = {}
+        # which adapter id each DECODE slot currently decodes with —
+        # unregister_adapter refuses while any slot references it
+        # (r4 advisor: a freed slot id reused mid-stream silently
+        # flips in-flight sequences to another adapter)
+        self._slot_adapters = np.zeros(max_slots, np.int32)
         import threading as _threading
         self._lora_lock = _threading.Lock()
         if lora_slots > 0:
@@ -496,8 +501,10 @@ class InferenceEngine:
     # -- paged-pool block allocator ------------------------------------
 
     def free_slot(self, slot: int) -> None:
-        """Return a finished slot's blocks to the pool (the scheduler
-        calls this; insert() also frees implicitly on slot reuse)."""
+        """Release a finished slot: its adapter reference always, its
+        KV blocks in paged mode (the scheduler calls this; insert()
+        also frees implicitly on slot reuse)."""
+        self._slot_adapters[slot] = 0
         if not self.kv_block:
             return
         self._free_blocks.extend(reversed(self._owned[slot]))
@@ -612,9 +619,14 @@ class InferenceEngine:
 
     def unregister_adapter(self, name: str) -> None:
         with self._lora_lock:
-            idx = self._lora_names.pop(name, None)
+            idx = self._lora_names.get(name)
             if idx is None:
                 return
+            if (self._slot_adapters == idx).any():
+                raise ValueError(
+                    f"adapter {name!r} is decoding in-flight "
+                    f"sequences; retry after they finish")
+            self._lora_names.pop(name)
             layers = dict(self.params["layers"])
             for key in list(layers):
                 if key.endswith("_lora_a") or key.endswith("_lora_b"):
@@ -705,14 +717,20 @@ class InferenceEngine:
         from .multihost import host_value
         return int(host_value(tok)), (k, v), len(ids), bucket
 
+    def blocks_needed(self, n_tokens: int) -> int:
+        """Pool blocks covering `n_tokens` KV rows + the next write —
+        the single accounting used by insert() AND the scheduler's
+        pre-prefill pool check (they must not drift)."""
+        return min(-(-(n_tokens + 1) // self.kv_block),
+                   self.max_blocks)
+
     def insert(self, state: DecodeState, kv, slot: int, true_len: int,
                token: int, bucket: int,
                adapter: Optional[str] = None) -> DecodeState:
-        aid = np.asarray(self.adapter_id(adapter), np.int32)
         if self.kv_block:
             bs = self.kv_block
-            self.free_slot(slot)
-            need = min(-(-(true_len + 1) // bs), self.max_blocks)
+            self.free_slot(slot)  # BEFORE recording the adapter ref
+            need = self.blocks_needed(true_len)
             if len(self._free_blocks) < need:
                 # backpressure, not a fault: the scheduler requeues
                 # this request until running streams free blocks
@@ -723,6 +741,14 @@ class InferenceEngine:
             self._owned[slot] = ids
             self._table[slot, :need] = ids
             self._host_len[slot] = true_len
+        # resolve + record under the adapter lock: an unregister
+        # between resolution and recording would zero the stacks this
+        # sequence is about to decode with (review TOCTOU)
+        with self._lora_lock:
+            aid_i = self.adapter_id(adapter)
+            self._slot_adapters[slot] = aid_i
+        aid = np.asarray(aid_i, np.int32)
+        if self.kv_block:
             nb_write = -(-bucket // bs)
             # blocks past the valid length land in the trash block (0)
             block_ids = np.zeros(nb_write, np.int32)

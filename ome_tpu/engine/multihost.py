@@ -262,16 +262,31 @@ class ReplicatedEngine:
 
     def register_adapter(self, name: str, adapter_dir: str) -> int:
         """Replicated hot adapter load: the staged dir must exist on
-        every host (shared PVC / serving-agent staging on each)."""
+        every host (shared PVC / serving-agent staging on each).
+        Local call FIRST: if it raises (bad dir, no free slot), no op
+        is published and followers stay consistent."""
         with self._oplock:
+            idx = self._engine.register_adapter(name, adapter_dir)
             self._pub.send({"op": "register_adapter", "name": name,
                             "path": adapter_dir})
-            return self._engine.register_adapter(name, adapter_dir)
+            return idx
 
     def unregister_adapter(self, name: str) -> None:
         with self._oplock:
+            # local first: an in-flight-adapter refusal
+            # (core.unregister_adapter ValueError) must not reach
+            # followers — their slot refs clear via the free_slot op,
+            # so a leader success replays cleanly
+            self._engine.unregister_adapter(name)
             self._pub.send({"op": "unregister_adapter", "name": name})
-            return self._engine.unregister_adapter(name)
+
+    def free_slot(self, slot: int) -> None:
+        """Replicated slot release (adapter refs + paged KV blocks) —
+        keeps follower allocators and the unregister guard in
+        lockstep with the leader's scheduler."""
+        with self._oplock:
+            self._pub.send({"op": "free_slot", "slot": int(slot)})
+            self._engine.free_slot(slot)
 
     def decode(self, state, temperature, top_k, top_p, mask=None):
         from .structured import pack_mask
@@ -379,7 +394,21 @@ def follower_loop(engine, sub: OpSubscriber,
         elif op == "register_adapter":
             engine.register_adapter(msg["name"], msg["path"])
         elif op == "unregister_adapter":
-            engine.unregister_adapter(msg["name"])
+            try:
+                engine.unregister_adapter(msg["name"])
+            except ValueError:
+                # the leader only publishes after ITS unload succeeded;
+                # a local refusal means this follower's adapter refs
+                # drifted (e.g. a missed free_slot) — clear the refs
+                # (NOT the KV blocks: active sequences still own those)
+                # and follow the leader rather than killing the group
+                log.warning("unregister %r refused locally; clearing "
+                            "stale adapter refs to follow the leader",
+                            msg["name"])
+                engine._slot_adapters[:] = 0
+                engine.unregister_adapter(msg["name"])
+        elif op == "free_slot":
+            engine.free_slot(msg["slot"])
         elif op == "decode":
             mask = unpack_mask(msg.get("mask"))
             kwargs = {} if mask is None else {"mask": mask}

@@ -452,8 +452,8 @@ class Scheduler:
         kvb = getattr(self.engine, "kv_block", 0)
         if not kvb:
             return True
-        need = min(-(-(min(len(req.prompt_ids), self.engine.max_seq)
-                       + 1) // kvb), self.engine.max_blocks)
+        need = self.engine.blocks_needed(
+            min(len(req.prompt_ids), self.engine.max_seq))
         stats = self.engine.kv_pool_stats
         return stats["kv_blocks_free"] >= need
 

@@ -117,7 +117,14 @@ class EngineServer:
                                                   "unregister_adapter"):
                         return self._json(400, {
                             "error": "engine has no adapter support"})
-                    eng.unregister_adapter(name)
+                    try:
+                        eng.unregister_adapter(name)
+                    except ValueError as e:
+                        # busy adapter (in-flight sequences): a
+                        # structured retryable conflict, not a dropped
+                        # connection
+                        return self._json(409, {"error": str(e),
+                                                "retryable": True})
                     return self._json(200, {"removed": name})
                 self._json(404, {"error": "not found"})
 

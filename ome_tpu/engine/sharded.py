@@ -41,7 +41,8 @@ class ShardedInferenceEngine(InferenceEngine):
                  max_slots: int = 8, max_seq: Optional[int] = None,
                  prefill_buckets: Optional[List[int]] = None,
                  mesh: Optional[Mesh] = None,
-                 prefix_cache_bytes: int = 0):
+                 prefix_cache_bytes: int = 0,
+                 lora_slots: int = 0, lora_rank: int = 16):
         if not cfg.mla and cfg.num_kv_heads % tp != 0:
             raise ValueError(
                 f"tp={tp} must divide num_kv_heads={cfg.num_kv_heads} "
@@ -52,9 +53,14 @@ class ShardedInferenceEngine(InferenceEngine):
         self.mesh = mesh or build_mesh(MeshConfig(tp=tp))
         self.tp = tp
         params = shard_params(params, self.mesh)
+        # multi-LoRA under tp: the adapter factor stacks ([L, n, r, K],
+        # a few MB) stay REPLICATED — GSPMD treats the unannotated
+        # leaves as replicated operands of the delta einsums, and
+        # register_adapter's host-side .at[].set updates every replica
         super().__init__(params, cfg, max_slots=max_slots, max_seq=max_seq,
                          prefill_buckets=prefill_buckets,
-                         prefix_cache_bytes=prefix_cache_bytes)
+                         prefix_cache_bytes=prefix_cache_bytes,
+                         lora_slots=lora_slots, lora_rank=lora_rank)
 
     # tp-sharded weights must not hit the un-partitioned int4 Pallas
     # kernel (GSPMD would replicate + all-gather the packed weight per

@@ -164,6 +164,18 @@ class ModelConfig:
         qscale = None
         if gemma2 and cfg.get("query_pre_attn_scalar"):
             qscale = cfg["query_pre_attn_scalar"] ** -0.5
+        # yarn/longrope multiply cos AND sin by an attention factor;
+        # q and k both scale, so logits scale by att^2 — fold it into
+        # the query scale (KV cache stays unscaled). MLA models apply
+        # their own mscale (models/mla.py) and skip this.
+        if not deepseek:
+            att = _rope_attention_factor(
+                cfg.get("rope_scaling"),
+                cfg.get("max_position_embeddings", 8192))
+            if att != 1.0:
+                head_dim = cfg.get("head_dim") or hidden // heads
+                qscale = (qscale if qscale is not None
+                          else head_dim ** -0.5) * att * att
         extra = {}
         if arch in ("PhimoeForCausalLM", "PhiMoEForCausalLM"):
             # the official Phi-3.5-MoE repo ships the capital-E
@@ -231,6 +243,32 @@ class ModelConfig:
         kw.update(mla_kw)
         kw.update(extra)  # per-architecture overrides win
         return cls(**kw)
+
+
+def _rope_attention_factor(sc: Optional[Dict[str, Any]],
+                           max_pos: int) -> float:
+    """cos/sin attention factor of yarn/longrope scaling (transformers
+    _compute_{yarn,longrope}_parameters)."""
+    if not sc:
+        return 1.0
+    import math
+    t = sc.get("rope_type", sc.get("type"))
+    if t == "yarn":
+        att = sc.get("attention_factor")
+        if att is not None:
+            return float(att)
+        f = sc.get("factor", 1.0)
+        return 0.1 * math.log(f) + 1.0 if f > 1 else 1.0
+    if t == "longrope":
+        att = sc.get("attention_factor")
+        if att is not None:
+            return float(att)
+        orig = sc.get("original_max_position_embeddings") or max_pos
+        s = max_pos / orig
+        if s <= 1.0:
+            return 1.0
+        return math.sqrt(1.0 + math.log(s) / math.log(orig))
+    return 1.0
 
 
 # -- presets ---------------------------------------------------------------

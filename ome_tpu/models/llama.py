@@ -295,7 +295,30 @@ def _rope_frequencies(cfg: ModelConfig) -> jax.Array:
     half = cfg.head_dim // 2
     freqs = 1.0 / cfg.rope_theta ** (jnp.arange(half, dtype=jnp.float32) / half)
     sc = cfg.rope_scaling
-    if sc and sc.get("rope_type", sc.get("type")) == "llama3":
+    rtype = sc.get("rope_type", sc.get("type")) if sc else None
+    if rtype not in (None, "default", "llama3", "yarn", "longrope",
+                     "linear"):
+        # silently unscaled frequencies serve wrong logits past the
+        # original window — refuse instead (r5 review)
+        raise ValueError(f"unsupported rope_scaling type {rtype!r}")
+    if rtype == "yarn":
+        # gpt-oss/qwen long-context; the cos/sin attention factor is
+        # folded into query_scale at config parse (logits scale by
+        # att^2 — equivalent, and the KV cache stays unscaled)
+        from .mla import yarn_frequencies
+        freqs, _ = yarn_frequencies(cfg, cfg.head_dim)
+    elif rtype == "longrope":
+        # phi3 family: per-dim extension factors; long list when the
+        # deployed window exceeds the original training window
+        orig = sc.get("original_max_position_embeddings",
+                      cfg.max_seq_len)
+        which = "long_factor" if cfg.max_seq_len > orig \
+            else "short_factor"
+        ext = jnp.asarray(sc[which], jnp.float32)
+        freqs = freqs / ext
+    elif rtype == "linear":
+        freqs = freqs / sc.get("factor", 1.0)
+    if rtype == "llama3":
         # Llama-3.1 NTK-by-parts frequency remapping
         factor = sc.get("factor", 8.0)
         lo = sc.get("low_freq_factor", 1.0)

@@ -230,9 +230,15 @@ def flash_decode_quantized(q: jax.Array, kq: jax.Array, vq: jax.Array,
     layout). q: [B, 1, H, D] bf16; kq/vq: [B, S, K, D] int8; scales
     [B, K, S] f32. Returns [B, 1, H, D] or None if shapes uncovered.
 
-    This is the serving engine's --kv-cache-dtype int8 path: the KV
+    Experimental building block, NOT wired into the engine: the KV
     read is the second-largest term in the decode step's HBM budget
-    after the weights (bench.py breakdown), and int8 halves it.
+    after the weights (bench.py breakdown) and int8 halves it, but on
+    v5e the in-kernel int8->bf16 convert costs more than the halved
+    read saves (measured 8.8 vs 8.3 ms on the attention microbench —
+    BASELINE.md round-4 notes). Wire behind a --kv-cache-dtype flag
+    on chips where that trade flips; until then it ships
+    numerics-tested (tests/test_ops.py) but unreachable from serving
+    (r4 advisor low #4: the docstring must not claim otherwise).
     """
     B, Sq, H, D = q.shape
     assert Sq == 1

@@ -199,7 +199,7 @@ def int4_matmul(x: jax.Array, qt, out_dtype=jnp.bfloat16,
         bkp = k // 2
         if bkp % gsize:
             return None
-    bn = min(512, n)
+    bn = min(int(os.environ.get("OME_INT4_BN", "512")), n)
     if n % bn or bn % 128:
         return None
     lead = x.shape[:-1]

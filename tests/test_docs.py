@@ -1,0 +1,79 @@
+"""Docs smoke tests: every `python -m ome_tpu...` the operator docs
+tell a user to run must at least parse `--help` in-process (r4 verdict
+#9 'commands smoke-tested'); cluster-side kubectl/helm steps are
+covered structurally by tests/test_charts.py. Also: every YAML block
+in the docs that declares an ome.io kind round-trips through the
+repo's own API types, and every intra-docs link resolves."""
+
+import io
+import pathlib
+import re
+from contextlib import redirect_stderr, redirect_stdout
+
+import pytest
+import yaml
+
+DOCS = sorted((pathlib.Path(__file__).resolve().parents[1]
+               / "docs").glob("*.md"))
+_MOD = re.compile(r"python -m ([a-zA-Z0-9_]+(?:\.[a-zA-Z0-9_]+)+)")
+
+
+def _modules():
+    mods = set()
+    for page in DOCS:
+        mods.update(_MOD.findall(page.read_text()))
+    return sorted(mods)
+
+
+def test_docs_exist():
+    names = {p.name for p in DOCS}
+    assert {"README.md", "install.md", "serve-a-model.md",
+            "multihost.md", "pd-disaggregation.md", "benchmark.md",
+            "quantization.md", "structured-outputs.md",
+            "paged-kv.md"} <= names
+
+
+@pytest.mark.parametrize("module", _modules())
+def test_doc_cli_helps(module):
+    import importlib
+    mod = importlib.import_module(module)
+    main = getattr(mod, "main", None)
+    if main is None:
+        mod = importlib.import_module(module + ".cli")
+        main = mod.main
+    buf = io.StringIO()
+    with pytest.raises(SystemExit) as e, redirect_stdout(buf), \
+            redirect_stderr(buf):
+        main(["--help"])
+    assert e.value.code == 0, buf.getvalue()
+    assert "usage" in buf.getvalue().lower()
+
+
+def test_docs_yaml_blocks_roundtrip():
+    from ome_tpu.core.kubeclient import kind_registry
+    from ome_tpu.core.serde import from_dict
+    reg = kind_registry()
+    checked = 0
+    for page in DOCS:
+        for block in re.findall(r"```yaml\n(.*?)```", page.read_text(),
+                                re.S):
+            for doc in yaml.safe_load_all(block):
+                if not isinstance(doc, dict) or "kind" not in doc:
+                    continue
+                if not str(doc.get("apiVersion", "")).startswith(
+                        "ome.io"):
+                    continue
+                cls = reg.get(doc["kind"])
+                assert cls is not None, (page.name, doc["kind"])
+                obj = from_dict(cls, doc)
+                assert obj.metadata.name, page.name
+                checked += 1
+    assert checked >= 5
+
+
+def test_docs_links_resolve():
+    root = DOCS[0].parent
+    for page in DOCS:
+        for target in re.findall(r"\]\(([A-Za-z0-9_.-]+\.md)\)",
+                                 page.read_text()):
+            assert (root / target).exists(), (page.name, target)

@@ -1,0 +1,245 @@
+"""GCP credential depth (r4 verdict #6): service-account key files,
+workload-identity federation, and expiry-driven refresh — all against
+local mock token servers, in the tests/test_cloudkms.py style. The
+reference's analog is its multi-cloud principal factory
+(/root/reference/pkg/auth/factory.go:21, pkg/principals)."""
+
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from ome_tpu.storage.signing import (FederatedSigner,
+                                     ServiceAccountSigner,
+                                     gcp_signer_from_credentials,
+                                     signer_from_env)
+
+cryptography = pytest.importorskip("cryptography")
+
+
+def _rsa_pem():
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pem = key.private_key_bytes if False else key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+    return key, pem.decode()
+
+
+@pytest.fixture()
+def token_server():
+    """Mock OAuth/STS endpoint recording every request body."""
+    calls = []
+    state = {"expires_in": 3600}
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            body = self.rfile.read(
+                int(self.headers.get("Content-Length", 0)))
+            if self.headers.get("Content-Type", "").startswith(
+                    "application/json"):
+                parsed = json.loads(body)
+            else:
+                parsed = dict(urllib.parse.parse_qsl(body.decode()))
+            calls.append((self.path, parsed,
+                          dict(self.headers.items())))
+            if self.path == "/impersonate":
+                out = {"accessToken": "impersonated-token",
+                       "expireTime": "2099-01-01T00:00:00Z"}
+            else:
+                out = {"access_token": f"tok-{len(calls)}",
+                       "expires_in": state["expires_in"],
+                       "token_type": "Bearer"}
+            data = json.dumps(out).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}", calls, state
+    srv.shutdown()
+
+
+def test_service_account_jwt_grant(token_server, tmp_path):
+    url, calls, _ = token_server
+    key, pem = _rsa_pem()
+    info = {"type": "service_account",
+            "client_email": "sa@proj.iam.gserviceaccount.com",
+            "private_key": pem, "token_uri": f"{url}/token"}
+    keyfile = tmp_path / "sa.json"
+    keyfile.write_text(json.dumps(info))
+    signer = gcp_signer_from_credentials(str(keyfile))
+    assert isinstance(signer, ServiceAccountSigner)
+    headers = signer.sign("GET", "https://storage.googleapis.com/b/o")
+    assert headers["Authorization"] == "Bearer tok-1"
+    # the JWT assertion must verify against the SA's public key
+    path, parsed, _ = calls[0]
+    assert path == "/token"
+    assert parsed["grant_type"] == \
+        "urn:ietf:params:oauth:grant-type:jwt-bearer"
+    h, c, sig = parsed["assertion"].split(".")
+    import base64
+
+    def unb64(s):
+        return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+    from cryptography.hazmat.primitives.asymmetric import padding
+    from cryptography.hazmat.primitives.hashes import SHA256
+    key.public_key().verify(unb64(sig), f"{h}.{c}".encode(),
+                            padding.PKCS1v15(), SHA256())
+    claims = json.loads(unb64(c))
+    assert claims["iss"] == info["client_email"]
+    assert claims["aud"] == info["token_uri"]
+    # cached: second sign does not re-hit the server
+    signer.sign("GET", "https://storage.googleapis.com/b/o2")
+    assert len(calls) == 1
+
+
+def test_token_refresh_near_expiry(token_server, tmp_path):
+    """Multi-hour downloads: a token expiring within 60 s is replaced
+    on the next request instead of failing mid-file."""
+    url, calls, state = token_server
+    state["expires_in"] = 30  # expires inside the refresh window
+    _, pem = _rsa_pem()
+    keyfile = tmp_path / "sa.json"
+    keyfile.write_text(json.dumps({
+        "type": "service_account", "client_email": "sa@p.iam",
+        "private_key": pem, "token_uri": f"{url}/token"}))
+    signer = gcp_signer_from_credentials(str(keyfile))
+    assert signer.sign("GET", "u")["Authorization"] == "Bearer tok-1"
+    assert signer.sign("GET", "u")["Authorization"] == "Bearer tok-2"
+    assert len(calls) == 2
+
+
+def test_workload_identity_federation_file_source(token_server,
+                                                  tmp_path):
+    url, calls, _ = token_server
+    subject = tmp_path / "oidc.jwt"
+    subject.write_text("subject-token-abc")
+    cred = tmp_path / "wif.json"
+    cred.write_text(json.dumps({
+        "type": "external_account",
+        "audience": "//iam.googleapis.com/projects/1/locations/global/"
+                    "workloadIdentityPools/p/providers/x",
+        "subject_token_type": "urn:ietf:params:oauth:token-type:jwt",
+        "token_url": f"{url}/sts",
+        "credential_source": {"file": str(subject)}}))
+    signer = gcp_signer_from_credentials(str(cred))
+    assert isinstance(signer, FederatedSigner)
+    headers = signer.sign("GET", "https://storage.googleapis.com/b/o")
+    assert headers["Authorization"] == "Bearer tok-1"
+    path, parsed, _ = calls[0]
+    assert path == "/sts"
+    assert parsed["subject_token"] == "subject-token-abc"
+    assert parsed["grant_type"] == \
+        "urn:ietf:params:oauth:grant-type:token-exchange"
+
+
+def test_federation_with_impersonation(token_server, tmp_path):
+    url, calls, _ = token_server
+    subject = tmp_path / "oidc.json"
+    subject.write_text(json.dumps({"access_token": "inner-tok"}))
+    cred = tmp_path / "wif.json"
+    cred.write_text(json.dumps({
+        "type": "external_account",
+        "audience": "//iam.googleapis.com/pool",
+        "token_url": f"{url}/sts",
+        "service_account_impersonation_url": f"{url}/impersonate",
+        "credential_source": {
+            "file": str(subject),
+            "format": {"type": "json",
+                       "subject_token_field_name": "access_token"}}}))
+    signer = gcp_signer_from_credentials(str(cred))
+    headers = signer.sign("GET", "u")
+    assert headers["Authorization"] == "Bearer impersonated-token"
+    assert [c[0] for c in calls] == ["/sts", "/impersonate"]
+    assert calls[0][1]["subject_token"] == "inner-tok"
+    # impersonation call authenticates with the STS token
+    assert calls[1][2].get("Authorization") == "Bearer tok-1"
+
+
+def test_signer_from_env_dispatch(token_server, tmp_path, monkeypatch):
+    url, _, _ = token_server
+    _, pem = _rsa_pem()
+    keyfile = tmp_path / "sa.json"
+    keyfile.write_text(json.dumps({
+        "type": "service_account", "client_email": "sa@p.iam",
+        "private_key": pem, "token_uri": f"{url}/token"}))
+    monkeypatch.setenv("GOOGLE_APPLICATION_CREDENTIALS", str(keyfile))
+    signer = signer_from_env("gcs")
+    assert isinstance(signer, ServiceAccountSigner)
+    monkeypatch.setenv("GOOGLE_APPLICATION_CREDENTIALS",
+                       str(tmp_path / "missing.json"))
+    monkeypatch.delenv("GOOGLE_OAUTH_ACCESS_TOKEN", raising=False)
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+    monkeypatch.delenv("OME_GCS_METADATA_AUTH", raising=False)
+    assert signer_from_env("gcs") is None  # anonymous fallback
+
+
+def test_gopher_private_gcs_all_three_modes(token_server, tmp_path,
+                                            monkeypatch):
+    """The verdict's done-when: a private-bucket download works in SA
+    / federation / metadata auth modes — mocked GCS checks the bearer
+    token before serving bytes."""
+    url, _, _ = token_server
+    from ome_tpu.storage.signing import GCSTokenSigner
+
+    blob = b"model-bytes-" * 64
+    seen_auth = []
+
+    class GCS(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            auth = self.headers.get("Authorization", "")
+            seen_auth.append(auth)
+            if not auth.startswith("Bearer "):
+                self.send_response(401)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+    gcs = HTTPServer(("127.0.0.1", 0), GCS)
+    threading.Thread(target=gcs.serve_forever, daemon=True).start()
+    gcs_url = f"http://127.0.0.1:{gcs.server_port}/bucket/obj"
+    try:
+        _, pem = _rsa_pem()
+        sa = tmp_path / "sa.json"
+        sa.write_text(json.dumps({
+            "type": "service_account", "client_email": "sa@p.iam",
+            "private_key": pem, "token_uri": f"{url}/token"}))
+        subject = tmp_path / "sub.jwt"
+        subject.write_text("sub")
+        wif = tmp_path / "wif.json"
+        wif.write_text(json.dumps({
+            "type": "external_account", "audience": "//iam/pool",
+            "token_url": f"{url}/sts",
+            "credential_source": {"file": str(subject)}}))
+        import urllib.request
+        for signer in (gcp_signer_from_credentials(str(sa)),
+                       gcp_signer_from_credentials(str(wif)),
+                       GCSTokenSigner(token="metadata-style-token")):
+            headers = signer.sign("GET", gcs_url)
+            req = urllib.request.Request(gcs_url, headers=headers)
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.read() == blob
+        assert len(seen_auth) == 3
+        assert all(a.startswith("Bearer ") for a in seen_auth)
+    finally:
+        gcs.shutdown()

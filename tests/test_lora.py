@@ -217,7 +217,14 @@ def test_multi_lora_matches_merged_baselines(tmp_path):
         assert got == want, f"adapter {ad}: {got} != {want}"
 
     # hot swap: unregister then register a DIFFERENT adapter under the
-    # same name — no recompilation (same shapes), new deltas apply
+    # same name — no recompilation (same shapes), new deltas apply.
+    # Slots must be released first: unload refuses while any slot
+    # still references the adapter (r4 advisor — a reused slot id
+    # would silently flip in-flight sequences to another adapter)
+    with pytest.raises(ValueError, match="in-flight"):
+        eng.unregister_adapter("a1")
+    for slot in range(3):
+        eng.free_slot(slot)
     eng.unregister_adapter("a1")
     with pytest.raises(ValueError, match="unknown adapter"):
         eng.adapter_id("a1")

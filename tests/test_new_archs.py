@@ -139,6 +139,64 @@ def test_gpt_oss_logits_match_transformers(tmp_path):
     assert "router_b" in params["layers"]
 
 
+def test_gpt_oss_yarn_rope_scaling(tmp_path):
+    """Real gpt-oss ships yarn rope_scaling; inv_freq remapping +
+    attention factor (folded into query_scale as att^2) must match
+    transformers. atol reflects this CPU's reduced-precision matmul
+    noise floor (~2e-3 at this depth); argmax is exact."""
+    hf = transformers.GptOssConfig(
+        vocab_size=120, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16, num_local_experts=4,
+        num_experts_per_tok=2, sliding_window=4,
+        max_position_embeddings=256,
+        rope_scaling={"rope_type": "yarn", "factor": 8.0,
+                      "beta_fast": 32.0, "beta_slow": 1.0,
+                      "original_max_position_embeddings": 32},
+        tie_word_embeddings=False)
+    model, d = _save_hf(tmp_path, hf)
+    params, cfg = _compare_logits(model, d, atol=1e-2)
+    assert cfg.query_scale is not None  # att^2 folded in
+
+
+def test_phi3_longrope_scaling(tmp_path):
+    hf = transformers.Phi3Config(
+        vocab_size=120, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, max_position_embeddings=256,
+        original_max_position_embeddings=32,
+        rope_scaling={"type": "longrope",
+                      "short_factor": [1.0] * 8,
+                      "long_factor": [2.0, 2.0, 2.5, 3.0, 3.5, 4.0,
+                                      5.0, 6.0]},
+        sliding_window=None, pad_token_id=0, bos_token_id=1,
+        eos_token_id=2, tie_word_embeddings=False)
+    model, d = _save_hf(tmp_path, hf)
+    _compare_logits(model, d, atol=1e-2)
+
+
+def test_unknown_rope_scaling_rejected(tmp_path):
+    """'dynamic' etc. would silently serve wrong logits past the
+    original window — loading must refuse."""
+    import json as _json
+    import os
+    hf = transformers.Phi3Config(
+        vocab_size=120, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, max_position_embeddings=64,
+        sliding_window=None, pad_token_id=0, bos_token_id=1,
+        eos_token_id=2, tie_word_embeddings=False)
+    _, d = _save_hf(tmp_path, hf)
+    cfgp = os.path.join(d, "config.json")
+    raw = _json.load(open(cfgp))
+    raw["rope_scaling"] = {"type": "dynamic", "factor": 2.0}
+    _json.dump(raw, open(cfgp, "w"))
+    params, cfg = ck.load_params(d, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="rope_scaling"):
+        llama.forward(params, cfg,
+                      jnp.asarray([[1, 2, 3]], jnp.int32))
+
+
 @pytest.mark.parametrize("family", ["phi3", "cohere"])
 def test_engine_decode_continuation(tmp_path, family):
     """The serving engine decodes greedily to the same tokens the
