@@ -727,6 +727,8 @@ class InferenceEngine:
     def insert(self, state: DecodeState, kv, slot: int, true_len: int,
                token: int, bucket: int,
                adapter: Optional[str] = None) -> DecodeState:
+        with self._lora_lock:
+            self.adapter_id(adapter)  # fail fast BEFORE the allocator
         if self.kv_block:
             bs = self.kv_block
             self.free_slot(slot)  # BEFORE recording the adapter ref
@@ -741,12 +743,19 @@ class InferenceEngine:
             self._owned[slot] = ids
             self._table[slot, :need] = ids
             self._host_len[slot] = true_len
-        # resolve + record under the adapter lock: an unregister
+        # re-resolve + record under the adapter lock: an unregister
         # between resolution and recording would zero the stacks this
-        # sequence is about to decode with (review TOCTOU)
-        with self._lora_lock:
-            aid_i = self.adapter_id(adapter)
-            self._slot_adapters[slot] = aid_i
+        # sequence is about to decode with (review TOCTOU); if it
+        # slipped into the window above, return the freshly allocated
+        # blocks instead of orphaning them on a live slot
+        try:
+            with self._lora_lock:
+                aid_i = self.adapter_id(adapter)
+                self._slot_adapters[slot] = aid_i
+        except UnknownAdapterError:
+            if self.kv_block:
+                self.free_slot(slot)
+            raise
         aid = np.asarray(aid_i, np.int32)
         if self.kv_block:
             nb_write = -(-bucket // bs)

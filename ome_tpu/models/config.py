@@ -253,6 +253,8 @@ def _rope_attention_factor(sc: Optional[Dict[str, Any]],
         return 1.0
     import math
     t = sc.get("rope_type", sc.get("type"))
+    if t == "su":  # early Phi-3 spelling of longrope
+        t = "longrope"
     if t == "yarn":
         att = sc.get("attention_factor")
         if att is not None:

@@ -296,6 +296,8 @@ def _rope_frequencies(cfg: ModelConfig) -> jax.Array:
     freqs = 1.0 / cfg.rope_theta ** (jnp.arange(half, dtype=jnp.float32) / half)
     sc = cfg.rope_scaling
     rtype = sc.get("rope_type", sc.get("type")) if sc else None
+    if rtype == "su":  # early Phi-3 releases' name for longrope
+        rtype = "longrope"
     if rtype not in (None, "default", "llama3", "yarn", "longrope",
                      "linear"):
         # silently unscaled frequencies serve wrong logits past the

@@ -317,17 +317,25 @@ class FederatedSigner(_RefreshingTokenSigner):
 
 def gcp_signer_from_credentials(path: Optional[str] = None):
     """GOOGLE_APPLICATION_CREDENTIALS dispatch: service-account key
-    file or workload-identity-federation credential config."""
+    file or workload-identity-federation credential config. A broken
+    credential file (or a missing `cryptography` package for the
+    RS256 grant) degrades to None so discovery falls back to the
+    metadata server instead of failing every download."""
     path = path or os.environ.get("GOOGLE_APPLICATION_CREDENTIALS")
     if not path or not os.path.exists(path):
         return None
-    with open(path) as f:
-        info = json.load(f)
-    kind = info.get("type")
-    if kind == "service_account":
-        return ServiceAccountSigner(info)
-    if kind == "external_account":
-        return FederatedSigner(info)
+    try:
+        with open(path) as f:
+            info = json.load(f)
+        kind = info.get("type")
+        if kind == "service_account":
+            return ServiceAccountSigner(info)
+        if kind == "external_account":
+            return FederatedSigner(info)
+    except Exception as e:  # noqa: BLE001
+        import logging
+        logging.getLogger("ome.storage").warning(
+            "ignoring unusable GCP credentials at %s: %s", path, e)
     return None
 
 

@@ -1512,6 +1512,73 @@ def family_runtime_docs():
                           "decode", "--prefill-peer",
                           "$(PREFILL_SERVICE_URL)", slots="64"), 8)},
         router=pd_router)
+    # PD breadth matching the reference's srt/*-pd-* family (kimi/
+    # mixtral/mistral shapes) on the in-repo engine
+    yield "runtimes/ome/ome-engine-pd-mixtral-rt.yaml", _csr(
+        "ome-engine-pd-mixtral",
+        [fmt("MixtralForCausalLM", prio=1)],  # pin explicitly
+        "100B", "180B",
+        {"runner": _tpu_runner(
+            ome, ome_args("--tp", "16", "--disaggregation-mode",
+                          "prefill", slots="8"), 4),
+         "workerSize": 3},
+        {"acceleratorClasses": ["tpu-v5p"], "minChips": 16,
+         "topologies": ["2x2x4"]},
+        decoder={"runner": _tpu_runner(
+            ome, ome_args("--tp", "16", "--disaggregation-mode",
+                          "decode", "--prefill-peer",
+                          "$(PREFILL_SERVICE_URL)", slots="48"), 4),
+            "workerSize": 3},
+        router=pd_router)
+    yield "runtimes/ome/ome-engine-pd-mistral-rt.yaml", _csr(
+        "ome-engine-pd-mistral",
+        # 4: 1 is the paged runtime's, 2/3 the small/vllm pair
+        [fmt("MistralForCausalLM", prio=4)],
+        "5B", "15B",
+        {"runner": _tpu_runner(
+            ome, ome_args("--disaggregation-mode", "prefill",
+                          slots="8"), 1)},
+        {"acceleratorClasses": ["tpu-v5e", "tpu-v6e"], "minChips": 2},
+        decoder={"runner": _tpu_runner(
+            ome, ome_args("--disaggregation-mode", "decode",
+                          "--prefill-peer", "$(PREFILL_SERVICE_URL)",
+                          slots="32"), 1)},
+        router=pd_router)
+    yield "runtimes/ome/ome-engine-pd-qwen-72b-rt.yaml", _csr(
+        "ome-engine-pd-qwen-72b",
+        [fmt("Qwen2ForCausalLM", prio=1), fmt("Qwen3ForCausalLM",
+                                              prio=1)],
+        "60B", "110B",
+        {"runner": _tpu_runner(
+            ome, ome_args("--tp", "8", "--disaggregation-mode",
+                          "prefill", slots="8"), 8)},
+        {"acceleratorClasses": ["tpu-v5p"], "minChips": 16,
+         "topologies": ["2x2x2"]},
+        decoder={"runner": _tpu_runner(
+            ome, ome_args("--tp", "8", "--disaggregation-mode",
+                          "decode", "--prefill-peer",
+                          "$(PREFILL_SERVICE_URL)", slots="64"), 8)},
+        router=pd_router)
+
+    # ---- paged-KV serving (round 5, OEP-0006): HBM sized by tokens
+    # in flight -> high slot counts for long mixed-length traffic ----
+    yield "runtimes/ome/ome-engine-paged-rt.yaml", _csr(
+        "ome-engine-paged",
+        # llama rides prio 4 (1 is jetstream's; 4 flips small llamas
+        # to the native paged engine while the v5e-tuned 8B entry at
+        # 8 keeps winning its class); the rest take the free prio 1
+        [fmt("LlamaForCausalLM", prio=4)] +
+        [fmt(a, prio=1) for a in
+         ("Qwen2ForCausalLM", "Qwen3ForCausalLM",
+          "MistralForCausalLM", "Phi3ForCausalLM")],
+        "100M", "15B",
+        {"runner": _tpu_runner(
+            ome, ome_args("--kv-block", "128", "--max-seq", "8192",
+                          slots="64"), 1)},
+        {"acceleratorClasses": ["tpu-v5e", "tpu-v6e"], "minChips": 1},
+        annotations={"ome.io/notes":
+                     "paged KV pool (vLLM-style) — pin explicitly via "
+                     "spec.runtime for long mixed-length workloads"})
 
     # ---- in-repo quantized serving (models/quant.py) ------------------
     yield "runtimes/ome/ome-engine-int8-rt.yaml", _csr(
