@@ -727,9 +727,11 @@ class InferenceEngine:
     def insert(self, state: DecodeState, kv, slot: int, true_len: int,
                token: int, bucket: int,
                adapter: Optional[str] = None) -> DecodeState:
-        with self._lora_lock:
-            self.adapter_id(adapter)  # fail fast BEFORE the allocator
         if self.kv_block:
+            with self._lora_lock:
+                # fail fast BEFORE the allocator touches any blocks;
+                # the dense path's only resolve is the locked one below
+                self.adapter_id(adapter)
             bs = self.kv_block
             self.free_slot(slot)  # BEFORE recording the adapter ref
             need = self.blocks_needed(true_len)

@@ -406,7 +406,7 @@ SUPPORTED_ARCHITECTURES = frozenset({
     # (LayerNorm + sparsemixer), command-r (parallel block, interleaved
     # rope, logit scale), gpt-oss (sinks, clamped-GLU biased experts)
     "Phi3ForCausalLM", "PhimoeForCausalLM", "PhiMoEForCausalLM",
-    "CohereForCausalLM", "GptOssForCausalLM",
+    "CohereForCausalLM", "Cohere2ForCausalLM", "GptOssForCausalLM",
     # decoder embedding models (engine/embed.py): bare AutoModel
     # checkpoints whose tensors lack the "model." prefix
     "MistralModel", "Qwen2Model", "Qwen3Model",

@@ -66,7 +66,9 @@ class ModelConfig:
     attn_bias: bool = False  # qwen2-style q/k/v projection biases
     # gemma2-family block shape (models/llama.py pair-scan path)
     mlp_activation: str = "silu"      # "silu" | "gelu_tanh"
-    alt_sliding_window: bool = False  # even layers sliding, odd global
+    alt_sliding_window: bool = False  # periodic sliding/global layers
+    sliding_pattern: int = 2          # period P: every P-th is global
+    rope_skip_global: bool = False    # cohere2: global layers are NoPE
     query_scale: Optional[float] = None  # overrides head_dim**-0.5
     post_block_norms: bool = False    # post-attn/post-mlp RMSNorms
     embed_scale: bool = False         # x *= sqrt(hidden) after embed
@@ -132,6 +134,14 @@ class ModelConfig:
         heads = cfg.get("num_attention_heads", 32)
         archs = cfg.get("architectures") or [""]
         arch = archs[0]
+        sc_raw = cfg.get("rope_scaling")
+        if sc_raw and sc_raw.get("rope_type",
+                                 sc_raw.get("type")) == "su":
+            # normalize early Phi-3's original spelling ONCE so every
+            # downstream reader (_rope_frequencies, the attention
+            # factor, mla) sees the canonical name
+            cfg = dict(cfg, rope_scaling=dict(sc_raw,
+                                              rope_type="longrope"))
         deepseek = arch.startswith("Deepseek")
         mla_kw = {}
         if deepseek:
@@ -188,6 +198,19 @@ class ModelConfig:
                          router_scoring="sparsemixer",
                          router_jitter=cfg.get("router_jitter_noise",
                                                0.01) or 0.0)
+        elif arch == "Cohere2ForCausalLM":
+            # command-r7b / command-a: the cohere parallel block plus
+            # a period-4 sliding pattern whose global layers skip RoPE
+            # (cite ref: pkg/hfutil/modelconfig parses cohere2)
+            extra = dict(norm_type="layernorm_nobias",
+                         parallel_block=True,
+                         logit_scale=cfg.get("logit_scale", 1.0),
+                         rope_interleaved=True,
+                         rms_norm_eps=cfg.get("layer_norm_eps", 1e-5),
+                         alt_sliding_window=True,
+                         sliding_pattern=cfg.get(
+                             "sliding_window_pattern", 4),
+                         rope_skip_global=True)
         elif arch in ("CohereForCausalLM", "CohereModel"):
             # command-r: weight-only mean-centered LayerNorm, PARALLEL
             # attn+MLP residual off one shared norm, interleaved rope,
@@ -253,8 +276,6 @@ def _rope_attention_factor(sc: Optional[Dict[str, Any]],
         return 1.0
     import math
     t = sc.get("rope_type", sc.get("type"))
-    if t == "su":  # early Phi-3 spelling of longrope
-        t = "longrope"
     if t == "yarn":
         att = sc.get("attention_factor")
         if att is not None:

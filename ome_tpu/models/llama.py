@@ -296,8 +296,6 @@ def _rope_frequencies(cfg: ModelConfig) -> jax.Array:
     freqs = 1.0 / cfg.rope_theta ** (jnp.arange(half, dtype=jnp.float32) / half)
     sc = cfg.rope_scaling
     rtype = sc.get("rope_type", sc.get("type")) if sc else None
-    if rtype == "su":  # early Phi-3 releases' name for longrope
-        rtype = "longrope"
     if rtype not in (None, "default", "llama3", "yarn", "longrope",
                      "linear"):
         # silently unscaled frequencies serve wrong logits past the
@@ -588,7 +586,8 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
            cache_kv: Optional[Tuple[jax.Array, jax.Array]],
            cache_index: Optional[jax.Array],
            window=_WINDOW_FROM_CFG, moe: Optional[bool] = None,
-           adapter_ids: Optional[jax.Array] = None):
+           adapter_ids: Optional[jax.Array] = None,
+           use_rope: bool = True):
     """One transformer block. cache_kv: ([B,Smax,K,Dh], [B,Smax,K,Dh]).
     `window` overrides cfg.sliding_window (the gemma2 pair-scan passes
     the per-layer value; None = global attention). `moe` overrides
@@ -606,7 +605,7 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
     else:
         a, new_cache = _mha(h, lp, cfg, freqs, positions, kv_len,
                             cache_kv, cache_index, window, uo,
-                            adapter_ids)
+                            adapter_ids, use_rope=use_rope)
     use_moe = cfg.is_moe if moe is None else moe
     if cfg.parallel_block:
         # command-r: attention and MLP both read the SAME normed
@@ -629,9 +628,10 @@ def _layer(x: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
 
 def _qkv(h: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
          positions: jax.Array, uo: bool,
-         adapter_ids: Optional[jax.Array] = None):
+         adapter_ids: Optional[jax.Array] = None, rope: bool = True):
     """Projected + biased + normed + roped q/k/v — shared between the
-    dense (_mha) and paged (forward_paged) attention paths."""
+    dense (_mha) and paged (forward_paged) attention paths.
+    `rope=False` is cohere2's NoPE global layers."""
     q = _proj_lora(h, lp, "wq", adapter_ids, cfg.dtype,
                    out_dims=(cfg.num_heads, cfg.head_dim))
     k = _proj_lora(h, lp, "wk", adapter_ids, cfg.dtype,
@@ -650,16 +650,19 @@ def _qkv(h: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
         else:
             q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps, uo)
             k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps, uo)
-    q = apply_rope(q, positions, freqs, cfg.rope_interleaved)
-    k = apply_rope(k, positions, freqs, cfg.rope_interleaved)
+    if rope:
+        q = apply_rope(q, positions, freqs, cfg.rope_interleaved)
+        k = apply_rope(k, positions, freqs, cfg.rope_interleaved)
     return q, k, v
 
 
 def _mha(h: jax.Array, lp: Params, cfg: ModelConfig, freqs: jax.Array,
          positions: jax.Array, kv_len, cache_kv, cache_index, window,
-         uo: bool, adapter_ids: Optional[jax.Array] = None):
+         uo: bool, adapter_ids: Optional[jax.Array] = None,
+         use_rope: bool = True):
     """Standard multi-head (GQA) attention on the pre-normed input."""
-    q, k, v = _qkv(h, lp, cfg, freqs, positions, uo, adapter_ids)
+    q, k, v = _qkv(h, lp, cfg, freqs, positions, uo, adapter_ids,
+                   rope=use_rope)
 
     if cache_kv is not None:
         ck, cv = cache_kv
@@ -854,42 +857,51 @@ def _final_logits(params: Params, cfg: ModelConfig,
 def _alt_window_scan(params: Params, cfg: ModelConfig, x: jax.Array,
                      freqs, positions, kv_len, cache: Optional[KVCache],
                      adapter_ids: Optional[jax.Array] = None):
-    """Scan over layer PAIRS: gemma2 alternates sliding-window (even
-    layers) and global (odd layers) attention. The pair body keeps both
-    window variants static — one compiled body, no dynamic masks."""
-    L = cfg.num_layers
-    assert L % 2 == 0, "alternating sliding window needs an even depth"
+    """Scan over layer GROUPS of `cfg.sliding_pattern` (P): layers
+    with (i+1) % P != 0 use the sliding window, every P-th layer is
+    global. gemma2/gpt-oss: P=2; command-r7b/command-a (cohere2):
+    P=4, and the global layers additionally skip RoPE
+    (cfg.rope_skip_global — Cohere2Attention applies rotary only on
+    sliding layers). The unrolled group body keeps every variant
+    static — one compiled body, no dynamic masks."""
+    L, P = cfg.num_layers, cfg.sliding_pattern
+    assert L % P == 0, \
+        f"alternating sliding window needs depth % {P} == 0"
 
-    def pair(a):
-        return a.reshape(L // 2, 2, *a.shape[1:])
+    def group(a):
+        return a.reshape(L // P, P, *a.shape[1:])
 
-    layers2 = jax.tree.map(pair, params["layers"])
+    layers_g = jax.tree.map(group, params["layers"])
     index = cache.index if cache is not None else None
 
     def body(x, per):
-        lp2, c2 = per
-        lp0 = jax.tree.map(lambda a: a[0], lp2)
-        lp1 = jax.tree.map(lambda a: a[1], lp2)
-        c0 = (c2[0][0], c2[1][0]) if c2 is not None else None
-        c1 = (c2[0][1], c2[1][1]) if c2 is not None else None
-        x, n0 = _layer(x, lp0, cfg, freqs, positions, kv_len, c0, index,
-                       window=cfg.sliding_window,
-                       adapter_ids=adapter_ids)
-        x, n1 = _layer(x, lp1, cfg, freqs, positions, kv_len, c1, index,
-                       window=None, adapter_ids=adapter_ids)
-        if n0 is None:
+        lp_g, c_g = per
+        nks, nvs = [], []
+        for j in range(P):
+            lp = jax.tree.map(lambda a: a[j], lp_g)
+            cj = (c_g[0][j], c_g[1][j]) if c_g is not None else None
+            is_global = (j + 1) % P == 0
+            x, nc = _layer(
+                x, lp, cfg, freqs, positions, kv_len, cj, index,
+                window=None if is_global else cfg.sliding_window,
+                adapter_ids=adapter_ids,
+                use_rope=not (is_global and cfg.rope_skip_global))
+            if nc is not None:
+                nks.append(nc[0])
+                nvs.append(nc[1])
+        if not nks:
             return x, None
-        return x, (jnp.stack([n0[0], n1[0]]), jnp.stack([n0[1], n1[1]]))
+        return x, (jnp.stack(nks), jnp.stack(nvs))
 
     if cache is not None:
-        x, (nk, nv) = lax.scan(body, x,
-                               (layers2, (pair(cache.k), pair(cache.v))))
+        x, (nk, nv) = lax.scan(
+            body, x, (layers_g, (group(cache.k), group(cache.v))))
         S = positions.shape[1]
         new_cache = KVCache(k=nk.reshape(cache.k.shape),
                             v=nv.reshape(cache.v.shape),
                             index=cache.index + S)
     else:
-        x, _ = lax.scan(body, x, (layers2, None))
+        x, _ = lax.scan(body, x, (layers_g, None))
         new_cache = None
     return x, new_cache
 

@@ -46,6 +46,16 @@ def pipeline_forward(params: Dict[str, Any], cfg: ModelConfig,
             "pipeline_forward needs structurally uniform stages; "
             "first_k_dense (DeepSeek) models mix dense and MoE layers "
             "— serve them via tp (engine/sharded.py) instead")
+    if cfg.alt_sliding_window and (cfg.sliding_pattern != 2
+                                   or cfg.rope_skip_global):
+        # the stage body below hardcodes the gemma2 P=2 pattern; a
+        # cohere2 config (P=4, NoPE globals) would run with the wrong
+        # window/rope per layer — refuse instead of silently serving
+        # wrong logits (r5 review)
+        raise NotImplementedError(
+            "pipeline_forward implements the P=2 alternating pattern "
+            "only; serve sliding_pattern!=2 / NoPE models via tp "
+            "(engine/sharded.py)")
     if cfg.alt_sliding_window and (cfg.num_layers // pp) % 2 != 0:
         raise ValueError(
             "alternating-sliding-window (gemma2) pipeline stages must "

@@ -1125,7 +1125,8 @@ def extra_runtime_docs():
     # alternates so aya-expanse/command-r flip to the in-repo engine
     yield "runtimes/ome/ome-engine-commandr-rt.yaml", _csr(
         "ome-engine-commandr",
-        [fmt("CohereForCausalLM", prio=8)],
+        [fmt("CohereForCausalLM", prio=8),
+         fmt("Cohere2ForCausalLM", prio=8)],  # command-r7b
         "1B", "40B",
         {"runner": _tpu_runner(
             ome, ["--model-dir", "$(MODEL_PATH)", "--tp", "4",
@@ -1134,8 +1135,9 @@ def extra_runtime_docs():
          "minChips": 4, "topologies": ["2x2", "2x2x1"]})
     yield "runtimes/ome/ome-engine-commandr-plus-rt.yaml", _csr(
         "ome-engine-commandr-plus",
-        [fmt("CohereForCausalLM", prio=8)],
-        "41B", "110B",
+        [fmt("CohereForCausalLM", prio=8),
+         fmt("Cohere2ForCausalLM", prio=8)],  # command-a (111B)
+        "41B", "115B",
         {"runner": _tpu_runner(
             ome, ["--model-dir", "$(MODEL_PATH)", "--tp", "16",
                   "--max-slots", "32", "--port", "8080"], 4),
@@ -1532,8 +1534,8 @@ def family_runtime_docs():
         router=pd_router)
     yield "runtimes/ome/ome-engine-pd-mistral-rt.yaml", _csr(
         "ome-engine-pd-mistral",
-        # 4: 1 is the paged runtime's, 2/3 the small/vllm pair
-        [fmt("MistralForCausalLM", prio=4)],
+        [fmt("MistralForCausalLM", prio=1)],  # pin explicitly: PD for
+        # a 7B is a deliberate choice, never the auto-default
         "5B", "15B",
         {"runner": _tpu_runner(
             ome, ome_args("--disaggregation-mode", "prefill",
@@ -1566,11 +1568,12 @@ def family_runtime_docs():
         "ome-engine-paged",
         # llama rides prio 4 (1 is jetstream's; 4 flips small llamas
         # to the native paged engine while the v5e-tuned 8B entry at
-        # 8 keeps winning its class); the rest take the free prio 1
+        # 8 keeps winning its class); qwen takes the free prio 1.
+        # NO mistral/phi3: their checkpoints carry sliding_window,
+        # which the paged engine refuses (dense cache only)
         [fmt("LlamaForCausalLM", prio=4)] +
         [fmt(a, prio=1) for a in
-         ("Qwen2ForCausalLM", "Qwen3ForCausalLM",
-          "MistralForCausalLM", "Phi3ForCausalLM")],
+         ("Qwen2ForCausalLM", "Qwen3ForCausalLM")],
         "100M", "15B",
         {"runner": _tpu_runner(
             ome, ome_args("--kv-block", "128", "--max-seq", "8192",

@@ -115,6 +115,11 @@ class TestCatalogRouting:
             "ome-engine-commandr"
         assert self._select(catalog, "command-r-plus") == \
             "ome-engine-commandr-plus"
+        # cohere2 (round-5 late addition: period-4 NoPE pattern)
+        assert self._select(catalog, "command-r7b-12-2024") == \
+            "ome-engine-commandr"
+        assert self._select(catalog, "command-a-03-2025") == \
+            "ome-engine-commandr-plus"
         assert self._select(catalog, "gpt-oss-20b") == \
             "ome-engine-moe"
         assert self._select(catalog, "gpt-oss-120b", "tpu-v5p") == \

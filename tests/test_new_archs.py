@@ -121,6 +121,20 @@ def test_cohere_qk_norm(tmp_path):
     assert params["layers"]["q_norm"].shape[-2:] == (4, 16)
 
 
+def test_cohere2_logits_match_transformers(tmp_path):
+    """command-r7b / command-a: cohere parallel block + period-4
+    sliding pattern with NoPE global layers."""
+    hf = transformers.Cohere2Config(
+        vocab_size=120, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=4, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        logit_scale=0.5, sliding_window=4, sliding_window_pattern=4)
+    model, d = _save_hf(tmp_path, hf)
+    params, cfg = _compare_logits(model, d)
+    assert cfg.alt_sliding_window and cfg.sliding_pattern == 4
+    assert cfg.rope_skip_global and cfg.parallel_block
+
+
 def test_gpt_oss_logits_match_transformers(tmp_path):
     """gpt-oss: attention sinks, alternating sliding layers, biased
     top-k router + clamped-GLU experts with biases."""
@@ -197,7 +211,7 @@ def test_unknown_rope_scaling_rejected(tmp_path):
                       jnp.asarray([[1, 2, 3]], jnp.int32))
 
 
-@pytest.mark.parametrize("family", ["phi3", "cohere"])
+@pytest.mark.parametrize("family", ["phi3", "cohere", "cohere2"])
 def test_engine_decode_continuation(tmp_path, family):
     """The serving engine decodes greedily to the same tokens the
     materialized forward would produce for the new families."""
@@ -208,6 +222,13 @@ def test_engine_decode_continuation(tmp_path, family):
             num_key_value_heads=2, max_position_embeddings=128,
             sliding_window=None, pad_token_id=0, bos_token_id=1,
             eos_token_id=2, tie_word_embeddings=False)
+    elif family == "cohere2":
+        hf = transformers.Cohere2Config(
+            vocab_size=120, hidden_size=64, intermediate_size=96,
+            num_hidden_layers=4, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128,
+            logit_scale=0.5, sliding_window=4,
+            sliding_window_pattern=4)
     else:
         hf = transformers.CohereConfig(
             vocab_size=120, hidden_size=64, intermediate_size=96,
