@@ -8,9 +8,10 @@ OpenAI-compatible HTTP front-end.
 
 from .core import DecodeState, InferenceEngine
 from .sampling import sample
-from .scheduler import Request, Scheduler
+from .scheduler import Request, Scheduler, SchedulerOverloaded
 from .server import EngineServer
 from .tokenizer import ByteTokenizer, load_tokenizer
 
 __all__ = ["DecodeState", "InferenceEngine", "Request", "Scheduler",
-           "EngineServer", "ByteTokenizer", "load_tokenizer", "sample"]
+           "SchedulerOverloaded", "EngineServer", "ByteTokenizer",
+           "load_tokenizer", "sample"]

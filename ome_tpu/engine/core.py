@@ -553,7 +553,16 @@ class InferenceEngine:
                 if not self._owned[b]:
                     continue  # b itself was the victim
                 if not self._free_blocks:
-                    continue  # nothing evictable: writes go to trash
+                    # nothing evictable and no block for b's next
+                    # write: preempt b EXPLICITLY rather than letting
+                    # its writes land in the trash block (a host/
+                    # device length desync a future allocator change
+                    # could silently re-enable). Defensively
+                    # unreachable today — any _preempt_victim success
+                    # above frees blocks — but cheap to keep honest.
+                    self._preempted.append(b)
+                    self.free_slot(b)
+                    continue
                 nid = self._free_blocks.pop()
                 self._owned[b].append(nid)
                 self._table[b, j] = nid

@@ -399,13 +399,19 @@ def follower_loop(engine, sub: OpSubscriber,
             except ValueError:
                 # the leader only publishes after ITS unload succeeded;
                 # a local refusal means this follower's adapter refs
-                # drifted (e.g. a missed free_slot) — clear the refs
-                # (NOT the KV blocks: active sequences still own those)
-                # and follow the leader rather than killing the group
+                # drifted (e.g. a missed free_slot) — clear ONLY the
+                # refused adapter's slot refs (other adapters' in-
+                # flight sequences are not drifted, and zeroing them
+                # would let a racing unregister of a busy adapter slip
+                # through), NOT the KV blocks: active sequences still
+                # own those. Then follow the leader rather than
+                # killing the group.
                 log.warning("unregister %r refused locally; clearing "
-                            "stale adapter refs to follow the leader",
-                            msg["name"])
-                engine._slot_adapters[:] = 0
+                            "its stale adapter refs to follow the "
+                            "leader", msg["name"])
+                idx = engine.adapter_id(msg["name"])
+                refs = engine._slot_adapters
+                refs[refs == idx] = 0
                 engine.unregister_adapter(msg["name"])
         elif op == "free_slot":
             engine.free_slot(msg["slot"])

@@ -144,7 +144,12 @@ class RemotePrefillEngine:
         it); `adapter` (a LoRA adapter name registered on BOTH pools)
         makes the prefill node compute the prefix with that adapter's
         deltas."""
+        from .. import faults
         from .structured import pack_mask
+
+        # deterministic fault injection: a dropped PD handoff is a
+        # TRANSIENT error (fails one request, scheduler stays up)
+        faults.fire("pd_fetch", key=self.peer_url, exc=PDError)
         body = json.dumps({
             "ids": list(map(int, prompt_ids)),
             "temperature": float(temperature), "top_k": int(top_k),

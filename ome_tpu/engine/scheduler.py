@@ -18,12 +18,21 @@ prefills, and a finished request releases its slot back.
 Multi-host leaders (engine/multihost.ReplicatedEngine) disable the
 overlap: followers replay the leader's op stream strictly in order, so
 ops must be published from one thread in execution order.
+
+Failure semantics (docs/failure-semantics.md): an engine-step fault
+fails only the in-flight batch; queued requests survive, the decode
+state is rebuilt after an exponential-backoff pause, and admission
+resumes — up to `max_restarts` consecutive attempts, after which the
+scheduler goes permanently dead (the pre-recovery behavior, and what
+a liveness probe should restart the pod on). Status is tri-state:
+`ok` (serving), `degraded` (recovering — requests queue), `dead`.
 """
 
 from __future__ import annotations
 
 import collections
 import itertools
+import math
 import queue
 import threading
 import time
@@ -32,9 +41,20 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from .. import faults
 from .core import DecodeState, InferenceEngine
 
 _ids = itertools.count()
+
+
+class SchedulerOverloaded(RuntimeError):
+    """The pending queue would exceed a bounded wait; the client
+    should back off for `retry_after` seconds (HTTP 429/Retry-After
+    rather than an indefinitely blocked handler)."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
 
 
 @dataclass
@@ -50,6 +70,10 @@ class Request:
     masker: Optional[object] = None
     # multi-LoRA: adapter name (engine register_adapter); None = base
     adapter: Optional[str] = None
+    # absolute time.monotonic() deadline; an expired request is shed
+    # at admission (never occupies a slot) or finished mid-decode
+    # with finish_reason="timeout"
+    deadline: Optional[float] = None
     id: int = field(default_factory=lambda: next(_ids))
     created: float = field(default_factory=time.monotonic)
     # results
@@ -66,7 +90,16 @@ class Request:
         self.output_ids.append(token)
         self.stream.put(token)
 
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (time.monotonic() if now is None else now)
+                >= self.deadline)
+
     def finish(self, reason: str):
+        # first finish wins: the server may time a request out while
+        # the scheduler concurrently finishes it (benign race)
+        if self.done.is_set():
+            return
         self.finish_reason = reason
         self.stream.put(None)
         self.done.set()
@@ -84,8 +117,18 @@ class Scheduler:
     # it needs the admission thread from start(), while tests and
     # multi-host leaders drive step() synchronously
     def __init__(self, engine: InferenceEngine, max_pending: int = 512,
-                 overlap: bool = False):
+                 overlap: bool = False, max_restarts: int = 3,
+                 restart_backoff: float = 0.05,
+                 max_queue_wait: float = 30.0):
         self.engine = engine
+        # crash recovery: consecutive engine-fault restarts tolerated
+        # before going permanently dead (0 = first fault is fatal, the
+        # pre-recovery fail-fast behavior)
+        self.max_restarts = max_restarts
+        self.restart_backoff = restart_backoff
+        # admission control: reject (429) when the estimated queue
+        # wait exceeds this many seconds
+        self.max_queue_wait = max_queue_wait
         self.state: DecodeState = engine.new_state()
         self.pending: "queue.Queue[Request]" = queue.Queue(max_pending)
         self.slots: List[Optional[Request]] = [None] * engine.max_slots
@@ -110,13 +153,38 @@ class Scheduler:
         self._admit_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._lock = threading.Lock()  # guards submit-vs-stop + stats
-        self.healthy = True
+        # tri-state health: ok (serving) / degraded (mid-recovery,
+        # requests queue) / dead (restart budget exhausted)
+        self._status = "ok"
+        self._restarts = 0  # consecutive faults since last good step
+        # the admission thread signals a local engine fault here; the
+        # scheduler thread owns recovery (one recoverer, no races)
+        self._fault_event = threading.Event()
+        # EWMAs for the queue-wait estimate (admission control)
+        self._ewma_step_s: Optional[float] = None
+        self._ewma_req_steps: Optional[float] = None
         self.stats: Dict[str, float] = {
             "requests_total": 0, "tokens_generated_total": 0,
             "prefill_total": 0, "decode_steps_total": 0,
             "queue_depth": 0, "active_slots": 0,
-            "preemptions_total": 0,
+            "preemptions_total": 0, "timeouts_total": 0,
+            "rejected_total": 0, "engine_faults_total": 0,
+            "restarts_total": 0,
         }
+
+    @property
+    def status(self) -> str:
+        return self._status
+
+    # backward-compat boolean view of the tri-state (degraded still
+    # accepts work, so it reads healthy)
+    @property
+    def healthy(self) -> bool:
+        return self._status != "dead"
+
+    @healthy.setter
+    def healthy(self, value: bool):
+        self._status = "ok" if value else "dead"
 
     def _inc(self, key: str, by: float = 1):
         with self._lock:
@@ -124,14 +192,46 @@ class Scheduler:
 
     # -- public --------------------------------------------------------
 
+    def _queue_wait_estimate(self, depth: int) -> Optional[float]:
+        """Rough seconds until a newly queued request would start
+        decoding: queue depth in batch waves x observed per-request
+        decode steps x observed step time. None until both EWMAs have
+        samples (cold start admits optimistically)."""
+        if depth <= 0 or self._ewma_step_s is None \
+                or self._ewma_req_steps is None:
+            return None
+        waves = math.ceil(depth / self.engine.max_slots)
+        return waves * self._ewma_req_steps * self._ewma_step_s
+
     def submit(self, req: Request) -> Request:
         # the lock makes submit-vs-stop atomic: a request either gets
         # queued before the shutdown drain, or is rejected here
         with self._lock:
-            if self._stop.is_set() or not self.healthy:
+            if self._stop.is_set() or self._status == "dead":
                 raise RuntimeError("scheduler unavailable")
             self.stats["requests_total"] += 1
-            self.pending.put_nowait(req)  # Full propagates -> HTTP 503
+            if req.expired():
+                # dead on arrival: never queued, never slotted
+                self.stats["timeouts_total"] += 1
+                req.finish("timeout")
+                return req
+            depth = self.pending.qsize()
+            est = self._queue_wait_estimate(depth + 1)
+            if depth >= self.pending.maxsize or \
+                    (est is not None and est > self.max_queue_wait):
+                self.stats["rejected_total"] += 1
+                retry = min(max(est if est is not None else 1.0, 0.5),
+                            30.0)
+                raise SchedulerOverloaded(
+                    f"pending queue saturated (depth {depth}, "
+                    f"estimated wait {est if est is not None else '?'}"
+                    "s)", retry_after=retry)
+            try:
+                self.pending.put_nowait(req)
+            except queue.Full:
+                self.stats["rejected_total"] += 1
+                raise SchedulerOverloaded(
+                    "pending queue full", retry_after=1.0) from None
         return req
 
     def start(self):
@@ -160,11 +260,26 @@ class Scheduler:
 
     def _next_pending(self) -> Request:
         """Requeued (bounced / preempted) requests go first; raises
-        queue.Empty like pending.get_nowait()."""
-        try:
-            return self._requeue.popleft()
-        except IndexError:
-            return self.pending.get_nowait()
+        queue.Empty like pending.get_nowait(). Expired or already-
+        finished (server-side timeout) requests are shed here — they
+        never occupy a decode slot."""
+        while True:
+            try:
+                req = self._requeue.popleft()
+            except IndexError:
+                req = self.pending.get_nowait()  # Empty propagates
+            if self._shed_if_expired(req):
+                continue
+            return req
+
+    def _shed_if_expired(self, req: Request) -> bool:
+        if req.done.is_set():
+            return True  # finished elsewhere (server-side timeout)
+        if req.expired():
+            self._inc("timeouts_total")
+            req.finish("timeout")
+            return True
+        return False
 
     def _fail_all(self, reason: str):
         with self._lock:
@@ -190,7 +305,10 @@ class Scheduler:
                     self.slots[slot] = None
                     free = getattr(self.engine, "free_slot", None)
                     if free is not None:
-                        free(slot)
+                        try:
+                            free(slot)
+                        except Exception:  # noqa: BLE001 — draining a
+                            pass  # faulted engine must not abort
                     r.finish(reason)
                     if self.overlap:
                         self._free_slots.release()
@@ -222,7 +340,12 @@ class Scheduler:
     # -- overlap mode: admission thread prefills, step() inserts -------
 
     def _admit_loop(self):
-        while not self._stop.is_set() and self.healthy:
+        while not self._stop.is_set() and self._status != "dead":
+            if self._status != "ok" or self._fault_event.is_set():
+                # recovery in flight: hold admission (requests queue)
+                # until the scheduler thread restores the engine state
+                time.sleep(0.005)
+                continue
             # slot credit first: at most max_slots prefills in flight
             # ahead of their inserts
             if not self._free_slots.acquire(timeout=0.05):
@@ -235,6 +358,9 @@ class Scheduler:
                 except queue.Empty:
                     self._free_slots.release()
                     continue
+            if self._shed_if_expired(req):
+                self._free_slots.release()
+                continue
             if not self._fits_pool(req):
                 req.finish("error")
                 self._free_slots.release()
@@ -268,15 +394,15 @@ class Scheduler:
                     req.finish("error")
                     self._free_slots.release()
                     continue
-                # local engine faults keep the fail-fast contract: no
-                # waiter may observe a healthy scheduler after its
-                # request failed
+                # local engine fault: this request is lost, but the
+                # SCHEDULER thread owns recovery — signal it and keep
+                # the admission thread alive to resume after restart
                 logging.getLogger("ome.engine").exception(
-                    "prefill failed; failing scheduler")
-                self.healthy = False
+                    "prefill failed; requesting engine recovery")
                 req.finish("error")
                 self._free_slots.release()
-                return
+                self._fault_event.set()
+                continue
             self._inc("prefill_total")
             # under _lock so a prefill that outlives stop()'s join or a
             # scheduler-thread death (e.g. a slow remote PD fetch)
@@ -317,8 +443,11 @@ class Scheduler:
                     req.finish("error")
                     self._free_slots.release()
                     continue
-                self.healthy = False
+                # engine fault: req is out of every queue so _recover
+                # cannot see it — fail it (and return its slot credit)
+                # before propagating to the recovery handler in _run
                 req.finish("error")
+                self._free_slots.release()
                 raise
             self.slots[slot] = req
             self._temp[slot] = req.temperature
@@ -368,12 +497,9 @@ class Scheduler:
                     # racing a hot adapter unload fails ONE request
                     req.finish("error")
                     continue
-                # req is out of the queue but not yet slotted — _fail_all
-                # cannot see it, so fail it here before propagating.
-                # Health flips FIRST: a waiter woken by this failure must
-                # never observe a healthy scheduler (the _run handler
-                # also sets it, but only after this frame unwinds)
-                self.healthy = False
+                # req is out of the queue but not yet slotted, so the
+                # recovery handler cannot see it — fail it here before
+                # propagating to _recover in _run
                 req.finish("error")
                 raise
             self.slots[slot] = req
@@ -392,7 +518,11 @@ class Scheduler:
     def _decode(self) -> bool:
         if not any(r is not None for r in self.slots):
             return False
+        # deterministic fault injection (tests, chaos drills): only
+        # real decode steps count as hits
+        faults.fire("engine_step")
         mask = self._build_mask()
+        t0 = time.monotonic()
         if mask is not None:
             self.state, toks = self.engine.decode(
                 self.state, self._temp, self._top_k, self._top_p,
@@ -400,6 +530,9 @@ class Scheduler:
         else:  # engine wrappers/fakes need no mask kwarg in their API
             self.state, toks = self.engine.decode(
                 self.state, self._temp, self._top_k, self._top_p)
+        dt = time.monotonic() - t0
+        self._ewma_step_s = dt if self._ewma_step_s is None \
+            else 0.9 * self._ewma_step_s + 0.1 * dt
         self._inc("decode_steps_total")
         # paged-KV pool pressure may have evicted sequences BEFORE this
         # step ran — their sampled token is garbage (their new KV row
@@ -499,6 +632,10 @@ class Scheduler:
             reason = "stop"  # the grammar accepted a complete value
         elif tok in req.stop_ids:
             reason = "stop"
+        elif req.expired():
+            # deadline passed mid-decode: partial output is returned
+            # with the honest finish reason
+            reason = "timeout"
         elif len(req.output_ids) >= req.max_new_tokens:
             reason = "length"
         elif (int(self._true_len[slot])
@@ -516,24 +653,97 @@ class Scheduler:
         free = getattr(self.engine, "free_slot", None)
         if free is not None:  # paged engines reclaim the KV blocks
             free(slot)
+        if reason == "timeout":
+            self._inc("timeouts_total")
+        n = max(len(req.output_ids), 1)
+        self._ewma_req_steps = float(n) if self._ewma_req_steps is None \
+            else 0.8 * self._ewma_req_steps + 0.2 * n
         req.finish(reason)
         if self.overlap:
             self._free_slots.release()
 
+    # -- crash recovery ------------------------------------------------
+
+    def _fail_batch(self, reason: str):
+        """Fail the in-flight batch ONLY: occupied slots are freed and
+        their requests finished; queued work (pending, _requeue, and
+        prefilled-awaiting-insert _ready items, whose KV is
+        independent of the decode state) survives the restart."""
+        for slot, r in enumerate(self.slots):
+            if r is None:
+                continue
+            self.slots[slot] = None
+            self._temp[slot] = 0.0
+            free = getattr(self.engine, "free_slot", None)
+            if free is not None:
+                try:
+                    free(slot)
+                except Exception:  # noqa: BLE001 — allocator state is
+                    pass  # rebuilt wholesale below anyway
+            r.finish(reason)
+            if self.overlap:
+                self._free_slots.release()
+
+    def _go_dead(self) -> bool:
+        with self._lock:
+            self._status = "dead"
+        self._fail_all("error")
+        return False
+
+    def _recover(self, err: BaseException) -> bool:
+        """Engine-step fault path: fail the in-flight batch, rebuild
+        the decode state after an exponential-backoff pause, resume
+        admitting. Returns False when the restart budget is exhausted
+        (scheduler dead) or the state rebuild itself fails."""
+        import logging
+        log = logging.getLogger("ome.engine")
+        self._inc("engine_faults_total")
+        with self._lock:
+            self._status = "degraded"
+        self._fail_batch("error")
+        self._restarts += 1
+        if self._restarts > self.max_restarts:
+            log.error("engine fault (%s); %d consecutive restarts "
+                      "exhausted the budget — scheduler dead", err,
+                      self._restarts - 1)
+            return self._go_dead()
+        delay = min(self.restart_backoff * (2 ** (self._restarts - 1)),
+                    5.0)
+        log.warning("engine fault (%s); restart %d/%d in %.3fs", err,
+                    self._restarts, self.max_restarts, delay)
+        if self._stop.wait(delay):
+            return True  # shutting down; stop() drains the queues
+        try:
+            self.state = self.engine.new_state()
+        except Exception:  # noqa: BLE001
+            log.exception("decode-state rebuild failed; scheduler dead")
+            return self._go_dead()
+        self._fault_event.clear()
+        with self._lock:
+            self._status = "ok"
+            self.stats["restarts_total"] += 1
+        return True
+
     def _run(self):
         while not self._stop.is_set():
             try:
-                if not self.healthy:
-                    # the admission thread died; fail waiters fast
+                if self._status == "dead":
+                    # no recovery left; fail waiters fast
                     self._fail_all("error")
                     return
-                if not self.step():
-                    time.sleep(0.001)
-            except Exception:  # noqa: BLE001 — a dead loop must not
-                # leave waiters hanging or /health lying
+                if self._fault_event.is_set():
+                    raise RuntimeError(
+                        "admission-thread engine fault")
+                did = self.step()
+                if did and self._status == "ok":
+                    self._restarts = 0  # a good step resets the budget
+                else:
+                    if not did:
+                        time.sleep(0.001)
+            except Exception as e:  # noqa: BLE001 — a dead loop must
+                # not leave waiters hanging or /health lying
                 import logging
                 logging.getLogger("ome.engine").exception(
-                    "scheduler step failed; failing in-flight requests")
-                self.healthy = False
-                self._fail_all("error")
-                return
+                    "scheduler step failed; failing in-flight batch")
+                if not self._recover(e):
+                    return

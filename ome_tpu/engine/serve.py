@@ -88,6 +88,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefill-peer", default=None,
                    help="prefill pool URL (required for "
                         "--disaggregation-mode decode)")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="consecutive engine-fault recovery attempts "
+                        "before the scheduler goes permanently dead "
+                        "(/health 503); 0 = first fault is fatal")
+    p.add_argument("--max-queue-wait", type=float, default=30.0,
+                   help="reject new requests (429 + Retry-After) when "
+                        "the estimated pending-queue wait exceeds "
+                        "this many seconds")
+    p.add_argument("--faults", default=None,
+                   help="deterministic fault-injection spec "
+                        "(ome_tpu/faults.py grammar, e.g. "
+                        "'engine_step.raise@100'); also via OME_FAULTS")
     return p
 
 
@@ -182,6 +194,13 @@ def load_engine(args, dist=None):
             raise SystemExit("multi-LoRA serving is single-host tp=1 "
                              "for now (adapter stacks are unsharded); "
                              "use a merged --adapter dir with tp>1")
+        if args.kv_block or args.kv_blocks:
+            # refuse loudly rather than silently serving a dense cache
+            # the operator sized a paged pool for
+            raise SystemExit("--kv-block/--kv-blocks (paged KV) is "
+                             "single-host tp=1 for now (the sharded "
+                             "engine keeps the dense per-slot cache); "
+                             "drop the flags with tp>1")
         # hand the host tree straight to shard_params: materializing it
         # on one device first would OOM exactly the models tp serves
         from .sharded import ShardedInferenceEngine
@@ -191,13 +210,31 @@ def load_engine(args, dist=None):
                                       prefix_cache_bytes=args.prefix_cache_mb << 20)
     import jax
     params = jax.tree.map(jnp.asarray, params)  # one transfer
-    engine = InferenceEngine(params, cfg, max_slots=args.max_slots,
-                             max_seq=max_seq,
-                             prefix_cache_bytes=args.prefix_cache_mb << 20,
-                             lora_slots=lora_slots,
-                             lora_rank=args.lora_rank,
-                             kv_block=args.kv_block,
-                             kv_blocks=args.kv_blocks)
+
+    def build(kv_block, kv_blocks):
+        return InferenceEngine(params, cfg, max_slots=args.max_slots,
+                               max_seq=max_seq,
+                               prefix_cache_bytes=args.prefix_cache_mb << 20,
+                               lora_slots=lora_slots,
+                               lora_rank=args.lora_rank,
+                               kv_block=kv_block,
+                               kv_blocks=kv_blocks)
+    try:
+        engine = build(args.kv_block, args.kv_blocks)
+    except ValueError as e:
+        if not args.kv_block or "paged KV" not in str(e):
+            raise
+        # graceful degradation: an auto-selected runtime may pass
+        # --kv-block for a model the paged coverage guard refuses
+        # (MLA/MoE/sliding-window arch, or head_dim/heads outside the
+        # Pallas kernel's envelope). Serving dense beats crash-looping
+        # the pod — but shout, because the operator sized HBM for a
+        # paged pool.
+        log.warning("paged KV unavailable for this model (%s); "
+                    "FALLING BACK to the dense per-slot cache — HBM "
+                    "use is max-slots x max-seq, not tokens in flight",
+                    e)
+        engine = build(0, None)
     for name, path in named_adapters.items():
         engine.register_adapter(name, path)
         log.info("registered LoRA adapter %r from %s", name, path)
@@ -208,6 +245,7 @@ class _NullScheduler:
     """Placeholder driving nothing — embeddings are stateless."""
 
     healthy = True
+    status = "ok"
     stats: dict = {}
     reject = "this deployment serves embeddings only"
 
@@ -247,6 +285,10 @@ def main(argv=None) -> int:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
     args = build_parser().parse_args(argv)
+    if args.faults:
+        from .. import faults
+        faults.install(args.faults)
+        log.warning("fault injection ACTIVE: %s", args.faults)
     if _adapter_args(args)[0] and args.random_weights:
         log.error("--adapter merge requires a real checkpoint "
                   "(incompatible with --random-weights); name=dir "
@@ -321,7 +363,9 @@ def main(argv=None) -> int:
         # leaders publish ops from ONE thread in execution order
         # (followers replay strictly sequentially); on PD decode nodes
         # it moves the remote KV fetch off the decode thread
-        scheduler = Scheduler(engine, overlap=dist is None)
+        scheduler = Scheduler(engine, overlap=dist is None,
+                              max_restarts=args.max_restarts,
+                              max_queue_wait=args.max_queue_wait)
     tok = load_tokenizer(args.model_dir)
     name = args.model_name or args.model_dir.rstrip("/").rsplit("/", 1)[-1]
     server = EngineServer(scheduler, tokenizer=tok, model_name=name,

@@ -17,7 +17,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from .scheduler import Request, Scheduler
+from .. import faults
+from .scheduler import Request, Scheduler, SchedulerOverloaded
 from .tokenizer import load_tokenizer
 
 
@@ -25,7 +26,8 @@ class EngineServer:
     def __init__(self, scheduler: Scheduler, tokenizer=None,
                  model_name: str = "ome-model", host: str = "127.0.0.1",
                  port: int = 0, embedder=None, pd_prefill=None,
-                 structured: bool = True):
+                 structured: bool = True,
+                 ready_queue_limit: Optional[int] = None):
         self.scheduler = scheduler
         self.tokenizer = tokenizer or load_tokenizer()
         self.model_name = model_name
@@ -34,6 +36,14 @@ class EngineServer:
         # structured outputs need host-built masks each step; multi-host
         # leaders and PD decode nodes disable them (serve.py)
         self.structured = structured
+        # /ready flips not-ready above this pending depth (readiness
+        # steers the router/k8s away BEFORE the queue saturates into
+        # 429s); default: half the scheduler's pending capacity
+        if ready_queue_limit is None:
+            maxp = getattr(getattr(scheduler, "pending", None),
+                           "maxsize", 0) or 512
+            ready_queue_limit = max(maxp // 2, 1)
+        self.ready_queue_limit = ready_queue_limit
         self.started_at = time.time()
         outer = self
 
@@ -44,11 +54,13 @@ class EngineServer:
                 pass
 
             # -- helpers ----------------------------------------------
-            def _json(self, code: int, obj):
+            def _json(self, code: int, obj, headers=None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -58,12 +70,36 @@ class EngineServer:
 
             # -- GET --------------------------------------------------
             def do_GET(self):
-                if self.path in ("/health", "/healthz", "/ready"):
-                    healthy = outer.scheduler.healthy
-                    self._json(200 if healthy else 503, {
-                        "status": "ok" if healthy else "unhealthy",
+                if self.path in ("/health", "/healthz"):
+                    # LIVENESS: only `dead` (restart budget exhausted)
+                    # should make k8s restart the pod — `degraded`
+                    # (mid-recovery) is a normal operating condition
+                    status = getattr(outer.scheduler, "status",
+                                     "ok" if outer.scheduler.healthy
+                                     else "dead")
+                    sched = outer.scheduler
+                    self._json(200 if status != "dead" else 503, {
+                        "status": status,
+                        "restarts": sched.stats.get(
+                            "restarts_total", 0)
+                        if getattr(sched, "stats", None) else 0,
                         "uptime_s": round(
                             time.time() - outer.started_at, 1)})
+                elif self.path == "/ready":
+                    # READINESS: take this replica out of rotation
+                    # while it is recovering OR its queue is deep —
+                    # without restarting it
+                    status = getattr(outer.scheduler, "status",
+                                     "ok" if outer.scheduler.healthy
+                                     else "dead")
+                    pend = getattr(outer.scheduler, "pending", None)
+                    depth = pend.qsize() if pend is not None else 0
+                    ready = (status == "ok"
+                             and depth <= outer.ready_queue_limit)
+                    self._json(200 if ready else 503, {
+                        "ready": ready, "status": status,
+                        "queue_depth": depth,
+                        "queue_limit": outer.ready_queue_limit})
                 elif self.path == "/v1/models":
                     data = [{"id": outer.model_name, "object": "model",
                              "owned_by": "ome-tpu"}]
@@ -93,6 +129,11 @@ class EngineServer:
 
             # -- POST -------------------------------------------------
             def do_POST(self):
+                code = faults.http("server_http", key=outer.model_name)
+                if code is not None:  # injected backend fault (tests)
+                    return self._json(code, {
+                        "error": f"injected fault (HTTP {code})"},
+                        headers={"Retry-After": "1"})
                 try:
                     payload = self._body()
                 except Exception as e:
@@ -260,6 +301,25 @@ class EngineServer:
                                      f"(serving {outer.model_name}, "
                                      "adapters: " + ", ".join(names)
                                      + ")"})
+                # per-request deadline: payload `timeout` is RELATIVE
+                # seconds; the X-Request-Deadline header (router-
+                # propagated) is ABSOLUTE epoch seconds. Both convert
+                # to the scheduler's monotonic clock; tightest wins.
+                deadline = None
+                try:
+                    rel = payload.get("timeout")
+                    if rel is not None:
+                        deadline = time.monotonic() + float(rel)
+                    hdr = self.headers.get("X-Request-Deadline")
+                    if hdr:
+                        mono = time.monotonic() + (float(hdr)
+                                                   - time.time())
+                        deadline = mono if deadline is None \
+                            else min(deadline, mono)
+                except (TypeError, ValueError):
+                    return self._json(400, {
+                        "error": "timeout / X-Request-Deadline must "
+                                 "be numeric seconds"})
                 req = Request(
                     prompt_ids=prompt if isinstance(prompt, list)
                     else tok.encode(prompt),
@@ -267,15 +327,32 @@ class EngineServer:
                     temperature=float(payload.get("temperature", 0.0)),
                     top_k=int(payload.get("top_k", 0)),
                     top_p=float(payload.get("top_p", 1.0)),
-                    masker=masker, adapter=adapter,
+                    masker=masker, adapter=adapter, deadline=deadline,
                     stop_ids=[tok.eos_id] if tok.eos_id is not None else [])
                 try:
                     outer.scheduler.submit(req)
+                except SchedulerOverloaded as e:
+                    # bounded-wait admission control: tell the client
+                    # (or the router's retry budget) when to come back
+                    return self._json(429, {"error": str(e)},
+                                      headers={"Retry-After": str(
+                                          int(e.retry_after) or 1)})
                 except Exception as e:
-                    return self._json(503, {"error": str(e)})
+                    return self._json(503, {"error": str(e)},
+                                      headers={"Retry-After": "1"})
                 if payload.get("stream"):
                     return self._stream(req, chat)
-                req.done.wait()
+                if req.deadline is not None:
+                    # bounded wait: if the scheduler has not finished
+                    # the request shortly after its deadline (it may
+                    # still sit queued), time it out from here —
+                    # finish() is first-wins, so this races safely
+                    remaining = req.deadline - time.monotonic()
+                    if not req.done.wait(max(remaining, 0) + 0.25):
+                        req.finish("timeout")
+                        req.done.wait()
+                else:
+                    req.done.wait()
                 text = tok.decode(req.output_ids)
                 usage = {"prompt_tokens": len(req.prompt_ids),
                          "completion_tokens": len(req.output_ids),

@@ -1,0 +1,216 @@
+"""Deterministic fault injection for the serving path.
+
+Production serving treats engine-step faults, slow backends, and
+dropped PD handoffs as NORMAL operating conditions — but none of the
+recovery paths (scheduler restart, router circuit breaking, deadline
+shedding) are testable without a way to make those faults happen on
+demand, at an exact step, the same way every run. This module is that
+switch: a process-global registry of counted injection rules that the
+hot paths consult through one cheap call.
+
+Spec grammar (comma-separated rules)::
+
+    point[.kind][=param]@start[:count]
+    point|key[.kind][=param]@start[:count]
+
+  * ``point`` — the injection site name (e.g. ``engine_step``,
+    ``router_forward``, ``pd_fetch``, ``server_http``);
+  * ``key`` — optional per-entity selector (a backend URL, a model
+    name); a keyed rule only matches ``fire(point, key=...)`` calls
+    with that exact key, an unkeyed rule matches every call at the
+    point. Keys may contain ``.``/``:``/``/`` (URLs qualify) but not
+    ``=`` (the param separator);
+  * ``kind`` — ``raise`` (default): raise at the site; ``slow``:
+    sleep ``param`` seconds, then continue; ``http``: make the site
+    answer with HTTP status ``param`` (default 503) — only sites that
+    call :func:`http` honor it;
+  * ``start``/``count`` — fire on hits ``start .. start+count-1`` of
+    that rule (1-based, per rule, process-global); ``count`` defaults
+    to 1. ``engine_step.raise@3`` fails exactly the third engine step.
+
+Activation: ``OME_FAULTS`` env var at first use, ``--faults`` flags on
+the serve/router entrypoints, or :func:`install` from tests. The spec
+is parsed once; every site costs one attribute read + truth test when
+no rules are installed.
+
+Wired sites:
+  * ``engine_step``    — scheduler decode step (raise/slow);
+  * ``server_http``    — EngineServer POST handling, key=model name
+    (http/raise/slow);
+  * ``router_forward`` — router -> backend forward, key=backend URL
+    (raise surfaces as URLError, i.e. a connection failure);
+  * ``pd_fetch``       — PD decode node's remote KV fetch (raise
+    surfaces as PDError: transient, fails one request).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = ["InjectedFault", "Rule", "FaultInjector", "parse_spec",
+           "install", "reset", "fire", "http", "active"]
+
+
+class InjectedFault(RuntimeError):
+    """Raised at a ``raise``-kind injection site."""
+
+
+@dataclass
+class Rule:
+    point: str                    # site name, with optional "|key"
+    kind: str = "raise"           # raise | slow | http
+    param: float = 0.0            # slow: seconds; http: status code
+    start: int = 1                # 1-based hit index the rule arms at
+    count: int = 1                # consecutive hits it stays armed for
+    seen: int = field(default=0)  # hits observed so far (mutable)
+
+    def matches(self, point: str, key: Optional[str]) -> bool:
+        if self.point == point:
+            return True
+        return key is not None and self.point == f"{point}|{key}"
+
+    def armed_hit(self) -> bool:
+        """Count one hit; True when this hit falls in the armed
+        window."""
+        self.seen += 1
+        return self.start <= self.seen < self.start + self.count
+
+
+def parse_spec(spec: str) -> List[Rule]:
+    rules: List[Rule] = []
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        head, sep, sched = entry.rpartition("@")
+        if not sep:
+            raise ValueError(
+                f"fault rule {entry!r}: missing @start[:count]")
+        start_s, _, count_s = sched.partition(":")
+        start, count = int(start_s), int(count_s) if count_s else 1
+        if start < 1 or count < 1:
+            raise ValueError(
+                f"fault rule {entry!r}: start and count must be >= 1")
+        if "=" in head:
+            pk, param_s = head.rsplit("=", 1)
+        else:
+            pk, param_s = head, ""
+        # keys (URLs) contain dots; the KIND never does, so split the
+        # kind off the right only when the tail names one
+        point, _, kind = pk.rpartition(".")
+        if kind not in ("raise", "slow", "http"):
+            point, kind = pk, "raise"
+        if not point:
+            raise ValueError(f"fault rule {entry!r}: empty point")
+        if kind == "http":
+            param = float(param_s) if param_s else 503.0
+        elif kind == "slow":
+            if not param_s:
+                raise ValueError(
+                    f"fault rule {entry!r}: slow needs =seconds")
+            param = float(param_s)
+        else:
+            param = 0.0
+        rules.append(Rule(point=point, kind=kind, param=param,
+                          start=start, count=count))
+    return rules
+
+
+class FaultInjector:
+    """Holds parsed rules; thread-safe counting."""
+
+    def __init__(self, rules: List[Rule]):
+        self.rules = rules
+        self._lock = threading.Lock()
+
+    def fire(self, point: str, key: Optional[str] = None,
+             exc: type = InjectedFault) -> None:
+        """Consult raise/slow rules at a site. Raises ``exc`` when a
+        raise rule is armed for this hit; sleeps for armed slow
+        rules."""
+        delay = 0.0
+        boom = None
+        with self._lock:
+            for r in self.rules:
+                if r.kind == "http" or not r.matches(point, key):
+                    continue
+                if r.armed_hit():
+                    if r.kind == "slow":
+                        delay = max(delay, r.param)
+                    else:
+                        boom = boom or exc(
+                            f"injected fault at {point}"
+                            + (f"|{key}" if key else "")
+                            + f" (hit {r.seen})")
+        if delay:
+            time.sleep(delay)
+        if boom is not None:
+            raise boom
+
+    def http(self, point: str, key: Optional[str] = None
+             ) -> Optional[int]:
+        """Status code an armed http rule wants the site to answer
+        with, else None."""
+        with self._lock:
+            for r in self.rules:
+                if r.kind != "http" or not r.matches(point, key):
+                    continue
+                if r.armed_hit():
+                    return int(r.param)
+        return None
+
+
+# -- process-global registry ----------------------------------------
+#
+# _injector is None until someone installs a spec (or OME_FAULTS is
+# set), so the per-site cost in production is a module attribute read
+# and an `is None` test.
+
+_injector: Optional[FaultInjector] = None
+_env_checked = False
+
+
+def install(spec: str) -> None:
+    """Install (or with an empty spec, clear) the global rule set."""
+    global _injector, _env_checked
+    _env_checked = True  # explicit install overrides the env var
+    rules = parse_spec(spec)
+    _injector = FaultInjector(rules) if rules else None
+
+
+def reset() -> None:
+    global _injector, _env_checked
+    _injector = None
+    _env_checked = True
+
+
+def _get() -> Optional[FaultInjector]:
+    global _injector, _env_checked
+    if not _env_checked:
+        _env_checked = True
+        spec = os.environ.get("OME_FAULTS", "")
+        if spec:
+            _injector = FaultInjector(parse_spec(spec))
+    return _injector
+
+
+def active() -> bool:
+    return _get() is not None
+
+
+def fire(point: str, key: Optional[str] = None,
+         exc: type = InjectedFault) -> None:
+    inj = _get()
+    if inj is not None:
+        inj.fire(point, key=key, exc=exc)
+
+
+def http(point: str, key: Optional[str] = None) -> Optional[int]:
+    inj = _get()
+    if inj is not None:
+        return inj.http(point, key=key)
+    return None

@@ -13,6 +13,19 @@ router component — SURVEY.md §2.9 "PD disaggregation"):
   * health: background probing of each backend's /health; unhealthy
     backends leave the rotation, failed requests retry on the next
     backend;
+  * resilience (docs/failure-semantics.md): a per-backend CIRCUIT
+    BREAKER layered on the health loop — `cb_threshold` consecutive
+    request failures open the circuit for an exponentially growing
+    cooldown, after which ONE half-open probe request re-admits (or
+    re-opens) it. The health probe alone cannot do this: a backend
+    whose /health lies (or flaps) would otherwise re-enter rotation
+    every probe interval and fail live traffic each time. Retries
+    draw from a token-bucket RETRY BUDGET (a fixed fraction of
+    request volume) with exponential backoff + jitter, so a dying
+    pool degrades into fast 503s instead of a retry storm;
+  * deadlines: the X-Request-Deadline header (absolute epoch seconds)
+    propagates to backends, bounds the upstream timeout, and expired
+    requests fail fast with 504 instead of burning a retry;
   * streaming passthrough: SSE bodies relay chunk-by-chunk.
 
 PD note: the KV handoff itself lives in the engines — decode nodes
@@ -51,23 +64,76 @@ class _ResponseStarted(Exception):
 
 
 class Backend:
-    def __init__(self, url: str, pool: str = "engine"):
+    def __init__(self, url: str, pool: str = "engine",
+                 cb_threshold: int = 3, cb_cooldown: float = 1.0,
+                 cb_max_cooldown: float = 30.0):
         self.url = url.rstrip("/")
         self.pool = pool
         self.healthy = True
         self.inflight = 0
         self.last_checked = 0.0
+        # circuit breaker (closed -> open -> half_open -> closed):
+        # consecutive REQUEST failures trip it; the health probe does
+        # not reset it — only a successful half-open data-path probe
+        # closes it again (a flapping /health cannot re-admit a
+        # backend that keeps failing live traffic)
+        self.cb_threshold = cb_threshold
+        self.cb_cooldown = cb_cooldown
+        self.cb_max_cooldown = cb_max_cooldown
+        self.cb_state = "closed"
+        self.cb_open_until = 0.0
+        self.fails = 0       # consecutive request failures
+        self.cb_trips = 0    # times opened (drives the backoff)
+        self._probe_inflight = False
+
+    # callers hold Router._lock (selection and result notes race)
+
+    def record_success(self):
+        self.fails = 0
+        self.cb_trips = 0
+        self.cb_state = "closed"
+        self._probe_inflight = False
+        self.healthy = True
+
+    def record_failure(self, now: float):
+        self.fails += 1
+        self._probe_inflight = False
+        if self.cb_state == "half_open" or \
+                self.fails >= self.cb_threshold:
+            self.cb_trips += 1
+            self.cb_state = "open"
+            self.cb_open_until = now + min(
+                self.cb_cooldown * (2 ** (self.cb_trips - 1)),
+                self.cb_max_cooldown)
+
+    def selectable(self, now: float) -> bool:
+        if self.cb_state == "open":
+            if now < self.cb_open_until:
+                return False
+            self.cb_state = "half_open"  # cooldown over: allow probes
+        if self.cb_state == "half_open":
+            # ONE probe request at a time re-tests the backend
+            return not self._probe_inflight
+        return self.healthy
 
     def __repr__(self):
         return f"Backend({self.url}, {self.pool}, " \
-               f"{'up' if self.healthy else 'down'})"
+               f"{'up' if self.healthy else 'down'}, " \
+               f"cb={self.cb_state})"
 
 
 class Router:
     def __init__(self, backends: List[Backend],
                  policy: str = "cache_aware",
-                 health_interval: float = 10.0):
+                 health_interval: float = 10.0,
+                 cb_threshold: Optional[int] = None,
+                 cb_cooldown: Optional[float] = None):
         self.backends = backends
+        for b in backends:  # router-level CB settings apply uniformly
+            if cb_threshold is not None:
+                b.cb_threshold = cb_threshold
+            if cb_cooldown is not None:
+                b.cb_cooldown = cb_cooldown
         self.policy = policy
         self.health_interval = health_interval
         self._rr = itertools.count()
@@ -77,7 +143,9 @@ class Router:
         self._health_thread: Optional[threading.Thread] = None
         self.stats: Dict[str, float] = {
             "requests_total": 0, "retries_total": 0,
-            "no_backend_total": 0}
+            "no_backend_total": 0, "circuit_open_total": 0,
+            "retry_budget_exhausted_total": 0,
+            "deadline_shed_total": 0}
 
     def inc(self, key: str, by: float = 1):
         with self._lock:  # handler threads are concurrent
@@ -91,22 +159,41 @@ class Router:
 
     def pick(self, pool: str, affinity_key: str = "",
              exclude: Optional[set] = None) -> Optional[Backend]:
+        now = time.monotonic()
         with self._lock:
-            alive = [b for b in self._alive(pool)
-                     if not exclude or b.url not in exclude]
+            alive = [b for b in self.backends
+                     if b.pool == pool and b.selectable(now)
+                     and (not exclude or b.url not in exclude)]
             if not alive:
                 return None
             if self.policy == "random":
-                return self._rng.choice(alive)
-            if self.policy == "cache_aware" and affinity_key:
+                chosen = self._rng.choice(alive)
+            elif self.policy == "cache_aware" and affinity_key:
                 # rendezvous (highest-random-weight) hashing: stable
                 # under backend set changes, no ring state
                 def weight(b: Backend) -> int:
                     return int.from_bytes(hashlib.blake2b(
                         f"{affinity_key}|{b.url}".encode(),
                         digest_size=8).digest(), "big")
-                return max(alive, key=weight)
-            return alive[next(self._rr) % len(alive)]
+                chosen = max(alive, key=weight)
+            else:
+                chosen = alive[next(self._rr) % len(alive)]
+            if chosen.cb_state == "half_open":
+                chosen._probe_inflight = True
+            return chosen
+
+    def note_result(self, backend: Backend, ok: bool):
+        """Feed a request outcome into the backend's circuit breaker
+        (and the boolean health bit the /health view exposes)."""
+        with self._lock:
+            if ok:
+                backend.record_success()
+            else:
+                was_open = backend.cb_state == "open"
+                backend.record_failure(time.monotonic())
+                backend.healthy = False
+                if backend.cb_state == "open" and not was_open:
+                    self.stats["circuit_open_total"] += 1
 
     # -- health --------------------------------------------------------
 
@@ -132,6 +219,32 @@ class Router:
         self._stop.set()
 
 
+class RetryBudget:
+    """Finagle-style token bucket bounding retry amplification: each
+    incoming request deposits `ratio` tokens (plus a small constant
+    burst floor to keep single-request failover working at low
+    traffic); each retry withdraws one. A pool-wide outage therefore
+    costs at most (1 + ratio) x offered load, not retries x load."""
+
+    def __init__(self, ratio: float = 0.2, burst: float = 10.0):
+        self.ratio = ratio
+        self.burst = burst
+        self._tokens = burst
+        self._lock = threading.Lock()
+
+    def deposit(self):
+        with self._lock:
+            self._tokens = min(self._tokens + self.ratio,
+                               self.burst)
+
+    def withdraw(self) -> bool:
+        with self._lock:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+
 def affinity_from_payload(payload: dict) -> str:
     """Prefix-affinity key: the leading content of the request, so a
     continuing conversation maps to the replica already holding its
@@ -148,9 +261,14 @@ def affinity_from_payload(payload: dict) -> str:
 
 class RouterServer:
     def __init__(self, router: Router, host: str = "0.0.0.0",
-                 port: int = 0, retries: int = 2):
+                 port: int = 0, retries: int = 2,
+                 retry_backoff: float = 0.05,
+                 retry_budget_ratio: float = 0.2):
         self.router = router
         self.retries = retries
+        self.retry_backoff = retry_backoff
+        self.budget = RetryBudget(ratio=retry_budget_ratio)
+        self._jitter = random.Random(1)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -159,11 +277,13 @@ class RouterServer:
             def log_message(self, *a):
                 pass
 
-            def _json(self, code: int, obj):
+            def _json(self, code: int, obj, headers=None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -214,20 +334,52 @@ class RouterServer:
                 other = "decoder" if want == "engine" else "engine"
                 return other if outer.router._alive(other) else want
 
+            def _deadline(self) -> Optional[float]:
+                """X-Request-Deadline: absolute epoch seconds."""
+                hdr = self.headers.get("X-Request-Deadline")
+                if not hdr:
+                    return None
+                try:
+                    return float(hdr)
+                except ValueError:
+                    return None
+
             def _proxy(self, body: bytes, stream: bool,
                        affinity: str = ""):
                 outer.router.inc("requests_total")
+                outer.budget.deposit()
+                deadline = self._deadline()
                 pool = self._pick_pool()
                 tried: set = set()
                 last_err = "no healthy backends"
                 for attempt in range(outer.retries + 1):
+                    if deadline is not None and time.time() >= deadline:
+                        # the client stopped caring: do not burn a
+                        # backend slot (or a retry token) on it
+                        outer.router.inc("deadline_shed_total")
+                        return self._json(504, {
+                            "error": "request deadline exceeded"})
+                    if attempt > 0:
+                        if not outer.budget.withdraw():
+                            # retry budget exhausted: fail fast rather
+                            # than amplify a pool-wide outage
+                            outer.router.inc(
+                                "retry_budget_exhausted_total")
+                            break
+                        delay = (outer.retry_backoff
+                                 * (2 ** (attempt - 1))
+                                 * (1 + outer._jitter.random()))
+                        time.sleep(delay)
                     backend = outer.router.pick(pool, affinity,
                                                 exclude=tried)
                     if backend is None:
                         break
                     tried.add(backend.url)
                     try:
-                        return self._forward(backend, body, stream)
+                        result = self._forward(backend, body, stream,
+                                               deadline)
+                        outer.router.note_result(backend, ok=True)
+                        return result
                     except _ClientGone:
                         # the CLIENT went away: nothing to retry, and
                         # the backend did nothing wrong
@@ -235,7 +387,7 @@ class RouterServer:
                     except _ResponseStarted as e:
                         # bytes already reached the client: a retry
                         # would interleave two responses on one socket
-                        backend.healthy = False
+                        outer.router.note_result(backend, ok=False)
                         log.warning("backend %s died mid-response: %s",
                                     backend.url, e)
                         try:
@@ -247,12 +399,13 @@ class RouterServer:
                     except (urllib.error.URLError, OSError,
                             ConnectionError) as e:
                         last_err = str(e)
-                        backend.healthy = False
+                        outer.router.note_result(backend, ok=False)
                         outer.router.inc("retries_total")
                         log.warning("backend %s failed (%s); retrying",
                                     backend.url, e)
                 outer.router.inc("no_backend_total")
-                self._json(503, {"error": f"routing failed: {last_err}"})
+                self._json(503, {"error": f"routing failed: {last_err}"},
+                           headers={"Retry-After": "1"})
 
             def _client_write(self, data: bytes):
                 try:
@@ -261,21 +414,46 @@ class RouterServer:
                     raise _ClientGone(str(e)) from e
 
             def _forward(self, backend: Backend, body: bytes,
-                         stream: bool):
+                         stream: bool, deadline: Optional[float] = None):
+                from .. import faults
+
+                # deterministic fault injection: an armed rule makes
+                # this backend look connection-dead (URLError), which
+                # exercises failover + the circuit breaker
+                faults.fire("router_forward", key=backend.url,
+                            exc=urllib.error.URLError)
+                headers = {"Content-Type": "application/json"}
+                timeout = 600.0
+                if deadline is not None:
+                    # propagate the client deadline downstream and
+                    # bound our own wait by it
+                    headers["X-Request-Deadline"] = repr(deadline)
+                    timeout = max(min(timeout,
+                                      deadline - time.time()), 0.05)
                 req = urllib.request.Request(
                     backend.url + self.path, data=body or None,
-                    method=self.command,
-                    headers={"Content-Type": "application/json"})
+                    method=self.command, headers=headers)
                 backend.inflight += 1
                 try:
-                    resp = urllib.request.urlopen(req, timeout=600)
+                    resp = urllib.request.urlopen(req, timeout=timeout)
                 except urllib.error.HTTPError as e:
-                    # HTTP errors are APPLICATION responses (4xx):
-                    # relay, don't failover
+                    if e.code >= 500:
+                        # a 5xx is a BACKEND failure (dead scheduler,
+                        # injected fault): close the response and let
+                        # the retry loop fail over + trip the breaker
+                        e.close()
+                        raise urllib.error.URLError(
+                            f"backend returned {e.code}") from e
+                    # 4xx are APPLICATION responses (bad request,
+                    # model not found, 429 overload): relay verbatim,
+                    # Retry-After included, don't failover
                     data = e.read()
                     self.send_response(e.code)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(data)))
+                    ra = e.headers.get("Retry-After")
+                    if ra:
+                        self.send_header("Retry-After", ra)
                     self.end_headers()
                     self._client_write(data)
                     return None
@@ -374,6 +552,24 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=8000)
     p.add_argument("--bind", default="0.0.0.0")
     p.add_argument("--health-interval", type=float, default=10.0)
+    p.add_argument("--retries", type=int, default=2,
+                   help="max failover attempts per request (budgeted: "
+                        "retries also draw from a token bucket "
+                        "replenished by request volume)")
+    p.add_argument("--retry-backoff", type=float, default=0.05,
+                   help="base delay before retry N doubles from here, "
+                        "with jitter")
+    p.add_argument("--cb-threshold", type=int, default=3,
+                   help="consecutive request failures that open a "
+                        "backend's circuit breaker")
+    p.add_argument("--cb-cooldown", type=float, default=1.0,
+                   help="initial circuit-open cooldown seconds "
+                        "(doubles per trip, capped at 30s); a single "
+                        "half-open probe re-admits the backend")
+    p.add_argument("--faults", default=None,
+                   help="deterministic fault-injection spec "
+                        "(ome_tpu/faults.py grammar); also via "
+                        "OME_FAULTS")
     p.add_argument("--engine-selector", default=None,
                    help="k8s label selector for engine Services "
                         "(k=v[,k=v]); requires --in-cluster/--kube-*")
@@ -384,6 +580,10 @@ def main(argv=None) -> int:
     p.add_argument("--in-cluster", action="store_true")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+    if args.faults:
+        from .. import faults
+        faults.install(args.faults)
+        log.warning("fault injection ACTIVE: %s", args.faults)
     backends = []
     for spec in args.backend:
         # only known pool prefixes split — URLs may contain '='
@@ -408,9 +608,13 @@ def main(argv=None) -> int:
     if not backends:
         p.error("at least one --backend or --engine-selector is required")
     router = Router(backends, policy=args.policy,
-                    health_interval=args.health_interval)
+                    health_interval=args.health_interval,
+                    cb_threshold=args.cb_threshold,
+                    cb_cooldown=args.cb_cooldown)
     router.check_health_once()
-    srv = RouterServer(router, host=args.bind, port=args.port).start()
+    srv = RouterServer(router, host=args.bind, port=args.port,
+                       retries=args.retries,
+                       retry_backoff=args.retry_backoff).start()
     log.info("router on :%d over %d backends (policy=%s)", srv.port,
              len(backends), args.policy)
     try:

@@ -32,14 +32,22 @@ def main() -> int:
     import jax
     # the image's sitecustomize pre-imports jax pinned to the axon TPU
     # backend; force the 1-local-CPU-device platform before distributed
-    # init (same dance as __graft_entry__._force_cpu_devices)
-    try:
+    # init (same dance as __graft_entry__._force_cpu_devices: older jax
+    # has no jax_num_cpu_devices option and defaults to 1 CPU device,
+    # which is exactly what each group process wants)
+    def _cpu():
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 1)
+        try:
+            jax.config.update("jax_num_cpu_devices", 1)
+        except AttributeError:
+            pass
+
+    try:
+        _cpu()
     except RuntimeError:
         import jax.extend.backend as jeb
         jeb.clear_backends()
-        jax.config.update("jax_num_cpu_devices", 1)
+        _cpu()
     jax.distributed.initialize(f"127.0.0.1:{coord_port}", nproc, pid)
     assert jax.device_count() == nproc, jax.devices()
 

@@ -4,6 +4,7 @@ and the OpenAI-compatible HTTP surface end-to-end."""
 
 import json
 import threading
+import time
 import urllib.request
 
 import jax
@@ -128,8 +129,10 @@ class TestEngineCorrectness:
                 sched.step()
 
     def test_scheduler_failure_fails_requests_and_health(self, world):
+        # max_restarts=0 pins the pre-recovery fail-fast contract: the
+        # FIRST engine fault is fatal (recovery paths: test_faults.py)
         cfg, params, engine = world
-        sched = Scheduler(engine)
+        sched = Scheduler(engine, max_restarts=0)
 
         def boom(*a, **k):
             raise RuntimeError("device fell over")
@@ -143,7 +146,12 @@ class TestEngineCorrectness:
             # generous timeout: the full suite can contend for the device
             assert req.done.wait(30)
             assert req.finish_reason == "error"
-            assert not sched.healthy
+            # the request fails before the scheduler thread finishes
+            # flipping health to dead, so poll briefly
+            deadline = time.monotonic() + 10
+            while sched.healthy:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
             with pytest.raises(RuntimeError):
                 sched.submit(Request(prompt_ids=[1], max_new_tokens=1))
         finally:

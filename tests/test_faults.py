@@ -1,0 +1,590 @@
+"""Fault-tolerant serving path, driven by the deterministic
+fault-injection harness (ome_tpu/faults.py).
+
+The recovery contracts under test (docs/failure-semantics.md):
+
+  * an injected ENGINE-STEP fault fails only the in-flight batch;
+    queued requests survive, the scheduler rebuilds its decode state
+    after backoff, a subsequent request completes, and /health is 200
+    again — while exhausting the restart budget goes permanently
+    dead (/health 503, submit rejected);
+  * an already-expired DEADLINE never occupies a decode slot and
+    returns finish_reason="timeout"; a deadline passing mid-decode
+    finishes the stream with "timeout"; a saturated pending queue
+    answers 429 + Retry-After instead of blocking the client;
+  * the ROUTER trips a backend's circuit breaker after consecutive
+    injected failures, routes around it (the health probe alone
+    cannot re-admit it), and re-admits it via a half-open probe;
+  * a dropped PD handoff fails ONE request, not the scheduler.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from ome_tpu import faults
+from ome_tpu.engine.scheduler import (Request, Scheduler,
+                                      SchedulerOverloaded)
+from ome_tpu.engine.server import EngineServer
+from ome_tpu.engine.tokenizer import ByteTokenizer
+from ome_tpu.router.server import (Backend, RetryBudget, Router,
+                                   RouterServer)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- fakes -----------------------------------------------------------
+
+
+class FakeEngine:
+    """Minimal engine double (no device work): deterministic token 3
+    every decode step, instrumented ctor/prefill/state counters."""
+
+    max_seq = 1024
+
+    def __init__(self, max_slots=2, decode_s=0.0):
+        self.max_slots = max_slots
+        self.decode_s = decode_s
+        self.new_state_calls = 0
+        self.prefill_calls = 0
+
+    def new_state(self):
+        self.new_state_calls += 1
+        return f"s{self.new_state_calls}"
+
+    def prefill(self, ids, t, k, p):
+        self.prefill_calls += 1
+        return 1, "kv", len(ids), 16
+
+    def insert(self, state, kv, slot, true_len, token, bucket):
+        return state
+
+    def decode(self, state, t, k, p):
+        if self.decode_s:
+            time.sleep(self.decode_s)
+        return state, np.full(self.max_slots, 3, np.int32)
+
+
+def _post(url, payload, headers=None, timeout=30):
+    """POST JSON; returns (status, headers, body-dict) and folds
+    HTTPError into the same shape (urllib raises on >= 400)."""
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), \
+                json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, dict(e.headers), json.loads(e.read())
+
+
+def _get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, json.loads(e.read())
+
+
+# -- the harness itself ----------------------------------------------
+
+
+class TestFaultSpec:
+    def test_grammar(self):
+        rules = faults.parse_spec(
+            "engine_step.raise@3, engine_step.slow=0.5@1:2, "
+            "server_http.http=429@2:3, "
+            "router_forward|http://10.0.0.1:8080.raise@1")
+        assert [(r.point, r.kind, r.param, r.start, r.count)
+                for r in rules] == [
+            ("engine_step", "raise", 0.0, 3, 1),
+            ("engine_step", "slow", 0.5, 1, 2),
+            ("server_http", "http", 429.0, 2, 3),
+            ("router_forward|http://10.0.0.1:8080", "raise", 0.0, 1, 1),
+        ]
+
+    def test_bad_specs_rejected(self):
+        for bad in ("engine_step", "engine_step.raise@0",
+                    "engine_step.slow@1", ".raise@1"):
+            with pytest.raises(ValueError):
+                faults.parse_spec(bad)
+
+    def test_fire_is_counted_and_keyed(self):
+        faults.install("p.raise@2:2, p|k2.raise@1")
+        faults.fire("p")                       # hit 1: unarmed
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("p")                   # hit 2: armed
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("p")                   # hit 3: armed (count=2)
+        faults.fire("p")                       # hit 4: exhausted
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("p", key="k2")         # keyed rule, own counter
+        faults.fire("p", key="other")          # wrong key: no match
+
+    def test_http_kind_and_custom_exc(self):
+        faults.install("site.http=418@1, conn.raise@1")
+        assert faults.http("site") == 418
+        assert faults.http("site") is None     # one-shot
+        with pytest.raises(urllib.error.URLError):
+            faults.fire("conn", exc=urllib.error.URLError)
+
+    def test_inactive_by_default(self):
+        assert not faults.active()
+        faults.fire("anything")                # no-op
+        assert faults.http("anything") is None
+
+
+# -- scheduler crash recovery ----------------------------------------
+
+
+class TestSchedulerRecovery:
+    def test_engine_fault_fails_batch_only_and_recovers(self):
+        """The acceptance path: fault hits the in-flight request, the
+        QUEUED request survives, decode state is rebuilt, and the
+        survivor completes."""
+        faults.install("engine_step.raise@3")
+        eng = FakeEngine(max_slots=1)
+        sched = Scheduler(eng, restart_backoff=0.01)
+        sched.start()
+        try:
+            a = sched.submit(Request(prompt_ids=[1, 2],
+                                     max_new_tokens=50))
+            b = sched.submit(Request(prompt_ids=[3, 4],
+                                     max_new_tokens=5))
+            assert a.done.wait(30) and a.finish_reason == "error"
+            assert b.done.wait(30) and b.finish_reason == "length"
+            assert len(b.output_ids) == 5  # fully served post-restart
+            assert sched.status == "ok" and sched.healthy
+            assert sched.stats["restarts_total"] == 1
+            assert sched.stats["engine_faults_total"] == 1
+            assert eng.new_state_calls == 2  # ctor + recovery rebuild
+        finally:
+            sched.stop()
+
+    def test_restart_budget_exhausted_goes_dead(self):
+        faults.install("engine_step.raise@1:100")
+        eng = FakeEngine(max_slots=1)
+        sched = Scheduler(eng, max_restarts=1, restart_backoff=0.001)
+        sched.start()
+        try:
+            a = sched.submit(Request(prompt_ids=[1], max_new_tokens=9))
+            b = sched.submit(Request(prompt_ids=[2], max_new_tokens=9))
+            assert a.done.wait(30) and a.finish_reason == "error"
+            assert b.done.wait(30) and b.finish_reason == "error"
+            deadline = time.monotonic() + 10
+            while sched.status != "dead":
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            assert not sched.healthy
+            with pytest.raises(RuntimeError):
+                sched.submit(Request(prompt_ids=[3], max_new_tokens=1))
+        finally:
+            sched.stop()
+
+    def test_overlap_admission_fault_recovers(self):
+        """A non-transient prefill fault on the admission thread loses
+        one request but the scheduler recovers instead of dying."""
+        eng = FakeEngine(max_slots=2)
+        orig = eng.prefill
+        calls = []
+
+        def flaky(ids, t, k, p):
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("device fell over")
+            return orig(ids, t, k, p)
+
+        eng.prefill = flaky
+        sched = Scheduler(eng, overlap=True, restart_backoff=0.01)
+        sched.start()
+        try:
+            a = sched.submit(Request(prompt_ids=[1], max_new_tokens=4))
+            assert a.done.wait(30) and a.finish_reason == "error"
+            b = sched.submit(Request(prompt_ids=[2], max_new_tokens=4))
+            assert b.done.wait(30) and b.finish_reason == "length"
+            assert sched.status == "ok"
+            assert sched.stats["restarts_total"] == 1
+        finally:
+            sched.stop()
+
+    def test_health_returns_200_again_after_recovery(self):
+        """End to end over HTTP: injected fault -> failed request ->
+        /health stays 200 (degraded is not dead) -> next request
+        completes -> /health reports ok."""
+        faults.install("engine_step.raise@2")
+        sched = Scheduler(FakeEngine(max_slots=1),
+                          restart_backoff=0.01)
+        srv = EngineServer(sched, tokenizer=ByteTokenizer(),
+                           model_name="fake")
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            code, body = _get(base + "/health")
+            assert code == 200
+            code, _, body = _post(base + "/v1/completions",
+                                  {"prompt": "hi", "max_tokens": 8})
+            assert code == 200
+            assert body["choices"][0]["finish_reason"] == "error"
+            code, _, body = _post(base + "/v1/completions",
+                                  {"prompt": "hi", "max_tokens": 4})
+            assert code == 200
+            assert body["choices"][0]["finish_reason"] == "length"
+            code, body = _get(base + "/health")
+            assert code == 200 and body["status"] == "ok"
+            assert body["restarts"] == 1
+        finally:
+            srv.stop()
+
+    def test_dead_scheduler_health_503(self):
+        faults.install("engine_step.raise@1:100")
+        sched = Scheduler(FakeEngine(max_slots=1), max_restarts=0)
+        srv = EngineServer(sched, tokenizer=ByteTokenizer(),
+                           model_name="fake")
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            code, _, body = _post(base + "/v1/completions",
+                                  {"prompt": "hi", "max_tokens": 8})
+            assert code == 200
+            assert body["choices"][0]["finish_reason"] == "error"
+            deadline = time.monotonic() + 10
+            while _get(base + "/health")[0] != 503:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            code, _, body = _post(base + "/v1/completions",
+                                  {"prompt": "x", "max_tokens": 1})
+            assert code == 503
+        finally:
+            srv.stop()
+
+    def test_ready_reflects_recovery_and_queue_depth(self):
+        """/ready (readiness) and /health (liveness) must disagree
+        while the replica is up but should not take traffic."""
+        sched = Scheduler(FakeEngine(max_slots=1, decode_s=0.05))
+        srv = EngineServer(sched, tokenizer=ByteTokenizer(),
+                           model_name="fake", ready_queue_limit=1)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            code, body = _get(base + "/ready")
+            assert code == 200 and body["ready"]
+            # one active stream + two queued > limit of 1
+            reqs = [sched.submit(Request(prompt_ids=[1],
+                                         max_new_tokens=10_000))
+                    for _ in range(3)]
+            deadline = time.monotonic() + 10
+            while _get(base + "/ready")[0] != 503:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            code, body = _get(base + "/ready")
+            assert code == 503 and body["queue_depth"] >= 2
+            assert _get(base + "/health")[0] == 200  # alive!
+            assert reqs  # keep references until shutdown drains them
+        finally:
+            srv.stop()
+
+
+# -- deadlines + admission control -----------------------------------
+
+
+class TestDeadlines:
+    def test_expired_deadline_never_occupies_slot(self):
+        eng = FakeEngine(max_slots=2)
+        sched = Scheduler(eng)
+        req = sched.submit(Request(prompt_ids=[1, 2], max_new_tokens=8,
+                                   deadline=time.monotonic() - 1.0))
+        assert req.done.is_set()
+        assert req.finish_reason == "timeout"
+        assert req.output_ids == []
+        assert eng.prefill_calls == 0  # shed at submit, never slotted
+        assert sched.stats["timeouts_total"] == 1
+
+    def test_expired_in_queue_shed_at_admission(self):
+        """A deadline that expires while the request waits in the
+        pending queue is shed by the admission pull, not prefilled."""
+        eng = FakeEngine(max_slots=2)
+        sched = Scheduler(eng)  # driven manually via step()
+        req = sched.submit(Request(
+            prompt_ids=[1], max_new_tokens=8,
+            deadline=time.monotonic() + 0.02))
+        time.sleep(0.05)  # expires while queued (no step running)
+        sched.step()
+        assert req.done.is_set() and req.finish_reason == "timeout"
+        assert eng.prefill_calls == 0
+
+    def test_http_timeout_zero_returns_timeout(self):
+        sched = Scheduler(FakeEngine(max_slots=1))
+        srv = EngineServer(sched, tokenizer=ByteTokenizer(),
+                           model_name="fake")
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            code, _, body = _post(base + "/v1/completions",
+                                  {"prompt": "hi", "max_tokens": 8,
+                                   "timeout": 0})
+            assert code == 200
+            assert body["choices"][0]["finish_reason"] == "timeout"
+            assert body["usage"]["completion_tokens"] == 0
+        finally:
+            srv.stop()
+
+    def test_deadline_mid_decode_finishes_timeout(self):
+        sched = Scheduler(FakeEngine(max_slots=1, decode_s=0.02))
+        srv = EngineServer(sched, tokenizer=ByteTokenizer(),
+                           model_name="fake")
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            code, _, body = _post(base + "/v1/completions",
+                                  {"prompt": "hi",
+                                   "max_tokens": 10_000,
+                                   "timeout": 0.25})
+            assert code == 200
+            assert body["choices"][0]["finish_reason"] == "timeout"
+            assert body["usage"]["completion_tokens"] > 0  # partial
+        finally:
+            srv.stop()
+
+    def test_deadline_header_absolute_epoch(self):
+        sched = Scheduler(FakeEngine(max_slots=1))
+        srv = EngineServer(sched, tokenizer=ByteTokenizer(),
+                           model_name="fake")
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            code, _, body = _post(
+                base + "/v1/completions",
+                {"prompt": "hi", "max_tokens": 8},
+                headers={"X-Request-Deadline": str(time.time() - 5)})
+            assert code == 200
+            assert body["choices"][0]["finish_reason"] == "timeout"
+        finally:
+            srv.stop()
+
+    def test_saturated_queue_429_with_retry_after(self):
+        sched = Scheduler(FakeEngine(max_slots=1, decode_s=0.05),
+                          max_pending=1)
+        srv = EngineServer(sched, tokenizer=ByteTokenizer(),
+                           model_name="fake")
+        srv.start()
+        try:
+            # fill the slot with a long stream, then the 1-deep queue
+            sched.submit(Request(prompt_ids=[1],
+                                 max_new_tokens=10_000))
+            deadline = time.monotonic() + 10
+            while sched.stats["active_slots"] < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            sched.submit(Request(prompt_ids=[2],
+                                 max_new_tokens=10_000))
+            with pytest.raises(SchedulerOverloaded) as ei:
+                sched.submit(Request(prompt_ids=[3],
+                                     max_new_tokens=4))
+            assert ei.value.retry_after >= 0.5
+            base = f"http://127.0.0.1:{srv.port}"
+            code, headers, body = _post(base + "/v1/completions",
+                                        {"prompt": "hi",
+                                         "max_tokens": 4})
+            assert code == 429
+            assert int(headers["Retry-After"]) >= 1
+            assert sched.stats["rejected_total"] >= 2
+        finally:
+            srv.stop()
+
+
+# -- router circuit breaking -----------------------------------------
+
+
+class _StubBackend:
+    """Tiny real HTTP backend; counts /v1 hits and records headers."""
+
+    def __init__(self):
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._send(200, {"status": "ok"})
+
+            def do_POST(self):
+                stub.hits += 1
+                stub.last_headers = dict(self.headers)
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                self._send(200, {"object": "text_completion",
+                                 "choices": [{"text": "ok"}]})
+
+        self.hits = 0
+        self.last_headers = {}
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestRouterCircuitBreaker:
+    def test_trips_ignores_health_flap_and_half_open_readmits(self):
+        """Consecutive failures trip the breaker ACROSS health-probe
+        re-admissions; while open the backend takes zero traffic even
+        when /health looks fine; after the cooldown one half-open
+        probe closes it again."""
+        stub = _StubBackend()
+        try:
+            faults.install(
+                f"router_forward|{stub.url}.raise@1:2")
+            router = Router([Backend(stub.url)], policy="round_robin",
+                            cb_threshold=2, cb_cooldown=0.2)
+            srv = RouterServer(router, host="127.0.0.1", port=0,
+                               retries=0).start()
+            try:
+                base = f"http://127.0.0.1:{srv.port}"
+                b = router.backends[0]
+                code, _, _ = _post(base + "/v1/completions",
+                                   {"prompt": "a"})
+                assert code == 503 and b.fails == 1
+                assert b.cb_state == "closed"
+                # the health probe says fine — but the breaker keeps
+                # counting CONSECUTIVE request failures
+                router.check_health_once()
+                assert b.healthy
+                code, _, _ = _post(base + "/v1/completions",
+                                   {"prompt": "a"})
+                assert code == 503
+                assert b.cb_state == "open"
+                assert router.stats["circuit_open_total"] == 1
+                # open: zero traffic reaches the backend, even after
+                # another clean health probe
+                router.check_health_once()
+                assert stub.hits == 0
+                code, _, _ = _post(base + "/v1/completions",
+                                   {"prompt": "a"})
+                assert code == 503 and stub.hits == 0
+                # cooldown over: one half-open probe (the fault rules
+                # are exhausted, so it succeeds) re-admits
+                time.sleep(0.25)
+                code, _, _ = _post(base + "/v1/completions",
+                                   {"prompt": "a"})
+                assert code == 200 and stub.hits == 1
+                assert b.cb_state == "closed" and b.fails == 0
+            finally:
+                srv.stop()
+        finally:
+            stub.close()
+
+    def test_routes_around_open_circuit(self):
+        """With one backend circuit-open, every request lands on the
+        other; the first request that found the fault failed over
+        transparently (retry within the same request)."""
+        a, b = _StubBackend(), _StubBackend()
+        try:
+            faults.install(f"router_forward|{a.url}.raise@1:10")
+            router = Router([Backend(a.url), Backend(b.url)],
+                            policy="round_robin",
+                            cb_threshold=1, cb_cooldown=30.0)
+            srv = RouterServer(router, host="127.0.0.1", port=0,
+                               retries=2, retry_backoff=0.001).start()
+            try:
+                base = f"http://127.0.0.1:{srv.port}"
+                for _ in range(4):
+                    code, _, _ = _post(base + "/v1/completions",
+                                       {"prompt": "x"})
+                    assert code == 200  # failover made faults invisible
+                assert a.hits == 0 and b.hits == 4
+                assert router.backends[0].cb_state == "open"
+                assert router.stats["retries_total"] >= 1
+            finally:
+                srv.stop()
+        finally:
+            a.close()
+            b.close()
+
+    def test_deadline_header_propagates_and_sheds(self):
+        stub = _StubBackend()
+        try:
+            router = Router([Backend(stub.url)], policy="round_robin")
+            srv = RouterServer(router, host="127.0.0.1",
+                               port=0).start()
+            try:
+                base = f"http://127.0.0.1:{srv.port}"
+                dl = time.time() + 30
+                code, _, _ = _post(base + "/v1/completions",
+                                   {"prompt": "x"},
+                                   headers={"X-Request-Deadline":
+                                            str(dl)})
+                assert code == 200
+                got = float(
+                    stub.last_headers["X-Request-Deadline"])
+                assert abs(got - dl) < 1e-6
+                # an expired deadline sheds BEFORE any forward
+                hits = stub.hits
+                code, _, body = _post(base + "/v1/completions",
+                                      {"prompt": "x"},
+                                      headers={"X-Request-Deadline":
+                                               str(time.time() - 1)})
+                assert code == 504 and stub.hits == hits
+                assert router.stats["deadline_shed_total"] == 1
+            finally:
+                srv.stop()
+        finally:
+            stub.close()
+
+    def test_retry_budget_bounds_amplification(self):
+        budget = RetryBudget(ratio=0.5, burst=2)
+        assert budget.withdraw() and budget.withdraw()
+        assert not budget.withdraw()  # burst spent
+        budget.deposit()              # +0.5: still < 1 token
+        assert not budget.withdraw()
+        budget.deposit()              # +0.5: one whole token
+        assert budget.withdraw()
+
+
+# -- PD handoff ------------------------------------------------------
+
+
+def test_pd_dropped_handoff_fails_one_request_not_scheduler():
+    from ome_tpu.engine.pd import RemotePrefillEngine
+    eng = RemotePrefillEngine(FakeEngine(max_slots=2),
+                              "http://127.0.0.1:9")  # dead peer
+    faults.install("pd_fetch.raise@1")
+    sched = Scheduler(eng, overlap=True)
+    sched.start()
+    try:
+        req = sched.submit(Request(prompt_ids=[1, 2],
+                                   max_new_tokens=4))
+        assert req.done.wait(30)
+        assert req.finish_reason == "error"
+        assert sched.status == "ok" and sched.healthy  # transient
+        assert sched.stats["engine_faults_total"] == 0
+    finally:
+        sched.stop()

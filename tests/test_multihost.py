@@ -214,3 +214,40 @@ def test_follower_replays_and_exits_on_drop():
     rc = multihost.follower_loop(FakeEngine(),
                                  FakeSub([{"op": "stop"}]))
     assert rc == 0
+
+
+def test_drift_repair_clears_only_refused_adapters_refs():
+    """A locally-refused unregister (follower adapter-ref drift) must
+    clear ONLY the refused adapter's slot refs before retrying —
+    zeroing other adapters' refs would let a racing unregister of a
+    busy adapter slip through."""
+    unregisters = []
+
+    class FakeEngine:
+        _slot_adapters = np.asarray([0, 2, 1, 2], np.int32)
+
+        def new_state(self):
+            return "s0"
+
+        def adapter_id(self, name):
+            return {"keep": 1, "refused": 2}[name]
+
+        def unregister_adapter(self, name):
+            unregisters.append(name)
+            if len(unregisters) == 1:
+                raise ValueError(f"adapter {name!r} is busy")
+
+    class FakeSub:
+        def __init__(self, msgs):
+            self.msgs = list(msgs)
+
+        def recv(self):
+            return self.msgs.pop(0) if self.msgs else {"op": "stop"}
+
+    eng = FakeEngine()
+    rc = multihost.follower_loop(eng, FakeSub(
+        [{"op": "unregister_adapter", "name": "refused"}]))
+    assert rc == 0
+    assert unregisters == ["refused", "refused"]  # refusal then retry
+    # slots 1 and 3 (refused adapter) cleared; slot 2 ("keep") intact
+    assert eng._slot_adapters.tolist() == [0, 0, 1, 0]

@@ -110,20 +110,27 @@ def test_overlap_matches_synchronous_tokens():
 
 def test_overlap_failure_fails_requests_and_health():
     """A prefill error on the admission thread must fail the request,
-    flip health, and fail in-flight work (same contract as sync)."""
+    flip health, and fail in-flight work (same contract as sync).
+    max_restarts=0 pins the fail-fast behavior; the recovery paths
+    live in test_faults.py."""
     eng = SlowFakeEngine(prefill_s=0.01)
 
     def boom(ids, t, k, p):
         raise RuntimeError("device fell over")
 
     eng.prefill = boom
-    sched = Scheduler(eng, overlap=True)
+    sched = Scheduler(eng, overlap=True, max_restarts=0)
     sched.start()
     try:
         req = sched.submit(Request(prompt_ids=[1, 2], max_new_tokens=4))
         assert req.done.wait(30)
         assert req.finish_reason == "error"
-        assert not sched.healthy
+        # the health flip is owned by the scheduler thread; the request
+        # fails on the admission thread first, so poll briefly
+        deadline = time.monotonic() + 10
+        while sched.healthy:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
         with pytest.raises(RuntimeError):
             sched.submit(Request(prompt_ids=[1], max_new_tokens=1))
     finally:

@@ -206,3 +206,29 @@ def test_paged_rejects_unsupported_models():
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     with pytest.raises(ValueError, match="paged KV"):
         InferenceEngine(params, cfg, max_slots=2, kv_block=16)
+
+
+def test_grow_blocks_exhaustion_preempts_explicitly(monkeypatch):
+    """When the pool is empty and no victim is evictable, _grow_blocks
+    must preempt the growing slot EXPLICITLY (requeue via
+    take_preempted) — never let its next write land in the trash
+    block, which would silently desync host/device lengths."""
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    paged = InferenceEngine(params, CFG, max_slots=2,
+                            prefill_buckets=[16], kv_block=16,
+                            kv_blocks=3)  # blocks 1,2 usable; 0=trash
+    # hand-build the corner: slot 0 owns the whole pool and its next
+    # write needs a third block
+    paged._owned[0] = [1, 2]
+    paged._free_blocks.clear()
+    paged._table[0, 0] = 1
+    paged._table[0, 1] = 2
+    paged._host_len[0] = 32
+    # force "nothing evictable" (the defensive branch is unreachable
+    # through _preempt_victim today — pin the contract directly)
+    monkeypatch.setattr(paged, "_preempt_victim", lambda: False)
+    paged._grow_blocks()
+    assert paged.take_preempted() == [0]
+    assert paged._owned[0] == []        # blocks returned to the pool
+    assert len(paged._free_blocks) == 2
+    assert paged._host_len[0] == 0      # no phantom write advanced it

@@ -96,3 +96,38 @@ def test_load_engine_from_safetensors_and_serve(tmp_path):
     finally:
         server.stop()
         sched.stop()
+
+
+def test_kv_block_with_tp_rejected_loudly(tmp_path):
+    """Paged KV is single-host tp=1: a tp>1 launch with --kv-block must
+    refuse at startup rather than silently serve the dense cache the
+    operator sized a paged pool for."""
+    d = _mk_model_dir(tmp_path, with_weights=False)
+    args = build_parser().parse_args(
+        ["--model-dir", d, "--random-weights", "--tp", "2",
+         "--kv-block", "16"])
+    with pytest.raises(SystemExit, match="paged KV"):
+        load_engine(args)
+
+
+def test_paged_unsupported_arch_falls_back_to_dense(tmp_path, caplog):
+    """An auto-selected runtime may pass --kv-block for a model the
+    paged coverage guard refuses (here: sliding-window attention).
+    load_engine degrades to the dense cache with a prominent warning
+    instead of crash-looping the pod."""
+    import logging
+
+    d = _mk_model_dir(tmp_path, with_weights=False)
+    cfg = json.loads(open(d + "/config.json").read())
+    cfg["sliding_window"] = 16
+    open(d + "/config.json", "w").write(json.dumps(cfg))
+    args = build_parser().parse_args(
+        ["--model-dir", d, "--random-weights", "--max-slots", "2",
+         "--max-seq", "32", "--kv-block", "16"])
+    with caplog.at_level(logging.WARNING, logger="ome.engine.serve"):
+        engine = load_engine(args)
+    assert engine.kv_block == 0  # dense
+    assert any("FALLING BACK" in r.message for r in caplog.records)
+    # still serves: the degraded engine is a working dense engine
+    tok, kv, true_len, bucket = engine.prefill([1, 2, 3])
+    assert 0 <= tok < 64
