@@ -42,9 +42,30 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from .. import faults
+from ..telemetry import Registry
 from .core import DecodeState, InferenceEngine
 
 _ids = itertools.count()
+
+# engine-step latencies cluster well under the Prometheus default
+# buckets' floor on TPU; extend downward so the histogram resolves
+# per-step time instead of lumping everything into the first bucket
+STEP_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                0.1, 0.25, 0.5, 1.0, 2.5)
+
+# stats-key -> help text; every counter the scheduler keeps is
+# mirrored into the shared registry under ome_engine_<key>
+_COUNTER_HELP = {
+    "requests_total": "Requests submitted to the scheduler",
+    "tokens_generated_total": "Decode tokens emitted across requests",
+    "prefill_total": "Prefill forwards executed",
+    "decode_steps_total": "Batched decode steps executed",
+    "preemptions_total": "Sequences preempted by KV pool pressure",
+    "timeouts_total": "Requests finished with finish_reason=timeout",
+    "rejected_total": "Requests rejected at admission (429)",
+    "engine_faults_total": "Engine-step faults (crash recovery runs)",
+    "restarts_total": "Successful scheduler crash recoveries",
+}
 
 
 class SchedulerOverloaded(RuntimeError):
@@ -74,12 +95,24 @@ class Request:
     # at admission (never occupies a slot) or finished mid-decode
     # with finish_reason="timeout"
     deadline: Optional[float] = None
+    # request-lifecycle tracing: the SpanContext the HTTP layer
+    # adopted from (or minted for) this request; flows into the JSONL
+    # request log so router and engine records share one trace id
+    trace: Optional[object] = None
     id: int = field(default_factory=lambda: next(_ids))
     created: float = field(default_factory=time.monotonic)
     # results
     output_ids: List[int] = field(default_factory=list)
     finish_reason: Optional[str] = None
+    # phase timestamps (monotonic): created -> scheduled (first decode
+    # slot) -> first token -> finished; the deltas are the queue-wait/
+    # TTFT/TPOT histograms and request-log fields
+    scheduled_at: Optional[float] = None
     first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    # one-shot observer the scheduler installs at submit(); must not
+    # block or take scheduler locks (finish() may run under them)
+    on_finish: Optional[object] = None
     done: threading.Event = field(default_factory=threading.Event)
     stream: "queue.Queue[Optional[int]]" = field(
         default_factory=queue.Queue)  # token ids; None = EOS sentinel
@@ -101,8 +134,15 @@ class Request:
         if self.done.is_set():
             return
         self.finish_reason = reason
+        self.finished_at = time.monotonic()
         self.stream.put(None)
         self.done.set()
+        cb, self.on_finish = self.on_finish, None
+        if cb is not None:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — telemetry must never
+                pass  # turn a finished request into a failure
 
     def wait_output(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):
@@ -119,8 +159,12 @@ class Scheduler:
     def __init__(self, engine: InferenceEngine, max_pending: int = 512,
                  overlap: bool = False, max_restarts: int = 3,
                  restart_backoff: float = 0.05,
-                 max_queue_wait: float = 30.0):
+                 max_queue_wait: float = 30.0,
+                 registry: Optional[Registry] = None):
         self.engine = engine
+        # shared telemetry registry: the EngineServer scrapes it on
+        # /metrics; stats-dict counters below are mirrored into it
+        self.registry = registry or Registry()
         # crash recovery: consecutive engine-fault restarts tolerated
         # before going permanently dead (0 = first fault is fatal, the
         # pre-recovery fail-fast behavior)
@@ -171,6 +215,40 @@ class Scheduler:
             "rejected_total": 0, "engine_faults_total": 0,
             "restarts_total": 0,
         }
+        R = self.registry
+        self._counters = {
+            key: R.counter(f"ome_engine_{key}", help)
+            for key, help in _COUNTER_HELP.items()}
+        self._h_queue_wait = R.histogram(
+            "ome_engine_queue_wait_seconds",
+            "Seconds between admission and first decode slot")
+        self._h_prefill = R.histogram(
+            "ome_engine_prefill_seconds",
+            "Per-request prefill forward seconds", buckets=STEP_BUCKETS)
+        self._h_decode_step = R.histogram(
+            "ome_engine_decode_step_seconds",
+            "Batched decode step seconds (one token per active slot)",
+            buckets=STEP_BUCKETS)
+        self._h_ttft = R.histogram(
+            "ome_engine_ttft_seconds",
+            "Time to first token (admission to first emit)")
+        self._h_tpot = R.histogram(
+            "ome_engine_tpot_seconds",
+            "Per-request mean time per output token after the first",
+            buckets=STEP_BUCKETS)
+        self._h_e2e = R.histogram(
+            "ome_engine_e2e_seconds",
+            "End-to-end request seconds (admission to finish)")
+        self._g_queue_depth = R.gauge(
+            "ome_engine_queue_depth", "Pending-queue depth")
+        self._g_active = R.gauge(
+            "ome_engine_active_slots", "Occupied decode slots")
+        self._g_occupancy = R.gauge(
+            "ome_engine_batch_occupancy_ratio",
+            "Occupied decode slots / max_slots")
+        self._g_status = R.gauge(
+            "ome_engine_status",
+            "Scheduler health state", labelnames=("state",))
 
     @property
     def status(self) -> str:
@@ -186,9 +264,62 @@ class Scheduler:
     def healthy(self, value: bool):
         self._status = "ok" if value else "dead"
 
+    def _inc_locked(self, key: str, by: float = 1):
+        """Caller holds self._lock. Mirrors into the registry — the
+        counter's own leaf lock nests safely under ours."""
+        self.stats[key] += by
+        c = self._counters.get(key)
+        if c is not None:
+            c.inc(by)
+
     def _inc(self, key: str, by: float = 1):
         with self._lock:
-            self.stats[key] += by
+            self._inc_locked(key, by)
+
+    def _observe_finish(self, req: Request):
+        """One-shot per-request latency observations, installed as
+        req.on_finish at submit. Runs on whatever thread called
+        finish() — touches only leaf-locked histograms."""
+        end = req.finished_at if req.finished_at is not None \
+            else time.monotonic()
+        self._h_e2e.observe(end - req.created)
+        if req.first_token_at is not None:
+            self._h_ttft.observe(req.first_token_at - req.created)
+            n = len(req.output_ids)
+            if n > 1:
+                self._h_tpot.observe(
+                    (end - req.first_token_at) / (n - 1))
+
+    def _mark_scheduled(self, req: Request):
+        """First time a request leaves the queue for a decode slot:
+        the queue-wait phase ends here. Requeued/preempted requests
+        keep their original mark (their wait was already served)."""
+        if req.scheduled_at is None:
+            req.scheduled_at = time.monotonic()
+            self._h_queue_wait.observe(req.scheduled_at - req.created)
+
+    def update_gauges(self):
+        """Refresh point-in-time gauges (called by /metrics scrapes
+        and after each step; counters stream in continuously)."""
+        self._g_queue_depth.set(self.pending.qsize())
+        active = sum(r is not None for r in self.slots)
+        self._g_active.set(active)
+        self._g_occupancy.set(active / max(self.engine.max_slots, 1))
+        status = self._status
+        for state in ("ok", "degraded", "dead"):
+            self._g_status.labels(state=state).set(
+                1 if state == status else 0)
+        pool = getattr(self.engine, "kv_pool_stats", None)
+        if pool and pool.get("kv_block_tokens"):  # paged engines only
+            total = pool.get("kv_blocks", 0)
+            free = pool.get("kv_blocks_free", 0)
+            self.registry.gauge(
+                "ome_engine_kv_blocks_free",
+                "Free paged-KV blocks").set(free)
+            self.registry.gauge(
+                "ome_engine_kv_block_utilization_ratio",
+                "Occupied fraction of the paged-KV pool").set(
+                (total - free) / total if total else 0.0)
 
     # -- public --------------------------------------------------------
 
@@ -209,17 +340,18 @@ class Scheduler:
         with self._lock:
             if self._stop.is_set() or self._status == "dead":
                 raise RuntimeError("scheduler unavailable")
-            self.stats["requests_total"] += 1
+            self._inc_locked("requests_total")
+            req.on_finish = self._observe_finish
             if req.expired():
                 # dead on arrival: never queued, never slotted
-                self.stats["timeouts_total"] += 1
+                self._inc_locked("timeouts_total")
                 req.finish("timeout")
                 return req
             depth = self.pending.qsize()
             est = self._queue_wait_estimate(depth + 1)
             if depth >= self.pending.maxsize or \
                     (est is not None and est > self.max_queue_wait):
-                self.stats["rejected_total"] += 1
+                self._inc_locked("rejected_total")
                 retry = min(max(est if est is not None else 1.0, 0.5),
                             30.0)
                 raise SchedulerOverloaded(
@@ -229,7 +361,7 @@ class Scheduler:
             try:
                 self.pending.put_nowait(req)
             except queue.Full:
-                self.stats["rejected_total"] += 1
+                self._inc_locked("rejected_total")
                 raise SchedulerOverloaded(
                     "pending queue full", retry_after=1.0) from None
         return req
@@ -371,6 +503,8 @@ class Scheduler:
                 self._free_slots.release()
                 time.sleep(0.01)
                 continue
+            self._mark_scheduled(req)
+            t0 = time.monotonic()
             try:
                 tok, kv, true_len, bucket = self._prefill_req(req)
             except Exception as e:  # noqa: BLE001
@@ -403,6 +537,7 @@ class Scheduler:
                 self._free_slots.release()
                 self._fault_event.set()
                 continue
+            self._h_prefill.observe(time.monotonic() - t0)
             self._inc("prefill_total")
             # under _lock so a prefill that outlives stop()'s join or a
             # scheduler-thread death (e.g. a slow remote PD fetch)
@@ -480,8 +615,11 @@ class Scheduler:
                 # prefill forward that insert would just bounce
                 self._requeue.appendleft(req)
                 break
+            self._mark_scheduled(req)
+            t0 = time.monotonic()
             try:
                 tok, kv, true_len, bucket = self._prefill_req(req)
+                self._h_prefill.observe(time.monotonic() - t0)
                 ikw = {} if req.adapter is None \
                     else {"adapter": req.adapter}
                 self.state = self.engine.insert(
@@ -533,6 +671,7 @@ class Scheduler:
         dt = time.monotonic() - t0
         self._ewma_step_s = dt if self._ewma_step_s is None \
             else 0.9 * self._ewma_step_s + 0.1 * dt
+        self._h_decode_step.observe(dt)
         self._inc("decode_steps_total")
         # paged-KV pool pressure may have evicted sequences BEFORE this
         # step ran — their sampled token is garbage (their new KV row
@@ -721,7 +860,7 @@ class Scheduler:
         self._fault_event.clear()
         with self._lock:
             self._status = "ok"
-            self.stats["restarts_total"] += 1
+            self._inc_locked("restarts_total")
         return True
 
     def _run(self):

@@ -100,6 +100,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deterministic fault-injection spec "
                         "(ome_tpu/faults.py grammar, e.g. "
                         "'engine_step.raise@100'); also via OME_FAULTS")
+    p.add_argument("--request-log", default=None,
+                   help="JSONL request-log path: one record per "
+                        "request with trace id, queue-wait/TTFT/TPOT, "
+                        "tokens, finish_reason (docs/observability.md)")
+    p.add_argument("--profile-dir", default=None,
+                   help="enable POST /debug/profile?seconds=N: "
+                        "on-demand jax.profiler captures into this "
+                        "directory (no-op off-TPU; off when unset)")
     return p
 
 
@@ -371,6 +379,8 @@ def main(argv=None) -> int:
     server = EngineServer(scheduler, tokenizer=tok, model_name=name,
                           host=args.host, port=args.port,
                           embedder=embedder, pd_prefill=pd_prefill,
+                          request_log=args.request_log,
+                          profile_dir=args.profile_dir,
                           # structured outputs work in every generation
                           # mode: masks ship inside the replicated op
                           # stream (multi-host) and the first token's

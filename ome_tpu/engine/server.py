@@ -14,12 +14,31 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from .. import faults
+from ..telemetry import Registry, tracing
+from ..telemetry import profiler as _profiler
+from ..telemetry.reqlog import coerce as _coerce_reqlog
 from .scheduler import Request, Scheduler, SchedulerOverloaded
 from .tokenizer import load_tokenizer
+
+# bounded path label for the HTTP counter: anything off this list
+# (adapter DELETEs carry a name, typos, scans) collapses to "other"
+# so request paths can never explode label cardinality
+_KNOWN_PATHS = frozenset((
+    "/health", "/healthz", "/ready", "/metrics", "/v1/models",
+    "/v1/completions", "/v1/chat/completions", "/v1/embeddings",
+    "/v1/adapters", "/pd/prefill", "/debug/profile"))
+
+
+def _path_label(path: str) -> str:
+    base = path.split("?", 1)[0]
+    if base.startswith("/v1/adapters/"):
+        return "/v1/adapters"
+    return base if base in _KNOWN_PATHS else "other"
 
 
 class EngineServer:
@@ -27,12 +46,32 @@ class EngineServer:
                  model_name: str = "ome-model", host: str = "127.0.0.1",
                  port: int = 0, embedder=None, pd_prefill=None,
                  structured: bool = True,
-                 ready_queue_limit: Optional[int] = None):
+                 ready_queue_limit: Optional[int] = None,
+                 registry: Optional[Registry] = None,
+                 request_log=None, profile_dir: Optional[str] = None):
         self.scheduler = scheduler
         self.tokenizer = tokenizer or load_tokenizer()
         self.model_name = model_name
         self.embedder = embedder  # engine/embed.py EmbeddingEngine
         self.pd_prefill = pd_prefill  # engine/pd.py prefill-node handler
+        # one registry per serving process: the scheduler already owns
+        # one (its counters/histograms live there); share it so one
+        # /metrics scrape exposes the whole process
+        self.registry = (registry
+                         or getattr(scheduler, "registry", None)
+                         or Registry())
+        # JSONL request log: RequestLog instance, path, or None (off)
+        self.request_log = _coerce_reqlog(request_log)
+        # on-demand jax.profiler captures are opt-in (--profile-dir);
+        # without it POST /debug/profile answers 403
+        self.profile_dir = profile_dir
+        self._http_requests = self.registry.counter(
+            "ome_engine_http_requests_total",
+            "HTTP requests served, by (bounded) path",
+            labelnames=("path",))
+        self._g_uptime = self.registry.gauge(
+            "ome_engine_uptime_seconds",
+            "Seconds since this server started")
         # structured outputs need host-built masks each step; multi-host
         # leaders and PD decode nodes disable them (serve.py)
         self.structured = structured
@@ -70,6 +109,8 @@ class EngineServer:
 
             # -- GET --------------------------------------------------
             def do_GET(self):
+                outer._http_requests.labels(
+                    path=_path_label(self.path)).inc()
                 if self.path in ("/health", "/healthz"):
                     # LIVENESS: only `dead` (restart budget exhausted)
                     # should make k8s restart the pod — `degraded`
@@ -112,12 +153,14 @@ class EngineServer:
                                      "parent": outer.model_name})
                     self._json(200, {"object": "list", "data": data})
                 elif self.path == "/metrics":
-                    lines = []
-                    for k, v in outer.scheduler.stats.items():
-                        name = f"ome_engine_{k}"
-                        lines.append(f"# TYPE {name} gauge")
-                        lines.append(f"{name} {v}")
-                    body = ("\n".join(lines) + "\n").encode()
+                    # point-in-time gauges refresh at scrape; counter/
+                    # histogram series stream in as requests run
+                    upd = getattr(outer.scheduler, "update_gauges",
+                                  None)
+                    if upd is not None:
+                        upd()
+                    outer._g_uptime.set(time.time() - outer.started_at)
+                    body = outer.registry.render().encode()
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "text/plain; version=0.0.4")
@@ -129,11 +172,15 @@ class EngineServer:
 
             # -- POST -------------------------------------------------
             def do_POST(self):
+                outer._http_requests.labels(
+                    path=_path_label(self.path)).inc()
                 code = faults.http("server_http", key=outer.model_name)
                 if code is not None:  # injected backend fault (tests)
                     return self._json(code, {
                         "error": f"injected fault (HTTP {code})"},
                         headers={"Retry-After": "1"})
+                if self.path.split("?", 1)[0] == "/debug/profile":
+                    return self._profile()
                 try:
                     payload = self._body()
                 except Exception as e:
@@ -150,7 +197,29 @@ class EngineServer:
                     return self._register_adapter(payload)
                 self._json(404, {"error": "not found"})
 
+            def _profile(self):
+                """POST /debug/profile?seconds=N — guarded on-demand
+                jax.profiler capture (telemetry/profiler.py)."""
+                if outer.profile_dir is None:
+                    return self._json(403, {
+                        "error": "profiling disabled (launch with "
+                                 "--profile-dir to enable)"})
+                qs = urllib.parse.urlparse(self.path).query
+                params = urllib.parse.parse_qs(qs)
+                try:
+                    seconds = float(params.get("seconds", ["1"])[0])
+                    result = _profiler.capture(outer.profile_dir,
+                                               seconds)
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
+                except _profiler.ProfileInProgress as e:
+                    return self._json(409, {"error": str(e)},
+                                      headers={"Retry-After": "1"})
+                return self._json(200, result)
+
             def do_DELETE(self):
+                outer._http_requests.labels(
+                    path=_path_label(self.path)).inc()
                 if self.path.startswith("/v1/adapters/"):
                     name = self.path.rsplit("/", 1)[-1]
                     eng = getattr(outer.scheduler, "engine", None)
@@ -328,20 +397,28 @@ class EngineServer:
                     top_k=int(payload.get("top_k", 0)),
                     top_p=float(payload.get("top_p", 1.0)),
                     masker=masker, adapter=adapter, deadline=deadline,
+                    # adopt the router's trace (traceparent header) or
+                    # mint one, so standalone engines still correlate
+                    trace=tracing.from_headers(self.headers),
                     stop_ids=[tok.eos_id] if tok.eos_id is not None else [])
                 try:
                     outer.scheduler.submit(req)
                 except SchedulerOverloaded as e:
                     # bounded-wait admission control: tell the client
                     # (or the router's retry budget) when to come back
+                    outer._log_request(req, outcome="rejected")
                     return self._json(429, {"error": str(e)},
                                       headers={"Retry-After": str(
                                           int(e.retry_after) or 1)})
                 except Exception as e:
+                    outer._log_request(req, outcome="rejected")
                     return self._json(503, {"error": str(e)},
                                       headers={"Retry-After": "1"})
                 if payload.get("stream"):
-                    return self._stream(req, chat)
+                    try:
+                        return self._stream(req, chat)
+                    finally:
+                        outer._log_request(req)
                 if req.deadline is not None:
                     # bounded wait: if the scheduler has not finished
                     # the request shortly after its deadline (it may
@@ -353,6 +430,7 @@ class EngineServer:
                         req.done.wait()
                 else:
                     req.done.wait()
+                outer._log_request(req)
                 text = tok.decode(req.output_ids)
                 usage = {"prompt_tokens": len(req.prompt_ids),
                          "completion_tokens": len(req.output_ids),
@@ -437,6 +515,39 @@ class EngineServer:
         eng = getattr(self.scheduler, "engine", None)
         return list(getattr(eng, "adapter_names", []) or [])
 
+    def _log_request(self, req: Request, outcome: Optional[str] = None):
+        """One JSONL record per finished (or rejected) request — the
+        engine half of the request-lifecycle trace; the router writes
+        the matching record with the same trace id."""
+        if not self.request_log.enabled:
+            return
+        end = req.finished_at if req.finished_at is not None \
+            else time.monotonic()
+
+        def _delta(a, b):
+            return round(b - a, 6) if a is not None and b is not None \
+                else None
+
+        n = len(req.output_ids)
+        tpot = None
+        if req.first_token_at is not None and n > 1:
+            tpot = round((end - req.first_token_at) / (n - 1), 6)
+        self.request_log.write({
+            "component": "engine",
+            "trace_id": getattr(req.trace, "trace_id", None),
+            "span_id": getattr(req.trace, "span_id", None),
+            "request_id": req.id,
+            "model": self.model_name,
+            "adapter": req.adapter,
+            "queue_wait_s": _delta(req.created, req.scheduled_at),
+            "ttft_s": _delta(req.created, req.first_token_at),
+            "tpot_s": tpot,
+            "e2e_s": round(end - req.created, 6),
+            "prompt_tokens": len(req.prompt_ids),
+            "output_tokens": n,
+            "finish_reason": outcome or req.finish_reason,
+        })
+
     def start(self):
         self.scheduler.start()
         self._thread = threading.Thread(target=self.httpd.serve_forever,
@@ -449,3 +560,4 @@ class EngineServer:
         self.scheduler.stop()
         if self._thread:
             self._thread.join(timeout=5)
+        self.request_log.close()

@@ -51,7 +51,23 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from ..telemetry import Registry, tracing
+from ..telemetry.reqlog import coerce as _coerce_reqlog
+
 log = logging.getLogger("ome.router")
+
+_COUNTER_HELP = {
+    "requests_total": "Requests received by the router",
+    "retries_total": "Backend failures that triggered a failover",
+    "no_backend_total": "Requests that exhausted every backend (503)",
+    "circuit_open_total": "Circuit-breaker open transitions",
+    "retry_budget_exhausted_total":
+        "Retries suppressed by the token-bucket budget",
+    "deadline_shed_total":
+        "Requests shed because their deadline had passed (504)",
+}
+
+_CB_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}
 
 
 class _ClientGone(Exception):
@@ -127,7 +143,8 @@ class Router:
                  policy: str = "cache_aware",
                  health_interval: float = 10.0,
                  cb_threshold: Optional[int] = None,
-                 cb_cooldown: Optional[float] = None):
+                 cb_cooldown: Optional[float] = None,
+                 registry: Optional[Registry] = None):
         self.backends = backends
         for b in backends:  # router-level CB settings apply uniformly
             if cb_threshold is not None:
@@ -141,15 +158,51 @@ class Router:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
-        self.stats: Dict[str, float] = {
-            "requests_total": 0, "retries_total": 0,
-            "no_backend_total": 0, "circuit_open_total": 0,
-            "retry_budget_exhausted_total": 0,
-            "deadline_shed_total": 0}
+        # every stat lives in the shared registry (leaf-locked
+        # counters), so mutation is uniformly guarded — no more
+        # direct dict bumps racing handler threads
+        self.registry = registry or Registry()
+        self._counters = {
+            key: self.registry.counter(f"ome_router_{key}", help)
+            for key, help in _COUNTER_HELP.items()}
+        self._g_backends_up = self.registry.gauge(
+            "ome_router_backends_up", "Backends passing health checks")
+        self._g_backend_healthy = self.registry.gauge(
+            "ome_router_backend_healthy",
+            "Per-backend health bit (1 healthy)",
+            labelnames=("backend", "pool"))
+        self._g_backend_cb = self.registry.gauge(
+            "ome_router_backend_circuit_state",
+            "Per-backend breaker state: 0 closed, 1 half-open, 2 open",
+            labelnames=("backend", "pool"))
+
+    @property
+    def stats(self) -> Dict[str, float]:
+        """Read-only snapshot of the registry-backed counters (the
+        pre-telemetry dict API; mutate via inc(), never this view)."""
+        return {key: c.value for key, c in self._counters.items()}
 
     def inc(self, key: str, by: float = 1):
-        with self._lock:  # handler threads are concurrent
-            self.stats[key] = self.stats.get(key, 0) + by
+        c = self._counters.get(key)
+        if c is None:  # late-declared stat (tests, extensions)
+            c = self._counters.setdefault(
+                key, self.registry.counter(f"ome_router_{key}"))
+        c.inc(by)
+
+    def update_gauges(self):
+        """Refresh the per-backend gauges (scrape-time; the breaker
+        and health bits otherwise only change on traffic/probes)."""
+        up = 0
+        with self._lock:
+            views = [(b.url, b.pool, b.healthy, b.cb_state)
+                     for b in self.backends]
+        for url, pool, healthy, cb_state in views:
+            up += bool(healthy)
+            self._g_backend_healthy.labels(
+                backend=url, pool=pool).set(1 if healthy else 0)
+            self._g_backend_cb.labels(backend=url, pool=pool).set(
+                _CB_STATE_VALUE.get(cb_state, 2))
+        self._g_backends_up.set(up)
 
     # -- selection -----------------------------------------------------
 
@@ -185,6 +238,7 @@ class Router:
     def note_result(self, backend: Backend, ok: bool):
         """Feed a request outcome into the backend's circuit breaker
         (and the boolean health bit the /health view exposes)."""
+        opened = False
         with self._lock:
             if ok:
                 backend.record_success()
@@ -192,8 +246,11 @@ class Router:
                 was_open = backend.cb_state == "open"
                 backend.record_failure(time.monotonic())
                 backend.healthy = False
-                if backend.cb_state == "open" and not was_open:
-                    self.stats["circuit_open_total"] += 1
+                opened = backend.cb_state == "open" and not was_open
+        if opened:
+            # same registry-counter path as every other stat bump
+            # (leaf-locked; kept outside _lock for uniformity)
+            self.inc("circuit_open_total")
 
     # -- health --------------------------------------------------------
 
@@ -263,12 +320,17 @@ class RouterServer:
     def __init__(self, router: Router, host: str = "0.0.0.0",
                  port: int = 0, retries: int = 2,
                  retry_backoff: float = 0.05,
-                 retry_budget_ratio: float = 0.2):
+                 retry_budget_ratio: float = 0.2,
+                 request_log=None):
         self.router = router
         self.retries = retries
         self.retry_backoff = retry_backoff
         self.budget = RetryBudget(ratio=retry_budget_ratio)
         self._jitter = random.Random(1)
+        self.request_log = _coerce_reqlog(request_log)
+        self._h_request = router.registry.histogram(
+            "ome_router_request_seconds",
+            "End-to-end proxied request seconds (retries included)")
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -297,14 +359,8 @@ class RouterServer:
                              "healthy": b.healthy}
                             for b in outer.router.backends]})
                 if self.path == "/metrics":
-                    lines = []
-                    for k, v in outer.router.stats.items():
-                        lines.append(f"# TYPE ome_router_{k} counter")
-                        lines.append(f"ome_router_{k} {v}")
-                    up = sum(b.healthy for b in outer.router.backends)
-                    lines.append("# TYPE ome_router_backends_up gauge")
-                    lines.append(f"ome_router_backends_up {up}")
-                    body = ("\n".join(lines) + "\n").encode()
+                    outer.router.update_gauges()
+                    body = outer.router.registry.render().encode()
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "text/plain; version=0.0.4")
@@ -346,10 +402,39 @@ class RouterServer:
 
             def _proxy(self, body: bytes, stream: bool,
                        affinity: str = ""):
+                # request-lifecycle tracing: adopt the caller's
+                # traceparent or mint a fresh trace; every forwarded
+                # hop carries a CHILD span of this context, and both
+                # router and engine request logs share the trace id
+                ctx = tracing.from_headers(self.headers)
+                t0 = time.monotonic()
+                outcome = {"backend": None, "pool": None,
+                           "status": "error", "retries": 0}
+                try:
+                    return self._route(body, stream, affinity, ctx,
+                                       outcome)
+                finally:
+                    dur = time.monotonic() - t0
+                    outer._h_request.observe(dur)
+                    if outer.request_log.enabled:
+                        outer.request_log.write({
+                            "component": "router",
+                            "trace_id": ctx.trace_id,
+                            "span_id": ctx.span_id,
+                            "path": self.path,
+                            "pool": outcome["pool"],
+                            "backend": outcome["backend"],
+                            "status": outcome["status"],
+                            "retries": outcome["retries"],
+                            "duration_s": round(dur, 6)})
+
+            def _route(self, body: bytes, stream: bool, affinity: str,
+                       ctx, outcome: dict):
                 outer.router.inc("requests_total")
                 outer.budget.deposit()
                 deadline = self._deadline()
                 pool = self._pick_pool()
+                outcome["pool"] = pool
                 tried: set = set()
                 last_err = "no healthy backends"
                 for attempt in range(outer.retries + 1):
@@ -357,6 +442,7 @@ class RouterServer:
                         # the client stopped caring: do not burn a
                         # backend slot (or a retry token) on it
                         outer.router.inc("deadline_shed_total")
+                        outcome["status"] = "deadline"
                         return self._json(504, {
                             "error": "request deadline exceeded"})
                     if attempt > 0:
@@ -375,14 +461,19 @@ class RouterServer:
                     if backend is None:
                         break
                     tried.add(backend.url)
+                    outcome["backend"] = backend.url
+                    outcome["retries"] = attempt
                     try:
                         result = self._forward(backend, body, stream,
-                                               deadline)
+                                               deadline,
+                                               trace=ctx.child())
                         outer.router.note_result(backend, ok=True)
+                        outcome["status"] = "ok"
                         return result
                     except _ClientGone:
                         # the CLIENT went away: nothing to retry, and
                         # the backend did nothing wrong
+                        outcome["status"] = "client_gone"
                         return None
                     except _ResponseStarted as e:
                         # bytes already reached the client: a retry
@@ -395,6 +486,7 @@ class RouterServer:
                         except OSError:
                             pass
                         self.close_connection = True
+                        outcome["status"] = "stream_abort"
                         return None
                     except (urllib.error.URLError, OSError,
                             ConnectionError) as e:
@@ -404,6 +496,7 @@ class RouterServer:
                         log.warning("backend %s failed (%s); retrying",
                                     backend.url, e)
                 outer.router.inc("no_backend_total")
+                outcome["status"] = "no_backend"
                 self._json(503, {"error": f"routing failed: {last_err}"},
                            headers={"Retry-After": "1"})
 
@@ -414,7 +507,8 @@ class RouterServer:
                     raise _ClientGone(str(e)) from e
 
             def _forward(self, backend: Backend, body: bytes,
-                         stream: bool, deadline: Optional[float] = None):
+                         stream: bool, deadline: Optional[float] = None,
+                         trace=None):
                 from .. import faults
 
                 # deterministic fault injection: an armed rule makes
@@ -423,6 +517,8 @@ class RouterServer:
                 faults.fire("router_forward", key=backend.url,
                             exc=urllib.error.URLError)
                 headers = {"Content-Type": "application/json"}
+                if trace is not None:
+                    headers[tracing.TRACEPARENT_HEADER] = trace.header()
                 timeout = 600.0
                 if deadline is not None:
                     # propagate the client deadline downstream and
@@ -518,6 +614,7 @@ class RouterServer:
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5)
+        self.request_log.close()
 
 
 def discover_backends(client, namespace: str, selector: Dict[str, str],
@@ -570,6 +667,10 @@ def main(argv=None) -> int:
                    help="deterministic fault-injection spec "
                         "(ome_tpu/faults.py grammar); also via "
                         "OME_FAULTS")
+    p.add_argument("--request-log", default=None,
+                   help="JSONL request-log path (one record per "
+                        "proxied request with trace id, backend, "
+                        "retries, duration; docs/observability.md)")
     p.add_argument("--engine-selector", default=None,
                    help="k8s label selector for engine Services "
                         "(k=v[,k=v]); requires --in-cluster/--kube-*")
@@ -614,7 +715,8 @@ def main(argv=None) -> int:
     router.check_health_once()
     srv = RouterServer(router, host=args.bind, port=args.port,
                        retries=args.retries,
-                       retry_backoff=args.retry_backoff).start()
+                       retry_backoff=args.retry_backoff,
+                       request_log=args.request_log).start()
     log.info("router on :%d over %d backends (policy=%s)", srv.port,
              len(backends), args.policy)
     try:

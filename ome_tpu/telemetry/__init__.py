@@ -1,0 +1,33 @@
+"""Unified telemetry layer: metrics, tracing, request logs, profiling.
+
+What the reference's operator assumes its engines provide (scrapeable
+Prometheus metrics for KEDA autoscaling, probe-able latency signals)
+but dependency-free and shared across every in-repo binary. Four
+pieces, each usable alone:
+
+  * registry  — labeled Counters/Gauges/Histograms + text 0.0.4
+                exposition (`Registry.render()` IS the /metrics body);
+  * tracing   — W3C traceparent SpanContext minted at the router and
+                propagated router→engine→scheduler;
+  * reqlog    — per-request JSONL records (`--request-log`) carrying
+                the trace id, phase latencies, and finish reason;
+  * profiler  — guarded on-demand jax.profiler capture
+                (`POST /debug/profile?seconds=N`).
+
+Metric catalog + contracts: docs/observability.md. Naming rules are
+linted by scripts/check_metrics.py (tier-1).
+"""
+
+from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
+                       MetricFamily, Registry, escape_label_value,
+                       format_value)
+from .reqlog import RequestLog
+from .tracing import (TRACEPARENT_HEADER, SpanContext, from_headers,
+                      new_trace, parse_traceparent)
+
+__all__ = [
+    "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "MetricFamily",
+    "Registry", "RequestLog", "SpanContext", "TRACEPARENT_HEADER",
+    "escape_label_value", "format_value", "from_headers", "new_trace",
+    "parse_traceparent",
+]

@@ -1,0 +1,60 @@
+"""Structured JSONL request log (`--request-log`).
+
+One JSON object per line per finished request, written append-only
+and flushed immediately so a crashed replica's log is still complete
+up to the fault. The record carries the trace id minted/adopted by
+tracing.py, which is what makes router and engine logs joinable:
+`grep <trace_id> router.jsonl engine.jsonl` reconstructs a request's
+full path. Schema documented in docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import IO, Optional
+
+
+class RequestLog:
+    """Thread-safe JSONL sink; a None path makes it a no-op so call
+    sites never need an `if log is not None` dance."""
+
+    def __init__(self, path: Optional[str] = None,
+                 stream: Optional[IO[str]] = None):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh: Optional[IO[str]] = stream
+        if path:
+            self._fh = open(path, "a", encoding="utf-8")
+
+    @property
+    def enabled(self) -> bool:
+        return self._fh is not None
+
+    def write(self, record: dict):
+        if self._fh is None:
+            return
+        rec = {"ts": round(time.time(), 6)}
+        rec.update(record)
+        line = json.dumps(rec, separators=(",", ":"),
+                          default=str) + "\n"
+        with self._lock:
+            if self._fh is None:
+                return
+            self._fh.write(line)
+            self._fh.flush()
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None and self.path:
+                self._fh.close()
+            self._fh = None
+
+
+def coerce(value) -> RequestLog:
+    """Accept a RequestLog, a path, or None (disabled) — the form
+    every server constructor takes for its request_log parameter."""
+    if isinstance(value, RequestLog):
+        return value
+    return RequestLog(path=value)

@@ -1,0 +1,65 @@
+"""Request-lifecycle tracing: trace ids + per-hop span ids.
+
+The wire format is the W3C `traceparent` header
+(`00-<32 hex trace id>-<16 hex span id>-<2 hex flags>`) so traces
+originated here interoperate with any surrounding mesh (Istio
+sidecars, cloud load balancers) that already speaks it. The router
+mints a trace per incoming request (or adopts the caller's), forwards
+a CHILD span to the engine, and both ends stamp the ids into their
+JSONL request logs — one grep correlates a slow client response with
+the exact engine replica, queue wait, and decode phase that produced
+it (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, replace
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+TRACEPARENT_HEADER = "traceparent"
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    trace_id: str  # 32 lowercase hex chars
+    span_id: str   # 16 lowercase hex chars
+    flags: str = "01"  # sampled
+
+    def child(self) -> "SpanContext":
+        """New span in the same trace (one per forwarding hop)."""
+        return replace(self, span_id=os.urandom(8).hex())
+
+    def header(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{self.flags}"
+
+
+def new_trace() -> SpanContext:
+    return SpanContext(trace_id=os.urandom(16).hex(),
+                       span_id=os.urandom(8).hex())
+
+
+def parse_traceparent(value) -> "SpanContext | None":
+    """Strict parse; anything malformed yields None (the caller mints
+    a fresh trace rather than propagating garbage ids)."""
+    if not value:
+        return None
+    m = _TRACEPARENT_RE.match(str(value).strip().lower())
+    if m is None:
+        return None
+    version, trace_id, span_id, flags = m.groups()
+    if version == "ff":
+        return None  # forbidden version per the spec
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None  # all-zero ids are invalid
+    return SpanContext(trace_id=trace_id, span_id=span_id, flags=flags)
+
+
+def from_headers(headers) -> SpanContext:
+    """Adopt the caller's context from an http.server headers mapping,
+    or mint a fresh trace when absent/malformed."""
+    ctx = parse_traceparent(headers.get(TRACEPARENT_HEADER))
+    return ctx if ctx is not None else new_trace()

@@ -1,0 +1,175 @@
+#!/usr/bin/env python3
+"""Static metric-naming lint (tier-1, via tests/test_telemetry.py).
+
+Walks every registry declaration in the source tree — calls of the
+form `<registry>.counter(...)` / `.gauge(...)` / `.histogram(...)` —
+and fails on naming violations before they can reach a dashboard:
+
+  * metric name missing an approved subsystem prefix
+    (`ome_*` / `model_agent_*`);
+  * a counter whose name does not end in `_total`;
+  * a scalar metric squatting on a histogram's reserved suffixes
+    (`_bucket`/`_sum`/`_count`);
+  * label NAMES that imply unbounded per-request cardinality
+    (request ids, trace ids, raw prompts) — each distinct label value
+    is a new time series, so these melt a Prometheus server.
+
+Names built from f-strings are resolved as far as module-level string
+constants allow; a name whose static prefix already violates the
+rules fails, one that is entirely dynamic is reported (loudly) but
+not failed — the runtime registry still enforces `_total`.
+
+Usage: python scripts/check_metrics.py [root-dir]    (default: ome_tpu)
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+from typing import Dict, List, Optional, Tuple
+
+ALLOWED_PREFIXES = ("ome_", "model_agent_")
+DECL_METHODS = ("counter", "gauge", "histogram")
+RESERVED_SUFFIXES = ("_bucket", "_sum", "_count")
+# label names whose VALUES are per-request/per-user unique — one time
+# series per value is a cardinality explosion, keep them in the
+# request log instead
+BANNED_LABELS = frozenset((
+    "id", "request_id", "requestid", "req_id", "trace_id", "span_id",
+    "prompt", "user", "user_id", "session_id", "token"))
+
+
+class Violation:
+    def __init__(self, path: pathlib.Path, line: int, msg: str):
+        self.path, self.line, self.msg = path, line, msg
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: {self.msg}"
+
+
+def _module_str_consts(tree: ast.Module) -> Dict[str, str]:
+    consts: Dict[str, str] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            consts[node.targets[0].id] = node.value.value
+    return consts
+
+
+def _static_prefix(node, consts: Dict[str, str]
+                   ) -> Tuple[str, bool]:
+    """(longest statically-known leading string, fully-static?)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, True
+    if isinstance(node, ast.Name) and node.id in consts:
+        return consts[node.id], True
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(str(piece.value))
+                continue
+            if (isinstance(piece, ast.FormattedValue)
+                    and isinstance(piece.value, ast.Name)
+                    and piece.value.id in consts):
+                parts.append(consts[piece.value.id])
+                continue
+            return "".join(parts), False
+        return "".join(parts), True
+    return "", False
+
+
+def _labelnames(call: ast.Call) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == "labelnames":
+            return kw.value
+    if len(call.args) >= 3:
+        return call.args[2]
+    return None
+
+
+def _check_call(call: ast.Call, kind: str, consts: Dict[str, str],
+                path: pathlib.Path, out: List[Violation],
+                dynamic: List[str]):
+    if not call.args:
+        return
+    name, fully_static = _static_prefix(call.args[0], consts)
+    line = call.lineno
+    if not name:
+        dynamic.append(f"{path}:{line}: fully dynamic {kind} name "
+                       "(runtime registry rules still apply)")
+    elif not name.startswith(ALLOWED_PREFIXES):
+        out.append(Violation(
+            path, line,
+            f"{kind} {name!r}: missing subsystem prefix "
+            f"(one of {ALLOWED_PREFIXES})"))
+    if fully_static and name:
+        if kind == "counter" and not name.endswith("_total"):
+            out.append(Violation(
+                path, line,
+                f"counter {name!r} must end in '_total'"))
+        if kind != "histogram" and name.endswith(RESERVED_SUFFIXES):
+            out.append(Violation(
+                path, line,
+                f"{kind} {name!r} ends in a histogram-reserved "
+                f"suffix {RESERVED_SUFFIXES}"))
+    labels = _labelnames(call)
+    if labels is not None and isinstance(labels, (ast.Tuple, ast.List)):
+        for el in labels.elts:
+            if isinstance(el, ast.Constant) and \
+                    str(el.value).lower() in BANNED_LABELS:
+                out.append(Violation(
+                    path, line,
+                    f"label {el.value!r} on {name or kind!r} implies "
+                    "unbounded cardinality (one series per request); "
+                    "put it in the request log, not a label"))
+
+
+def check_file(path: pathlib.Path) -> Tuple[List[Violation], List[str]]:
+    tree = ast.parse(path.read_text(encoding="utf-8"),
+                     filename=str(path))
+    consts = _module_str_consts(tree)
+    violations: List[Violation] = []
+    dynamic: List[str] = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in DECL_METHODS):
+            _check_call(node, node.func.attr, consts, path,
+                        violations, dynamic)
+    return violations, dynamic
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = pathlib.Path(argv[0]) if argv else \
+        pathlib.Path(__file__).resolve().parents[1] / "ome_tpu"
+    if not root.exists():
+        print(f"check_metrics: no such directory {root}",
+              file=sys.stderr)
+        return 2
+    violations: List[Violation] = []
+    dynamic: List[str] = []
+    files = sorted(root.rglob("*.py"))
+    # the registry implementation itself manipulates generic names;
+    # its internal calls are not declarations
+    files = [f for f in files
+             if "telemetry" not in f.parts or f.name != "registry.py"]
+    for f in files:
+        v, d = check_file(f)
+        violations.extend(v)
+        dynamic.extend(d)
+    for note in dynamic:
+        print(f"note: {note}")
+    for v in violations:
+        print(f"VIOLATION: {v}")
+    print(f"check_metrics: {len(files)} files, "
+          f"{len(violations)} violation(s)")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -496,6 +496,12 @@ class TestRouterCircuitBreaker:
                 code, _, _ = _post(base + "/v1/completions",
                                    {"prompt": "a"})
                 assert code == 200 and stub.hits == 1
+                # the router notes the success AFTER relaying the
+                # response bytes; give the handler thread a moment
+                deadline = time.monotonic() + 5
+                while b.cb_state != "closed" and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.01)
                 assert b.cb_state == "closed" and b.fails == 0
             finally:
                 srv.stop()

@@ -1,0 +1,553 @@
+"""Shared telemetry layer (ome_tpu/telemetry/): exposition-format
+validity, histogram semantics, label escaping, concurrent scrapes,
+traceparent propagation router->engine, JSONL request logs joinable
+by trace id, the /debug/profile guard, and the metric-naming lint."""
+
+import json
+import pathlib
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ome_tpu.engine.scheduler import Scheduler
+from ome_tpu.engine.server import EngineServer
+from ome_tpu.engine.tokenizer import ByteTokenizer
+from ome_tpu.router.server import Backend, Router, RouterServer
+from ome_tpu.telemetry import (DEFAULT_BUCKETS, Registry, RequestLog,
+                               escape_label_value, new_trace,
+                               parse_traceparent, tracing)
+
+from test_faults import FakeEngine
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+# -- strict Prometheus text-format 0.0.4 line grammar ----------------
+
+_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{" + _LABEL + r"(?:," + _LABEL + r")*\})?"
+    r" (?P<value>[+-]?(?:\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|Inf)|NaN)$")
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+                      r"(counter|gauge|histogram|summary|untyped)$")
+
+
+def parse_exposition(text: str):
+    """Validate EVERY line against the grammar; return
+    ({series_name_with_labels: value}, {family: type})."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    samples, types = {}, {}
+    seen_families = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP"):
+            assert _HELP_RE.match(line), f"bad HELP line: {line!r}"
+            continue
+        if line.startswith("# TYPE"):
+            m = _TYPE_RE.match(line)
+            assert m, f"bad TYPE line: {line!r}"
+            fam, kind = m.groups()
+            assert fam not in types, f"duplicate TYPE for {fam}"
+            types[fam] = kind
+            seen_families.append(fam)
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        key = m.group("name") + (m.group("labels") or "")
+        assert key not in samples, f"duplicate series {key}"
+        v = m.group("value")
+        samples[key] = float(v.replace("Inf", "inf"))
+        # every sample belongs to the most recently opened family
+        # (grouped exposition, per the format spec)
+        fam = seen_families[-1] if seen_families else ""
+        assert m.group("name").startswith(fam), \
+            f"sample {key} outside its TYPE group {fam}"
+    return samples, types
+
+
+def wait_for_jsonl(path, timeout: float = 10.0) -> dict:
+    """Last record of a JSONL file, waiting for it to appear: the
+    router writes its record AFTER the response bytes reach the
+    client, so an immediate read can race the handler thread."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        text = path.read_text() if path.exists() else ""
+        if text.endswith("\n") and text.strip():
+            return json.loads(text.splitlines()[-1])
+        time.sleep(0.01)
+    raise AssertionError(f"no complete record in {path}")
+
+
+def scrape(url: str, timeout: float = 30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        return r.read().decode()
+
+
+# -- registry unit tests ---------------------------------------------
+
+
+class TestRegistry:
+    def test_counter_requires_total_suffix(self):
+        r = Registry()
+        with pytest.raises(ValueError, match="_total"):
+            r.counter("ome_requests")
+
+    def test_counter_rejects_negative(self):
+        r = Registry()
+        with pytest.raises(ValueError):
+            r.counter("ome_x_total").inc(-1)
+
+    def test_histogram_rejects_reserved_suffixes(self):
+        r = Registry()
+        for bad in ("ome_x_bucket", "ome_x_sum", "ome_x_count",
+                    "ome_x_total"):
+            with pytest.raises(ValueError):
+                r.histogram(bad)
+
+    def test_redeclare_idempotent_conflict_raises(self):
+        r = Registry()
+        c1 = r.counter("ome_a_total", "h")
+        assert r.counter("ome_a_total") is c1
+        with pytest.raises(ValueError, match="already declared"):
+            r.gauge("ome_a_total")
+        with pytest.raises(ValueError, match="already declared"):
+            r.counter("ome_a_total", labelnames=("k",))
+
+    def test_exposition_is_valid_and_typed(self):
+        r = Registry()
+        r.counter("ome_req_total", "reqs",
+                  labelnames=("path",)).labels(path="/v1").inc(3)
+        r.gauge("ome_depth", "queue depth").set(7.5)
+        r.histogram("ome_lat_seconds", "latency").observe(0.2)
+        samples, types = parse_exposition(r.render())
+        assert types == {"ome_req_total": "counter",
+                         "ome_depth": "gauge",
+                         "ome_lat_seconds": "histogram"}
+        assert samples['ome_req_total{path="/v1"}'] == 3
+        assert samples["ome_depth"] == 7.5
+        assert samples["ome_lat_seconds_count"] == 1
+
+    def test_histogram_buckets_cumulative_and_monotonic(self):
+        r = Registry()
+        h = r.histogram("ome_lat_seconds", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.05, 0.5, 5.0, 50.0):
+            h.observe(v)
+        samples, _ = parse_exposition(r.render())
+        series = [samples[f'ome_lat_seconds_bucket{{le="{le}"}}']
+                  for le in ("0.1", "1", "10")]
+        series.append(samples['ome_lat_seconds_bucket{le="+Inf"}'])
+        assert series == [2, 3, 4, 5]  # cumulative
+        assert all(a <= b for a, b in zip(series, series[1:]))
+        assert samples["ome_lat_seconds_count"] == 5
+        assert series[-1] == samples["ome_lat_seconds_count"]
+        assert samples["ome_lat_seconds_sum"] == pytest.approx(55.6)
+
+    def test_default_buckets_sorted(self):
+        assert list(DEFAULT_BUCKETS) == sorted(DEFAULT_BUCKETS)
+
+    def test_label_escaping_round_trips(self):
+        raw = 'quo"te\\slash\nnewline'
+        esc = escape_label_value(raw)
+        assert "\n" not in esc
+        r = Registry()
+        r.counter("ome_esc_total", "h",
+                  labelnames=("k",)).labels(k=raw).inc()
+        samples, _ = parse_exposition(r.render())
+        (key,) = samples
+        # unescape per the format spec and recover the original
+        m = re.search(r'k="(.*)"', key, re.S)
+        unescaped = (m.group(1).replace("\\n", "\n")
+                     .replace('\\"', '"').replace("\\\\", "\\"))
+        assert unescaped == raw
+
+    def test_labeled_family_rejects_bare_and_wrong_labels(self):
+        r = Registry()
+        c = r.counter("ome_l_total", labelnames=("a", "b"))
+        with pytest.raises(ValueError):
+            c.inc()  # labeled family needs .labels(...)
+        with pytest.raises(ValueError):
+            c.labels(a="1")  # missing b
+        with pytest.raises(ValueError):
+            c.labels(a="1", b="2", c="3")
+
+    def test_snapshot_and_get(self):
+        r = Registry()
+        r.counter("ome_a_total").inc(2)
+        r.histogram("ome_h_seconds").observe(1)
+        assert r.get("ome_a_total") == 2
+        assert r.get("ome_h_seconds") == 1  # histograms -> count
+        assert r.get("ome_missing") is None
+        snap = r.snapshot()
+        assert snap["ome_a_total"] == 2
+        assert snap["ome_h_seconds_count"] == 1
+
+    def test_concurrent_updates_and_scrapes(self):
+        """Writers hammer a labeled counter + histogram while a reader
+        renders continuously: every render must parse, and the final
+        totals must be exact (no lost updates)."""
+        r = Registry()
+        c = r.counter("ome_hits_total", "h", labelnames=("w",))
+        h = r.histogram("ome_work_seconds", "h")
+        n_threads, n_iter = 8, 500
+        stop = threading.Event()
+        bad: list = []
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    parse_exposition(r.render())
+                except AssertionError as e:  # pragma: no cover
+                    bad.append(e)
+                    return
+
+        def writer(i):
+            child = c.labels(w=str(i % 2))
+            for _ in range(n_iter):
+                child.inc()
+                h.observe(0.01)
+
+        rt = threading.Thread(target=reader)
+        rt.start()
+        ws = [threading.Thread(target=writer, args=(i,))
+              for i in range(n_threads)]
+        for t in ws:
+            t.start()
+        for t in ws:
+            t.join()
+        stop.set()
+        rt.join()
+        assert not bad, f"scrape raced to an invalid body: {bad[0]}"
+        samples, _ = parse_exposition(r.render())
+        total = samples['ome_hits_total{w="0"}'] + \
+            samples['ome_hits_total{w="1"}']
+        assert total == n_threads * n_iter
+        assert samples["ome_work_seconds_count"] == n_threads * n_iter
+
+
+# -- tracing ---------------------------------------------------------
+
+
+class TestTracing:
+    def test_header_round_trip(self):
+        ctx = new_trace()
+        got = parse_traceparent(ctx.header())
+        assert (got.trace_id, got.span_id) == (ctx.trace_id,
+                                               ctx.span_id)
+
+    def test_child_keeps_trace_changes_span(self):
+        ctx = new_trace()
+        kid = ctx.child()
+        assert kid.trace_id == ctx.trace_id
+        assert kid.span_id != ctx.span_id
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # zero trace id
+        "00-" + "1" * 32 + "-" + "0" * 16 + "-01",   # zero span id
+        "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",   # forbidden version
+        "00-" + "g" * 32 + "-" + "2" * 16 + "-01",   # non-hex
+        "00-" + "1" * 31 + "-" + "2" * 16 + "-01",   # short
+    ])
+    def test_malformed_rejected(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_from_headers_adopts_or_mints(self):
+        ctx = new_trace()
+        adopted = tracing.from_headers(
+            {"traceparent": ctx.header()})
+        assert adopted.trace_id == ctx.trace_id
+        minted = tracing.from_headers({})
+        assert re.fullmatch(r"[0-9a-f]{32}", minted.trace_id)
+
+
+# -- request log -----------------------------------------------------
+
+
+class TestRequestLog:
+    def test_disabled_is_noop(self):
+        rl = RequestLog()
+        assert not rl.enabled
+        rl.write({"a": 1})  # must not raise
+        rl.close()
+
+    def test_writes_jsonl_with_ts(self, tmp_path):
+        p = tmp_path / "req.jsonl"
+        rl = RequestLog(str(p))
+        rl.write({"component": "test", "n": 1})
+        rl.write({"component": "test", "n": 2})
+        rl.close()
+        recs = [json.loads(line) for line in
+                p.read_text().splitlines()]
+        assert [r["n"] for r in recs] == [1, 2]
+        assert all("ts" in r for r in recs)
+
+
+# -- naming lint (scripts/check_metrics.py, tier-1 wiring) -----------
+
+
+class TestMetricsLint:
+    SCRIPT = REPO / "scripts" / "check_metrics.py"
+
+    def test_repo_tree_is_clean(self):
+        proc = subprocess.run(
+            [sys.executable, str(self.SCRIPT)],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_violations_fail(self, tmp_path):
+        (tmp_path / "bad.py").write_text(
+            "PREFIX = 'ome_'\n"
+            "def setup(r):\n"
+            "    r.counter('requests_total')\n"        # no prefix
+            "    r.counter('ome_hits')\n"              # no _total
+            "    r.gauge('ome_last_sum')\n"            # reserved suffix
+            "    r.gauge('ome_x', 'h', labelnames=('request_id',))\n"
+            "    r.counter(f'{PREFIX}ok_total')\n")    # fine (resolved)
+        proc = subprocess.run(
+            [sys.executable, str(self.SCRIPT), str(tmp_path)],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 1
+        assert proc.stdout.count("VIOLATION") == 4
+        assert "request_id" in proc.stdout
+        assert "ok_total" not in proc.stdout
+
+
+# -- served surfaces: engine + router over HTTP ----------------------
+
+
+@pytest.fixture()
+def engine_server(tmp_path):
+    sched = Scheduler(FakeEngine(max_slots=2))
+    log_path = tmp_path / "engine.jsonl"
+    srv = EngineServer(sched, tokenizer=ByteTokenizer(),
+                       model_name="tiny", port=0,
+                       request_log=str(log_path),
+                       profile_dir=str(tmp_path / "prof"))
+    srv.start()
+    yield srv, sched, log_path
+    srv.stop()
+
+
+def _post(url, payload=None, headers=None, data=None, timeout=30):
+    body = data if data is not None else \
+        json.dumps(payload or {}).encode()
+    req = urllib.request.Request(
+        url, data=body,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+class TestEngineMetricsEndpoint:
+    def test_counters_are_counters_with_total_suffix(
+            self, engine_server):
+        """The satellite fix: the old emitter typed EVERYTHING as
+        gauge; counters must render `# TYPE ... counter` + _total."""
+        srv, sched, _ = engine_server
+        base = f"http://127.0.0.1:{srv.port}"
+        _post(base + "/v1/completions",
+              {"prompt": "hi", "max_tokens": 4})
+        samples, types = parse_exposition(scrape(base + "/metrics"))
+        for key in ("requests_total", "prefill_total",
+                    "decode_steps_total", "tokens_generated_total"):
+            name = f"ome_engine_{key}"
+            assert types[name] == "counter", name
+        assert samples["ome_engine_requests_total"] >= 1
+        assert types["ome_engine_queue_depth"] == "gauge"
+        # no counter may render under any other name shape
+        for fam, kind in types.items():
+            if kind == "counter":
+                assert fam.endswith("_total"), fam
+
+    def test_latency_histograms_fill_after_a_request(
+            self, engine_server):
+        srv, sched, _ = engine_server
+        base = f"http://127.0.0.1:{srv.port}"
+        status, _, out = _post(base + "/v1/completions",
+                               {"prompt": "hello", "max_tokens": 4})
+        assert status == 200
+        assert out["usage"]["completion_tokens"] == 4
+        samples, types = parse_exposition(scrape(base + "/metrics"))
+        for fam in ("ome_engine_queue_wait_seconds",
+                    "ome_engine_ttft_seconds",
+                    "ome_engine_tpot_seconds",
+                    "ome_engine_e2e_seconds",
+                    "ome_engine_prefill_seconds",
+                    "ome_engine_decode_step_seconds"):
+            assert types[fam] == "histogram", fam
+            assert samples[f"{fam}_count"] >= 1, fam
+            assert samples[f'{fam}_bucket{{le="+Inf"}}'] == \
+                samples[f"{fam}_count"], fam
+        # occupancy/status gauges refresh at scrape time
+        assert samples["ome_engine_batch_occupancy_ratio"] <= 1.0
+        assert samples['ome_engine_status{state="ok"}'] == 1
+
+    def test_http_request_counter_bounds_path_label(
+            self, engine_server):
+        srv, _, _ = engine_server
+        base = f"http://127.0.0.1:{srv.port}"
+        for path in ("/health", "/definitely/not/a/route"):
+            try:
+                urllib.request.urlopen(base + path, timeout=30)
+            except urllib.error.HTTPError:
+                pass
+        samples, _ = parse_exposition(scrape(base + "/metrics"))
+        assert samples[
+            'ome_engine_http_requests_total{path="/health"}'] >= 1
+        assert samples[
+            'ome_engine_http_requests_total{path="other"}'] >= 1
+        assert not any("/definitely" in k for k in samples)
+
+    def test_engine_request_log_and_adopted_trace(self, engine_server):
+        srv, _, log_path = engine_server
+        base = f"http://127.0.0.1:{srv.port}"
+        ctx = new_trace()
+        status, _, _ = _post(base + "/v1/completions",
+                             {"prompt": "hi", "max_tokens": 3},
+                             headers={"traceparent": ctx.header()})
+        assert status == 200
+        rec = wait_for_jsonl(log_path)
+        assert rec["component"] == "engine"
+        assert rec["trace_id"] == ctx.trace_id
+        assert rec["model"] == "tiny"
+        assert rec["output_tokens"] == 3
+        assert rec["finish_reason"] == "length"
+        assert rec["queue_wait_s"] is not None
+        assert rec["ttft_s"] >= 0
+        assert rec["tpot_s"] >= 0
+        assert rec["e2e_s"] >= rec["ttft_s"]
+
+    def test_profile_endpoint_guarded_and_noop_off_tpu(
+            self, engine_server, tmp_path):
+        srv, sched, _ = engine_server
+        base = f"http://127.0.0.1:{srv.port}"
+        # enabled server: CPU capture is a structured no-op
+        status, _, out = _post(base + "/debug/profile?seconds=0.5", {})
+        assert status == 200
+        assert out["captured"] is False
+        assert out["platform"] == "cpu"
+        # bad duration -> 400
+        status, _, _ = _post(base + "/debug/profile?seconds=0", {})
+        assert status == 400
+        status, _, _ = _post(base + "/debug/profile?seconds=9999", {})
+        assert status == 400
+
+    def test_profile_endpoint_403_when_disabled(self, tmp_path):
+        sched = Scheduler(FakeEngine(max_slots=1))
+        srv = EngineServer(sched, tokenizer=ByteTokenizer(),
+                           model_name="t", port=0)  # no profile_dir
+        srv.start()
+        try:
+            status, _, out = _post(
+                f"http://127.0.0.1:{srv.port}/debug/profile", {})
+            assert status == 403
+            assert "--profile-dir" in out["error"]
+        finally:
+            srv.stop()
+
+
+class TestRouterTelemetry:
+    def test_stats_mutation_goes_through_registry(self):
+        router = Router([Backend("http://a")])
+        router.inc("circuit_open_total")
+        assert router.stats["circuit_open_total"] == 1
+        # the dict view is a snapshot: external += cannot corrupt it
+        view = router.stats
+        view["circuit_open_total"] = 99
+        assert router.stats["circuit_open_total"] == 1
+        samples, types = parse_exposition(router.registry.render())
+        assert types["ome_router_circuit_open_total"] == "counter"
+        assert samples["ome_router_circuit_open_total"] == 1
+
+    def test_note_result_opens_breaker_and_counts_once(self):
+        router = Router([Backend("http://a", cb_threshold=2)])
+        b = router.backends[0]
+        router.note_result(b, ok=False)
+        assert router.stats["circuit_open_total"] == 0
+        router.note_result(b, ok=False)  # second failure trips it
+        assert router.stats["circuit_open_total"] == 1
+        router.update_gauges()
+        samples, _ = parse_exposition(router.registry.render())
+        assert samples[
+            'ome_router_backend_circuit_state'
+            '{backend="http://a",pool="engine"}'] == 2  # open
+
+    def test_router_to_engine_trace_and_metrics(self, tmp_path):
+        """Acceptance: a router-originated trace id lands, identical,
+        in BOTH JSONL request logs, and both /metrics bodies parse as
+        valid Prometheus text with the latency histograms filled."""
+        sched = Scheduler(FakeEngine(max_slots=2))
+        elog = tmp_path / "engine.jsonl"
+        esrv = EngineServer(sched, tokenizer=ByteTokenizer(),
+                            model_name="tiny", port=0,
+                            request_log=str(elog))
+        esrv.start()
+        rlog = tmp_path / "router.jsonl"
+        router = Router([Backend(f"http://127.0.0.1:{esrv.port}")])
+        rsrv = RouterServer(router, host="127.0.0.1", port=0,
+                            request_log=str(rlog)).start()
+        try:
+            base = f"http://127.0.0.1:{rsrv.port}"
+            status, _, out = _post(base + "/v1/completions",
+                                   {"model": "tiny", "prompt": "hi",
+                                    "max_tokens": 4}, timeout=120)
+            assert status == 200
+            assert out["usage"]["completion_tokens"] == 4
+
+            r_rec = wait_for_jsonl(rlog)
+            e_rec = wait_for_jsonl(elog)
+            assert r_rec["component"] == "router"
+            assert e_rec["component"] == "engine"
+            assert r_rec["trace_id"] == e_rec["trace_id"]
+            assert re.fullmatch(r"[0-9a-f]{32}", r_rec["trace_id"])
+            # per-hop spans differ even though the trace id is shared
+            assert r_rec["span_id"] != e_rec["span_id"]
+            assert r_rec["status"] == "ok"
+            assert r_rec["backend"] == \
+                f"http://127.0.0.1:{esrv.port}"
+
+            e_samples, e_types = parse_exposition(
+                scrape(f"http://127.0.0.1:{esrv.port}/metrics"))
+            for fam in ("ome_engine_ttft_seconds",
+                        "ome_engine_tpot_seconds",
+                        "ome_engine_queue_wait_seconds"):
+                assert e_types[fam] == "histogram"
+                assert e_samples[f"{fam}_count"] >= 1, fam
+            r_samples, r_types = parse_exposition(
+                scrape(base + "/metrics"))
+            assert r_types["ome_router_requests_total"] == "counter"
+            assert r_samples["ome_router_requests_total"] >= 1
+            assert r_samples["ome_router_request_seconds_count"] >= 1
+            assert r_samples["ome_router_backends_up"] == 1
+        finally:
+            rsrv.stop()
+            esrv.stop()
+
+
+class TestModelAgentShim:
+    def test_shim_renders_through_registry(self):
+        from ome_tpu.modelagent.metrics import Metrics
+        m = Metrics()
+        m.inc("downloads_total", 2)
+        m.observe("staged_gib", 1.25)
+        assert m.get("downloads_total") == 2
+        samples, types = parse_exposition(m.render())
+        assert types["model_agent_downloads_total"] == "counter"
+        assert samples["model_agent_downloads_total"] == 2
+        assert samples["model_agent_staged_gib"] == 1.25
+        assert m.snapshot() == {"downloads_total": 2.0,
+                                "staged_gib": 1.25}
+        m.reset()
+        assert m.get("downloads_total") == 0
