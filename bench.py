@@ -315,6 +315,51 @@ def main() -> None:
     # -- bf16 headline --------------------------------------------------
     bf16_tps, bf16_step, bf16_w, bf16_attn = run_mode(params, "bf16")
 
+    # -- decode-loop step gap: sync fetch vs pipelined offload ----------
+    # The serving scheduler's host bubble (the quantity its
+    # ome_engine_step_gap_seconds histogram tracks): time from one
+    # decode dispatch RETURNING to the next one STARTING. "sync"
+    # fetches each dispatch's tokens before dispatching again (the
+    # --pipeline-depth 0 loop); "pipelined" starts an async host copy
+    # and reads tokens one dispatch LATE (depth 1), so the fetch
+    # overlaps device execution instead of serializing with it.
+    def step_gap_ms(pipelined: bool) -> float:
+        per, top = split_layers(params)
+        tok, cache = prefill(params, prompt,
+                             llama.KVCache.create(cfg, BATCH, CACHE_LEN))
+        ks = [cache.k[l] for l in range(cfg.num_layers)]
+        vs = [cache.v[l] for l in range(cfg.num_layers)]
+        st = (tok, ks, vs, cache.index)
+        st = decode_k(per, top, *st)  # warm, not timed
+        sync(st[0])
+        n_disp = (DECODE_STEPS - 1) // MULTISTEP
+        gaps, disp_end, pending = [], None, None
+        for _ in range(n_disp - 1):
+            t0 = time.perf_counter()
+            if disp_end is not None:
+                gaps.append(t0 - disp_end)
+            st = decode_k(per, top, *st)
+            disp_end = time.perf_counter()
+            toks = st[0]
+            if pipelined:
+                copy = getattr(toks, "copy_to_host_async", None)
+                if copy is not None:
+                    copy()
+                if pending is not None:
+                    np.asarray(jax.device_get(pending))
+                pending = toks
+            else:
+                np.asarray(jax.device_get(toks))
+        if pending is not None:
+            np.asarray(jax.device_get(pending))
+        return sum(gaps) / max(len(gaps), 1) * 1000
+
+    gap_sync = step_gap_ms(False)
+    gap_pipe = step_gap_ms(True)
+    log(f"bench: [bf16] decode {bf16_tps:.1f} tok/s | mean step gap "
+        f"{gap_sync:.2f} ms/dispatch sync-fetch -> {gap_pipe:.2f} ms "
+        f"pipelined (async token offload, one-dispatch lag)")
+
     # -- steady-state prefill (TTFT proxy) + MFU ------------------------
     cache2 = llama.KVCache.create(cfg, BATCH, CACHE_LEN)
     prompt2 = jax.random.randint(jax.random.PRNGKey(2), (BATCH, PREFILL),
@@ -481,6 +526,8 @@ def main() -> None:
         "prefill_ms_batch32x128": round(pbest * 1000, 1),
         "prefill_mfu": round(mfu, 3),
         "dispatch_ms": round(disp_ms, 2),
+        "decode_step_gap_ms": {"sync": round(gap_sync, 2),
+                               "pipelined": round(gap_pipe, 2)},
         "achievable_gbps": round(bw_ach, 1),
         "decode_effective_gbps": round(eff_gbps, 1),
         "decode_ms_breakdown": {

@@ -70,6 +70,17 @@ def _bucketize(n: int, buckets: List[int]) -> int:
     return buckets[-1]
 
 
+def _sampling_array(x, dtype) -> np.ndarray:
+    """Per-step sampling params: convert host inputs, but pass
+    device-resident jax.Arrays through untouched — converting those
+    back with np.asarray would force a device->host sync in the middle
+    of the decode loop (exactly the bubble the pipelined scheduler
+    removes by caching them on device)."""
+    if isinstance(x, jax.Array):
+        return x
+    return np.asarray(x, dtype)
+
+
 class PrefixCache:
     """Radix (token-block trie) cache of prompt-prefix KV with an HBM
     byte budget.
@@ -246,6 +257,12 @@ class InferenceEngine:
             self._free_blocks = list(range(self.kv_blocks - 1, 0, -1))
             self._host_len = np.zeros(max_slots, np.int64)
             self._preempted: List[int] = []
+            # device-resident copy of the block table, re-uploaded
+            # only when the host table actually changed (insert /
+            # free_slot / a _grow_blocks block append) — most decode
+            # steps append no block, so they reuse the previous upload
+            self._table_dirty = True
+            self._table_dev: Optional[jax.Array] = None
         if prefill_buckets is None:
             prefill_buckets, b = [], 64
             while b < self.max_seq:
@@ -482,6 +499,8 @@ class InferenceEngine:
             self._free_blocks = list(range(self.kv_blocks - 1, 0, -1))
             self._host_len[:] = 0
             self._preempted = []
+            self._table_dirty = True
+            self._table_dev = None
             pool = (L, self.kv_blocks, self.kv_block,
                     cfg.kv_cache_heads)
             return DecodeState(
@@ -510,6 +529,7 @@ class InferenceEngine:
         self._free_blocks.extend(reversed(self._owned[slot]))
         self._owned[slot] = []
         self._table[slot] = 0
+        self._table_dirty = True
         self._host_len[slot] = 0
 
     def take_preempted(self) -> List[int]:
@@ -566,6 +586,7 @@ class InferenceEngine:
                 nid = self._free_blocks.pop()
                 self._owned[b].append(nid)
                 self._table[b, j] = nid
+                self._table_dirty = True
             self._host_len[b] = w + 1  # mirror of the device +1
 
     @property
@@ -753,6 +774,7 @@ class InferenceEngine:
             ids = [self._free_blocks.pop() for _ in range(need)]
             self._owned[slot] = ids
             self._table[slot, :need] = ids
+            self._table_dirty = True
             self._host_len[slot] = true_len
         # re-resolve + record under the adapter lock: an unregister
         # between resolution and recording would zero the stacks this
@@ -788,24 +810,44 @@ class InferenceEngine:
     def decode(self, state: DecodeState, temperature, top_k, top_p,
                mask: Optional[np.ndarray] = None,
                ) -> Tuple[DecodeState, jax.Array]:
-        """One decode step for ALL slots. Sampling params: [B] arrays.
-        `mask` ([B, V] bool) routes through the masked program
-        (structured outputs); None keeps the maskless one."""
+        """One decode step for ALL slots. Sampling params: [B] arrays
+        — host arrays are converted; already-device-resident
+        jax.Arrays (the scheduler's sampling cache) pass straight
+        through. `mask` ([B, V] bool) routes through the masked
+        program (structured outputs); None keeps the maskless one.
+
+        The returned tokens stay device-resident with a host copy
+        already in flight (`copy_to_host_async`), so a pipelined
+        caller can dispatch the next step before reading them; the
+        eventual `np.asarray(toks)` then completes an overlapped copy
+        instead of starting a blocking one."""
         key = self._next_key()
-        sampling = (np.asarray(temperature, np.float32),
-                    np.asarray(top_k, np.int32),
-                    np.asarray(top_p, np.float32))
+        sampling = (_sampling_array(temperature, np.float32),
+                    _sampling_array(top_k, np.int32),
+                    _sampling_array(top_p, np.float32))
         if self.kv_block:
             self._grow_blocks()
-            table = self._table.copy()  # stable while the step runs
+            if self._table_dirty or self._table_dev is None:
+                # upload once per table CHANGE, not once per step; the
+                # copy keeps the device table stable while steps run
+                self._table_dev = jnp.asarray(self._table.copy())
+                self._table_dirty = False
+            table = self._table_dev
             if mask is not None:
-                return self._decode_masked_paged_fn(
+                state, toks = self._decode_masked_paged_fn(
                     self.params, state, table, *sampling, key,
                     np.asarray(mask, bool))
-            return self._decode_paged_fn(self.params, state, table,
-                                         *sampling, key)
-        if mask is not None:
-            return self._decode_masked_fn(
+            else:
+                state, toks = self._decode_paged_fn(
+                    self.params, state, table, *sampling, key)
+        elif mask is not None:
+            state, toks = self._decode_masked_fn(
                 self.params, state, *sampling, key,
                 np.asarray(mask, bool))
-        return self._decode_fn(self.params, state, *sampling, key)
+        else:
+            state, toks = self._decode_fn(self.params, state,
+                                          *sampling, key)
+        copy = getattr(toks, "copy_to_host_async", None)
+        if copy is not None:  # sharded/global arrays may not have it
+            copy()
+        return state, toks

@@ -39,6 +39,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+import jax.numpy as jnp
 import numpy as np
 
 from .. import faults
@@ -160,8 +161,17 @@ class Scheduler:
                  overlap: bool = False, max_restarts: int = 3,
                  restart_backoff: float = 0.05,
                  max_queue_wait: float = 30.0,
+                 pipeline_depth: int = 1,
                  registry: Optional[Registry] = None):
         self.engine = engine
+        # decode pipelining (docs/decode-pipelining.md): number of
+        # decode steps dispatched ahead of token emission. 0 = fetch
+        # every step synchronously (pre-pipelining behavior); 1 = the
+        # JetStream shape — step k's tokens are read only after step
+        # k+1 was dispatched, hiding the host-side bubble. Batches
+        # with structured-output (masked) slots fall back to the
+        # synchronous path per step regardless.
+        self.pipeline_depth = max(int(pipeline_depth), 0)
         # shared telemetry registry: the EngineServer scrapes it on
         # /metrics; stats-dict counters below are mirrored into it
         self.registry = registry or Registry()
@@ -193,6 +203,26 @@ class Scheduler:
         # new arrivals (their generated tokens ride along as prompt)
         self._requeue: "collections.deque[Request]" = \
             collections.deque()
+        # pipelined decode: dispatched-but-not-yet-read steps, each a
+        # (device tokens, slot-occupancy snapshot, generation
+        # snapshot) triple; _drain_inflight is the ONLY place these
+        # tokens are fetched to the host
+        self._inflight: "collections.deque[tuple]" = collections.deque()
+        # per-slot occupancy generation: bumped on EVERY occupancy
+        # change (admit, finish, preempt, fail), so a lagged token is
+        # emitted only if its slot still holds the same admission it
+        # was sampled for — a requeued request re-admitted into the
+        # same slot must not absorb the old admission's stale token
+        self._slot_gen = [0] * B
+        # device-resident sampling params (temperature/top_k/top_p as
+        # one jnp tuple), rebuilt only when a slot's occupancy or
+        # params change — not three np.asarray uploads per step
+        self._sampling_dev: Optional[tuple] = None
+        # monotonic timestamp of the last dispatch RETURN; the gap to
+        # the next dispatch START is the host-side bubble the
+        # pipelining removes (None after idle/recovery so those pauses
+        # don't pollute the histogram)
+        self._dispatch_end: Optional[float] = None
         self._thread: Optional[threading.Thread] = None
         self._admit_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -229,6 +259,11 @@ class Scheduler:
             "ome_engine_decode_step_seconds",
             "Batched decode step seconds (one token per active slot)",
             buckets=STEP_BUCKETS)
+        self._h_step_gap = R.histogram(
+            "ome_engine_step_gap_seconds",
+            "Host-side gap between consecutive decode dispatches (the "
+            "bubble decode pipelining hides; idle/recovery pauses are "
+            "excluded)", buckets=STEP_BUCKETS)
         self._h_ttft = R.histogram(
             "ome_engine_ttft_seconds",
             "Time to first token (admission to first emit)")
@@ -414,6 +449,8 @@ class Scheduler:
         return False
 
     def _fail_all(self, reason: str):
+        self._inflight.clear()  # unread steps die with their batch
+        self._dispatch_end = None
         with self._lock:
             while True:
                 try:
@@ -435,6 +472,7 @@ class Scheduler:
             for slot, r in enumerate(self.slots):
                 if r is not None:
                     self.slots[slot] = None
+                    self._slot_changed(slot)
                     free = getattr(self.engine, "free_slot", None)
                     if free is not None:
                         try:
@@ -585,6 +623,7 @@ class Scheduler:
                 self._free_slots.release()
                 raise
             self.slots[slot] = req
+            self._slot_changed(slot)
             self._temp[slot] = req.temperature
             self._top_k[slot] = req.top_k
             self._top_p[slot] = req.top_p
@@ -641,6 +680,7 @@ class Scheduler:
                 req.finish("error")
                 raise
             self.slots[slot] = req
+            self._slot_changed(slot)
             self._temp[slot] = req.temperature
             self._top_k[slot] = req.top_k
             self._top_p[slot] = req.top_p
@@ -653,51 +693,117 @@ class Scheduler:
             admitted += 1
         return did
 
+    def _slot_changed(self, slot: int):
+        """Every slot-occupancy change funnels through here: the
+        generation bump retires any in-flight lagged token sampled for
+        the previous occupant, and the device sampling cache is
+        dropped so the next dispatch re-uploads the new [B] params."""
+        self._slot_gen[slot] += 1
+        self._sampling_dev = None
+
+    def _sampling(self):
+        """Device-resident (temperature, top_k, top_p) for the whole
+        batch, re-uploaded only after an occupancy/param change — not
+        three fresh host arrays per decode step."""
+        if self._sampling_dev is None:
+            self._sampling_dev = (jnp.asarray(self._temp),
+                                  jnp.asarray(self._top_k),
+                                  jnp.asarray(self._top_p))
+        return self._sampling_dev
+
+    def _drain_inflight(self, keep: int = 0) -> bool:
+        """Read dispatched steps older than the newest `keep`, oldest
+        first, emitting each token whose slot still holds the SAME
+        admission it was sampled for. Slots that finished, preempted,
+        failed, or were re-admitted since dispatch had their
+        generation bumped, so their speculative token is discarded
+        here. This is the decode loop's only device->host token fetch
+        (enforced by scripts/check_decode_sync.py) — under pipelining
+        it runs AFTER the next step was dispatched, and the async copy
+        decode() started is usually already complete."""
+        did = False
+        while len(self._inflight) > keep:
+            toks, snap_slots, snap_gens = self._inflight.popleft()
+            host_toks = np.asarray(toks)
+            for slot, req in enumerate(snap_slots):
+                if (req is None or self.slots[slot] is not req
+                        or self._slot_gen[slot] != snap_gens[slot]):
+                    continue
+                tok = int(host_toks[slot])
+                req.emit(tok)
+                self._inc("tokens_generated_total")
+                self._maybe_finish(slot, tok)
+            did = True
+        return did
+
     def _decode(self) -> bool:
         if not any(r is not None for r in self.slots):
-            return False
+            # the batch drained while a step was still in flight: read
+            # it out (every token discards — its slot finished) so the
+            # entry cannot strand
+            self._dispatch_end = None
+            return self._drain_inflight()
         # deterministic fault injection (tests, chaos drills): only
-        # real decode steps count as hits
+        # real decode steps count as hits. A fault here leaves the
+        # lag queue to _recover, which drops it unread — lagged
+        # tokens of a failed batch are never emitted.
         faults.fire("engine_step")
-        mask = self._build_mask()
+        # structured outputs need token k ON HOST to build mask k+1,
+        # so a batch containing masked slots degrades to the
+        # synchronous path — detected per step, not globally: the
+        # batch re-pipelines as soon as its masked requests finish
+        masked = any(r is not None and r.masker is not None
+                     for r in self.slots)
+        if masked and self._inflight:
+            self._drain_inflight()
+            if not any(r is not None for r in self.slots):
+                return True  # draining finished every slot
+        mask = self._build_mask() if masked else None
+        depth = 0 if mask is not None else self.pipeline_depth
+        sampling = self._sampling()
         t0 = time.monotonic()
+        if self._dispatch_end is not None:
+            self._h_step_gap.observe(t0 - self._dispatch_end)
         if mask is not None:
             self.state, toks = self.engine.decode(
-                self.state, self._temp, self._top_k, self._top_p,
-                mask=mask)
+                self.state, *sampling, mask=mask)
         else:  # engine wrappers/fakes need no mask kwarg in their API
             self.state, toks = self.engine.decode(
-                self.state, self._temp, self._top_k, self._top_p)
-        dt = time.monotonic() - t0
+                self.state, *sampling)
+        self._dispatch_end = time.monotonic()
+        dt = self._dispatch_end - t0
         self._ewma_step_s = dt if self._ewma_step_s is None \
             else 0.9 * self._ewma_step_s + 0.1 * dt
         self._h_decode_step.observe(dt)
         self._inc("decode_steps_total")
-        # paged-KV pool pressure may have evicted sequences BEFORE this
-        # step ran — their sampled token is garbage (their new KV row
-        # went to the trash block), so requeue them without emitting:
-        # generated-so-far tokens ride along as prompt and decoding
-        # resumes after a re-prefill (vLLM recompute preemption)
+        self._inflight.append(
+            (toks, list(self.slots), list(self._slot_gen)))
+        # emit steps older than the pipeline window — with the next
+        # step now dispatched, reading them costs no dispatch overlap
+        self._drain_inflight(keep=max(depth, 1))
+        # paged-KV pool pressure may have evicted sequences BEFORE the
+        # step above ran — the token it samples for them is garbage
+        # (their new KV row went to the trash block), so requeue
+        # without emitting: the generation bump makes the lag queue
+        # discard their pending token, and generated-so-far tokens
+        # ride along as prompt for the re-prefill (vLLM recompute
+        # preemption). Their PREVIOUS step's token was valid and was
+        # emitted by the drain above, before output_ids was folded in.
         take = getattr(self.engine, "take_preempted", None)
         for slot in (take() if take is not None else ()):
             req = self.slots[slot]
             if req is None:
                 continue
             self.slots[slot] = None
+            self._slot_changed(slot)
             self._temp[slot] = 0.0
             req.prompt_ids = list(req.prompt_ids) + list(req.output_ids)
             self._requeue.appendleft(req)
             self._inc("preemptions_total")
             if self.overlap:
                 self._free_slots.release()
-        host_toks = np.asarray(toks)
-        for slot, req in enumerate(self.slots):
-            if req is None:
-                continue
-            tok = int(host_toks[slot])
-            req.emit(tok)
-            self._inc("tokens_generated_total")
-            self._maybe_finish(slot, tok)
+        if depth == 0:
+            self._drain_inflight()
         return True
 
     def _fits_pool(self, req: Request) -> bool:
@@ -788,6 +894,7 @@ class Scheduler:
         else:
             return
         self.slots[slot] = None
+        self._slot_changed(slot)
         self._temp[slot] = 0.0
         free = getattr(self.engine, "free_slot", None)
         if free is not None:  # paged engines reclaim the KV blocks
@@ -808,10 +915,17 @@ class Scheduler:
         their requests finished; queued work (pending, _requeue, and
         prefilled-awaiting-insert _ready items, whose KV is
         independent of the decode state) survives the restart."""
+        # drop dispatched-but-unread steps WITHOUT fetching: reading
+        # tokens of a faulted step would re-raise (or deadlock on) the
+        # failed computation, and the failed batch's lagged tokens
+        # must not be emitted anyway
+        self._inflight.clear()
+        self._dispatch_end = None
         for slot, r in enumerate(self.slots):
             if r is None:
                 continue
             self.slots[slot] = None
+            self._slot_changed(slot)
             self._temp[slot] = 0.0
             free = getattr(self.engine, "free_slot", None)
             if free is not None:

@@ -96,6 +96,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="reject new requests (429 + Retry-After) when "
                         "the estimated pending-queue wait exceeds "
                         "this many seconds")
+    p.add_argument("--pipeline-depth", type=int, default=1,
+                   help="decode steps dispatched ahead of token "
+                        "emission: 1 overlaps the host-side token "
+                        "fetch/finish bookkeeping with the next "
+                        "device step (one-step emission lag), 0 "
+                        "restores the synchronous fetch-every-step "
+                        "loop; structured-output batches always run "
+                        "synchronously")
     p.add_argument("--faults", default=None,
                    help="deterministic fault-injection spec "
                         "(ome_tpu/faults.py grammar, e.g. "
@@ -373,7 +381,8 @@ def main(argv=None) -> int:
         # it moves the remote KV fetch off the decode thread
         scheduler = Scheduler(engine, overlap=dist is None,
                               max_restarts=args.max_restarts,
-                              max_queue_wait=args.max_queue_wait)
+                              max_queue_wait=args.max_queue_wait,
+                              pipeline_depth=args.pipeline_depth)
     tok = load_tokenizer(args.model_dir)
     name = args.model_name or args.model_dir.rstrip("/").rsplit("/", 1)[-1]
     server = EngineServer(scheduler, tokenizer=tok, model_name=name,

@@ -124,6 +124,8 @@ class EngineServer:
                         "restarts": sched.stats.get(
                             "restarts_total", 0)
                         if getattr(sched, "stats", None) else 0,
+                        "pipeline_depth": getattr(
+                            sched, "pipeline_depth", 0),
                         "uptime_s": round(
                             time.time() - outer.started_at, 1)})
                 elif self.path == "/ready":

@@ -1,0 +1,469 @@
+"""Pipelined decode loop (docs/decode-pipelining.md).
+
+The contracts under test:
+
+  * EQUIVALENCE: greedy decode emits byte-identical token streams at
+    pipeline depth 0 (synchronous fetch) and depth 1 (one-step lag),
+    including mid-stream finishes and paged-KV preemption;
+  * LAG SEMANTICS: at depth 1 a step's tokens are emitted only after
+    the NEXT step was dispatched, and a finished slot's one extra
+    speculative token is discarded — including after preemption and
+    slot reuse (the generation counter, not just identity);
+  * FAILURE COMPOSITION: an injected engine-step crash with a step in
+    flight drops that step's lagged tokens (never emitted), recovery
+    drains the lag queue without deadlocking, and deadline expiry
+    mid-flight finishes with "timeout" and no post-finish tokens;
+  * MASKED FALLBACK: batches with structured-output slots run
+    synchronously per step and re-pipeline when the masked requests
+    finish;
+  * DEVICE-RESIDENT STEP INPUTS: the paged block table and the [B]
+    sampling params are re-uploaded only when they actually change;
+  * the check_decode_sync.py lint keeps synchronous fetches out of
+    the scheduler's step path (wired tier-1 here, like the metrics
+    lint in test_telemetry.py).
+"""
+
+import pathlib
+import subprocess
+import sys
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ome_tpu import faults
+from ome_tpu.engine.core import InferenceEngine
+from ome_tpu.engine.scheduler import Request, Scheduler
+from ome_tpu.models import config as cfgs
+from ome_tpu.models import llama
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = cfgs.tiny_test().replace(max_seq_len=128)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(params, cfg, max_slots=4,
+                             prefill_buckets=[16, 32, 64])
+    return cfg, params, engine
+
+
+@pytest.fixture(scope="module")
+def paged_world():
+    """Undersized paged pool (4 usable blocks x 16 tokens) so decode
+    growth preempts victims — the hardest case the lag queue must
+    compose with."""
+    cfg = cfgs.tiny_test().replace(max_seq_len=128)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    # bucket 32 covers the longest resume prompt (12 + 8 generated),
+    # so a preempted request is never TRUNCATED at re-prefill — resume
+    # content must not depend on when preemption happened, or the
+    # cross-depth equality below would test prompt truncation instead
+    # of the lag queue
+    engine = InferenceEngine(params, cfg, max_slots=4,
+                             prefill_buckets=[32], kv_block=16,
+                             kv_blocks=5)
+    return cfg, params, engine
+
+
+def reference_greedy(params, cfg, prompt_ids, n_steps):
+    cache = llama.KVCache.create(cfg, 1, cfg.max_seq_len)
+    tokens = jnp.asarray([prompt_ids], jnp.int32)
+    logits, cache = llama.forward(params, cfg, tokens, cache=cache)
+    out = [int(jnp.argmax(logits[0, -1]))]
+    for _ in range(n_steps - 1):
+        logits, cache = llama.forward(
+            params, cfg, jnp.asarray([[out[-1]]], jnp.int32),
+            cache=cache)
+        out.append(int(jnp.argmax(logits[0, -1])))
+    return out
+
+
+def _drive(sched, reqs, iters=600):
+    for _ in range(iters):
+        if all(r.done.is_set() for r in reqs):
+            return
+        sched.step()
+    raise AssertionError(
+        f"requests not done after {iters} steps: "
+        f"{[(r.id, r.finish_reason, len(r.output_ids)) for r in reqs]}")
+
+
+# -- fakes ------------------------------------------------------------
+
+
+class CountingEngine:
+    """Engine double whose decode emits the 1-based DISPATCH NUMBER as
+    every slot's token — so a test can read, from the output stream
+    alone, exactly which dispatches were emitted, which were lagged,
+    and which speculative steps were discarded. Prefill always returns
+    token 100 (disjoint from step numbers)."""
+
+    max_seq = 1024
+
+    def __init__(self, max_slots=2, decode_s=0.0):
+        self.max_slots = max_slots
+        self.decode_s = decode_s
+        self.steps = 0
+        self.new_state_calls = 0
+        self.cfg = types.SimpleNamespace(vocab_size=16)
+
+    def new_state(self):
+        self.new_state_calls += 1
+        return f"s{self.new_state_calls}"
+
+    def prefill(self, ids, t, k, p, **kw):
+        return 100, "kv", len(ids), 16
+
+    def insert(self, state, kv, slot, true_len, token, bucket):
+        return state
+
+    def decode(self, state, t, k, p, mask=None):
+        if self.decode_s:
+            time.sleep(self.decode_s)
+        self.steps += 1
+        return state, np.full(self.max_slots, self.steps, np.int32)
+
+
+class PassMasker:
+    """Permissive structured-output masker: routes the batch through
+    the masked (synchronous) path without constraining anything."""
+
+    def __init__(self):
+        self.fed = []
+
+    def mask(self, V, closing=False, remaining=None):
+        return np.ones(V, bool)
+
+    def feed(self, tok):
+        self.fed.append(tok)
+
+    def done(self):
+        return False
+
+    def closing_distance(self):
+        return 0
+
+
+# -- equivalence: depth 1 must stream exactly what depth 0 streams ----
+
+
+class TestPipelinedEquivalence:
+    def test_greedy_streams_identical_with_midstream_finish(self, world):
+        """Staggered admissions with different budgets (so slots
+        finish and are reused mid-run) — depth 0 and depth 1 must
+        produce byte-identical streams, both matching the plain
+        single-sequence reference."""
+        cfg, params, engine = world
+        plans = [([1, 7, 42, 99, 5], 12), ([1, 100, 200, 300], 4),
+                 ([1, 250], 9), ([2, 3, 4, 5, 6, 7], 6),
+                 ([9, 8, 7], 3)]
+        want = [reference_greedy(params, cfg, p, n) for p, n in plans]
+
+        outs = {}
+        for depth in (0, 1):
+            sched = Scheduler(engine, pipeline_depth=depth)
+            reqs = []
+            for i, (p, n) in enumerate(plans):
+                reqs.append(sched.submit(
+                    Request(prompt_ids=p, max_new_tokens=n)))
+                if i % 2:
+                    sched.step()  # stagger admissions mid-decode
+            _drive(sched, reqs)
+            outs[depth] = [list(r.output_ids) for r in reqs]
+            assert all(r.finish_reason == "length" for r in reqs)
+        assert outs[0] == outs[1]
+        assert outs[1] == want
+
+    def test_paged_preemption_streams_identical(self, paged_world):
+        """Pool pressure preempts mid-stream; preempted slots' lagged
+        tokens must not be emitted and resumes must not diverge: both
+        depths finish every request with the same bytes."""
+        cfg, params, engine = paged_world
+        prompts = [[i + 1, 5, 9, 13, i + 2, 40, 41, 42, 43, 44, 45,
+                    46] for i in range(4)]
+        outs, preempts = {}, {}
+        for depth in (0, 1):
+            sched = Scheduler(engine, pipeline_depth=depth)
+            reqs = [sched.submit(Request(prompt_ids=p,
+                                         max_new_tokens=8))
+                    for p in prompts]
+            _drive(sched, reqs, iters=2000)
+            assert all(len(r.output_ids) == 8 for r in reqs), \
+                [len(r.output_ids) for r in reqs]
+            outs[depth] = [list(r.output_ids) for r in reqs]
+            preempts[depth] = sched.stats["preemptions_total"]
+        # the scenario must actually exercise preemption to mean much
+        assert preempts[0] > 0 and preempts[1] > 0
+        assert outs[0] == outs[1]
+
+    def test_deadline_expiry_is_a_clean_prefix(self, world):
+        """A deadline passing mid-flight can't be byte-compared across
+        depths (finish timing is wall-clock), but both runs must be
+        prefixes of the same greedy stream, finish with 'timeout', and
+        never emit past the finish."""
+        cfg, params, engine = world
+        prompt = [3, 1, 4, 1, 5]
+        outs = {}
+        for depth in (0, 1):
+            sched = Scheduler(engine, pipeline_depth=depth)
+            req = sched.submit(Request(
+                prompt_ids=prompt, max_new_tokens=10_000,
+                deadline=time.monotonic() + 0.25))
+            _drive(sched, [req], iters=10_000)
+            assert req.finish_reason == "timeout"
+            n = len(req.output_ids)
+            for _ in range(5):  # speculative tokens must be discarded
+                sched.step()
+            assert len(req.output_ids) == n
+            outs[depth] = list(req.output_ids)
+        short, long_ = sorted(outs.values(), key=len)
+        assert short == long_[:len(short)]
+
+
+# -- lag semantics (CountingEngine: tokens ARE dispatch numbers) ------
+
+
+class TestLagSemantics:
+    def test_one_step_lag_and_speculative_discard(self):
+        eng = CountingEngine(max_slots=1)
+        sched = Scheduler(eng, pipeline_depth=1)
+        req = sched.submit(Request(prompt_ids=[1], max_new_tokens=3))
+        sched.step()  # admit (emits prefill token) + dispatch 1
+        assert req.output_ids == [100]  # step 1 still in flight
+        sched.step()  # dispatch 2, emit lagged step 1
+        assert req.output_ids == [100, 1]
+        sched.step()  # dispatch 3, emit step 2 -> budget reached
+        assert req.output_ids == [100, 1, 2]
+        assert req.finish_reason == "length"
+        sched.step()  # drains step 3: slot finished, token discarded
+        assert req.output_ids == [100, 1, 2]
+        assert eng.steps == 3  # one speculative dispatch past finish
+
+    def test_depth0_is_synchronous(self):
+        eng = CountingEngine(max_slots=1)
+        sched = Scheduler(eng, pipeline_depth=0)
+        req = sched.submit(Request(prompt_ids=[1], max_new_tokens=3))
+        sched.step()
+        assert req.output_ids == [100, 1]  # same-step emission
+        sched.step()
+        assert req.output_ids == [100, 1, 2]
+        assert req.finish_reason == "length"
+        assert eng.steps == 2  # no speculative dispatch
+
+    def test_slot_reuse_does_not_leak_stale_token(self):
+        """B is admitted into A's slot while A's last speculative step
+        is still in flight; the generation counter must keep that
+        stale token out of B's stream."""
+        eng = CountingEngine(max_slots=1)
+        sched = Scheduler(eng, pipeline_depth=1)
+        a = sched.submit(Request(prompt_ids=[1], max_new_tokens=2))
+        b = sched.submit(Request(prompt_ids=[2], max_new_tokens=2))
+        _drive(sched, [a, b], iters=50)
+        assert a.output_ids == [100, 1]
+        # b's stream: its own prefill token + a post-reuse dispatch —
+        # never dispatch 2's token (sampled while a owned the slot)
+        assert b.output_ids[0] == 100
+        assert 2 not in b.output_ids[1:]
+
+
+# -- failure composition ----------------------------------------------
+
+
+class TestCrashAndDeadline:
+    def test_crash_drops_inflight_step_and_recovers(self):
+        """Crash at dispatch 3 with dispatch 2 still in flight: the
+        failed batch's lagged token (2) must never be emitted, and the
+        queued survivor completes after recovery — no deadlock on the
+        dropped step."""
+        faults.install("engine_step.raise@3")
+        eng = CountingEngine(max_slots=1)
+        sched = Scheduler(eng, max_restarts=2, restart_backoff=0.01,
+                          pipeline_depth=1)
+        a = sched.submit(Request(prompt_ids=[1], max_new_tokens=50))
+        b = sched.submit(Request(prompt_ids=[2], max_new_tokens=3))
+        sched.start()
+        try:
+            assert a.done.wait(10)
+            assert b.done.wait(10)
+        finally:
+            sched.stop()
+        assert a.finish_reason == "error"
+        assert a.output_ids == [100, 1]  # step 2 dropped unread
+        assert b.finish_reason == "length"
+        assert b.output_ids == [100, 3, 4]  # post-recovery dispatches
+        assert sched.stats["restarts_total"] == 1
+        assert eng.new_state_calls == 2
+
+    def test_deadline_mid_flight_discards_speculative_token(self):
+        eng = CountingEngine(max_slots=1)
+        sched = Scheduler(eng, pipeline_depth=1)
+        req = sched.submit(Request(
+            prompt_ids=[1], max_new_tokens=1000,
+            deadline=time.monotonic() + 0.05))
+        sched.step()  # admit + dispatch 1
+        time.sleep(0.06)  # deadline passes with step 1 in flight
+        sched.step()  # dispatch 2; lagged step-1 token -> timeout
+        assert req.finish_reason == "timeout"
+        n = len(req.output_ids)
+        for _ in range(3):
+            sched.step()  # step 2 drains to a finished slot
+        assert len(req.output_ids) == n
+
+
+# -- structured outputs degrade to the synchronous path ---------------
+
+
+class TestMaskedFallback:
+    def test_masked_batch_runs_synchronously(self):
+        eng = CountingEngine(max_slots=2)
+        sched = Scheduler(eng, pipeline_depth=1)
+        req = sched.submit(Request(prompt_ids=[1], max_new_tokens=4,
+                                   masker=PassMasker()))
+        sched.step()
+        # synchronous: the dispatched step's token arrives SAME step,
+        # and nothing is left in flight (mask k+1 needs token k)
+        assert req.output_ids == [100, 1]
+        assert len(sched._inflight) == 0
+        sched.step()
+        assert req.output_ids == [100, 1, 2]
+        assert len(sched._inflight) == 0
+
+    def test_repipelines_after_masked_request_finishes(self):
+        eng = CountingEngine(max_slots=2)
+        sched = Scheduler(eng, pipeline_depth=1)
+        masked = sched.submit(Request(prompt_ids=[1], max_new_tokens=2,
+                                      masker=PassMasker()))
+        plain = sched.submit(Request(prompt_ids=[2],
+                                     max_new_tokens=10))
+        while not masked.done.is_set():
+            sched.step()
+            assert len(sched._inflight) == 0  # degraded while masked
+        sched.step()
+        assert len(sched._inflight) == 1  # pipelining resumed
+        _drive(sched, [plain], iters=50)
+        assert len(plain.output_ids) == 10
+
+
+# -- device-resident step inputs --------------------------------------
+
+
+class TestDeviceResidentInputs:
+    def test_page_table_upload_reused_between_steps(self, paged_world):
+        cfg, params, engine = paged_world
+        sched = Scheduler(engine, pipeline_depth=1)
+        req = sched.submit(Request(prompt_ids=[1, 2, 3, 4],
+                                   max_new_tokens=6))
+        sched.step()  # admit + first decode: uploads the table
+        assert engine._table_dirty is False
+        dev0 = engine._table_dev
+        assert dev0 is not None
+        sched.step()  # no allocator change inside the block
+        assert engine._table_dev is dev0  # same upload reused
+        _drive(sched, [req], iters=50)
+        # finish frees the slot -> table changed -> marked dirty
+        assert engine._table_dirty is True
+
+    def test_sampling_params_cached_until_occupancy_change(self):
+        eng = CountingEngine(max_slots=2)
+        sched = Scheduler(eng, pipeline_depth=1)
+        req = sched.submit(Request(prompt_ids=[1], max_new_tokens=4))
+        sched.step()
+        cached = sched._sampling_dev
+        assert cached is not None
+        assert all(isinstance(x, jax.Array) for x in cached)
+        sched.step()
+        assert sched._sampling_dev is cached  # no rebuild per step
+        _drive(sched, [req], iters=50)
+        assert sched._sampling_dev is None  # finish invalidated it
+
+    def test_core_decode_passes_jax_arrays_through(self, world):
+        """core.decode must not round-trip device-resident sampling
+        params through np.asarray (that sync is the bubble)."""
+        from ome_tpu.engine.core import _sampling_array
+        dev = jnp.zeros(4, jnp.float32)
+        assert _sampling_array(dev, np.float32) is dev
+        host = _sampling_array([0.0] * 4, np.float32)
+        assert isinstance(host, np.ndarray)
+
+
+# -- telemetry --------------------------------------------------------
+
+
+class TestStepGapMetric:
+    def test_histogram_rendered_and_observed(self):
+        eng = CountingEngine(max_slots=1)
+        sched = Scheduler(eng, pipeline_depth=1)
+        req = sched.submit(Request(prompt_ids=[1], max_new_tokens=6))
+        _drive(sched, [req], iters=50)
+        body = sched.registry.render()
+        assert "ome_engine_step_gap_seconds_bucket" in body
+        # >= 2 consecutive dispatches happened, so gaps were observed
+        assert sched.registry.get("ome_engine_step_gap_seconds") >= 1
+
+    def test_cli_exposes_pipeline_depth(self):
+        from ome_tpu.engine.serve import build_parser
+        args = build_parser().parse_args(
+            ["--model-dir", "x", "--pipeline-depth", "0"])
+        assert args.pipeline_depth == 0
+        assert build_parser().parse_args(
+            ["--model-dir", "x"]).pipeline_depth == 1
+
+
+# -- the decode-loop sync lint (tier-1, like the metrics lint) --------
+
+
+class TestDecodeSyncLint:
+    SCRIPT = REPO / "scripts" / "check_decode_sync.py"
+
+    def test_scheduler_step_path_is_clean(self):
+        proc = subprocess.run(
+            [sys.executable, str(self.SCRIPT)],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_sync_fetch_in_step_path_flagged(self, tmp_path):
+        bad = tmp_path / "bad_scheduler.py"
+        bad.write_text(
+            "import numpy as np\n"
+            "class S:\n"
+            "    def _decode(self):\n"
+            "        toks = self.engine.decode(self.state)\n"
+            "        host = np.asarray(toks)\n"        # sync fetch
+            "        toks.block_until_ready()\n"       # sync
+            "        return host\n"
+            "    def _drain_inflight(self):\n"
+            "        return np.asarray(self.q.pop())\n"  # sanctioned
+            "    def helper(self):\n"
+            "        return np.asarray([1])\n")          # off-path
+        proc = subprocess.run(
+            [sys.executable, str(self.SCRIPT), str(bad)],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 1
+        assert proc.stdout.count("VIOLATION") == 2
+        assert "np.asarray" in proc.stdout
+        assert ".block_until_ready" in proc.stdout
+
+    def test_async_copy_is_not_flagged(self, tmp_path):
+        ok = tmp_path / "ok_scheduler.py"
+        ok.write_text(
+            "class S:\n"
+            "    def _decode(self):\n"
+            "        toks = self.engine.decode(self.state)\n"
+            "        toks.copy_to_host_async()\n"
+            "        self.q.append(toks)\n")
+        proc = subprocess.run(
+            [sys.executable, str(self.SCRIPT), str(ok)],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
