@@ -482,6 +482,83 @@ def main() -> None:
     log(f"bench: [paged] decode batch 64: {paged_tps:.1f} tok/s "
         f"(block-table pool attention)")
 
+    # -- self-drafting speculative decode (engine verify path) ----------
+    # Measures the SERVING engine's n-gram draft + batched-verify loop
+    # (engine/spec.py + InferenceEngine.verify — the --spec-tokens
+    # path) against the same engine's plain decode loop, on a
+    # high-n-gram-hit workload: after a greedy warmup the random-weight
+    # streams settle into short cycles (as repetitive serving traffic
+    # does), so the prompt-lookup drafter proposes the continuation
+    # and the verify forward accepts most of it — one weight read
+    # yields several tokens per slot.
+    def bench_spec(p):
+        from ome_tpu.engine import spec as spec_drafter
+        from ome_tpu.engine.core import InferenceEngine
+
+        K_SPEC = int(os.environ.get("OME_BENCH_SPEC_K", "4"))
+        SLOTS = BATCH
+        WARM, MEAS = 40, 24  # rows: 17 + 40 + 24 + 5 + 24*5 <= 256
+        eng = InferenceEngine(p, cfg, max_slots=SLOTS,
+                              max_seq=CACHE_LEN, prefill_buckets=[16])
+        state = eng.new_state()
+        rng = np.random.default_rng(7)
+        streams = []
+        for s in range(SLOTS):
+            pat = rng.integers(0, cfg.vocab_size, size=4)
+            ids = [int(x) for x in np.tile(pat, 4)]  # 16-token prompt
+            tok, kv, true_len, bucket = eng.prefill(ids)
+            state = eng.insert(state, kv, s, true_len, tok, bucket)
+            streams.append(ids + [tok])
+        B = SLOTS
+        t = np.zeros((B,), np.float32)
+        tk = np.zeros((B,), np.int32)
+        tp = np.ones((B,), np.float32)
+        for _ in range(WARM):  # reach the repetitive steady state
+            state, toks = eng.decode(state, t, tk, tp)
+            for s, v in enumerate(np.asarray(toks)):
+                streams[s].append(int(v))
+        # plain decode tok/s, sync fetch per step (depth-0 loop shape)
+        t0 = time.perf_counter()
+        for _ in range(MEAS):
+            state, toks = eng.decode(state, t, tk, tp)
+            for s, v in enumerate(np.asarray(toks)):
+                streams[s].append(int(v))
+        plain_tps = SLOTS * MEAS / (time.perf_counter() - t0)
+
+        def spec_step():
+            drafts = np.zeros((B, K_SPEC), np.int32)
+            dlen = np.zeros((B,), np.int32)
+            for s in range(B):
+                d = spec_drafter.propose(streams[s], K_SPEC)
+                drafts[s, :d.size] = d
+                dlen[s] = d.size
+            nonlocal state
+            state, out, acc = eng.verify(state, drafts, dlen, t, tk, tp)
+            host_out, host_acc = np.asarray(out), np.asarray(acc)
+            emitted = 0
+            for s in range(B):
+                n = int(host_acc[s]) + 1
+                streams[s].extend(int(x) for x in host_out[s, :n])
+                emitted += n
+            return int(dlen.sum()), int(host_acc.sum()), emitted
+
+        spec_step()  # compile the verify program, not timed
+        proposed = accepted = emitted = 0
+        t0 = time.perf_counter()
+        for _ in range(MEAS):
+            pr, ac, em = spec_step()
+            proposed += pr
+            accepted += ac
+            emitted += em
+        spec_tps = emitted / (time.perf_counter() - t0)
+        return plain_tps, spec_tps, accepted / max(proposed, 1), K_SPEC
+
+    spec_plain_tps, spec_tps, spec_rate, spec_k = bench_spec(params)
+    log(f"bench: [spec] k={spec_k} batch {BATCH}: plain "
+        f"{spec_plain_tps:.1f} tok/s -> spec {spec_tps:.1f} tok/s "
+        f"({100*spec_tps/spec_plain_tps-100:+.0f}%, accept rate "
+        f"{100*spec_rate:.0f}%)")
+
     # -- rooflines ------------------------------------------------------
     # Per decode step the chip must read all weights once (amortized
     # across the batch) + each sequence's KV cache.
@@ -523,6 +600,10 @@ def main() -> None:
         "int8_tokens_per_sec": round(int8_tps, 1),
         "int4_tokens_per_sec": round(int4_tps, 1),
         "paged_decode_tokens_per_sec_batch64": round(paged_tps, 1),
+        "spec_decode_tokens_per_sec": round(spec_tps, 1),
+        "spec_accept_rate": round(spec_rate, 3),
+        "spec_plain_tokens_per_sec": round(spec_plain_tps, 1),
+        "spec_k": spec_k,
         "prefill_ms_batch32x128": round(pbest * 1000, 1),
         "prefill_mfu": round(mfu, 3),
         "dispatch_ms": round(disp_ms, 2),

@@ -31,7 +31,7 @@ from jax import lax
 
 from ..models import llama
 from ..models.config import ModelConfig
-from .sampling import sample
+from .sampling import sample, spec_verify
 
 Params = llama.Params
 
@@ -109,6 +109,7 @@ class PrefixCache:
         self.bytes = 0
         self.hits = 0
         self.misses = 0
+        self.evictions = 0
 
     def _leaf_bytes(self, k, v) -> int:
         return k.nbytes + v.nbytes
@@ -160,6 +161,7 @@ class PrefixCache:
                 if self.bytes <= self.capacity_bytes:
                     return
                 self.bytes -= self._leaf_bytes(*node["kv"])
+                self.evictions += 1
                 del parent_map[key]
 
     def match(self, ids, usable=None) -> Optional[tuple]:
@@ -465,6 +467,56 @@ class InferenceEngine:
                                tokens=toks,
                                adapters=state.adapters), toks
 
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=("k",))
+        def _verify(params, state: DecodeState, drafts, draft_len,
+                    temperature, top_k, top_p, key, k: int):
+            """Speculative verify: one forward over [last_token,
+            draft_0..draft_{k-1}] per slot scores all k+1 positions in
+            a single weight pass. Draft K/V is written at the slot's
+            cache index like any decode write; the ROLLBACK of
+            rejected rows is just the per-slot index update below —
+            rows past `lengths + accepted + 1` are unreachable
+            (kv_len masking) and the next step overwrites them."""
+            toks = jnp.concatenate([state.tokens[:, None], drafts],
+                                   axis=1)  # [B, k+1]
+            cache = llama.KVCache(k=state.k, v=state.v,
+                                  index=state.lengths)
+            logits, nc = llama.forward(params, cfg_, toks, cache=cache,
+                                       adapter_ids=state.adapters)
+            out, accepted = spec_verify(logits, drafts, draft_len, key,
+                                        temperature, top_k, top_p)
+            new_tok = jnp.take_along_axis(out, accepted[:, None],
+                                          axis=1)[:, 0]
+            return DecodeState(k=nc.k, v=nc.v,
+                               lengths=state.lengths + accepted + 1,
+                               tokens=new_tok,
+                               adapters=state.adapters), out, accepted
+
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=("k",))
+        def _verify_paged(params, state: DecodeState, table, drafts,
+                          draft_len, temperature, top_k, top_p, key,
+                          k: int):
+            """Paged-pool verify: the engine pre-allocates blocks
+            covering all k+1 speculative rows before dispatch
+            (_grow_blocks_spec); commit_spec() returns the surplus to
+            the pool after the accepted count is known."""
+            toks = jnp.concatenate([state.tokens[:, None], drafts],
+                                   axis=1)
+            cache = llama.PagedKVCache(k=state.k, v=state.v,
+                                       index=state.lengths, table=table)
+            logits, nc = llama.forward_paged(
+                params, cfg_, toks, cache, adapter_ids=state.adapters)
+            out, accepted = spec_verify(logits, drafts, draft_len, key,
+                                        temperature, top_k, top_p)
+            new_tok = jnp.take_along_axis(out, accepted[:, None],
+                                          axis=1)[:, 0]
+            return DecodeState(k=nc.k, v=nc.v,
+                               lengths=state.lengths + accepted + 1,
+                               tokens=new_tok,
+                               adapters=state.adapters), out, accepted
+
         self._prefill_fn = _prefill
         self._prefill_masked_fn = _prefill_masked
         self._prefill_suffix_fn = _prefill_suffix
@@ -474,6 +526,8 @@ class InferenceEngine:
         self._insert_paged_fn = _insert_paged
         self._decode_paged_fn = _decode_paged
         self._decode_masked_paged_fn = _decode_masked_paged
+        self._verify_fn = _verify
+        self._verify_paged_fn = _verify_paged
         self._step = 0
         self._root_key = jax.random.PRNGKey(0)
         # prefill (admission thread) and decode (scheduler thread) both
@@ -588,6 +642,55 @@ class InferenceEngine:
                 self._table[b, j] = nid
                 self._table_dirty = True
             self._host_len[b] = w + 1  # mirror of the device +1
+
+    def _grow_blocks_spec(self, rows: int) -> None:
+        """Pre-allocate blocks covering each active slot's next `rows`
+        writes (a verify step writes k+1 speculative rows at once) —
+        WITHOUT advancing the host length mirror: how far the device
+        actually advanced is only known after the accepted counts are
+        drained, when commit_spec() reconciles and returns the
+        surplus. Pool pressure preempts victims exactly like
+        _grow_blocks."""
+        for b in range(self.max_slots):
+            if not self._owned[b]:
+                continue
+            w = int(self._host_len[b])
+            top = min(w + rows, self.max_seq)  # write rows [w, top)
+            need = min(-(-top // self.kv_block), self.max_blocks)
+            while len(self._owned[b]) < need:
+                j = len(self._owned[b])
+                while not self._free_blocks:
+                    if not self._preempt_victim():
+                        break
+                if not self._owned[b]:
+                    break  # b itself was the victim
+                if not self._free_blocks:
+                    # same honesty guard as _grow_blocks: never let a
+                    # live slot write into the trash block
+                    self._preempted.append(b)
+                    self.free_slot(b)
+                    break
+                nid = self._free_blocks.pop()
+                self._owned[b].append(nid)
+                self._table[b, j] = nid
+                self._table_dirty = True
+
+    def commit_spec(self, slot: int, advance: int) -> None:
+        """Reconcile a slot's host length mirror after a drained
+        verify step advanced its device length by `advance`
+        (= accepted + 1), and return speculatively-allocated blocks
+        past the new length to the pool — the paged-KV rollback of
+        rejected draft rows."""
+        if not self.kv_block or not self._owned[slot]:
+            return
+        self._host_len[slot] = min(
+            int(self._host_len[slot]) + advance, self.max_seq)
+        need = self.blocks_needed(int(self._host_len[slot]))
+        while len(self._owned[slot]) > need:
+            nid = self._owned[slot].pop()
+            self._table[slot, len(self._owned[slot])] = 0
+            self._free_blocks.append(nid)
+            self._table_dirty = True
 
     @property
     def kv_pool_stats(self) -> Dict[str, int]:
@@ -851,3 +954,47 @@ class InferenceEngine:
         if copy is not None:  # sharded/global arrays may not have it
             copy()
         return state, toks
+
+    def verify(self, state: DecodeState, drafts: np.ndarray,
+               draft_len: np.ndarray, temperature, top_k, top_p,
+               ) -> Tuple[DecodeState, jax.Array, jax.Array]:
+        """One speculative verify step for ALL slots: score the k
+        drafted tokens plus one bonus position in a single weight
+        pass and accept per slot the longest valid prefix
+        (sampling.spec_verify). A slot with draft_len 0 degenerates
+        to a plain decode step — same logits, same sampling rule.
+
+        drafts: [B, k] int32 host array (garbage past draft_len);
+        draft_len: [B] int32 in [0, k]. Sampling params as decode().
+        Returns (state, out_tokens [B, k+1], accepted [B]) with host
+        copies of the outputs already in flight, mirroring decode():
+        slot b emits out_tokens[b, :accepted[b]+1].
+
+        Dense callers may pipeline verify steps like decode steps;
+        paged callers must drain each step and commit_spec() before
+        the next (the block pre-allocation below needs the reconciled
+        host lengths)."""
+        key = self._next_key()
+        sampling = (_sampling_array(temperature, np.float32),
+                    _sampling_array(top_k, np.int32),
+                    _sampling_array(top_p, np.float32))
+        drafts = np.asarray(drafts, np.int32)
+        draft_len = np.asarray(draft_len, np.int32)
+        k = int(drafts.shape[1])
+        if self.kv_block:
+            self._grow_blocks_spec(k + 1)
+            if self._table_dirty or self._table_dev is None:
+                self._table_dev = jnp.asarray(self._table.copy())
+                self._table_dirty = False
+            state, out, accepted = self._verify_paged_fn(
+                self.params, state, self._table_dev, drafts,
+                draft_len, *sampling, key, k=k)
+        else:
+            state, out, accepted = self._verify_fn(
+                self.params, state, drafts, draft_len, *sampling,
+                key, k=k)
+        for arr in (out, accepted):
+            copy = getattr(arr, "copy_to_host_async", None)
+            if copy is not None:
+                copy()
+        return state, out, accepted

@@ -15,18 +15,18 @@ import jax.numpy as jnp
 NEG_INF = -1.0e30
 
 
-def sample(logits: jax.Array, key: jax.Array, temperature: jax.Array,
-           top_k: jax.Array, top_p: jax.Array) -> jax.Array:
-    """Sample next tokens.
+def filtered_logits(logits: jax.Array, temperature: jax.Array,
+                    top_k: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Temperature-scaled, top-k/top-p-masked logits.
 
-    logits: [B, V] float; temperature/top_k/top_p: [B]
-    (temperature<=0 means greedy; top_k<=0 disables top-k;
-    top_p>=1 disables nucleus filtering).
-    Returns [B] int32.
+    The distribution `sample` (and the speculative verify acceptance
+    rule) actually draws from: logits [B, V] float, params [B].
+    Filtered-out entries are NEG_INF; greedy rows (temperature<=0)
+    pass through with temperature 1 — callers pick argmax for those.
+    Returns [B, V] float32.
     """
     logits = logits.astype(jnp.float32)
     B, V = logits.shape
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     # scale by temperature (guard the greedy rows against div-by-zero)
     safe_t = jnp.where(temperature > 0, temperature, 1.0)[:, None]
@@ -51,7 +51,98 @@ def sample(logits: jax.Array, key: jax.Array, temperature: jax.Array,
     keep_sorted = keep_k & keep_p  # rank 0 always survives both
     keep = jax.vmap(
         lambda o, m: jnp.zeros((V,), bool).at[o].set(m))(order, keep_sorted)
-    scaled = jnp.where(keep, scaled, NEG_INF)
+    return jnp.where(keep, scaled, NEG_INF)
 
+
+def sample(logits: jax.Array, key: jax.Array, temperature: jax.Array,
+           top_k: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Sample next tokens.
+
+    logits: [B, V] float; temperature/top_k/top_p: [B]
+    (temperature<=0 means greedy; top_k<=0 disables top-k;
+    top_p>=1 disables nucleus filtering).
+    Returns [B] int32.
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = filtered_logits(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
     return jnp.where(temperature > 0, sampled, greedy)
+
+
+def spec_verify(logits: jax.Array, drafts: jax.Array,
+                draft_len: jax.Array, key: jax.Array,
+                temperature: jax.Array, top_k: jax.Array,
+                top_p: jax.Array) -> tuple:
+    """Batched draft verification (Leviathan et al. 2023).
+
+    One verify forward scored `S = k+1` positions per slot: position 0
+    follows the committed last token, position i (1<=i<=k) follows
+    draft token i-1. This decides, per slot, the longest accepted
+    draft prefix and the one extra token the step emits beyond it.
+
+    logits: [B, S, V] — verify-forward logits; drafts: [B, k] int32;
+    draft_len: [B] int32 in [0, k] (0 = slot did not draft: the step
+    degenerates to a plain decode for that slot); key: PRNG key;
+    temperature/top_k/top_p: [B].
+
+    Acceptance: greedy slots accept draft d_i iff it equals the argmax
+    at position i; temperature>0 slots accept d_i with probability
+    p_i(d_i) under the *filtered* target distribution (the same one
+    `sample` draws from — a point-mass n-gram draft makes the
+    Leviathan rule reduce to this), and on rejection resample from
+    p_i with d_i zeroed and renormalized, which preserves the target
+    distribution exactly.
+
+    Returns (out_tokens [B, S] int32, accepted [B] int32): slot b
+    emits out_tokens[b, :accepted[b]+1]; out_tokens[b, accepted[b]]
+    is the slot's new "last sampled token" (the next step's input).
+    """
+    logits = logits.astype(jnp.float32)
+    B, S, V = logits.shape
+    k = S - 1
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, S]
+    filt = filtered_logits(
+        logits.reshape(B * S, V),
+        jnp.repeat(temperature, S), jnp.repeat(top_k, S),
+        jnp.repeat(top_p, S)).reshape(B, S, V)
+    probs = jax.nn.softmax(filt, axis=-1)
+
+    pos = jnp.arange(k)[None, :]
+    in_draft = pos < draft_len[:, None]
+    kacc, kres, kbon = jax.random.split(key, 3)
+
+    # per-position accept decisions, then the longest accepted prefix
+    draft_p = jnp.take_along_axis(
+        probs[:, :k], drafts[..., None], axis=-1)[..., 0]  # [B, k]
+    u = jax.random.uniform(kacc, (B, k))
+    accept = jnp.where(temperature[:, None] > 0,
+                       u < draft_p, drafts == greedy[:, :k])
+    run = jnp.cumprod((accept & in_draft).astype(jnp.int32), axis=1)
+    accepted = jnp.sum(run, axis=1).astype(jnp.int32)  # [B] in [0, k]
+
+    # the token emitted at the stop position: on rejection at i, the
+    # residual sample (p_i with d_i removed, renormalized); on full
+    # acceptance (stop == draft_len), a plain sample from p_stop
+    is_draft_tok = jnp.arange(V)[None, None, :] == drafts[..., None]
+    resid_tok = jax.random.categorical(
+        kres, jnp.where(is_draft_tok, NEG_INF, filt[:, :k]),
+        axis=-1).astype(jnp.int32)  # [B, k]
+    bonus_tok = jax.random.categorical(
+        kbon, filt, axis=-1).astype(jnp.int32)  # [B, S]
+    stop_tok = jnp.concatenate([
+        jnp.where(pos == draft_len[:, None],
+                  bonus_tok[:, :k], resid_tok),
+        bonus_tok[:, k:]], axis=1)  # [B, S]
+    # greedy slots emit argmax(raw logits) at the stop position either
+    # way: on rejection the masked argmax equals the unmasked one
+    # (the rejected draft wasn't the argmax), matching `sample`
+    stop_tok = jnp.where(temperature[:, None] > 0, stop_tok, greedy)
+
+    next_tok = jnp.take_along_axis(stop_tok, accepted[:, None], axis=1)
+    drafts_pad = jnp.concatenate(
+        [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)  # [B, S]
+    j = jnp.arange(S)[None, :]
+    out = jnp.where(j < accepted[:, None], drafts_pad,
+                    jnp.where(j == accepted[:, None], next_tok, 0))
+    return out.astype(jnp.int32), accepted

@@ -44,6 +44,7 @@ import numpy as np
 
 from .. import faults
 from ..telemetry import Registry
+from . import spec as spec_drafter
 from .core import DecodeState, InferenceEngine
 
 _ids = itertools.count()
@@ -66,7 +67,26 @@ _COUNTER_HELP = {
     "rejected_total": "Requests rejected at admission (429)",
     "engine_faults_total": "Engine-step faults (crash recovery runs)",
     "restarts_total": "Successful scheduler crash recoveries",
+    "spec_steps_total": "Speculative verify steps dispatched",
+    "spec_proposed_tokens_total":
+        "Draft tokens proposed by the n-gram drafter",
+    "spec_accepted_tokens_total":
+        "Draft tokens accepted by verify forwards",
 }
+
+
+class _SpecStep:
+    """Lag-queue payload of one speculative verify step: the device-
+    resident [B, k+1] emitted-token matrix and [B] accepted counts
+    (host copies already in flight, like plain decode tokens), plus
+    the host-side draft lengths for acceptance-rate accounting."""
+
+    __slots__ = ("out", "accepted", "draft_len")
+
+    def __init__(self, out, accepted, draft_len):
+        self.out = out
+        self.accepted = accepted
+        self.draft_len = draft_len
 
 
 class SchedulerOverloaded(RuntimeError):
@@ -162,8 +182,18 @@ class Scheduler:
                  restart_backoff: float = 0.05,
                  max_queue_wait: float = 30.0,
                  pipeline_depth: int = 1,
+                 spec_tokens: int = 0,
                  registry: Optional[Registry] = None):
         self.engine = engine
+        # speculative decoding (docs/speculative-decoding.md): max
+        # draft tokens per slot per step proposed by the host-side
+        # n-gram drafter (engine/spec.py) and verified in ONE batched
+        # forward. 0 = off (plain decode, the default); steps where no
+        # slot drafts, masked (structured-output) batches, and slots
+        # near the cache capacity fall back to plain decode — so the
+        # emitted streams are identical either way for greedy slots,
+        # and distributionally identical for temperature > 0.
+        self.spec_tokens = max(int(spec_tokens), 0)
         # decode pipelining (docs/decode-pipelining.md): number of
         # decode steps dispatched ahead of token emission. 0 = fetch
         # every step synchronously (pre-pipelining behavior); 1 = the
@@ -243,7 +273,9 @@ class Scheduler:
             "queue_depth": 0, "active_slots": 0,
             "preemptions_total": 0, "timeouts_total": 0,
             "rejected_total": 0, "engine_faults_total": 0,
-            "restarts_total": 0,
+            "restarts_total": 0, "spec_steps_total": 0,
+            "spec_proposed_tokens_total": 0,
+            "spec_accepted_tokens_total": 0,
         }
         R = self.registry
         self._counters = {
@@ -284,6 +316,29 @@ class Scheduler:
         self._g_status = R.gauge(
             "ome_engine_status",
             "Scheduler health state", labelnames=("state",))
+        self._h_spec_accept = R.histogram(
+            "ome_engine_spec_accept_rate",
+            "Per-verify-step fraction of proposed draft tokens "
+            "accepted (steps where at least one slot drafted)",
+            buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0))
+        self._h_spec_accepted = R.histogram(
+            "ome_engine_spec_accepted_tokens_per_step",
+            "Accepted draft tokens per drafting slot per verify step",
+            buckets=(0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0))
+        # prefix-cache observability (engine counters are plain ints;
+        # update_gauges mirrors them by delta so /metrics sees them)
+        self._c_pc_hits = R.counter(
+            "ome_engine_prefix_cache_hits_total",
+            "Prefix-cache hits (prompts that reused cached KV)")
+        self._c_pc_misses = R.counter(
+            "ome_engine_prefix_cache_misses_total",
+            "Prefix-cache misses")
+        self._c_pc_evictions = R.counter(
+            "ome_engine_prefix_cache_evictions_total",
+            "Prefix-cache leaf blocks evicted by the byte budget")
+        self._g_pc_bytes = R.gauge(
+            "ome_engine_prefix_cache_bytes",
+            "Device bytes resident in the prefix cache")
 
     @property
     def status(self) -> str:
@@ -344,6 +399,18 @@ class Scheduler:
         for state in ("ok", "degraded", "dead"):
             self._g_status.labels(state=state).set(
                 1 if state == status else 0)
+        pc = getattr(self.engine, "prefix_cache", None)
+        if pc is not None:
+            # counters on the cache are plain ints (bumped inside the
+            # prefill path without registry locks); mirror by delta
+            for counter, value in ((self._c_pc_hits, pc.hits),
+                                   (self._c_pc_misses, pc.misses),
+                                   (self._c_pc_evictions,
+                                    pc.evictions)):
+                delta = value - counter.value
+                if delta > 0:
+                    counter.inc(delta)
+            self._g_pc_bytes.set(pc.bytes)
         pool = getattr(self.engine, "kv_pool_stats", None)
         if pool and pool.get("kv_block_tokens"):  # paged engines only
             total = pool.get("kv_blocks", 0)
@@ -724,6 +791,10 @@ class Scheduler:
         did = False
         while len(self._inflight) > keep:
             toks, snap_slots, snap_gens = self._inflight.popleft()
+            if isinstance(toks, _SpecStep):
+                self._drain_spec(toks, snap_slots, snap_gens)
+                did = True
+                continue
             host_toks = np.asarray(toks)
             for slot, req in enumerate(snap_slots):
                 if (req is None or self.slots[slot] is not req
@@ -735,6 +806,46 @@ class Scheduler:
                 self._maybe_finish(slot, tok)
             did = True
         return did
+
+    def _drain_spec(self, step: _SpecStep, snap_slots, snap_gens):
+        """Emit one drained verify step: slot b produced
+        out[b, :accepted[b]+1] (accepted draft prefix + one sampled
+        token). Runs only from _drain_inflight — the host fetch below
+        completes the async copies verify() started. A slot that
+        finishes mid-prefix (stop token / deadline / length) discards
+        the rest of its accepted tokens, exactly as those steps would
+        never have run without speculation; the usual generation
+        check discards whole slots that changed occupant since
+        dispatch."""
+        host_out = np.asarray(step.out)
+        host_acc = np.asarray(step.accepted)
+        dlen = step.draft_len
+        proposed = int(dlen.sum())
+        if proposed:
+            # acceptance accounting covers every drafting slot, even
+            # ones whose tokens are later discarded — the drafter/
+            # verify quality signal is about what the model accepted
+            accepted = int(host_acc.sum())
+            self._h_spec_accept.observe(accepted / proposed)
+            for slot in np.nonzero(dlen)[0]:
+                self._h_spec_accepted.observe(int(host_acc[slot]))
+            self._inc("spec_accepted_tokens_total", accepted)
+        commit = getattr(self.engine, "commit_spec", None)
+        for slot, req in enumerate(snap_slots):
+            if (req is None or self.slots[slot] is not req
+                    or self._slot_gen[slot] != snap_gens[slot]):
+                continue
+            n = int(host_acc[slot]) + 1
+            if commit is not None:
+                # paged KV: reconcile the host length mirror and
+                # return the speculative surplus blocks to the pool
+                commit(slot, n)
+            for tok in host_out[slot, :n]:
+                req.emit(int(tok))
+                self._inc("tokens_generated_total")
+                self._maybe_finish(slot, int(tok))
+                if self.slots[slot] is not req:
+                    break  # finished mid-prefix: drop the tail
 
     def _decode(self) -> bool:
         if not any(r is not None for r in self.slots):
@@ -759,7 +870,41 @@ class Scheduler:
             if not any(r is not None for r in self.slots):
                 return True  # draining finished every slot
         mask = self._build_mask() if masked else None
-        depth = 0 if mask is not None else self.pipeline_depth
+        # speculative decoding: draft with the host-side n-gram
+        # matcher and verify the whole batch in one multi-token
+        # forward. Masked batches stay non-speculative (the grammar
+        # needs token k on host to build mask k+1 — same reason they
+        # degrade to synchronous), engines without a verify op (fakes,
+        # remote wrappers) stay plain, and a batch where any slot is
+        # within k+1 rows of cache capacity falls back for the step
+        # (the verify write needs k+1 rows of headroom per slot).
+        drafts = dlen = None
+        if (self.spec_tokens > 0 and mask is None
+                and getattr(self.engine, "verify", None) is not None):
+            drafts, dlen = self._build_drafts(self.spec_tokens)
+            if dlen.any() and self._inflight:
+                # drafts must align with the DEVICE's last committed
+                # token: a lagged in-flight step would shift the
+                # drafted continuation by its unread tokens, so the
+                # verify would reject nearly everything. Drain first
+                # (only when someone actually drafted — non-repetitive
+                # workloads keep the plain pipeline), then re-draft
+                # from the now-complete stream.
+                self._drain_inflight()
+                if not any(r is not None for r in self.slots):
+                    return True  # draining finished every slot
+                drafts, dlen = self._build_drafts(self.spec_tokens)
+            if not dlen.any() or not self._spec_headroom(
+                    self.spec_tokens):
+                drafts = dlen = None  # nobody drafted: plain decode
+        # verify steps run the lag queue at depth 0, like masked
+        # steps: the next round's drafts need this step's tokens on
+        # host, and paged engines must reconcile block allocation
+        # against the drained accepted counts before the next
+        # dispatch. The verify itself amortizes the sync bubble over
+        # the accepted tokens; plain fallback steps keep pipelining.
+        depth = 0 if (mask is not None or drafts is not None) \
+            else self.pipeline_depth
         sampling = self._sampling()
         t0 = time.monotonic()
         if self._dispatch_end is not None:
@@ -767,6 +912,10 @@ class Scheduler:
         if mask is not None:
             self.state, toks = self.engine.decode(
                 self.state, *sampling, mask=mask)
+        elif drafts is not None:
+            self.state, out, acc = self.engine.verify(
+                self.state, drafts, dlen, *sampling)
+            toks = _SpecStep(out, acc, dlen)
         else:  # engine wrappers/fakes need no mask kwarg in their API
             self.state, toks = self.engine.decode(
                 self.state, *sampling)
@@ -776,6 +925,9 @@ class Scheduler:
             else 0.9 * self._ewma_step_s + 0.1 * dt
         self._h_decode_step.observe(dt)
         self._inc("decode_steps_total")
+        if drafts is not None:
+            self._inc("spec_steps_total")
+            self._inc("spec_proposed_tokens_total", int(dlen.sum()))
         self._inflight.append(
             (toks, list(self.slots), list(self._slot_gen)))
         # emit steps older than the pipeline window — with the next
@@ -797,7 +949,13 @@ class Scheduler:
             self.slots[slot] = None
             self._slot_changed(slot)
             self._temp[slot] = 0.0
-            req.prompt_ids = list(req.prompt_ids) + list(req.output_ids)
+            # fold only the tokens generated SINCE this admission:
+            # outputs[:base_out] were folded by a previous preemption
+            # and already sit inside prompt_ids — re-adding them would
+            # corrupt the resume prompt the second time a request is
+            # preempted
+            req.prompt_ids = list(req.prompt_ids) + list(
+                req.output_ids[int(self._base_out[slot]):])
             self._requeue.appendleft(req)
             self._inc("preemptions_total")
             if self.overlap:
@@ -805,6 +963,47 @@ class Scheduler:
         if depth == 0:
             self._drain_inflight()
         return True
+
+    def _spec_headroom(self, k: int) -> bool:
+        """True when every active slot has cache headroom for the k+1
+        speculative KV rows a verify step writes — including rows the
+        still-inflight steps may commit. A near-capacity slot makes
+        the whole step fall back to plain decode (it finishes with
+        reason=length within a step or two anyway); without this, a
+        clamped multi-row cache write would corrupt earlier rows."""
+        need = (len(self._inflight) + 1) * (k + 1)
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            used = (int(self._true_len[slot]) + len(req.output_ids)
+                    - int(self._base_out[slot]))
+            if used + need > self.engine.max_seq:
+                return False
+        return True
+
+    def _build_drafts(self, k: int):
+        """Per-slot n-gram drafts from each request's host-visible
+        committed stream (prompt + emitted output — under pipelining
+        this lags the device by the lag-queue depth, which only costs
+        acceptance, never correctness). Returns ([B, k] int32 drafts,
+        [B] int32 draft lengths); a slot with no match drafts 0
+        tokens and degenerates to plain decode inside the verify."""
+        B = self.engine.max_slots
+        drafts = np.zeros((B, k), np.int32)
+        dlen = np.zeros((B,), np.int32)
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            # outputs[:base_out] of a resumed request are already
+            # folded into prompt_ids — slicing keeps the drafter's
+            # view of the stream free of duplicated spans
+            d = spec_drafter.propose(
+                list(req.prompt_ids)
+                + list(req.output_ids[int(self._base_out[slot]):]), k)
+            if d.size:
+                drafts[slot, :d.size] = d
+                dlen[slot] = d.size
+        return drafts, dlen
 
     def _fits_pool(self, req: Request) -> bool:
         """Paged KV only: a request whose worst-case footprint exceeds

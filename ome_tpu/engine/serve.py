@@ -104,6 +104,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "restores the synchronous fetch-every-step "
                         "loop; structured-output batches always run "
                         "synchronously")
+    p.add_argument("--spec-tokens", type=int, default=0,
+                   help="speculative decoding: max draft tokens per "
+                        "slot per step proposed by the host-side "
+                        "n-gram drafter and verified in one batched "
+                        "multi-token forward "
+                        "(docs/speculative-decoding.md); 0 = off "
+                        "(default). Greedy output is byte-identical "
+                        "either way; single-host only")
     p.add_argument("--faults", default=None,
                    help="deterministic fault-injection spec "
                         "(ome_tpu/faults.py grammar, e.g. "
@@ -379,10 +387,18 @@ def main(argv=None) -> int:
         # leaders publish ops from ONE thread in execution order
         # (followers replay strictly sequentially); on PD decode nodes
         # it moves the remote KV fetch off the decode thread
+        if dist is not None and args.spec_tokens > 0:
+            # the multi-host op stream replicates prefill/insert/
+            # decode only — a leader-side verify op would desync the
+            # followers' replay; refuse rather than silently diverge
+            log.error("--spec-tokens requires single-host serving "
+                      "(the multi-host op stream has no verify op)")
+            return 2
         scheduler = Scheduler(engine, overlap=dist is None,
                               max_restarts=args.max_restarts,
                               max_queue_wait=args.max_queue_wait,
-                              pipeline_depth=args.pipeline_depth)
+                              pipeline_depth=args.pipeline_depth,
+                              spec_tokens=args.spec_tokens)
     tok = load_tokenizer(args.model_dir)
     name = args.model_name or args.model_dir.rstrip("/").rsplit("/", 1)[-1]
     server = EngineServer(scheduler, tokenizer=tok, model_name=name,

@@ -126,6 +126,8 @@ class EngineServer:
                         if getattr(sched, "stats", None) else 0,
                         "pipeline_depth": getattr(
                             sched, "pipeline_depth", 0),
+                        "spec_tokens": getattr(
+                            sched, "spec_tokens", 0),
                         "uptime_s": round(
                             time.time() - outer.started_at, 1)})
                 elif self.path == "/ready":

@@ -82,6 +82,14 @@ class ShardedInferenceEngine(InferenceEngine):
         with self._no_int4_kernel():
             return super().decode(*a, **kw)
 
+    def verify(self, *a, **kw):
+        # speculative verify is the same dense multi-token forward
+        # GSPMD already propagates shardings through (tokens/drafts
+        # replicated, KV head-sharded) — only the int4-kernel gate
+        # needs the decode treatment
+        with self._no_int4_kernel():
+            return super().verify(*a, **kw)
+
     def _kv_sharding(self) -> NamedSharding:
         # [L, B, S, K, Dh]: KV heads on tp. MLA caches ONE latent head
         # (kv_cache_heads == 1) — replicated; the latent cache is tiny

@@ -772,23 +772,26 @@ def forward_paged(params: Params, cfg: ModelConfig, tokens: jax.Array,
                   cache: PagedKVCache,
                   adapter_ids: Optional[jax.Array] = None,
                   ) -> Tuple[jax.Array, PagedKVCache]:
-    """Single-token decode over a paged (block-pool) KV cache.
+    """Short-sequence decode over a paged (block-pool) KV cache.
 
-    tokens: [B, 1]. Each slot writes its new K/V row into pool block
-    `table[b, index[b] // block]` at offset `index[b] % block`, then
-    attends over its block chain (ops/paged.py). Standard GQA models
+    tokens: [B, S] with small S — 1 for plain decode, k+1 for a
+    speculative verify step (engine/core.py). Each slot writes its S
+    new K/V rows into pool blocks `table[b, (index[b]+s) // block]`
+    at offsets `(index[b]+s) % block` (the engine pre-allocates the
+    covering blocks), then attends over its block chain with
+    per-query causal masking (ops/paged.py). Standard GQA models
     only — MLA, MoE, and sliding-window variants keep the dense path
     (the engine guards). cite: vLLM PagedAttention, which the
     reference consumes via its SGLang/vLLM runtimes (SURVEY.md L0,
     /root/reference/config/runtimes/srt/*); here it is in-repo and
     TPU-static.
     """
-    from ..ops.paged import paged_attention
+    from ..ops.paged import paged_attention, paged_attention_multi
     B, S = tokens.shape
-    assert S == 1, "forward_paged is decode-only"
     bs = cache.k.shape[2]
     M = cache.table.shape[1]
-    positions = cache.index[:, None]
+    positions = cache.index[:, None] + jnp.arange(S,
+                                                  dtype=jnp.int32)[None, :]
     kv_len = cache.index + 1
     emb = params["embed"]
     x = emb.take(tokens, cfg.dtype) if isinstance(emb, QTensor) \
@@ -800,18 +803,32 @@ def forward_paged(params: Params, cfg: ModelConfig, tokens: jax.Array,
     rows = jnp.arange(B)
     # clamp keeps a finished slot whose length outgrew its table row
     # in-bounds; its row points at the trash block by then
-    blk = cache.table[rows, jnp.minimum(cache.index // bs, M - 1)]
-    off = cache.index % bs
+    blk = cache.table[rows[:, None],
+                      jnp.minimum(positions // bs, M - 1)]  # [B, S]
+    off = positions % bs
 
     def body(x, per):
         lp, kp, vp = per
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, uo)
         q, k, v = _qkv(h, lp, cfg, freqs, positions, uo, adapter_ids)
-        kp = kp.at[blk, off].set(k[:, 0].astype(kp.dtype))
-        vp = vp.at[blk, off].set(v[:, 0].astype(vp.dtype))
-        attn = paged_attention(q, kp, vp, cache.table, kv_len,
-                               scale=cfg.query_scale,
-                               logit_softcap=cfg.attn_logit_softcap)
+        # the S writes per slot land on consecutive rows (distinct
+        # (block, offset) pairs), so the unrolled scatter order
+        # doesn't matter; trash-block collisions between inactive
+        # slots are never read back
+        for s in range(S):
+            kp = kp.at[blk[:, s], off[:, s]].set(
+                k[:, s].astype(kp.dtype))
+            vp = vp.at[blk[:, s], off[:, s]].set(
+                v[:, s].astype(vp.dtype))
+        if S == 1:
+            attn = paged_attention(q, kp, vp, cache.table, kv_len,
+                                   scale=cfg.query_scale,
+                                   logit_softcap=cfg.attn_logit_softcap)
+        else:
+            attn = paged_attention_multi(
+                q, kp, vp, cache.table, positions,
+                scale=cfg.query_scale,
+                logit_softcap=cfg.attn_logit_softcap)
         a = _proj_lora(attn, lp, "wo", adapter_ids, cfg.dtype,
                        flatten=2)
         if cfg.post_block_norms:
@@ -826,7 +843,7 @@ def forward_paged(params: Params, cfg: ModelConfig, tokens: jax.Array,
 
     x, (nk, nv) = lax.scan(body, x,
                            (params["layers"], cache.k, cache.v))
-    new_cache = PagedKVCache(k=nk, v=nv, index=cache.index + 1,
+    new_cache = PagedKVCache(k=nk, v=nv, index=cache.index + S,
                              table=cache.table)
     return _final_logits(params, cfg, x), new_cache
 

@@ -72,6 +72,50 @@ def paged_attention_xla(q: jax.Array, k_pool: jax.Array,
     return out.reshape(B, 1, H, D).astype(q.dtype)
 
 
+def paged_attention_multi(q: jax.Array, k_pool: jax.Array,
+                          v_pool: jax.Array, table: jax.Array,
+                          q_positions: jax.Array,
+                          scale: Optional[float] = None,
+                          logit_softcap: Optional[float] = None,
+                          ) -> jax.Array:
+    """Multi-query causal paged attention (speculative verify).
+
+    Like paged_attention_xla but with Sq >= 1 queries per slot, each
+    at its own sequence position: query s of slot b attends pool rows
+    at sequence positions <= q_positions[b, s] (its own freshly
+    written K/V row included — matching the dense decode convention
+    kv_len = index + 1). XLA gather path only: the verify forward
+    amortizes one weight pass over Sq tokens, so the gather cost is
+    shared the same way; a Pallas multi-query kernel can slot in
+    behind the same contract later.
+
+    q: [B, Sq, H, D]; pools: [N, bs, K, D]; table: [B, M] int32;
+    q_positions: [B, Sq] int32. Returns [B, Sq, H, D].
+    """
+    B, Sq, H, D = q.shape
+    _, bs, K, _ = k_pool.shape
+    M = table.shape[1]
+    scale = scale if scale is not None else D ** -0.5
+    kg = jnp.take(k_pool, table, axis=0).reshape(B, M * bs, K, -1)
+    vg = jnp.take(v_pool, table, axis=0).reshape(B, M * bs, K, -1)
+    G = H // K
+    qh = q.reshape(B, Sq, K, G, D)
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", qh.astype(jnp.float32),
+                        kg.astype(jnp.float32)) * scale
+    if logit_softcap:
+        logits = jnp.tanh(logits / logit_softcap) * logit_softcap
+    col = jnp.arange(M * bs, dtype=jnp.int32)
+    # per-query causal+length mask: rows past a slot's chain sit in
+    # trash-block gathers at sequence positions > q_positions, so one
+    # comparison covers both
+    valid = col[None, None, :] <= q_positions[:, :, None]  # [B, Sq, S]
+    logits = jnp.where(valid[:, None, None, :, :], logits, M_INIT)
+    p = jax.nn.softmax(logits, axis=-1)
+    p = jnp.where(valid[:, None, None, :, :], p, 0.0)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", p, vg.astype(jnp.float32))
+    return out.reshape(B, Sq, H, D).astype(q.dtype)
+
+
 def _paged_kernel(lim_ref, tbl_ref, q_ref, k_ref, v_ref, *refs,
                   bs: int, scale: float, softcap: Optional[float]):
     # identical math to the dense decode kernel: `start` stays in

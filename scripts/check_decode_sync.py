@@ -28,13 +28,18 @@ import sys
 from typing import List
 
 # the scheduler functions on the per-step hot path: everything that
-# runs between two decode dispatches
+# runs between two decode dispatches — including the speculative
+# verify/accept path (_spec_headroom gates, _build_drafts builds the
+# n-gram drafts from HOST-side token lists; neither may touch device
+# arrays synchronously)
 STEP_PATH = frozenset((
     "step", "_decode", "_insert_ready", "_admit", "_build_mask",
-    "_maybe_finish", "_sampling"))
-# the one sanctioned fetch point: it reads a step whose successor was
-# already dispatched, so the copy it completes was already in flight
-ALLOWED = frozenset(("_drain_inflight",))
+    "_maybe_finish", "_sampling", "_spec_headroom", "_build_drafts"))
+# the sanctioned fetch points: they read a step whose successor was
+# already dispatched, so the copy they complete was already in flight
+# (_drain_spec is _drain_inflight's speculative-step arm and is only
+# called from it)
+ALLOWED = frozenset(("_drain_inflight", "_drain_spec"))
 
 _SYNC_MODULE_CALLS = frozenset((
     ("np", "asarray"), ("np", "array"),
