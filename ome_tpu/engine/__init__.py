@@ -7,11 +7,14 @@ OpenAI-compatible HTTP front-end.
 """
 
 from .core import DecodeState, InferenceEngine
+from .journal import RequestJournal
 from .sampling import sample
-from .scheduler import Request, Scheduler, SchedulerOverloaded
+from .scheduler import (Request, Scheduler, SchedulerDraining,
+                        SchedulerOverloaded)
 from .server import EngineServer
 from .tokenizer import ByteTokenizer, load_tokenizer
 
-__all__ = ["DecodeState", "InferenceEngine", "Request", "Scheduler",
+__all__ = ["DecodeState", "InferenceEngine", "Request",
+           "RequestJournal", "Scheduler", "SchedulerDraining",
            "SchedulerOverloaded", "EngineServer", "ByteTokenizer",
            "load_tokenizer", "sample"]

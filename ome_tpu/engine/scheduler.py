@@ -99,6 +99,16 @@ class SchedulerOverloaded(RuntimeError):
         self.retry_after = retry_after
 
 
+class SchedulerDraining(RuntimeError):
+    """The replica received SIGTERM and is draining: in-flight work
+    finishes, new admissions answer 503 + Retry-After so the client
+    (or the router) resubmits elsewhere."""
+
+    def __init__(self, msg: str, retry_after: float = 2.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
 @dataclass
 class Request:
     prompt_ids: List[int]
@@ -120,6 +130,11 @@ class Request:
     # adopted from (or minted for) this request; flows into the JSONL
     # request log so router and engine records share one trace id
     trace: Optional[object] = None
+    # durable requests (engine/journal.py): the journal id this
+    # request is recorded under; assigned at admit, carried by
+    # restart-resumed requests so progress keeps appending to the
+    # original journal entry
+    journal_id: Optional[int] = None
     id: int = field(default_factory=lambda: next(_ids))
     created: float = field(default_factory=time.monotonic)
     # results
@@ -183,8 +198,16 @@ class Scheduler:
                  max_queue_wait: float = 30.0,
                  pipeline_depth: int = 1,
                  spec_tokens: int = 0,
-                 registry: Optional[Registry] = None):
+                 registry: Optional[Registry] = None,
+                 journal=None):
         self.engine = engine
+        # durable requests (engine/journal.py, docs/durability.md):
+        # when set, every unmasked admission is journaled, progress
+        # records append at each step boundary, and restart resume
+        # replays whatever has no tombstone. Masked (structured-
+        # output) requests are NOT journaled — their grammar state is
+        # not serializable, so a resumed fold could not rebuild it.
+        self.journal = journal
         # speculative decoding (docs/speculative-decoding.md): max
         # draft tokens per slot per step proposed by the host-side
         # n-gram drafter (engine/spec.py) and verified in ONE batched
@@ -205,6 +228,8 @@ class Scheduler:
         # shared telemetry registry: the EngineServer scrapes it on
         # /metrics; stats-dict counters below are mirrored into it
         self.registry = registry or Registry()
+        if self.journal is not None:
+            self.journal.bind(self.registry)
         # crash recovery: consecutive engine-fault restarts tolerated
         # before going permanently dead (0 = first fault is fatal, the
         # pre-recovery fail-fast behavior)
@@ -260,6 +285,15 @@ class Scheduler:
         # tri-state health: ok (serving) / degraded (mid-recovery,
         # requests queue) / dead (restart budget exhausted)
         self._status = "ok"
+        # graceful drain (SIGTERM): new submissions are rejected with
+        # 503 while in-flight and queued work keeps running to
+        # completion; stop() then evicts whatever the grace window
+        # did not finish
+        self._draining = False
+        # requests the admission thread holds between popping them
+        # from a queue and parking them in _ready — drain_idle() must
+        # see them as in-flight work
+        self._admitting = 0
         self._restarts = 0  # consecutive faults since last good step
         # the admission thread signals a local engine fault here; the
         # scheduler thread owns recovery (one recoverer, no races)
@@ -380,6 +414,20 @@ class Scheduler:
                 self._h_tpot.observe(
                     (end - req.first_token_at) / (n - 1))
 
+    def _request_finished(self, req: Request):
+        """Installed as req.on_finish at submit: latency observations
+        plus the journal's terminal record. A `shutdown` finish
+        (drain-timeout eviction) or an `engine_fault` from a dead
+        scheduler leaves the journal entry live — the process is
+        going away and a restart resumes the work; every other reason
+        means the request is DONE and tombstones it."""
+        self._observe_finish(req)
+        if self.journal is not None:
+            resumable = req.finish_reason == "shutdown" or (
+                req.finish_reason == "engine_fault"
+                and self._status == "dead")
+            self.journal.finish(req, resumable=resumable)
+
     def _mark_scheduled(self, req: Request):
         """First time a request leaves the queue for a decode slot:
         the queue-wait phase ends here. Requeued/preempted requests
@@ -442,8 +490,12 @@ class Scheduler:
         with self._lock:
             if self._stop.is_set() or self._status == "dead":
                 raise RuntimeError("scheduler unavailable")
+            if self._draining:
+                raise SchedulerDraining(
+                    "scheduler draining (shutdown signal received); "
+                    "resubmit to another replica")
             self._inc_locked("requests_total")
-            req.on_finish = self._observe_finish
+            req.on_finish = self._request_finished
             if req.expired():
                 # dead on arrival: never queued, never slotted
                 self._inc_locked("timeouts_total")
@@ -466,6 +518,8 @@ class Scheduler:
                 self._inc_locked("rejected_total")
                 raise SchedulerOverloaded(
                     "pending queue full", retry_after=1.0) from None
+            if self.journal is not None and req.masker is None:
+                self.journal.admit(req)
         return req
 
     def start(self):
@@ -490,7 +544,92 @@ class Scheduler:
             self._thread.join(timeout=10)
         if self._admit_thread:
             self._admit_thread.join(timeout=10)
+        # `shutdown` (vs `engine_fault`): an orderly eviction — the
+        # work was fine, the process is going away. The router may
+        # safely retry these, and a journal keeps them resumable.
         self._fail_all("shutdown")
+
+    # -- graceful drain (docs/durability.md) ---------------------------
+
+    def begin_drain(self):
+        """Stop admitting NEW requests (503 SchedulerDraining) while
+        queued and in-flight work keeps running to completion. The
+        decode loop is untouched — drain is an admission-side state,
+        not a stop."""
+        with self._lock:
+            self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain_idle(self) -> bool:
+        """True when no admitted work remains anywhere in the
+        scheduler: the drain controller polls this to know the grace
+        window can end early."""
+        return (self.pending.empty() and not self._requeue
+                and self._ready.empty() and not self._inflight
+                and self._admitting == 0
+                and all(r is None for r in self.slots))
+
+    # -- restart resume (docs/durability.md) ---------------------------
+
+    def resume_from_journal(self) -> int:
+        """Re-admit every unfinished request the journal replays,
+        with generated-so-far tokens folded into the prompt — the
+        exact recompute-resume fold paged-KV preemption uses, so a
+        greedy stream continues byte-identical to an uninterrupted
+        run. Original deadlines are honored (journaled as epoch,
+        converted back to this process's monotonic clock); an entry
+        that expired while the replica was down finishes `timeout`
+        through the normal DOA shedding. Returns the number of
+        requests re-admitted."""
+        import logging
+        log = logging.getLogger("ome.engine")
+        j = self.journal
+        if j is None:
+            return 0
+        try:
+            entries = j.replay()
+        except Exception:  # noqa: BLE001 — a corrupt journal must not
+            # stop the replica from serving new work
+            log.exception("journal replay failed; starting empty")
+            j._count(j._c_errors, "errors")
+            return 0
+        n = 0
+        now_mono = time.monotonic()
+        now_wall = time.time()
+        for e in entries:
+            deadline = None
+            if e.deadline_epoch is not None:
+                deadline = now_mono + (e.deadline_epoch - now_wall)
+            req = Request(
+                prompt_ids=list(e.prompt_ids) + list(e.output_ids),
+                max_new_tokens=e.max_new_tokens,
+                temperature=e.temperature, top_k=e.top_k,
+                top_p=e.top_p, stop_ids=list(e.stop_ids),
+                adapter=e.adapter, deadline=deadline,
+                journal_id=e.jid,
+                output_ids=list(e.output_ids))
+            if len(req.output_ids) >= req.max_new_tokens:
+                # it had already produced its whole budget; only the
+                # tombstone was lost to the crash
+                req.finish("length")
+                j.finish(req)
+                continue
+            try:
+                self.submit(req)
+            except SchedulerOverloaded:
+                # more journal than queue: leave the entry live for
+                # the next restart rather than dropping it
+                log.warning("journal: queue full, request %d not "
+                            "resumed (stays journaled)", e.jid)
+                continue
+            n += 1
+        if n:
+            j.note_replayed(n)
+            log.info("journal: resumed %d unfinished request(s)", n)
+        return n
 
     def _next_pending(self) -> Request:
         """Requeued (bounced / preempted) requests go first; raises
@@ -568,6 +707,11 @@ class Scheduler:
             active = any(r is not None for r in self.slots)
             admitted = self._admit(limit=1 if active else None)
         decoded = self._decode()
+        if self.journal is not None:
+            # progress records cover everything emitted up to this
+            # step boundary, so a crash never loses a token a client
+            # already saw; the batch fsync policy piggybacks here
+            self.journal.poll()
         with self._lock:
             self.stats["queue_depth"] = self.pending.qsize()
             self.stats["active_slots"] = sum(
@@ -595,66 +739,73 @@ class Scheduler:
                 except queue.Empty:
                     self._free_slots.release()
                     continue
-            if self._shed_if_expired(req):
-                self._free_slots.release()
-                continue
-            if not self._fits_pool(req):
-                req.finish("error")
-                self._free_slots.release()
-                continue
-            if not self._pool_ready(req):
-                # saturated pool: back off instead of re-prefilling
-                self._requeue.appendleft(req)
-                self._free_slots.release()
-                time.sleep(0.01)
-                continue
-            self._mark_scheduled(req)
-            t0 = time.monotonic()
+            # from here until the request lands in _ready (or
+            # finishes), it is invisible to every queue — the counter
+            # keeps drain_idle() honest about it
+            self._admitting += 1
             try:
-                tok, kv, true_len, bucket = self._prefill_req(req)
-            except Exception as e:  # noqa: BLE001
-                import logging
-
-                from .core import UnknownAdapterError
-
-                # engines that fetch prefill remotely (PD decode
-                # nodes) declare which errors are TRANSIENT — a peer
-                # restarting mid-rollout fails one request, not every
-                # in-flight stream on this node. An unknown LoRA
-                # adapter (request racing a hot unload) is likewise
-                # that request's problem, never an engine fault.
-                transient = (UnknownAdapterError,) + tuple(
-                    getattr(self.engine, "transient_prefill_errors",
-                            ()))
-                if isinstance(e, transient):
-                    logging.getLogger("ome.engine").warning(
-                        "transient prefill failure for request %s: %s",
-                        req.id, e)
+                if self._shed_if_expired(req):
+                    self._free_slots.release()
+                    continue
+                if not self._fits_pool(req):
                     req.finish("error")
                     self._free_slots.release()
                     continue
-                # local engine fault: this request is lost, but the
-                # SCHEDULER thread owns recovery — signal it and keep
-                # the admission thread alive to resume after restart
-                logging.getLogger("ome.engine").exception(
-                    "prefill failed; requesting engine recovery")
-                req.finish("error")
-                self._free_slots.release()
-                self._fault_event.set()
-                continue
-            self._h_prefill.observe(time.monotonic() - t0)
-            self._inc("prefill_total")
-            # under _lock so a prefill that outlives stop()'s join or a
-            # scheduler-thread death (e.g. a slow remote PD fetch)
-            # cannot strand its request in _ready after _fail_all
-            # drained it — the waiter would hang forever
-            with self._lock:
-                if self._stop.is_set() or not self.healthy:
-                    req.finish("shutdown" if self._stop.is_set()
-                               else "error")
+                if not self._pool_ready(req):
+                    # saturated pool: back off instead of re-prefilling
+                    self._requeue.appendleft(req)
                     self._free_slots.release()
-                    return
-                self._ready.put((req, tok, kv, true_len, bucket))
+                    time.sleep(0.01)
+                    continue
+                self._mark_scheduled(req)
+                t0 = time.monotonic()
+                try:
+                    tok, kv, true_len, bucket = self._prefill_req(req)
+                except Exception as e:  # noqa: BLE001
+                    import logging
+
+                    from .core import UnknownAdapterError
+
+                    # engines that fetch prefill remotely (PD decode
+                    # nodes) declare which errors are TRANSIENT — a peer
+                    # restarting mid-rollout fails one request, not every
+                    # in-flight stream on this node. An unknown LoRA
+                    # adapter (request racing a hot unload) is likewise
+                    # that request's problem, never an engine fault.
+                    transient = (UnknownAdapterError,) + tuple(
+                        getattr(self.engine, "transient_prefill_errors",
+                                ()))
+                    if isinstance(e, transient):
+                        logging.getLogger("ome.engine").warning(
+                            "transient prefill failure for request "
+                            "%s: %s", req.id, e)
+                        req.finish("error")
+                        self._free_slots.release()
+                        continue
+                    # local engine fault: this request is lost, but the
+                    # SCHEDULER thread owns recovery — signal it and keep
+                    # the admission thread alive to resume after restart
+                    logging.getLogger("ome.engine").exception(
+                        "prefill failed; requesting engine recovery")
+                    req.finish("error")
+                    self._free_slots.release()
+                    self._fault_event.set()
+                    continue
+                self._h_prefill.observe(time.monotonic() - t0)
+                self._inc("prefill_total")
+                # under _lock so a prefill that outlives stop()'s join
+                # or a scheduler-thread death (e.g. a slow remote PD
+                # fetch) cannot strand its request in _ready after
+                # _fail_all drained it — the waiter would hang forever
+                with self._lock:
+                    if self._stop.is_set() or not self.healthy:
+                        req.finish("shutdown" if self._stop.is_set()
+                                   else "error")
+                        self._free_slots.release()
+                        return
+                    self._ready.put((req, tok, kv, true_len, bucket))
+            finally:
+                self._admitting -= 1
 
     def _insert_ready(self) -> bool:
         did = False
@@ -713,51 +864,62 @@ class Scheduler:
                 req = self._next_pending()
             except queue.Empty:
                 break
-            if not self._fits_pool(req):
-                req.finish("error")
-                continue
-            if not self._pool_ready(req):
-                # pool saturated: retry next step WITHOUT burning a
-                # prefill forward that insert would just bounce
-                self._requeue.appendleft(req)
-                break
-            self._mark_scheduled(req)
-            t0 = time.monotonic()
+            # between the pop and the slot assignment (or a requeue)
+            # the request is in no queue — the counter keeps
+            # drain_idle() honest about it, exactly as in the overlap
+            # admission thread
+            self._admitting += 1
             try:
-                tok, kv, true_len, bucket = self._prefill_req(req)
-                self._h_prefill.observe(time.monotonic() - t0)
-                ikw = {} if req.adapter is None \
-                    else {"adapter": req.adapter}
-                self.state = self.engine.insert(
-                    self.state, kv, slot, true_len, tok, bucket, **ikw)
-            except Exception as e:
-                from .core import KVPoolExhausted, UnknownAdapterError
-                if isinstance(e, KVPoolExhausted):
-                    # paged-KV backpressure: retry next step, after
-                    # running streams have freed blocks
-                    self._requeue.appendleft(req)
-                    break
-                if isinstance(e, UnknownAdapterError):
-                    # racing a hot adapter unload fails ONE request
+                if not self._fits_pool(req):
                     req.finish("error")
                     continue
-                # req is out of the queue but not yet slotted, so the
-                # recovery handler cannot see it — fail it here before
-                # propagating to _recover in _run
-                req.finish("error")
-                raise
-            self.slots[slot] = req
-            self._slot_changed(slot)
-            self._temp[slot] = req.temperature
-            self._top_k[slot] = req.top_k
-            self._top_p[slot] = req.top_p
-            self._true_len[slot] = true_len
-            self._base_out[slot] = len(req.output_ids)
-            self._inc("prefill_total")
-            req.emit(tok)
-            self._maybe_finish(slot, tok)
-            did = True
-            admitted += 1
+                if not self._pool_ready(req):
+                    # pool saturated: retry next step WITHOUT burning
+                    # a prefill forward that insert would just bounce
+                    self._requeue.appendleft(req)
+                    break
+                self._mark_scheduled(req)
+                t0 = time.monotonic()
+                try:
+                    tok, kv, true_len, bucket = self._prefill_req(req)
+                    self._h_prefill.observe(time.monotonic() - t0)
+                    ikw = {} if req.adapter is None \
+                        else {"adapter": req.adapter}
+                    self.state = self.engine.insert(
+                        self.state, kv, slot, true_len, tok, bucket,
+                        **ikw)
+                except Exception as e:
+                    from .core import (KVPoolExhausted,
+                                       UnknownAdapterError)
+                    if isinstance(e, KVPoolExhausted):
+                        # paged-KV backpressure: retry next step,
+                        # after running streams have freed blocks
+                        self._requeue.appendleft(req)
+                        break
+                    if isinstance(e, UnknownAdapterError):
+                        # racing a hot adapter unload fails ONE
+                        # request
+                        req.finish("error")
+                        continue
+                    # req is out of the queue but not yet slotted, so
+                    # the recovery handler cannot see it — fail it
+                    # here before propagating to _recover in _run
+                    req.finish("error")
+                    raise
+                self.slots[slot] = req
+                self._slot_changed(slot)
+                self._temp[slot] = req.temperature
+                self._top_k[slot] = req.top_k
+                self._top_p[slot] = req.top_p
+                self._true_len[slot] = true_len
+                self._base_out[slot] = len(req.output_ids)
+                self._inc("prefill_total")
+                req.emit(tok)
+                self._maybe_finish(slot, tok)
+                did = True
+                admitted += 1
+            finally:
+                self._admitting -= 1
         return did
 
     def _slot_changed(self, slot: int):
@@ -1139,7 +1301,12 @@ class Scheduler:
     def _go_dead(self) -> bool:
         with self._lock:
             self._status = "dead"
-        self._fail_all("error")
+        # `engine_fault` (vs `shutdown`): the replica crashed out from
+        # under the work — the router may retry it elsewhere, and a
+        # journal keeps these entries live for the replacement process
+        # to resume (status is already `dead` when _fail_all finishes
+        # them, which is what _request_finished keys on)
+        self._fail_all("engine_fault")
         return False
 
     def _recover(self, err: BaseException) -> bool:
@@ -1152,13 +1319,17 @@ class Scheduler:
         self._inc("engine_faults_total")
         with self._lock:
             self._status = "degraded"
-        self._fail_batch("error")
         self._restarts += 1
         if self._restarts > self.max_restarts:
+            # budget exhausted: go dead BEFORE failing the batch, so
+            # the in-flight requests finish under dead status (their
+            # journal entries stay live for the replacement process —
+            # this crash kills the pod, not just the batch)
             log.error("engine fault (%s); %d consecutive restarts "
                       "exhausted the budget — scheduler dead", err,
                       self._restarts - 1)
             return self._go_dead()
+        self._fail_batch("engine_fault")
         delay = min(self.restart_backoff * (2 ** (self._restarts - 1)),
                     5.0)
         log.warning("engine fault (%s); restart %d/%d in %.3fs", err,
@@ -1180,8 +1351,9 @@ class Scheduler:
         while not self._stop.is_set():
             try:
                 if self._status == "dead":
-                    # no recovery left; fail waiters fast
-                    self._fail_all("error")
+                    # no recovery left; fail waiters fast (this is a
+                    # crash, not a drain — hence engine_fault)
+                    self._fail_all("engine_fault")
                     return
                 if self._fault_event.is_set():
                     raise RuntimeError(

@@ -18,6 +18,8 @@ from __future__ import annotations
 import argparse
 import logging
 import sys
+import threading
+import time
 
 log = logging.getLogger("ome.engine.serve")
 
@@ -112,6 +114,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "(docs/speculative-decoding.md); 0 = off "
                         "(default). Greedy output is byte-identical "
                         "either way; single-host only")
+    p.add_argument("--journal", default=None, metavar="DIR",
+                   help="durable requests (docs/durability.md): "
+                        "append-only JSONL request journal in DIR; "
+                        "admitted requests and their generated tokens "
+                        "are journaled, and on restart unfinished "
+                        "requests resume byte-identical (greedy) to "
+                        "an uninterrupted run")
+    p.add_argument("--journal-fsync",
+                   choices=("always", "batch", "off"), default="batch",
+                   help="journal durability: 'always' fsyncs every "
+                        "append, 'batch' (default) fsyncs at most "
+                        "every ~100ms from the scheduler loop, 'off' "
+                        "leaves flushing to the OS")
+    p.add_argument("--journal-compact-mb", type=int, default=4,
+                   help="rewrite the journal (dropping tombstoned "
+                        "entries, consolidating progress) when it "
+                        "exceeds this many MiB")
+    p.add_argument("--drain-grace", type=float, default=30.0,
+                   help="graceful-drain window after SIGTERM: /ready "
+                        "flips 503 and new work is rejected while "
+                        "in-flight requests get this many seconds to "
+                        "finish; leftovers are journaled (with "
+                        "--journal) and evicted with finish_reason="
+                        "shutdown. A second SIGTERM/SIGINT forces "
+                        "immediate shutdown")
     p.add_argument("--faults", default=None,
                    help="deterministic fault-injection spec "
                         "(ome_tpu/faults.py grammar, e.g. "
@@ -271,6 +298,7 @@ class _NullScheduler:
     healthy = True
     status = "ok"
     stats: dict = {}
+    registry = None
     reject = "this deployment serves embeddings only"
 
     def start(self):
@@ -281,6 +309,109 @@ class _NullScheduler:
 
     def submit(self, req):
         raise RuntimeError(self.reject)
+
+    def begin_drain(self):
+        pass
+
+    def drain_idle(self):
+        return True  # stateless: nothing in flight to wait for
+
+
+class DrainController:
+    """SIGTERM/SIGINT choreography (docs/durability.md drain state
+    machine): the FIRST signal begins a graceful drain — /ready flips
+    503 (the router stops selecting this replica), new admissions are
+    rejected 503 + Retry-After, in-flight and queued requests get up
+    to `grace` seconds to finish; a SECOND signal (either kind)
+    forces immediate shutdown. Either way the process exits 0 — with
+    a journal, whatever did not finish is durably recorded and the
+    replacement process resumes it."""
+
+    def __init__(self, server, scheduler, grace: float = 30.0,
+                 journal=None, poll_interval: float = 0.02):
+        self.server = server
+        self.scheduler = scheduler
+        self.grace = grace
+        self.journal = journal
+        self.poll_interval = poll_interval
+        self._signalled = threading.Event()
+        self._force = threading.Event()
+        self.drained: bool = False
+        reg = getattr(scheduler, "registry", None)
+        self._g_draining = reg.gauge(
+            "ome_engine_draining",
+            "1 while this replica is draining after SIGTERM") \
+            if reg is not None else None
+        self._g_duration = reg.gauge(
+            "ome_engine_drain_duration_seconds",
+            "Seconds the last (or current) drain has taken") \
+            if reg is not None else None
+
+    def install(self):
+        """Install the signal handlers (main thread only — the
+        interpreter requires it)."""
+        import signal
+        signal.signal(signal.SIGTERM, self.handle_signal)
+        signal.signal(signal.SIGINT, self.handle_signal)
+
+    def handle_signal(self, *_):
+        if self._signalled.is_set():
+            self._force.set()  # second signal: stop waiting
+        else:
+            self._signalled.set()
+
+    def wait(self):
+        """Block until the first signal, then run the drain."""
+        self._signalled.wait()
+        return self.drain()
+
+    def drain(self) -> bool:
+        """Run the drain window; returns True when every in-flight
+        request finished inside the grace period."""
+        from .. import faults
+        t0 = time.monotonic()
+        log.warning("shutdown signal: draining (grace %.1fs; signal "
+                    "again to force)", self.grace)
+        begin = getattr(self.server, "begin_drain", None)
+        if begin is not None:
+            begin()
+        else:  # bare scheduler (tests without an HTTP front)
+            sched_begin = getattr(self.scheduler, "begin_drain", None)
+            if sched_begin is not None:
+                sched_begin()
+        if self._g_draining is not None:
+            self._g_draining.set(1)
+        drained = False
+        idle = getattr(self.scheduler, "drain_idle", None)
+        while time.monotonic() - t0 < self.grace:
+            if self._force.is_set():
+                log.warning("second signal: forcing shutdown with "
+                            "work in flight")
+                break
+            if idle is not None and idle():
+                drained = True
+                break
+            if self._g_duration is not None:
+                self._g_duration.set(time.monotonic() - t0)
+            time.sleep(self.poll_interval)
+        if not drained and not self._force.is_set():
+            # deterministic harness hook: lets tests pin the
+            # drain-timeout eviction path
+            faults.fire("drain_timeout")
+        dur = time.monotonic() - t0
+        if self._g_duration is not None:
+            self._g_duration.set(dur)
+        if drained:
+            log.info("drain complete in %.2fs (all requests "
+                     "finished)", dur)
+        else:
+            log.warning("drain window closed after %.2fs with work "
+                        "in flight; evicting with finish_reason="
+                        "shutdown%s", dur,
+                        " (journaled for resume)"
+                        if self.journal is not None else "")
+        self.drained = drained
+        return drained
 
 
 class _PrefillNodeScheduler(_NullScheduler):
@@ -358,6 +489,11 @@ def main(argv=None) -> int:
 
     embedder = None
     pd_prefill = None
+    journal = None
+    if args.journal and (args.task == "embed"
+                         or args.disaggregation_mode == "prefill"):
+        log.warning("--journal only applies to generation/decode "
+                    "scheduling; ignoring it for this role")
     if args.task == "embed":
         embedder = load_embedder(args)
         scheduler = _NullScheduler()
@@ -394,11 +530,19 @@ def main(argv=None) -> int:
             log.error("--spec-tokens requires single-host serving "
                       "(the multi-host op stream has no verify op)")
             return 2
+        if args.journal:
+            from .journal import RequestJournal
+            journal = RequestJournal(
+                args.journal, fsync=args.journal_fsync,
+                compact_bytes=args.journal_compact_mb << 20)
+            log.info("request journal at %s (fsync=%s)",
+                     journal.path, args.journal_fsync)
         scheduler = Scheduler(engine, overlap=dist is None,
                               max_restarts=args.max_restarts,
                               max_queue_wait=args.max_queue_wait,
                               pipeline_depth=args.pipeline_depth,
-                              spec_tokens=args.spec_tokens)
+                              spec_tokens=args.spec_tokens,
+                              journal=journal)
     tok = load_tokenizer(args.model_dir)
     name = args.model_name or args.model_dir.rstrip("/").rsplit("/", 1)[-1]
     server = EngineServer(scheduler, tokenizer=tok, model_name=name,
@@ -414,17 +558,27 @@ def main(argv=None) -> int:
     log.info("serving %s on %s:%d (%s)", name, args.host, server.port,
              "embeddings" if embedder else
              f"slots={scheduler.engine.max_slots}")
+    # restart resume BEFORE serving: unfinished requests from the
+    # previous process re-enter the queue ahead of new traffic
+    if journal is not None:
+        resume = getattr(scheduler, "resume_from_journal", None)
+        if resume is not None:
+            resume()
     server.start()
+    ctl = DrainController(server, scheduler, grace=args.drain_grace,
+                          journal=journal)
     try:
-        import signal
-        import threading
-        stop = threading.Event()
-        signal.signal(signal.SIGTERM, lambda *a: stop.set())
-        signal.signal(signal.SIGINT, lambda *a: stop.set())
-        stop.wait()
+        ctl.install()
+        # first signal starts the graceful drain; a second forces it
+        ctl.wait()
     finally:
         server.stop()
         scheduler.stop()
+        if journal is not None:
+            # stop() evicted leftovers with finish_reason=shutdown,
+            # which flushed their final progress WITHOUT tombstones —
+            # the replacement process resumes them
+            journal.close()
         if dist is not None:
             # orderly group teardown: the stop op releases followers
             # from recv() so every process reaches jax.distributed

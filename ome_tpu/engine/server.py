@@ -22,7 +22,8 @@ from .. import faults
 from ..telemetry import Registry, tracing
 from ..telemetry import profiler as _profiler
 from ..telemetry.reqlog import coerce as _coerce_reqlog
-from .scheduler import Request, Scheduler, SchedulerOverloaded
+from .scheduler import (Request, Scheduler, SchedulerDraining,
+                        SchedulerOverloaded)
 from .tokenizer import load_tokenizer
 
 # bounded path label for the HTTP counter: anything off this list
@@ -83,6 +84,12 @@ class EngineServer:
                            "maxsize", 0) or 512
             ready_queue_limit = max(maxp // 2, 1)
         self.ready_queue_limit = ready_queue_limit
+        # graceful drain (SIGTERM, docs/durability.md): /ready flips
+        # to 503 so the router health loop stops selecting this
+        # replica, and new work answers 503 + Retry-After with the
+        # X-OME-Draining marker the router treats as "skip, don't
+        # count a failure"; in-flight requests keep streaming
+        self.draining = False
         self.started_at = time.time()
         outer = self
 
@@ -121,6 +128,7 @@ class EngineServer:
                     sched = outer.scheduler
                     self._json(200 if status != "dead" else 503, {
                         "status": status,
+                        "draining": outer.draining,
                         "restarts": sched.stats.get(
                             "restarts_total", 0)
                         if getattr(sched, "stats", None) else 0,
@@ -140,9 +148,11 @@ class EngineServer:
                     pend = getattr(outer.scheduler, "pending", None)
                     depth = pend.qsize() if pend is not None else 0
                     ready = (status == "ok"
+                             and not outer.draining
                              and depth <= outer.ready_queue_limit)
                     self._json(200 if ready else 503, {
                         "ready": ready, "status": status,
+                        "draining": outer.draining,
                         "queue_depth": depth,
                         "queue_limit": outer.ready_queue_limit})
                 elif self.path == "/v1/models":
@@ -183,6 +193,18 @@ class EngineServer:
                     return self._json(code, {
                         "error": f"injected fault (HTTP {code})"},
                         headers={"Retry-After": "1"})
+                if outer.draining and self.path.split("?", 1)[0] in (
+                        "/v1/completions", "/v1/chat/completions",
+                        "/v1/embeddings", "/pd/prefill"):
+                    # drain rejection: X-OME-Draining tells the router
+                    # to fail over WITHOUT charging this replica a
+                    # circuit-breaker failure or a retry token
+                    return self._json(503, {
+                        "error": "replica draining (shutting down); "
+                                 "retry another backend",
+                        "draining": True},
+                        headers={"Retry-After": "2",
+                                 "X-OME-Draining": "1"})
                 if self.path.split("?", 1)[0] == "/debug/profile":
                     return self._profile()
                 try:
@@ -414,6 +436,15 @@ class EngineServer:
                     return self._json(429, {"error": str(e)},
                                       headers={"Retry-After": str(
                                           int(e.retry_after) or 1)})
+                except SchedulerDraining as e:
+                    # drain began between the do_POST gate and this
+                    # submit: same 503 + draining marker
+                    outer._log_request(req, outcome="rejected")
+                    return self._json(503, {"error": str(e),
+                                            "draining": True},
+                                      headers={"Retry-After": str(
+                                          int(e.retry_after) or 1),
+                                          "X-OME-Draining": "1"})
                 except Exception as e:
                     outer._log_request(req, outcome="rejected")
                     return self._json(503, {"error": str(e)},
@@ -551,6 +582,17 @@ class EngineServer:
             "output_tokens": n,
             "finish_reason": outcome or req.finish_reason,
         })
+
+    def begin_drain(self):
+        """Flip this replica to draining: /ready answers 503 (the
+        router health loop stops selecting it), new work answers 503
+        with the X-OME-Draining marker, in-flight requests keep
+        streaming. The HTTP server stays up for the whole grace
+        window — clients mid-stream must be able to finish."""
+        self.draining = True
+        drain = getattr(self.scheduler, "begin_drain", None)
+        if drain is not None:
+            drain()
 
     def start(self):
         self.scheduler.start()

@@ -40,7 +40,15 @@ Wired sites:
   * ``router_forward`` — router -> backend forward, key=backend URL
     (raise surfaces as URLError, i.e. a connection failure);
   * ``pd_fetch``       — PD decode node's remote KV fetch (raise
-    surfaces as PDError: transient, fails one request).
+    surfaces as PDError: transient, fails one request);
+  * ``journal_append`` — request-journal record write (raise degrades
+    the journal: serving continues, durability is lost);
+  * ``journal_fsync``  — request-journal fsync (raise degrades, as
+    above; slow models a stalling disk);
+  * ``journal_replay`` — journal scan at startup (raise makes resume
+    fail open: the engine starts empty instead of crashing);
+  * ``drain_timeout``  — graceful-drain grace expiry (slow extends
+    the drain window to exercise the force path).
 """
 
 from __future__ import annotations

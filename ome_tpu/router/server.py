@@ -65,6 +65,9 @@ _COUNTER_HELP = {
         "Retries suppressed by the token-bucket budget",
     "deadline_shed_total":
         "Requests shed because their deadline had passed (504)",
+    "draining_skips_total":
+        "Forwards redirected because the backend announced it was "
+        "draining (free failover: no breaker hit, no retry token)",
 }
 
 _CB_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}
@@ -77,6 +80,12 @@ class _ClientGone(Exception):
 class _ResponseStarted(Exception):
     """Backend failed after response bytes reached the client —
     failover would corrupt the stream."""
+
+
+class _BackendDraining(Exception):
+    """Backend answered 503 + X-OME-Draining: it is shutting down
+    gracefully. Fail over for free — the backend is HEALTHY, so the
+    redirect must not trip its breaker or spend a retry token."""
 
 
 class Backend:
@@ -101,6 +110,13 @@ class Backend:
         self.fails = 0       # consecutive request failures
         self.cb_trips = 0    # times opened (drives the backoff)
         self._probe_inflight = False
+        # drain-aware routing: a draining backend (SIGTERM, finishing
+        # in-flight work) leaves rotation WITHOUT being a failure —
+        # distinct from the breaker's `open` (which punishes) and
+        # from healthy=False (which marks it unreachable). Set by the
+        # /ready probe and by X-OME-Draining responses; cleared when
+        # the probe sees it ready again (rollback / cancelled drain).
+        self.draining = False
 
     # callers hold Router._lock (selection and result notes race)
 
@@ -123,6 +139,8 @@ class Backend:
                 self.cb_max_cooldown)
 
     def selectable(self, now: float) -> bool:
+        if self.draining:
+            return False  # leaving rotation, but NOT a failure
         if self.cb_state == "open":
             if now < self.cb_open_until:
                 return False
@@ -135,7 +153,8 @@ class Backend:
     def __repr__(self):
         return f"Backend({self.url}, {self.pool}, " \
                f"{'up' if self.healthy else 'down'}, " \
-               f"cb={self.cb_state})"
+               f"cb={self.cb_state}" \
+               f"{', draining' if self.draining else ''})"
 
 
 class Router:
@@ -175,6 +194,13 @@ class Router:
             "ome_router_backend_circuit_state",
             "Per-backend breaker state: 0 closed, 1 half-open, 2 open",
             labelnames=("backend", "pool"))
+        self._g_backends_draining = self.registry.gauge(
+            "ome_router_backends_draining",
+            "Backends currently draining (out of rotation, healthy)")
+        self._g_backend_draining = self.registry.gauge(
+            "ome_router_backend_draining",
+            "Per-backend draining bit (1 draining)",
+            labelnames=("backend", "pool"))
 
     @property
     def stats(self) -> Dict[str, float]:
@@ -193,22 +219,27 @@ class Router:
         """Refresh the per-backend gauges (scrape-time; the breaker
         and health bits otherwise only change on traffic/probes)."""
         up = 0
+        draining = 0
         with self._lock:
-            views = [(b.url, b.pool, b.healthy, b.cb_state)
+            views = [(b.url, b.pool, b.healthy, b.cb_state, b.draining)
                      for b in self.backends]
-        for url, pool, healthy, cb_state in views:
+        for url, pool, healthy, cb_state, drain in views:
             up += bool(healthy)
+            draining += bool(drain)
             self._g_backend_healthy.labels(
                 backend=url, pool=pool).set(1 if healthy else 0)
             self._g_backend_cb.labels(backend=url, pool=pool).set(
                 _CB_STATE_VALUE.get(cb_state, 2))
+            self._g_backend_draining.labels(
+                backend=url, pool=pool).set(1 if drain else 0)
         self._g_backends_up.set(up)
+        self._g_backends_draining.set(draining)
 
     # -- selection -----------------------------------------------------
 
     def _alive(self, pool: str) -> List[Backend]:
         return [b for b in self.backends
-                if b.pool == pool and b.healthy]
+                if b.pool == pool and b.healthy and not b.draining]
 
     def pick(self, pool: str, affinity_key: str = "",
              exclude: Optional[set] = None) -> Optional[Backend]:
@@ -252,17 +283,69 @@ class Router:
             # (leaf-locked; kept outside _lock for uniformity)
             self.inc("circuit_open_total")
 
+    def note_draining(self, backend: Backend):
+        """The backend announced it is draining (503 + X-OME-Draining).
+        Take it out of rotation WITHOUT penalty: the drain is
+        deliberate, not a fault, so the breaker and the health bit are
+        untouched — the /ready probe re-admits it if the drain is
+        cancelled. Also releases a half-open probe slot so the drain
+        cannot wedge the breaker."""
+        with self._lock:
+            backend.draining = True
+            backend._probe_inflight = False
+
+    def probe_aborted(self, backend: Backend):
+        """A half-open probe request ended without a backend verdict
+        (e.g. the CLIENT disconnected mid-probe). Release the probe
+        slot; otherwise _probe_inflight stays latched and the backend
+        can never be re-tested — it is wedged out of rotation until
+        process restart."""
+        with self._lock:
+            backend._probe_inflight = False
+
     # -- health --------------------------------------------------------
 
     def check_health_once(self):
         for b in list(self.backends):
-            try:
-                with urllib.request.urlopen(b.url + "/health",
-                                            timeout=5) as resp:
-                    b.healthy = resp.status == 200
-            except Exception:
-                b.healthy = False
-            b.last_checked = time.time()
+            healthy, draining = self._probe_backend(b)
+            with self._lock:
+                b.healthy = healthy
+                b.draining = draining
+                b.last_checked = time.time()
+
+    @staticmethod
+    def _probe_backend(b: Backend):
+        """Probe /ready (falling back to /health for pre-readiness
+        backends). Returns (healthy, draining): a draining replica
+        answers /ready with 503 + {"draining": true} while still
+        finishing in-flight work — it is HEALTHY but must leave the
+        rotation, and re-enters it if a later probe sees 200 again."""
+        try:
+            with urllib.request.urlopen(b.url + "/ready",
+                                        timeout=5) as resp:
+                return resp.status == 200, False
+        except urllib.error.HTTPError as e:
+            if e.code == 503:
+                try:
+                    info = json.loads(e.read() or b"{}")
+                except ValueError:
+                    info = {}
+                e.close()
+                if info.get("draining"):
+                    return True, True
+                return False, False  # not ready for another reason
+            e.close()
+            if e.code == 404:
+                # old backend without /ready: fall back to /health
+                try:
+                    with urllib.request.urlopen(b.url + "/health",
+                                                timeout=5) as resp:
+                        return resp.status == 200, False
+                except Exception:
+                    return False, False
+            return False, False
+        except Exception:
+            return False, False
 
     def start_health_loop(self):
         def loop():
@@ -356,7 +439,8 @@ class RouterServer:
                         "status": "ok" if up else "no healthy backends",
                         "backends": [
                             {"url": b.url, "pool": b.pool,
-                             "healthy": b.healthy}
+                             "healthy": b.healthy,
+                             "draining": b.draining}
                             for b in outer.router.backends]})
                 if self.path == "/metrics":
                     outer.router.update_gauges()
@@ -437,7 +521,14 @@ class RouterServer:
                 outcome["pool"] = pool
                 tried: set = set()
                 last_err = "no healthy backends"
-                for attempt in range(outer.retries + 1):
+                # `failures` counts TRANSPORT failures only; a draining
+                # redirect is free (no retry token, no backoff, no
+                # breaker hit). Terminates regardless: every iteration
+                # adds the picked backend to `tried`, and pick()
+                # excludes tried backends.
+                failures = 0
+                need_backoff = False
+                while failures <= outer.retries:
                     if deadline is not None and time.time() >= deadline:
                         # the client stopped caring: do not burn a
                         # backend slot (or a retry token) on it
@@ -445,7 +536,8 @@ class RouterServer:
                         outcome["status"] = "deadline"
                         return self._json(504, {
                             "error": "request deadline exceeded"})
-                    if attempt > 0:
+                    if need_backoff:
+                        need_backoff = False
                         if not outer.budget.withdraw():
                             # retry budget exhausted: fail fast rather
                             # than amplify a pool-wide outage
@@ -453,7 +545,7 @@ class RouterServer:
                                 "retry_budget_exhausted_total")
                             break
                         delay = (outer.retry_backoff
-                                 * (2 ** (attempt - 1))
+                                 * (2 ** (failures - 1))
                                  * (1 + outer._jitter.random()))
                         time.sleep(delay)
                     backend = outer.router.pick(pool, affinity,
@@ -462,7 +554,7 @@ class RouterServer:
                         break
                     tried.add(backend.url)
                     outcome["backend"] = backend.url
-                    outcome["retries"] = attempt
+                    outcome["retries"] = failures
                     try:
                         result = self._forward(backend, body, stream,
                                                deadline,
@@ -470,9 +562,20 @@ class RouterServer:
                         outer.router.note_result(backend, ok=True)
                         outcome["status"] = "ok"
                         return result
+                    except _BackendDraining:
+                        # deliberate shutdown, not a fault: take the
+                        # backend out of rotation and move on without
+                        # touching the breaker or the retry budget
+                        outer.router.note_draining(backend)
+                        outer.router.inc("draining_skips_total")
+                        log.info("backend %s draining; redirecting",
+                                 backend.url)
+                        continue
                     except _ClientGone:
                         # the CLIENT went away: nothing to retry, and
-                        # the backend did nothing wrong
+                        # the backend did nothing wrong — but release
+                        # its half-open probe slot if this was a probe
+                        outer.router.probe_aborted(backend)
                         outcome["status"] = "client_gone"
                         return None
                     except _ResponseStarted as e:
@@ -495,6 +598,8 @@ class RouterServer:
                         outer.router.inc("retries_total")
                         log.warning("backend %s failed (%s); retrying",
                                     backend.url, e)
+                        failures += 1
+                        need_backoff = True
                 outer.router.inc("no_backend_total")
                 outcome["status"] = "no_backend"
                 self._json(503, {"error": f"routing failed: {last_err}"},
@@ -533,6 +638,10 @@ class RouterServer:
                 try:
                     resp = urllib.request.urlopen(req, timeout=timeout)
                 except urllib.error.HTTPError as e:
+                    if e.code == 503 and e.headers.get("X-OME-Draining"):
+                        # graceful shutdown announcement, not a fault
+                        e.close()
+                        raise _BackendDraining(backend.url) from e
                     if e.code >= 500:
                         # a 5xx is a BACKEND failure (dead scheduler,
                         # injected fault): close the response and let

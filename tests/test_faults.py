@@ -15,10 +15,23 @@ The recovery contracts under test (docs/failure-semantics.md):
   * the ROUTER trips a backend's circuit breaker after consecutive
     injected failures, routes around it (the health probe alone
     cannot re-admit it), and re-admits it via a half-open probe;
-  * a dropped PD handoff fails ONE request, not the scheduler.
+  * a dropped PD handoff fails ONE request, not the scheduler;
+  * SIGTERM begins a GRACEFUL DRAIN (docs/durability.md): /ready
+    flips 503 with the draining marker while /health stays 200, new
+    admissions answer 503 + Retry-After + X-OME-Draining, in-flight
+    work finishes inside the grace window, and a second signal forces
+    shutdown with the leftovers evicted finish_reason="shutdown";
+  * every `faults.fire(...)` site in the tree is documented in the
+    fault-point catalog (scripts/check_fault_points.py, run here so
+    the lint is tier-1).
 """
 
 import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
 import threading
 import time
 import urllib.error
@@ -30,7 +43,9 @@ import pytest
 
 from ome_tpu import faults
 from ome_tpu.engine.scheduler import (Request, Scheduler,
+                                      SchedulerDraining,
                                       SchedulerOverloaded)
+from ome_tpu.engine.serve import DrainController
 from ome_tpu.engine.server import EngineServer
 from ome_tpu.engine.tokenizer import ByteTokenizer
 from ome_tpu.router.server import (Backend, RetryBudget, Router,
@@ -165,7 +180,7 @@ class TestSchedulerRecovery:
                                      max_new_tokens=50))
             b = sched.submit(Request(prompt_ids=[3, 4],
                                      max_new_tokens=5))
-            assert a.done.wait(30) and a.finish_reason == "error"
+            assert a.done.wait(30) and a.finish_reason == "engine_fault"
             assert b.done.wait(30) and b.finish_reason == "length"
             assert len(b.output_ids) == 5  # fully served post-restart
             assert sched.status == "ok" and sched.healthy
@@ -183,8 +198,8 @@ class TestSchedulerRecovery:
         try:
             a = sched.submit(Request(prompt_ids=[1], max_new_tokens=9))
             b = sched.submit(Request(prompt_ids=[2], max_new_tokens=9))
-            assert a.done.wait(30) and a.finish_reason == "error"
-            assert b.done.wait(30) and b.finish_reason == "error"
+            assert a.done.wait(30) and a.finish_reason == "engine_fault"
+            assert b.done.wait(30) and b.finish_reason == "engine_fault"
             deadline = time.monotonic() + 10
             while sched.status != "dead":
                 assert time.monotonic() < deadline
@@ -238,7 +253,7 @@ class TestSchedulerRecovery:
             code, _, body = _post(base + "/v1/completions",
                                   {"prompt": "hi", "max_tokens": 8})
             assert code == 200
-            assert body["choices"][0]["finish_reason"] == "error"
+            assert body["choices"][0]["finish_reason"] == "engine_fault"
             code, _, body = _post(base + "/v1/completions",
                                   {"prompt": "hi", "max_tokens": 4})
             assert code == 200
@@ -260,7 +275,7 @@ class TestSchedulerRecovery:
             code, _, body = _post(base + "/v1/completions",
                                   {"prompt": "hi", "max_tokens": 8})
             assert code == 200
-            assert body["choices"][0]["finish_reason"] == "error"
+            assert body["choices"][0]["finish_reason"] == "engine_fault"
             deadline = time.monotonic() + 10
             while _get(base + "/health")[0] != 503:
                 assert time.monotonic() < deadline
@@ -594,3 +609,166 @@ def test_pd_dropped_handoff_fails_one_request_not_scheduler():
         assert sched.stats["engine_faults_total"] == 0
     finally:
         sched.stop()
+
+
+# -- graceful drain (docs/durability.md) -----------------------------
+
+
+class TestGracefulDrain:
+    def test_drain_gates_admissions_but_finishes_inflight(self):
+        """begin_drain flips /ready to 503 (with the draining marker)
+        while /health stays 200; new POSTs answer 503 + Retry-After +
+        X-OME-Draining; direct submits raise SchedulerDraining; and
+        the in-flight stream runs to a NORMAL completion."""
+        sched = Scheduler(FakeEngine(max_slots=1, decode_s=0.005))
+        srv = EngineServer(sched, tokenizer=ByteTokenizer(),
+                           model_name="fake")
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            r = sched.submit(Request(prompt_ids=[1],
+                                     max_new_tokens=40))
+            srv.begin_drain()
+            code, body = _get(base + "/ready")
+            assert code == 503 and body["draining"] is True
+            code, body = _get(base + "/health")
+            assert code == 200 and body["draining"] is True  # alive!
+            code, hdrs, body = _post(base + "/v1/completions",
+                                     {"prompt": "hi", "max_tokens": 2})
+            assert code == 503 and body["draining"] is True
+            assert hdrs.get("X-OME-Draining") == "1"
+            assert "Retry-After" in hdrs
+            with pytest.raises(SchedulerDraining):
+                sched.submit(Request(prompt_ids=[2], max_new_tokens=1))
+            assert r.done.wait(30) and r.finish_reason == "length"
+            assert len(r.output_ids) == 40  # stream was NOT cut short
+            deadline = time.monotonic() + 10
+            while not sched.drain_idle():
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+        finally:
+            srv.stop()
+
+    def test_drain_waits_for_request_in_prefill(self):
+        """A request popped from pending but still in prefill sits in
+        no queue and no slot; drain_idle() must still count it (the
+        admission counter covers BOTH admission paths), or the drain
+        declares victory mid-prefill and the stop that follows evicts
+        a request the grace window should have finished."""
+        eng = FakeEngine(max_slots=1)
+        orig = eng.prefill
+
+        def slow(ids, t, k, p):
+            time.sleep(0.4)
+            return orig(ids, t, k, p)
+
+        eng.prefill = slow
+        sched = Scheduler(eng)
+        sched.start()
+        try:
+            r = sched.submit(Request(prompt_ids=[1], max_new_tokens=3))
+            deadline = time.monotonic() + 5
+            while sched.pending.qsize():  # wait for the pop
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            ctl = DrainController(None, sched, grace=10.0,
+                                  poll_interval=0.005)
+            ctl._signalled.set()
+            assert ctl.drain() is True
+            assert r.done.is_set() and r.finish_reason == "length"
+        finally:
+            sched.stop()
+
+    def test_sigterm_triggers_graceful_drain(self):
+        """A real SIGTERM through DrainController.install(): wait()
+        unblocks, the drain completes inside the grace window, and
+        the scheduler is left draining (admissions rejected)."""
+        sched = Scheduler(FakeEngine(max_slots=1, decode_s=0.002))
+        sched.start()
+        ctl = DrainController(None, sched, grace=20.0,
+                              poll_interval=0.005)
+        old_term = signal.getsignal(signal.SIGTERM)
+        old_int = signal.getsignal(signal.SIGINT)
+        try:
+            ctl.install()
+            r = sched.submit(Request(prompt_ids=[1],
+                                     max_new_tokens=20))
+            threading.Timer(
+                0.05, os.kill, (os.getpid(), signal.SIGTERM)).start()
+            assert ctl.wait() is True  # drained inside grace
+            assert ctl.drained
+            assert r.done.is_set() and r.finish_reason == "length"
+            assert sched.draining
+            with pytest.raises(SchedulerDraining):
+                sched.submit(Request(prompt_ids=[2], max_new_tokens=1))
+            assert sched.registry.get("ome_engine_draining") == 1
+        finally:
+            signal.signal(signal.SIGTERM, old_term)
+            signal.signal(signal.SIGINT, old_int)
+            sched.stop()
+
+    def test_second_signal_forces_shutdown_with_work_in_flight(self):
+        """The grace window is 30s but the SECOND signal ends it
+        immediately; the orderly stop that follows evicts the
+        unfinished stream with finish_reason="shutdown" (resumable,
+        were a journal attached)."""
+        sched = Scheduler(FakeEngine(max_slots=1, decode_s=0.05))
+        sched.start()
+        ctl = DrainController(None, sched, grace=30.0,
+                              poll_interval=0.005)
+        r = sched.submit(Request(prompt_ids=[1],
+                                 max_new_tokens=100_000))
+        ctl.handle_signal()  # first: begin drain
+        ctl.handle_signal()  # second: force
+        t0 = time.monotonic()
+        assert ctl.drain() is False
+        assert time.monotonic() - t0 < 5.0  # did NOT sit out the 30s
+        sched.stop()  # serve.main's next move after a forced drain
+        assert r.done.wait(10) and r.finish_reason == "shutdown"
+
+    def test_drain_timeout_fault_point_fires_on_expiry(self):
+        """The drain_timeout harness point fires exactly when the
+        grace window closes with work still in flight (and not on a
+        forced or completed drain — the other tests run with no
+        faults installed and would blow up here if it did)."""
+        faults.install("drain_timeout.raise@1")
+        sched = Scheduler(FakeEngine(max_slots=1, decode_s=0.05))
+        sched.start()
+        r = sched.submit(Request(prompt_ids=[1],
+                                 max_new_tokens=100_000))
+        ctl = DrainController(None, sched, grace=0.05,
+                              poll_interval=0.005)
+        try:
+            with pytest.raises(faults.InjectedFault):
+                ctl.drain()
+        finally:
+            sched.stop()
+        assert r.done.wait(10) and r.finish_reason == "shutdown"
+
+
+# -- fault-point catalog lint ----------------------------------------
+
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+_LINT = _REPO / "scripts" / "check_fault_points.py"
+
+
+class TestFaultPointLint:
+    def test_repo_fault_points_all_documented(self):
+        res = subprocess.run([sys.executable, str(_LINT)],
+                             capture_output=True, text=True,
+                             timeout=120)
+        assert res.returncode == 0, res.stdout + res.stderr
+
+    def test_undocumented_point_fails(self, tmp_path):
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "mod.py").write_text(
+            "from ome_tpu import faults\n"
+            "faults.fire('nonexistent_point')\n")
+        res = subprocess.run(
+            [sys.executable, str(_LINT), str(src),
+             str(_REPO / "docs" / "failure-semantics.md")],
+            capture_output=True, text=True, timeout=120)
+        assert res.returncode == 1
+        assert "nonexistent_point" in res.stdout

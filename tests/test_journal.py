@@ -1,0 +1,511 @@
+"""Durable requests (docs/durability.md): the crash-safe request
+journal and restart resume.
+
+Contracts under test:
+
+  * WAL roundtrip: admit + progress + tombstone records survive a
+    reopen; a resumable finish leaves the entry live, a normal finish
+    tombstones it;
+  * torn-tail tolerance: a crash mid-append is repaired on open (the
+    partial line is truncated, everything before it replays);
+  * compaction: an oversized journal is rewritten atomically without
+    losing live state;
+  * fsync policy: `always` syncs per append, `batch` at the poll
+    interval, `off` never from poll; resumable evictions sync under
+    every policy;
+  * degradation: journal I/O faults (injected) never fail requests;
+    a replay fault fails open — the engine starts empty;
+  * resume: the kill-and-resume acceptance path — a greedy stream
+    interrupted by a fatal engine fault resumes BYTE-IDENTICAL in a
+    fresh scheduler, original deadlines are honored across the
+    restart, and resume composes with spec decoding + paged-KV pool
+    pressure on the real engine.
+"""
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ome_tpu import faults
+from ome_tpu.engine.core import InferenceEngine
+from ome_tpu.engine.journal import (FILENAME, FSYNC_POLICIES,
+                                    RequestJournal)
+from ome_tpu.engine.scheduler import Request, Scheduler
+from ome_tpu.models import config as cfgs
+from ome_tpu.models import llama
+from ome_tpu.telemetry import Registry
+
+from test_pipeline import reference_greedy
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class SeqEngine:
+    """Deterministic position-dependent fake: the token at sequence
+    position L is always 100+L, so a resumed fold (prompt + generated
+    prefix re-prefilled) reproduces the uninterrupted stream exactly —
+    the property the byte-identity tests assert."""
+
+    max_seq = 4096
+
+    def __init__(self, max_slots=1):
+        self.max_slots = max_slots
+        self._pos = np.zeros(max_slots, np.int64)
+
+    def new_state(self):
+        return "s"
+
+    def prefill(self, ids, t, k, p, **kw):
+        return 100 + len(ids), "kv", len(ids), 16
+
+    def insert(self, state, kv, slot, true_len, token, bucket):
+        self._pos[slot] = true_len + 1
+        return state
+
+    def decode(self, state, t, k, p, mask=None):
+        toks = (100 + self._pos).astype(np.int32)
+        self._pos += 1
+        return state, toks
+
+
+def _journal_lines(directory):
+    with open(os.path.join(directory, FILENAME), encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _raw_path(directory):
+    return os.path.join(directory, FILENAME)
+
+
+# -- WAL mechanics ----------------------------------------------------
+
+
+class TestJournalWAL:
+    def test_roundtrip_resumable_vs_tombstone(self, tmp_path):
+        d = str(tmp_path)
+        j = RequestJournal(d, fsync="off")
+        a = Request(prompt_ids=[1, 2, 3], max_new_tokens=8,
+                    temperature=0.5, top_k=4, top_p=0.9,
+                    stop_ids=[42], adapter="lora-x")
+        b = Request(prompt_ids=[9], max_new_tokens=4)
+        j.admit(a)
+        j.admit(b)
+        a.output_ids.extend([7, 8])
+        b.output_ids.append(5)
+        j.poll()  # flushes prog records
+        a.finish_reason = "shutdown"
+        j.finish(a, resumable=True)       # entry stays live
+        b.finish_reason = "stop"
+        j.finish(b)                       # tombstoned
+        j.close()
+
+        j2 = RequestJournal(d)
+        entries = j2.replay()
+        assert len(entries) == 1
+        e = entries[0]
+        assert e.jid == a.journal_id
+        assert e.prompt_ids == [1, 2, 3]
+        assert e.output_ids == [7, 8]
+        assert e.max_new_tokens == 8 and e.temperature == 0.5
+        assert e.top_k == 4 and e.top_p == 0.9
+        assert e.stop_ids == [42] and e.adapter == "lora-x"
+        # jids never collide with journaled ones after a restart
+        assert j2._seq > max(a.journal_id, b.journal_id)
+        j2.close()
+
+    def test_progress_records_are_incremental(self, tmp_path):
+        d = str(tmp_path)
+        j = RequestJournal(d, fsync="off")
+        r = Request(prompt_ids=[1], max_new_tokens=10)
+        j.admit(r)
+        r.output_ids.extend([11, 12])
+        j.poll()
+        r.output_ids.append(13)
+        j.poll()
+        j.poll()  # nothing new: no empty prog record
+        j.close()
+        progs = [rec for rec in _journal_lines(d) if rec["t"] == "prog"]
+        assert [p["toks"] for p in progs] == [[11, 12], [13]]
+
+    def test_torn_tail_repaired_on_open(self, tmp_path):
+        d = str(tmp_path)
+        j = RequestJournal(d, fsync="off")
+        r = Request(prompt_ids=[4, 5], max_new_tokens=6)
+        j.admit(r)
+        r.output_ids.extend([20, 21])
+        j.poll()
+        j.close()
+        # simulate a crash mid-append: a partial record, no newline
+        with open(_raw_path(d), "a", encoding="utf-8") as f:
+            f.write('{"t":"prog","jid":0,"to')
+        torn_size = os.path.getsize(_raw_path(d))
+
+        j2 = RequestJournal(d)
+        entries = j2.replay()
+        assert len(entries) == 1
+        assert entries[0].output_ids == [20, 21]  # pre-tear survives
+        # and the file was repaired in place (tail truncated)
+        assert os.path.getsize(_raw_path(d)) < torn_size
+        # the repaired journal appends cleanly
+        r2 = Request(prompt_ids=[6], max_new_tokens=2)
+        j2.admit(r2)
+        j2.close()
+        assert all(isinstance(rec, dict) for rec in _journal_lines(d))
+
+    def test_mid_file_garbage_skipped(self, tmp_path):
+        d = str(tmp_path)
+        j = RequestJournal(d, fsync="off")
+        a = Request(prompt_ids=[1], max_new_tokens=4)
+        j.admit(a)
+        j.close()
+        with open(_raw_path(d), "a", encoding="utf-8") as f:
+            f.write("NOT JSON AT ALL\n")
+            f.write(json.dumps({"t": "prog", "jid": a.journal_id,
+                                "toks": [33]}) + "\n")
+        j2 = RequestJournal(d)
+        entries = j2.replay()
+        assert len(entries) == 1
+        assert entries[0].output_ids == [33]  # record AFTER garbage
+        j2.close()
+
+    def test_compaction_rewrites_and_preserves_state(self, tmp_path):
+        d = str(tmp_path)
+        j = RequestJournal(d, fsync="off", compact_bytes=600)
+        done = Request(prompt_ids=[1], max_new_tokens=2)
+        live = Request(prompt_ids=[2], max_new_tokens=500)
+        j.admit(done)
+        j.admit(live)
+        done.finish_reason = "length"
+        j.finish(done)
+        for i in range(40):  # many prog records push past the cap
+            live.output_ids.append(1000 + i)
+            j.poll()
+        assert j.compactions >= 1
+        # compacted file: one admit + one consolidated prog per live
+        # entry; the tombstoned request is gone entirely
+        recs = _journal_lines(d)
+        jids = {r["jid"] for r in recs}
+        assert done.journal_id not in jids
+        live_size = os.path.getsize(_raw_path(d))
+        assert live_size <= 600 + 200  # bounded again after rewrite
+        j.close()
+        j2 = RequestJournal(d)
+        entries = j2.replay()
+        assert len(entries) == 1
+        assert entries[0].output_ids == [1000 + i for i in range(40)]
+        j2.close()
+
+    def test_fsync_policy(self, tmp_path, monkeypatch):
+        calls = []
+        monkeypatch.setattr(os, "fsync", lambda fd: calls.append(fd))
+
+        j = RequestJournal(str(tmp_path / "always"), fsync="always")
+        j.admit(Request(prompt_ids=[1], max_new_tokens=2))
+        assert len(calls) == 1            # per-append
+        j.close()
+
+        calls.clear()
+        j = RequestJournal(str(tmp_path / "batch"), fsync="batch",
+                           fsync_interval=0.0)
+        j.admit(Request(prompt_ids=[1], max_new_tokens=2))
+        assert not calls                  # append alone does not sync
+        j.poll()
+        assert len(calls) == 1            # interval elapsed -> sync
+        j.close()
+
+        calls.clear()
+        j = RequestJournal(str(tmp_path / "off"), fsync="off")
+        r = Request(prompt_ids=[1], max_new_tokens=2)
+        j.admit(r)
+        j.poll()
+        assert not calls                  # off: poll never syncs
+        r.finish_reason = "shutdown"
+        j.finish(r, resumable=True)
+        assert len(calls) == 1            # eviction syncs regardless
+        j.close()
+
+    def test_bad_fsync_policy_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            RequestJournal(str(tmp_path), fsync="sometimes")
+        assert "sometimes" not in FSYNC_POLICIES
+
+    def test_append_fault_degrades_not_raises(self, tmp_path):
+        faults.install("journal_append.raise@1")
+        j = RequestJournal(str(tmp_path), fsync="off")
+        reg = Registry()
+        j.bind(reg)
+        r = Request(prompt_ids=[1], max_new_tokens=2)
+        j.admit(r)                        # injected failure: no raise
+        assert j.degraded and j.errors == 1
+        assert reg.get("ome_engine_journal_errors_total") == 1
+        # the journal keeps working after the one-shot fault
+        r2 = Request(prompt_ids=[2], max_new_tokens=2)
+        j.admit(r2)
+        assert j.appends >= 1
+        j.close()
+
+    def test_fsync_fault_degrades(self, tmp_path):
+        faults.install("journal_fsync.raise@1")
+        j = RequestJournal(str(tmp_path), fsync="always")
+        j.admit(Request(prompt_ids=[1], max_new_tokens=2))
+        assert j.degraded and j.errors == 1
+        j.close()
+
+
+# -- scheduler integration: kill and resume ---------------------------
+
+
+def _wait(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+
+
+class TestRestartResume:
+    def test_kill_and_resume_byte_identical(self, tmp_path):
+        """The acceptance path: a fatal engine fault (restart budget
+        0) evicts the in-flight greedy stream resumably; a fresh
+        scheduler over the same journal folds the generated prefix
+        into the prompt and the combined stream is byte-identical to
+        an uninterrupted run."""
+        d = str(tmp_path)
+        # uninterrupted reference on an identical engine
+        ref_sched = Scheduler(SeqEngine(), restart_backoff=0.01)
+        ref_sched.start()
+        ref = ref_sched.submit(Request(prompt_ids=[1, 2, 3],
+                                       max_new_tokens=8))
+        assert ref.done.wait(15) and ref.finish_reason == "length"
+        ref_sched.stop()
+        assert len(ref.output_ids) == 8
+
+        faults.install("engine_step.raise@4")
+        j = RequestJournal(d, fsync="batch", fsync_interval=0.0)
+        sched = Scheduler(SeqEngine(), max_restarts=0, journal=j)
+        sched.start()
+        req = sched.submit(Request(prompt_ids=[1, 2, 3],
+                                   max_new_tokens=8))
+        assert req.done.wait(15)
+        assert req.finish_reason == "engine_fault"
+        _wait(lambda: sched.status == "dead")
+        got_before = list(req.output_ids)
+        assert 0 < len(got_before) < 8   # genuinely interrupted
+        sched.stop()
+        j.close()
+        faults.reset()
+
+        # "new process": fresh scheduler + engine over the same dir
+        j2 = RequestJournal(d)
+        sched2 = Scheduler(SeqEngine(), journal=j2)
+        assert sched2.resume_from_journal() == 1
+        assert j2.replayed == 1
+        resumed = sched2.pending.queue[0]
+        # the preemption fold: prompt grew by the generated prefix,
+        # output_ids pre-seeded so the client stream continues
+        assert resumed.prompt_ids == [1, 2, 3] + got_before
+        assert resumed.output_ids == got_before
+        sched2.start()
+        assert resumed.done.wait(15)
+        assert resumed.finish_reason == "length"
+        sched2.stop()
+        assert resumed.output_ids == ref.output_ids  # byte-identical
+        # completion tombstoned the entry: nothing replays next time
+        j2.close()
+        j3 = RequestJournal(d)
+        assert j3.replay() == []
+        j3.close()
+
+    def test_deadline_honored_across_restart(self, tmp_path):
+        """The journal stores the ABSOLUTE deadline: a request whose
+        deadline passed while the replica was down is shed as timeout
+        at resume, not granted a fresh budget."""
+        d = str(tmp_path)
+        j = RequestJournal(d, fsync="off")
+        live = Request(prompt_ids=[1], max_new_tokens=4,
+                       deadline=time.monotonic() + 60.0)
+        gone = Request(prompt_ids=[2], max_new_tokens=4,
+                       deadline=time.monotonic() + 0.01)
+        j.admit(live)
+        j.admit(gone)
+        for r in (live, gone):
+            r.finish_reason = "shutdown"
+            j.finish(r, resumable=True)
+        j.close()
+        time.sleep(0.05)  # `gone` expires while the replica is down
+
+        j2 = RequestJournal(d)
+        by_jid = {e.jid: e for e in j2.replay()}
+        # epoch round-trips to ~the original monotonic budget
+        back = by_jid[live.journal_id].deadline_epoch - time.time()
+        assert 55.0 < back < 60.5
+        sched = Scheduler(SeqEngine(), journal=j2)
+        sched.resume_from_journal()
+        # expired-on-arrival: shed at submit, before any slot
+        assert sched.stats["timeouts_total"] == 1
+        sched.start()
+        _wait(lambda: sched.drain_idle())
+        sched.stop()
+        j2.close()
+        # the timed-out entry was tombstoned, the live one finished
+        j3 = RequestJournal(d)
+        assert j3.replay() == []
+        j3.close()
+
+    def test_budget_already_spent_finishes_length(self, tmp_path):
+        """An entry whose journaled tokens already reach max_new lost
+        only its tombstone to the crash: resume finishes it `length`
+        without re-admitting."""
+        d = str(tmp_path)
+        j = RequestJournal(d, fsync="off")
+        r = Request(prompt_ids=[1], max_new_tokens=3)
+        j.admit(r)
+        r.output_ids.extend([5, 6, 7])    # full budget generated
+        r.finish_reason = "shutdown"
+        j.finish(r, resumable=True)
+        j.close()
+        j2 = RequestJournal(d)
+        sched = Scheduler(SeqEngine(), journal=j2)
+        assert sched.resume_from_journal() == 0
+        assert sched.pending.qsize() == 0
+        j2.close()
+        j3 = RequestJournal(d)
+        assert j3.replay() == []          # tombstoned by the resume
+        j3.close()
+
+    def test_replay_fault_fails_open(self, tmp_path):
+        d = str(tmp_path)
+        j = RequestJournal(d, fsync="off")
+        r = Request(prompt_ids=[1], max_new_tokens=4)
+        j.admit(r)
+        r.finish_reason = "shutdown"
+        j.finish(r, resumable=True)
+        j.close()
+        faults.install("journal_replay.raise@1")
+        j2 = RequestJournal(d)
+        sched = Scheduler(SeqEngine(), journal=j2)
+        assert sched.resume_from_journal() == 0  # empty, not a crash
+        assert j2.errors == 1
+        j2.close()
+
+    def test_masked_requests_not_journaled(self, tmp_path):
+        """Structured-output requests carry unserializable grammar
+        state: they are never journaled (and so never resumed)."""
+        d = str(tmp_path)
+        j = RequestJournal(d, fsync="off")
+        sched = Scheduler(SeqEngine(), journal=j)
+        masked = sched.submit(Request(prompt_ids=[1], max_new_tokens=4,
+                                      masker=object()))
+        plain = sched.submit(Request(prompt_ids=[2], max_new_tokens=4))
+        assert masked.journal_id is None
+        assert plain.journal_id is not None
+        j.close()
+
+
+# -- real engine: resume composes with spec decode + paged KV ---------
+
+
+@pytest.fixture(scope="module")
+def paged_world():
+    """Undersized paged pool (5 blocks x 16 tokens, 4 slots) so decode
+    growth preempts victims while speculation pre-allocates blocks."""
+    cfg = cfgs.tiny_test().replace(max_seq_len=128)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(params, cfg, max_slots=4,
+                             prefill_buckets=[32], kv_block=16,
+                             kv_blocks=5)
+    return cfg, params, engine
+
+
+class TestResumeComposes:
+    def test_spec_and_paged_kv_resume_byte_identical(self, tmp_path,
+                                                     paged_world):
+        """Kill-and-resume on the REAL engine with spec_tokens>0 and
+        paged-KV pool pressure: every journaled stream completes
+        byte-identical to the uninterrupted greedy reference."""
+        cfg, params, engine = paged_world
+        d = str(tmp_path)
+        plans = [([1, 7, 42, 99, 5, 1, 7, 42, 99], 16),
+                 ([3, 4, 3, 4, 3], 14),
+                 ([2, 3, 4, 5, 6, 7], 12)]
+        want = {tuple(p): reference_greedy(params, cfg, p, n)
+                for p, n in plans}
+
+        faults.install("engine_step.raise@5")
+        j = RequestJournal(d, fsync="batch", fsync_interval=0.0)
+        sched = Scheduler(engine, max_restarts=0, pipeline_depth=1,
+                          spec_tokens=3, journal=j)
+        reqs = [sched.submit(Request(prompt_ids=p, max_new_tokens=n))
+                for p, n in plans]
+        sched.start()
+        for r in reqs:
+            assert r.done.wait(60), r.id
+        _wait(lambda: sched.status == "dead", timeout=30)
+        sched.stop()
+        j.close()
+        faults.reset()
+        interrupted = [r for r in reqs
+                       if r.finish_reason == "engine_fault"]
+        assert interrupted  # the fault caught work mid-stream
+
+        j2 = RequestJournal(d)
+        sched2 = Scheduler(engine, pipeline_depth=1, spec_tokens=3,
+                           journal=j2)
+        entries = {e.jid: e for e in j2.replay()}
+        n = sched2.resume_from_journal()
+        assert n == len(entries) > 0
+        resumed = list(sched2.pending.queue)
+        sched2.start()
+        try:
+            for r in resumed:
+                assert r.done.wait(120), r.id
+                assert r.finish_reason == "length"
+                e = entries[r.journal_id]
+                ref = want[tuple(e.prompt_ids)]
+                # journaled prefix + post-resume tokens == reference
+                assert list(r.output_ids) == ref
+                assert r.output_ids[:len(e.output_ids)] == e.output_ids
+        finally:
+            sched2.stop()
+            j2.close()
+
+
+# -- CLI surface ------------------------------------------------------
+
+
+class TestServeFlags:
+    def test_journal_flags_parse(self):
+        from ome_tpu.engine.serve import build_parser
+        args = build_parser().parse_args(
+            ["--model-dir", "/m", "--random-weights",
+             "--journal", "/var/lib/ome/journal",
+             "--journal-fsync", "always",
+             "--journal-compact-mb", "8",
+             "--drain-grace", "5.5"])
+        assert args.journal == "/var/lib/ome/journal"
+        assert args.journal_fsync == "always"
+        assert args.journal_compact_mb == 8
+        assert args.drain_grace == 5.5
+
+    def test_defaults(self):
+        from ome_tpu.engine.serve import build_parser
+        args = build_parser().parse_args(
+            ["--model-dir", "/m", "--random-weights"])
+        assert args.journal is None
+        assert args.journal_fsync == "batch"
+        assert args.drain_grace == 30.0
+
+    def test_bad_fsync_choice_rejected(self):
+        from ome_tpu.engine.serve import build_parser
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(
+                ["--model-dir", "/m", "--random-weights",
+                 "--journal-fsync", "sometimes"])

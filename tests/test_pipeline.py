@@ -299,7 +299,7 @@ class TestCrashAndDeadline:
             assert b.done.wait(10)
         finally:
             sched.stop()
-        assert a.finish_reason == "error"
+        assert a.finish_reason == "engine_fault"
         assert a.output_ids == [100, 1]  # step 2 dropped unread
         assert b.finish_reason == "length"
         assert b.output_ids == [100, 3, 4]  # post-recovery dispatches

@@ -1,9 +1,14 @@
 """ome-router: policies, health/failover, streaming passthrough —
-including routing over two real in-repo engine servers."""
+including routing over two real in-repo engine servers — plus the
+half-open probe-slot release regression and drain-aware routing
+(docs/failure-semantics.md#draining-backends)."""
 
 import json
+import threading
+import time
 import urllib.error
 import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import jax
 import pytest
@@ -145,6 +150,219 @@ class TestEndToEnd:
             assert ei.value.code == 503
         finally:
             rs.stop()
+
+
+class _DrainStub:
+    """Stub backend with a switchable draining state: /ready answers
+    the engine's drain contract (503 + draining:true), POSTs answer
+    503 + X-OME-Draining while draining, 200 otherwise."""
+
+    def __init__(self, ready_status=200):
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, obj, headers=None):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/ready":
+                    if stub.ready_status == 404:
+                        return self._send(404, {"error": "no route"})
+                    if stub.draining:
+                        return self._send(503, {"ready": False,
+                                                "draining": True})
+                    return self._send(200, {"ready": True,
+                                            "draining": False})
+                return self._send(200, {"status": "ok"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                self.rfile.read(n)
+                if stub.draining:
+                    return self._send(
+                        503, {"error": "replica draining",
+                              "draining": True},
+                        headers={"Retry-After": "2",
+                                 "X-OME-Draining": "1"})
+                stub.hits += 1
+                return self._send(200, {"object": "text_completion",
+                                        "choices": [{"text": "ok"}]})
+
+        self.draining = False
+        self.ready_status = ready_status
+        self.hits = 0
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _post_json(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        with e:
+            return e.code, json.loads(e.read())
+
+
+class TestProbeSlotRelease:
+    """Regression: the half-open probe slot (_probe_inflight) must be
+    released on EVERY probe outcome. It used to leak when the probe
+    request ended via _ClientGone (client disconnected mid-probe):
+    record_success/record_failure never ran, _probe_inflight stayed
+    latched, and the backend was wedged out of rotation forever."""
+
+    def _half_open(self):
+        r = Router([Backend("http://a")], policy="round_robin")
+        b = r.backends[0]
+        b.cb_state = "half_open"
+        return r, b
+
+    def test_abandoned_probe_wedges_without_release(self):
+        r, b = self._half_open()
+        assert r.pick("engine") is b       # the one probe slot...
+        assert b._probe_inflight
+        # ...and with the slot latched, the backend is unpickable —
+        # this is the permanent wedge if no outcome ever lands
+        assert r.pick("engine") is None
+
+    def test_probe_aborted_releases_slot(self):
+        r, b = self._half_open()
+        assert r.pick("engine") is b
+        r.probe_aborted(b)                 # what _route does on
+        assert not b._probe_inflight       # _ClientGone now
+        assert r.pick("engine") is b       # re-testable immediately
+
+    def test_note_draining_releases_slot_too(self):
+        r, b = self._half_open()
+        assert r.pick("engine") is b
+        r.note_draining(b)                 # drain answer during probe
+        assert not b._probe_inflight
+        assert b.draining
+        # draining excludes it from selection — but NOT by the wedge
+        assert r.pick("engine") is None
+        b.draining = False                 # probe saw /ready 200
+        assert r.pick("engine") is b
+
+
+class TestDrainAwareRouting:
+    def test_draining_excluded_from_selection(self):
+        r = Router([Backend("http://a"), Backend("http://b")],
+                   policy="round_robin")
+        r.backends[0].draining = True
+        assert all(r.pick("engine").url == "http://b"
+                   for _ in range(4))
+        assert [x.url for x in r._alive("engine")] == ["http://b"]
+
+    def test_ready_probe_sets_and_clears_draining(self):
+        stub = _DrainStub()
+        try:
+            r = Router([Backend(stub.url)], policy="round_robin")
+            b = r.backends[0]
+            r.check_health_once()
+            assert b.healthy and not b.draining
+            stub.draining = True
+            r.check_health_once()
+            # draining is NOT unhealthy: the replica is finishing
+            # in-flight work and must not be liveness-killed
+            assert b.healthy and b.draining
+            assert r.pick("engine") is None
+            stub.draining = False          # rollback / cancelled drain
+            r.check_health_once()
+            assert b.healthy and not b.draining
+            assert r.pick("engine") is b
+        finally:
+            stub.close()
+
+    def test_ready_404_falls_back_to_health(self):
+        stub = _DrainStub(ready_status=404)  # pre-readiness backend
+        try:
+            r = Router([Backend(stub.url)], policy="round_robin")
+            r.check_health_once()
+            b = r.backends[0]
+            assert b.healthy and not b.draining
+        finally:
+            stub.close()
+
+    def test_mid_request_drain_fails_over_for_free(self):
+        """A 503 + X-OME-Draining answer redirects within the same
+        request WITHOUT a breaker hit or a retry token — retries=0
+        proves the failover consumed no retry budget."""
+        a, b = _DrainStub(), _DrainStub()
+        a.draining = True
+        try:
+            router = Router([Backend(a.url), Backend(b.url)],
+                            policy="round_robin", cb_threshold=1)
+            srv = RouterServer(router, host="127.0.0.1", port=0,
+                               retries=0).start()
+            try:
+                base = f"http://127.0.0.1:{srv.port}"
+                for _ in range(3):
+                    code, body = _post_json(base + "/v1/completions",
+                                            {"prompt": "x"})
+                    assert code == 200
+                assert b.hits == 3 and a.hits == 0
+                ba = next(x for x in router.backends
+                          if x.url == a.url)
+                # deliberate shutdown is not a fault: breaker closed,
+                # zero consecutive-failure count, zero retries spent
+                assert ba.draining
+                assert ba.cb_state == "closed" and ba.fails == 0
+                assert router.stats["draining_skips_total"] == 1
+                assert router.stats["retries_total"] == 0
+                assert router.stats["circuit_open_total"] == 0
+            finally:
+                srv.stop()
+        finally:
+            a.close()
+            b.close()
+
+    def test_gauges_and_health_view_expose_draining(self):
+        stub = _DrainStub()
+        stub.draining = True
+        try:
+            router = Router([Backend(stub.url)], policy="round_robin")
+            router.check_health_once()
+            router.update_gauges()
+            assert router.registry.get(
+                "ome_router_backends_draining") == 1
+            assert router.registry.get(
+                "ome_router_backend_draining",
+                backend=stub.url, pool="engine") == 1
+            srv = RouterServer(router, host="127.0.0.1",
+                               port=0).start()
+            try:
+                base = f"http://127.0.0.1:{srv.port}"
+                with urllib.request.urlopen(base + "/health",
+                                            timeout=30) as resp:
+                    h = json.loads(resp.read())
+                assert h["backends"][0]["draining"] is True
+                assert h["backends"][0]["healthy"] is True
+            finally:
+                srv.stop()
+        finally:
+            stub.close()
 
 
 class TestDiscovery:

@@ -361,7 +361,7 @@ class TestCrashWithSpec:
             assert b.done.wait(10)
         finally:
             sched.stop()
-        assert a.finish_reason == "error"
+        assert a.finish_reason == "engine_fault"
         assert sched.stats["restarts_total"] == 1
         assert sched.stats["spec_steps_total"] >= 2  # pre-crash
         # every emitted token is verified content — never a stale or
@@ -392,7 +392,7 @@ class TestCrashWithSpec:
         assert sched.stats["restarts_total"] == 1
         assert sched.stats["spec_steps_total"] > 0
         reasons = {r.finish_reason for r in reqs}
-        assert "error" in reasons and "length" in reasons
+        assert "engine_fault" in reasons and "length" in reasons
         for r, w in zip(reqs, want):
             if r.finish_reason == "length":
                 assert list(r.output_ids) == w
