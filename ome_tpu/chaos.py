@@ -1,0 +1,996 @@
+"""Chaos soak harness: randomized fault schedules, real invariants.
+
+PRs 1 and 5 built the failure-handling ingredients — deterministic
+fault injection (faults.py), scheduler crash recovery, the durable
+request journal, graceful drain, drain-aware routing, and now the PD
+prefill pool with failover — but each is tested in isolation. This
+module composes them: it stands up a real topology (router + prefill/
+decode/unified engine SUBPROCESSES), drives a mixed workload (greedy +
+temperature sampling, speculative tokens, paged-KV pressure), injects
+a seed-derived schedule of fault points and process-level kills
+(SIGKILL mid-decode, SIGTERM drain, prefill-peer death mid-handoff),
+and then asserts the system-level invariants that individual tests
+cannot:
+
+  1. **No accepted request is lost.** After recovery + journal drain,
+     every journaled admit is tombstoned: the client got an answer,
+     or the respawned process resumed and finished the request.
+  2. **Greedy streams are byte-identical** to a fault-free oracle run
+     of the same (prompt, max_tokens) — failover, restart-resume,
+     preemption, and speculation may not change emitted bytes.
+  3. **KV block-pool conservation** (the PagedAttention discipline):
+     at quiescence, free + slot-owned blocks account for the whole
+     pool (`ome_engine_kv_conservation_ok` — the prefix cache holds
+     separate device buffers, outside the pool by design).
+  4. **/metrics stays consistent**: counters are monotone within one
+     process incarnation, and draining gauges return to zero once the
+     episode's drains complete.
+
+Every schedule derives from ``random.Random(f"{seed}:{episode}")`` —
+a violation prints the seed, the exact schedule, and a one-command
+replay line. The runner REFUSES to start if any fault point it would
+inject is missing from the documented catalog in
+docs/failure-semantics.md (reusing scripts/check_fault_points.py), so
+the harness and the failure-contract docs cannot drift apart.
+
+CLI (also exposed as ``scripts/chaos_soak.py``)::
+
+    python -m ome_tpu.chaos --seed 7 --episodes 50
+    python -m ome_tpu.chaos --seed 7 --episode 23   # replay one
+
+This module imports no jax: the subprocess children re-enter through
+``--serve-child``, which forces the virtual CPU platform in-process
+(the image's sitecustomize pins the TPU backend, so env vars alone
+are not enough) before handing argv to the real entrypoints.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import pathlib
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+CATALOG_DOC = REPO_ROOT / "docs" / "failure-semantics.md"
+
+# fault points the schedule generator may draw from, by role. Kept
+# deliberately clear of journal_* faults: a degraded journal cannot
+# honor invariant 1, so journal durability faults stay in their own
+# unit tests (tests/test_journal.py).
+ENGINE_FAULT_MENU = ("engine_step",)
+PD_FAULT_MENU = ("pd_peer_connect", "pd_fetch", "pd_deserialize",
+                 "pd_insert")
+ROUTER_FAULT_MENU = ("router_forward",)
+
+
+class ChaosError(RuntimeError):
+    """Harness refusal or setup failure (not an invariant violation)."""
+
+
+# -- fault-catalog preflight -----------------------------------------
+
+
+def _load_check_fault_points():
+    path = REPO_ROOT / "scripts" / "check_fault_points.py"
+    spec = importlib.util.spec_from_file_location(
+        "_chaos_check_fault_points", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def preflight_fault_points(specs: Sequence[str],
+                           doc: Optional[pathlib.Path] = None) -> None:
+    """Refuse to run a schedule that injects any fault point absent
+    from the documented catalog — the same source of truth
+    scripts/check_fault_points.py enforces in CI."""
+    from . import faults
+    points = set()
+    for spec in specs:
+        if spec:
+            points |= faults.spec_points(spec)
+    if not points:
+        return
+    cfp = _load_check_fault_points()
+    catalog = cfp.catalog_points(doc or CATALOG_DOC)
+    missing = sorted(points - catalog)
+    if missing:
+        raise ChaosError(
+            "refusing to run: fault point(s) not in the "
+            f"failure-semantics catalog: {', '.join(missing)} "
+            f"(document them in {CATALOG_DOC.name} first)")
+
+
+# -- subprocess management -------------------------------------------
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _http(url: str, payload: Optional[dict] = None,
+          timeout: float = 10.0) -> Tuple[int, object]:
+    """GET (payload None) or POST json; returns (status, parsed body).
+    Raises URLError/OSError on transport failure."""
+    data = None
+    headers = {}
+    if payload is not None:
+        data = json.dumps(payload).encode()
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            status = resp.status
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        status = e.code
+        e.close()
+    try:
+        return status, json.loads(raw)
+    except ValueError:
+        return status, raw
+
+
+class ManagedProc:
+    """One child process (engine or router) the harness can kill,
+    drain, and respawn. `incarnation` increments per start() so
+    metrics samples from different lives are never compared."""
+
+    def __init__(self, name: str, role: str, args: List[str],
+                 port: int, log_path: pathlib.Path):
+        self.name = name
+        self.role = role          # "engine" | "router"
+        self.args = args          # argv AFTER the role token
+        self.port = port
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self.incarnation = 0
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self, faults_spec: Optional[str] = None) -> None:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["OME_CHAOS_CPU"] = "1"
+        env["PYTHONPATH"] = str(REPO_ROOT) + (
+            os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else "")
+        env.pop("OME_FAULTS", None)  # faults only via explicit argv
+        args = list(self.args)
+        if faults_spec:
+            args += ["--faults", faults_spec]
+        cmd = [sys.executable, "-m", "ome_tpu.chaos", "--serve-child",
+               self.role] + args
+        self.incarnation += 1
+        log_fh = open(self.log_path, "a", encoding="utf-8")
+        log_fh.write(f"\n==== incarnation {self.incarnation}: "
+                     f"{' '.join(cmd)}\n")
+        log_fh.flush()
+        self.proc = subprocess.Popen(
+            cmd, cwd=str(REPO_ROOT), env=env, stdout=log_fh,
+            stderr=subprocess.STDOUT, start_new_session=True)
+        log_fh.close()  # the child owns the fd now
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def kill(self) -> None:
+        if self.alive():
+            self.proc.kill()
+            self.proc.wait()
+
+    def term(self) -> None:
+        if self.alive():
+            self.proc.send_signal(signal.SIGTERM)
+
+    def wait_exit(self, timeout: float = 30.0) -> None:
+        if self.proc is None:
+            return
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+
+    def stop(self) -> None:
+        if self.alive():
+            self.term()
+            self.wait_exit(10.0)
+        self.kill()
+
+    def tail(self, n: int = 25) -> str:
+        try:
+            lines = self.log_path.read_text(
+                encoding="utf-8", errors="replace").splitlines()
+            return "\n".join(lines[-n:])
+        except OSError:
+            return "<no log>"
+
+    def wait_ready(self, timeout: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not self.alive():
+                raise ChaosError(
+                    f"{self.name} exited during startup (rc="
+                    f"{self.proc.returncode}); log tail:\n"
+                    f"{self.tail()}")
+            try:
+                status, _ = _http(self.url + "/health", timeout=2.0)
+                if status == 200:
+                    return
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.25)
+        raise ChaosError(f"{self.name} not ready after {timeout}s; "
+                         f"log tail:\n{self.tail()}")
+
+
+def _serve_child(argv: List[str]) -> int:
+    """Re-entry point for harness subprocesses: force the virtual CPU
+    platform IN-PROCESS (sitecustomize pins the TPU backend; env vars
+    don't stick), then hand argv to the real entrypoint."""
+    if not argv:
+        raise SystemExit("--serve-child needs a role: engine|router")
+    role, rest = argv[0], argv[1:]
+    if os.environ.get("OME_CHAOS_CPU"):
+        sys.path.insert(0, str(REPO_ROOT))
+        from __graft_entry__ import _force_cpu_devices
+        _force_cpu_devices(int(os.environ.get("OME_CHAOS_CPU_N", "1")))
+    if role == "engine":
+        from .engine import serve
+        return serve.main(rest)
+    if role == "router":
+        from .router import server
+        return server.main(rest)
+    raise SystemExit(f"unknown --serve-child role {role!r}")
+
+
+# -- metrics scraping ------------------------------------------------
+
+
+def scrape_metrics(url: str, timeout: float = 5.0) -> Dict[str, float]:
+    """Parse a Prometheus text exposition into {'name{labels}': value}."""
+    status, body = _http(url + "/metrics", timeout=timeout)
+    if status != 200:
+        raise ChaosError(f"/metrics answered {status} at {url}")
+    if isinstance(body, bytes):
+        body = body.decode("utf-8", errors="replace")
+    elif not isinstance(body, str):
+        body = json.dumps(body)
+    out: Dict[str, float] = {}
+    for line in body.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        try:
+            out[key] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+class MetricsWatch:
+    """Background /metrics poller asserting counter monotonicity
+    within each process incarnation. Samples that straddle a restart
+    (incarnation changed while scraping) are discarded."""
+
+    def __init__(self, procs: Sequence[ManagedProc],
+                 interval: float = 0.5):
+        self.procs = list(procs)
+        self.interval = interval
+        self.violations: List[str] = []
+        self._last: Dict[Tuple[str, int], Dict[str, float]] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def poll_once(self):
+        for p in self.procs:
+            inc = p.incarnation
+            if not p.alive():
+                continue
+            try:
+                sample = scrape_metrics(p.url, timeout=2.0)
+            except (ChaosError, urllib.error.URLError, OSError):
+                continue
+            if p.incarnation != inc or not p.alive():
+                continue  # straddled a restart: not comparable
+            prev = self._last.get((p.name, inc))
+            if prev is not None:
+                for key, val in sample.items():
+                    name = key.split("{", 1)[0]
+                    if not name.endswith("_total"):
+                        continue
+                    before = prev.get(key)
+                    if before is not None and val < before:
+                        self.violations.append(
+                            f"counter regression on {p.name} "
+                            f"(incarnation {inc}): {key} "
+                            f"{before} -> {val}")
+            self._last[(p.name, inc)] = sample
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.poll_once()
+            self._stop.wait(self.interval)
+
+
+# -- journal inspection ----------------------------------------------
+
+
+def journal_live_entries(path: pathlib.Path) -> Dict[int, dict]:
+    """Admitted-but-untombstoned requests in a journal file; a torn
+    final line (crash mid-append) is skipped, like replay does."""
+    live: Dict[int, dict] = {}
+    if not path.exists():
+        return live
+    for line in path.read_text(encoding="utf-8",
+                               errors="replace").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn tail
+        t, jid = rec.get("t"), rec.get("jid")
+        if t == "admit":
+            live[jid] = rec
+        elif t == "prog" and jid in live:
+            live[jid].setdefault("toks", []).extend(rec.get("toks", []))
+        elif t == "fin":
+            live.pop(jid, None)
+    return live
+
+
+# -- workload --------------------------------------------------------
+
+
+@dataclass
+class ChaosRequest:
+    prompt: str
+    max_tokens: int
+    temperature: float
+    top_k: int = 0
+    top_p: float = 1.0
+    delay: float = 0.0
+    # filled by the client thread:
+    status: Optional[int] = None
+    text: Optional[str] = None
+    finish_reason: Optional[str] = None
+    error: Optional[str] = None
+
+    def payload(self) -> dict:
+        return {"prompt": self.prompt, "max_tokens": self.max_tokens,
+                "temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p}
+
+
+def _gen_workload(rng: random.Random, n: int,
+                  spread: float) -> List[ChaosRequest]:
+    out = []
+    for _ in range(n):
+        prompt = "".join(rng.choice("abcdefgh ") for _ in
+                         range(rng.randint(4, 12)))
+        greedy = rng.random() < 0.6
+        out.append(ChaosRequest(
+            prompt=prompt,
+            max_tokens=rng.randint(6, 20),
+            temperature=0.0 if greedy else rng.choice((0.7, 1.0)),
+            top_k=0 if greedy else rng.choice((0, 20)),
+            top_p=1.0 if greedy else rng.choice((1.0, 0.9)),
+            delay=rng.uniform(0.0, spread)))
+    return out
+
+
+def _drive(url: str, reqs: Sequence[ChaosRequest],
+           timeout: float = 60.0) -> None:
+    """Send every request against `url` on client threads, honoring
+    per-request start delays; blocks until all have an outcome."""
+
+    def one(r: ChaosRequest):
+        time.sleep(r.delay)
+        try:
+            status, body = _http(url + "/v1/completions", r.payload(),
+                                 timeout=timeout)
+            r.status = status
+            if status == 200 and isinstance(body, dict):
+                choice = (body.get("choices") or [{}])[0]
+                r.text = choice.get("text")
+                r.finish_reason = choice.get("finish_reason")
+            else:
+                r.error = str(body)[:200]
+        except Exception as e:  # noqa: BLE001 — a dead proxy/engine
+            r.error = f"{type(e).__name__}: {e}"  # is expected chaos
+
+    threads = [threading.Thread(target=one, args=(r,), daemon=True)
+               for r in reqs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout + 30.0)
+
+
+# -- the episode -----------------------------------------------------
+
+
+@dataclass
+class Topology:
+    """Subprocess layout for one episode."""
+
+    prefill: int = 2
+    decode: int = 2
+    unified: int = 0
+    router: bool = True
+    kv_block: int = 16
+    kv_blocks: int = 40
+    max_slots: int = 2
+    spec_tokens: int = 0
+    pd_local_fallback: bool = False
+    drain_grace: float = 4.0
+
+    def engine_count(self) -> int:
+        return self.prefill + self.decode + self.unified
+
+
+@dataclass
+class Episode:
+    seed: int
+    index: int
+    topo: Topology
+    requests: List[ChaosRequest] = field(default_factory=list)
+    fault_specs: Dict[str, str] = field(default_factory=dict)
+    events: List[Tuple[float, str, str]] = field(default_factory=list)
+    violations: List[str] = field(default_factory=list)
+
+    def schedule(self) -> dict:
+        return {"seed": self.seed, "episode": self.index,
+                "faults": self.fault_specs,
+                "events": [{"at": round(at, 3), "action": act,
+                            "target": tgt}
+                           for at, act, tgt in self.events],
+                "requests": len(self.requests)}
+
+    def replay_command(self) -> str:
+        return (f"python scripts/chaos_soak.py --seed {self.seed} "
+                f"--episode {self.index}")
+
+
+def _plan_episode(seed: int, index: int, topo: Topology, n_requests: int,
+                  spread: float) -> Episode:
+    """Everything random in an episode comes from this ONE generator
+    seeded by (seed, index) — the whole schedule replays from the two
+    numbers a violation prints."""
+    rng = random.Random(f"{seed}:{index}")
+    ep = Episode(seed=seed, index=index, topo=topo)
+    ep.requests = _gen_workload(rng, n_requests, spread)
+
+    decode_names = [f"decode{i}" for i in range(topo.decode)]
+    unified_names = [f"unified{i}" for i in range(topo.unified)]
+    prefill_names = [f"prefill{i}" for i in range(topo.prefill)]
+
+    # fault-point schedules: at most one rule per serving proc so an
+    # episode stays interpretable; hits land in the episode's early
+    # request volume
+    for name in decode_names:
+        if rng.random() < 0.7:
+            point = rng.choice(PD_FAULT_MENU + ENGINE_FAULT_MENU)
+            ep.fault_specs[name] = \
+                f"{point}.raise@{rng.randint(1, 4)}"
+    for name in unified_names:
+        if rng.random() < 0.5:
+            ep.fault_specs[name] = \
+                f"engine_step.raise@{rng.randint(2, 6)}"
+    if topo.router and rng.random() < 0.3:
+        ep.fault_specs["router"] = \
+            f"router_forward.raise@{rng.randint(1, 3)}"
+
+    # process-level events: kills and drains at seeded offsets
+    serving = decode_names + unified_names
+    n_events = rng.randint(0, 2) if serving else 0
+    for _ in range(n_events):
+        action = rng.choice(("sigkill", "sigterm"))
+        ep.events.append((rng.uniform(0.5, spread),
+                          action, rng.choice(serving)))
+    if prefill_names and rng.random() < 0.6:
+        # prefill-peer death mid-handoff: the decode pool must fail
+        # over (or fall back locally) without a scheduler restart
+        ep.events.append((rng.uniform(0.2, spread * 0.7),
+                          "kill_prefill", rng.choice(prefill_names)))
+    ep.events.sort(key=lambda e: e[0])
+    return ep
+
+
+class ChaosRunner:
+    """Owns the topology's processes and the per-soak oracle engine;
+    runs episodes and evaluates invariants."""
+
+    def __init__(self, topo: Topology, base_dir: pathlib.Path,
+                 model_dir: Optional[str] = None,
+                 keep_logs: bool = False,
+                 journal_drain_timeout: float = 90.0):
+        self.topo = topo
+        self.base = base_dir
+        self.base.mkdir(parents=True, exist_ok=True)
+        self.keep_logs = keep_logs
+        self.journal_drain_timeout = journal_drain_timeout
+        # empty model dir + --random-weights = the deterministic
+        # tiny_test config with ByteTokenizer: every engine in the
+        # topology (and the oracle) inits IDENTICAL weights from
+        # PRNGKey(0), which is what makes invariant 2 meaningful
+        self.model_dir = model_dir or str(self._ensure_model_dir())
+        self.oracle: Optional[ManagedProc] = None
+        self._oracle_cache: Dict[Tuple[str, int], Tuple[str, str]] = {}
+
+    def _ensure_model_dir(self) -> pathlib.Path:
+        d = self.base / "model"
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    # -- oracle ------------------------------------------------------
+
+    def _engine_args(self, port: int, topo: Topology,
+                     journal_dir: Optional[pathlib.Path] = None,
+                     role: Optional[str] = None,
+                     prefill_urls: Sequence[str] = (),
+                     reqlog: Optional[pathlib.Path] = None
+                     ) -> List[str]:
+        args = ["--model-dir", self.model_dir, "--random-weights",
+                "--dtype", "float32", "--host", "127.0.0.1",
+                "--port", str(port),
+                "--max-slots", str(topo.max_slots),
+                "--prefix-cache-mb", "8",
+                "--drain-grace", str(topo.drain_grace)]
+        if topo.kv_block:
+            args += ["--kv-block", str(topo.kv_block),
+                     "--kv-blocks", str(topo.kv_blocks)]
+        if topo.spec_tokens and role != "prefill":
+            args += ["--spec-tokens", str(topo.spec_tokens)]
+        if role == "prefill":
+            args += ["--disaggregation-mode", "prefill"]
+        elif role == "decode":
+            args += ["--disaggregation-mode", "decode",
+                     "--pd-attempt-timeout", "15"]
+            for u in prefill_urls:
+                args += ["--prefill-url", u]
+            if topo.pd_local_fallback:
+                args += ["--pd-local-fallback"]
+        if journal_dir is not None:
+            args += ["--journal", str(journal_dir),
+                     "--journal-fsync", "always"]
+        if reqlog is not None:
+            args += ["--request-log", str(reqlog)]
+        return args
+
+    def start_oracle(self) -> ManagedProc:
+        """One fault-free unified engine, alive for the whole soak:
+        the reference every greedy response is byte-compared against."""
+        if self.oracle is not None and self.oracle.alive():
+            return self.oracle
+        port = free_port()
+        topo = Topology(prefill=0, decode=0, unified=1, router=False,
+                        kv_block=self.topo.kv_block,
+                        kv_blocks=max(self.topo.kv_blocks, 64),
+                        max_slots=self.topo.max_slots,
+                        spec_tokens=0)
+        self.oracle = ManagedProc(
+            "oracle", "engine",
+            self._engine_args(port, topo), port,
+            self.base / "oracle.log")
+        self.oracle.start()
+        self.oracle.wait_ready()
+        return self.oracle
+
+    def oracle_text(self, prompt: str, max_tokens: int
+                    ) -> Tuple[str, str]:
+        key = (prompt, max_tokens)
+        if key not in self._oracle_cache:
+            oracle = self.start_oracle()
+            status, body = _http(
+                oracle.url + "/v1/completions",
+                {"prompt": prompt, "max_tokens": max_tokens,
+                 "temperature": 0.0}, timeout=60.0)
+            if status != 200 or not isinstance(body, dict):
+                raise ChaosError(
+                    f"oracle answered {status}: {str(body)[:200]}")
+            choice = body["choices"][0]
+            self._oracle_cache[key] = (choice.get("text"),
+                                       choice.get("finish_reason"))
+        return self._oracle_cache[key]
+
+    def close(self):
+        if self.oracle is not None:
+            self.oracle.stop()
+
+    # -- one episode -------------------------------------------------
+
+    def run_episode(self, ep: Episode) -> Episode:
+        preflight_fault_points(list(ep.fault_specs.values()))
+        topo = ep.topo
+        epdir = self.base / f"ep{ep.index}"
+        epdir.mkdir(parents=True, exist_ok=True)
+
+        prefills = []
+        for i in range(topo.prefill):
+            port = free_port()
+            name = f"prefill{i}"
+            prefills.append(ManagedProc(
+                name, "engine",
+                self._engine_args(port, topo, role="prefill"),
+                port, epdir / f"{name}.log"))
+        prefill_urls = [p.url for p in prefills]
+
+        serving = []
+        journals: Dict[str, pathlib.Path] = {}
+        for i in range(topo.decode):
+            port = free_port()
+            name = f"decode{i}"
+            jdir = epdir / f"journal-{name}"
+            journals[name] = jdir / "requests.jsonl"
+            serving.append(ManagedProc(
+                name, "engine",
+                self._engine_args(port, topo, journal_dir=jdir,
+                                  role="decode",
+                                  prefill_urls=prefill_urls,
+                                  reqlog=epdir / f"{name}.reqlog"),
+                port, epdir / f"{name}.log"))
+        for i in range(topo.unified):
+            port = free_port()
+            name = f"unified{i}"
+            jdir = epdir / f"journal-{name}"
+            journals[name] = jdir / "requests.jsonl"
+            serving.append(ManagedProc(
+                name, "engine",
+                self._engine_args(port, topo, journal_dir=jdir,
+                                  reqlog=epdir / f"{name}.reqlog"),
+                port, epdir / f"{name}.log"))
+
+        router = None
+        if topo.router:
+            rport = free_port()
+            rargs = ["--bind", "127.0.0.1", "--port", str(rport),
+                     "--policy", "round_robin",
+                     "--health-interval", "1.0"]
+            for s in serving:
+                rargs += ["--backend", s.url]
+            router = ManagedProc("router", "router", rargs, rport,
+                                 epdir / "router.log")
+
+        procs = prefills + serving + ([router] if router else [])
+        by_name = {p.name: p for p in procs}
+        watch = None
+        try:
+            for p in prefills + serving:
+                p.start(ep.fault_specs.get(p.name))
+            for p in prefills + serving:
+                p.wait_ready()
+            if router:
+                router.start(ep.fault_specs.get("router"))
+                router.wait_ready()
+
+            watch = MetricsWatch(procs).start()
+            front = (router or serving[0]).url
+
+            # workload client threads + the kill/term schedule run
+            # concurrently — that's the "mid-handoff" in the ISSUE
+            driver = threading.Thread(
+                target=_drive, args=(front, ep.requests), daemon=True)
+            t0 = time.monotonic()
+            driver.start()
+            killed: List[ManagedProc] = []
+            for at, action, target in ep.events:
+                delay = t0 + at - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                victim = by_name.get(target)
+                if victim is None or not victim.alive():
+                    continue
+                if action == "sigkill" or action == "kill_prefill":
+                    victim.kill()
+                else:
+                    victim.term()
+                    victim.wait_exit(topo.drain_grace + 20.0)
+                killed.append(victim)
+            driver.join(180.0)
+
+            # recovery: every killed/drained proc respawns FAULT-FREE
+            # (the schedule already fired; replay must re-run it, not
+            # the respawn), then resumes its journal
+            for victim in killed:
+                victim.wait_exit(5.0)
+                victim.start()
+            for victim in killed:
+                victim.wait_ready()
+
+            self._await_journal_drain(ep, journals, by_name)
+            self._check_journals(ep, journals)
+            self._check_greedy(ep)
+            self._check_kv_conservation(ep, serving)
+            self._check_draining_zero(ep, router)
+            watch.stop()
+            watch.poll_once()
+            ep.violations.extend(watch.violations)
+            watch = None
+        finally:
+            if watch is not None:
+                watch.stop()
+            for p in procs:
+                p.stop()
+        return ep
+
+    # -- invariants --------------------------------------------------
+
+    def _await_journal_drain(self, ep: Episode,
+                             journals: Dict[str, pathlib.Path],
+                             by_name: Dict[str, ManagedProc]) -> None:
+        deadline = time.monotonic() + self.journal_drain_timeout
+        while time.monotonic() < deadline:
+            leftover = {name: journal_live_entries(path)
+                        for name, path in journals.items()}
+            if not any(leftover.values()):
+                return
+            # a proc that crashed OUTSIDE the schedule (startup race,
+            # OOM) would wedge this wait — surface it instead
+            for name in leftover:
+                p = by_name.get(name)
+                if p is not None and not p.alive():
+                    ep.violations.append(
+                        f"{name} died outside the schedule with "
+                        f"{len(leftover[name])} journaled request(s) "
+                        f"unresumed; log tail:\n{p.tail()}")
+                    return
+            time.sleep(0.5)
+        # timed out: _check_journals reports the specifics
+
+    def _check_journals(self, ep: Episode,
+                        journals: Dict[str, pathlib.Path]) -> None:
+        """Invariant 1: journal ⊕ responses cover all admits — after
+        recovery + resume, no admit record is left untombstoned."""
+        for name, path in journals.items():
+            live = journal_live_entries(path)
+            if live:
+                ep.violations.append(
+                    f"request-loss: {name} journal has "
+                    f"{len(live)} admitted request(s) never finished "
+                    f"(jids {sorted(live)[:8]})")
+
+    def _check_greedy(self, ep: Episode) -> None:
+        """Invariant 2: greedy completions match the fault-free
+        oracle byte-for-byte. Only cleanly finished responses compare
+        — errored/timed-out/shutdown requests are covered by the
+        journal invariant instead."""
+        for r in ep.requests:
+            if r.temperature != 0.0 or r.status != 200:
+                continue
+            if r.finish_reason not in ("stop", "length"):
+                continue
+            want_text, want_fin = self.oracle_text(r.prompt,
+                                                   r.max_tokens)
+            if r.text != want_text or r.finish_reason != want_fin:
+                ep.violations.append(
+                    "greedy divergence: prompt "
+                    f"{r.prompt!r} max_tokens={r.max_tokens}: got "
+                    f"{r.text!r} ({r.finish_reason}), oracle "
+                    f"{want_text!r} ({want_fin})")
+
+    def _check_kv_conservation(self, ep: Episode,
+                               serving: Sequence[ManagedProc]) -> None:
+        """Invariant 3: at quiescence every paged pool conserves
+        blocks (free + owned = total − trash block); the gauge is
+        computed per scrape by Scheduler.update_gauges."""
+        if not ep.topo.kv_block:
+            return
+        for p in serving:
+            if not p.alive():
+                continue
+            try:
+                sample = scrape_metrics(p.url)
+            except (ChaosError, urllib.error.URLError, OSError) as e:
+                ep.violations.append(
+                    f"kv-conservation: cannot scrape {p.name}: {e}")
+                continue
+            ok = sample.get("ome_engine_kv_conservation_ok")
+            if ok is not None and ok != 1.0:
+                ep.violations.append(
+                    f"kv-conservation violated on {p.name}: free="
+                    f"{sample.get('ome_engine_kv_blocks_free')} "
+                    f"owned={sample.get('ome_engine_kv_blocks_owned')}")
+
+    def _check_draining_zero(self, ep: Episode,
+                             router: Optional[ManagedProc]) -> None:
+        """Invariant 4b: once the episode's drains finish, the
+        router's draining gauges return to zero (the health loop
+        re-probes at --health-interval)."""
+        if router is None or not router.alive():
+            return
+        deadline = time.monotonic() + 15.0
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                sample = scrape_metrics(router.url)
+            except (ChaosError, urllib.error.URLError, OSError):
+                return
+            last = sample.get("ome_router_backends_draining", 0.0)
+            if not last:
+                return
+            time.sleep(1.0)
+        ep.violations.append(
+            f"draining gauge stuck: ome_router_backends_draining="
+            f"{last} after episode end")
+
+
+# -- soak entry ------------------------------------------------------
+
+
+def run_soak(seed: int, episodes: Sequence[int], topo: Topology,
+             base_dir: pathlib.Path, n_requests: int, spread: float,
+             keep_logs: bool = False,
+             journal_drain_timeout: float = 90.0) -> int:
+    from .telemetry import Registry
+    registry = Registry()
+    c_episodes = registry.counter("ome_chaos_episodes_total",
+                                  "Chaos episodes completed")
+    c_requests = registry.counter("ome_chaos_requests_total",
+                                  "Chaos workload requests driven")
+    c_violations = registry.counter(
+        "ome_chaos_invariant_failures_total",
+        "Invariant violations detected across the soak")
+    runner = ChaosRunner(topo, base_dir, keep_logs=keep_logs,
+                         journal_drain_timeout=journal_drain_timeout)
+    failed = []
+    try:
+        for index in episodes:
+            ep = _plan_episode(seed, index, topo, n_requests, spread)
+            print(f"[chaos] episode {index}: "
+                  f"{len(ep.requests)} requests, faults="
+                  f"{ep.fault_specs or '{}'}, events="
+                  f"{[(round(a, 2), b, c) for a, b, c in ep.events]}",
+                  flush=True)
+            runner.run_episode(ep)
+            c_episodes.inc()
+            c_requests.inc(len(ep.requests))
+            if ep.violations:
+                c_violations.inc(len(ep.violations))
+                failed.append(ep)
+                print(f"[chaos] EPISODE {index} FAILED "
+                      f"({len(ep.violations)} violation(s)):",
+                      flush=True)
+                for v in ep.violations:
+                    print(f"  - {v}", flush=True)
+                print("[chaos] schedule: "
+                      + json.dumps(ep.schedule()), flush=True)
+                print(f"[chaos] replay: {ep.replay_command()}",
+                      flush=True)
+            else:
+                print(f"[chaos] episode {index} OK", flush=True)
+    finally:
+        runner.close()
+    total = len(list(episodes))
+    print(f"[chaos] soak done: {total - len(failed)}/{total} episodes "
+          f"clean, {int(c_violations.value)} violation(s)", flush=True)
+    if failed:
+        print("[chaos] replay failing episodes with:", flush=True)
+        for ep in failed:
+            print(f"  {ep.replay_command()}", flush=True)
+    return 1 if failed else 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="chaos_soak",
+        description="Seed-replayable chaos soak over a router + "
+                    "prefill/decode/unified engine topology with "
+                    "invariant checking (docs/README.md). Subprocess "
+                    "re-entry: --serve-child {engine,router} ARGS...")
+    p.add_argument("--seed", type=int, default=0,
+                   help="schedule seed; a violation's printed "
+                        "(seed, episode) pair replays exactly")
+    p.add_argument("--episodes", type=int, default=5,
+                   help="number of episodes (0..N-1) to run")
+    p.add_argument("--episode", type=int, default=None,
+                   help="run exactly ONE episode index (replay mode)")
+    p.add_argument("--prefill", type=int, default=2,
+                   help="prefill engines in the PD pool")
+    p.add_argument("--decode", type=int, default=2,
+                   help="PD decode engines behind the router")
+    p.add_argument("--unified", type=int, default=0,
+                   help="monolithic (non-PD) engines behind the router")
+    p.add_argument("--no-router", action="store_true",
+                   help="drive the first serving engine directly")
+    p.add_argument("--requests", type=int, default=10,
+                   help="workload requests per episode")
+    p.add_argument("--spread", type=float, default=4.0,
+                   help="seconds the workload (and fault events) are "
+                        "spread over")
+    p.add_argument("--kv-block", type=int, default=16,
+                   help="paged-KV block size for the engines (0 = "
+                        "dense; disables the conservation invariant)")
+    p.add_argument("--kv-blocks", type=int, default=40,
+                   help="paged-KV pool size (small = pool pressure)")
+    p.add_argument("--max-slots", type=int, default=2)
+    p.add_argument("--spec-tokens", type=int, default=0,
+                   help="speculative draft tokens on decode/unified "
+                        "engines (greedy stays byte-identical)")
+    p.add_argument("--pd-local-fallback", action="store_true",
+                   help="decode engines compute prefill locally when "
+                        "the whole prefill pool is down")
+    p.add_argument("--drain-grace", type=float, default=4.0)
+    p.add_argument("--journal-drain-timeout", type=float, default=90.0,
+                   help="seconds to wait after recovery for resumed "
+                        "requests to tombstone their journal entries")
+    p.add_argument("--base-dir", default=None,
+                   help="scratch directory for logs/journals "
+                        "(default: a fresh temp dir)")
+    p.add_argument("--keep-logs", action="store_true",
+                   help="do not delete the scratch directory")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--serve-child":
+        return _serve_child(argv[1:])
+    args = build_parser().parse_args(argv)
+    topo = Topology(prefill=args.prefill, decode=args.decode,
+                    unified=args.unified, router=not args.no_router,
+                    kv_block=args.kv_block, kv_blocks=args.kv_blocks,
+                    max_slots=args.max_slots,
+                    spec_tokens=args.spec_tokens,
+                    pd_local_fallback=args.pd_local_fallback,
+                    drain_grace=args.drain_grace)
+    if topo.engine_count() == 0:
+        build_parser().error("topology has no serving engines")
+    if topo.decode and not topo.prefill:
+        build_parser().error("--decode engines need a --prefill pool "
+                             "(or use --unified engines)")
+    if args.base_dir:
+        base = pathlib.Path(args.base_dir)
+        cleanup = False
+    else:
+        import tempfile
+        base = pathlib.Path(tempfile.mkdtemp(prefix="ome-chaos-"))
+        cleanup = not args.keep_logs
+    episodes = ([args.episode] if args.episode is not None
+                else list(range(args.episodes)))
+    try:
+        rc = run_soak(args.seed, episodes, topo, base,
+                      n_requests=args.requests, spread=args.spread,
+                      keep_logs=args.keep_logs,
+                      journal_drain_timeout=args.journal_drain_timeout)
+    finally:
+        if cleanup:
+            import shutil
+            shutil.rmtree(base, ignore_errors=True)
+        else:
+            print(f"[chaos] logs kept under {base}", flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

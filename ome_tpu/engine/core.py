@@ -699,6 +699,32 @@ class InferenceEngine:
                                               ())),
                 "kv_block_tokens": self.kv_block}
 
+    def kv_conservation(self) -> Tuple[bool, int]:
+        """Block-pool conservation check (the PagedAttention
+        discipline): free + owned must account for every allocatable
+        block (kv_blocks − 1; block 0 is the reserved trash block), no
+        block may appear twice, block 0 may never be owned, and the
+        device block table must mirror the host owned lists. Returns
+        (ok, owned_count). Authoritative at quiescence — the chaos
+        harness asserts it between episodes; a concurrent insert can
+        make a mid-step scrape read False transiently."""
+        if not self.kv_block:
+            return True, 0
+        free = list(self._free_blocks)
+        owned_all: List[int] = []
+        for slot in range(self.max_slots):
+            owned = [int(b) for b in self._owned[slot]]
+            owned_all.extend(owned)
+            row = [int(x) for x in
+                   np.asarray(self._table[slot, :len(owned)])]
+            if row != owned:
+                return False, len(owned_all)
+        blocks = [int(b) for b in free] + owned_all
+        ok = (len(blocks) == self.kv_blocks - 1
+              and len(set(blocks)) == len(blocks)
+              and 0 not in blocks)
+        return ok, len(owned_all)
+
     # -- multi-LoRA registry -------------------------------------------
 
     @property

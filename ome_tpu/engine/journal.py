@@ -66,6 +66,12 @@ class JournalEntry:
     deadline_epoch: Optional[float] = None
     trace_id: Optional[str] = None
     output_ids: List[int] = field(default_factory=list)
+    # PD provenance stamped by the serving node (e.g. {"mode":
+    # "pd-decode", "peers": [...]}): records that this request's
+    # prefill came over the PD handoff, so a resumed process knows the
+    # replay must re-prefill through its prefill pool (or local
+    # fallback) rather than assume local compute produced the KV
+    pd: Optional[dict] = None
 
 
 class _Live:
@@ -85,11 +91,15 @@ class RequestJournal:
 
     def __init__(self, directory: str, fsync: str = "batch",
                  fsync_interval: float = 0.1,
-                 compact_bytes: int = 4 << 20):
+                 compact_bytes: int = 4 << 20,
+                 provenance: Optional[dict] = None):
         if fsync not in FSYNC_POLICIES:
             raise ValueError(
                 f"journal fsync policy {fsync!r} not in "
                 f"{FSYNC_POLICIES}")
+        # stamped into every admit record (see JournalEntry.pd); the
+        # PD decode role passes its pool topology here
+        self.provenance = provenance
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, FILENAME)
         self.fsync = fsync
@@ -227,7 +237,8 @@ class RequestJournal:
                     adapter=rec.get("adapter"),
                     deadline_epoch=rec.get("deadline"),
                     trace_id=rec.get("trace"),
-                    output_ids=[int(t) for t in rec.get("toks", [])]))
+                    output_ids=[int(t) for t in rec.get("toks", [])],
+                    pd=rec.get("pd")))
         return out
 
     def note_replayed(self, n: int):
@@ -306,6 +317,8 @@ class RequestJournal:
                    "adapter": req.adapter,
                    "deadline": deadline_epoch,
                    "trace": getattr(req.trace, "trace_id", None)}
+            if self.provenance is not None:
+                rec["pd"] = self.provenance
             self._append(rec)
             rec = dict(rec)
             rec["toks"] = []

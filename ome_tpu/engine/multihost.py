@@ -218,7 +218,7 @@ class ReplicatedEngine:
 
     def prefill(self, prompt_ids, temperature: float = 0.0,
                 top_k: int = 0, top_p: float = 1.0, first_mask=None,
-                adapter=None):
+                adapter=None, deadline=None, trace=None):
         from .structured import pack_mask
         kw = {}
         if first_mask is not None:
@@ -231,8 +231,16 @@ class ReplicatedEngine:
                 # PD decode group: the leader fetches the KV wire blob
                 # ONCE and ships the bytes to followers — a follower
                 # re-fetching could draw a different sampled token on
-                # the prefill node (its RNG advances per request)
+                # the prefill node (its RNG advances per request).
+                # deadline/trace stay leader-side: followers only see
+                # the replicated bytes, never the network. Forwarded
+                # only when set, so blob providers predating the pool
+                # (no deadline/trace kwargs) keep working.
                 import base64
+                if deadline is not None:
+                    kw["deadline"] = deadline
+                if trace is not None:
+                    kw["trace"] = trace
                 blob = blob_fn(prompt_ids, temperature, top_k, top_p,
                                **kw)
                 self._pub.send({"op": "prefill_blob",

@@ -23,16 +23,29 @@ is transport-agnostic for a future device-to-device path.
 
 Sampling stays correct across the split: temperature-0 decode is
 key-independent, and sampled prefill draws its key on the prefill node
-— the decode node never re-draws for the prompt token.
+— the decode node never re-draws for the prompt token. A failed fetch
+retried against ANOTHER peer re-draws there for temperature > 0 — the
+streams are distributionally identical, and greedy stays byte-exact.
+
+Failure semantics (docs/pd-disaggregation.md): the decode node holds a
+POOL of prefill peers, each tracked with the router's circuit-breaker
+/ draining discipline (router/server.py Backend — one readiness
+contract across every pool in the system). A failed fetch retries
+against the next healthy peer with a per-attempt timeout capped by the
+request's own deadline; when every peer is out, an optional local
+fallback computes the prefill on the decode engine itself. All of it
+is per-request: the scheduler never restarts for a peer's death.
 """
 
 from __future__ import annotations
 
 import json
 import struct
+import threading
+import time
 import urllib.error
 import urllib.request
-from typing import Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -108,24 +121,156 @@ def deserialize_kv(data: bytes) -> Tuple[int, np.ndarray, np.ndarray,
     return header["token"], k, v, header["true_len"], header["bucket"]
 
 
+class PrefillPool:
+    """Health-tracked prefill peers, reusing the router's Backend
+    state machine verbatim (circuit breaker closed→open→half_open with
+    exponential cooldown; `draining` as a deliberate, non-failure exit
+    from rotation) so PD failover and router failover obey one
+    discipline.
+
+    Thread-safe: the scheduler's admission thread and synchronous
+    step() callers both pick peers; multi-host leaders fetch under the
+    op lock but the gauge reads race freely."""
+
+    def __init__(self, urls: Sequence[str], cb_threshold: int = 2,
+                 cb_cooldown: float = 0.5,
+                 cb_max_cooldown: float = 15.0):
+        from ..router.server import Backend
+        if not urls:
+            raise ValueError("PrefillPool needs at least one peer URL")
+        seen = []
+        for u in urls:
+            u = u.rstrip("/")
+            if u not in seen:
+                seen.append(u)
+        self.peers = [Backend(u, pool="prefill",
+                              cb_threshold=cb_threshold,
+                              cb_cooldown=cb_cooldown,
+                              cb_max_cooldown=cb_max_cooldown)
+                      for u in seen]
+        self._lock = threading.Lock()
+        self._next = 0
+
+    @property
+    def urls(self) -> List[str]:
+        return [p.url for p in self.peers]
+
+    def healthy_count(self) -> int:
+        now = time.monotonic()
+        with self._lock:
+            return sum(1 for p in self.peers if p.selectable(now))
+
+    def pick(self, exclude: Sequence[str] = ()):
+        """Next selectable peer round-robin, or None when the whole
+        pool is out of rotation. A half-open peer claims its single
+        probe slot here — the data-path attempt IS the probe."""
+        now = time.monotonic()
+        with self._lock:
+            n = len(self.peers)
+            for i in range(n):
+                p = self.peers[(self._next + i) % n]
+                if p.url in exclude or not p.selectable(now):
+                    continue
+                self._next = (self._next + i + 1) % n
+                if p.cb_state == "half_open":
+                    p._probe_inflight = True
+                return p
+        return None
+
+    def note_success(self, peer):
+        with self._lock:
+            peer.record_success()
+
+    def note_failure(self, peer):
+        with self._lock:
+            peer.record_failure(time.monotonic())
+            peer.healthy = False
+
+    def note_draining(self, peer):
+        """503 + X-OME-Draining from a peer: a deliberate exit, not a
+        fault — no breaker charge, and the probe slot is released so
+        the drain cannot wedge the breaker (router discipline)."""
+        with self._lock:
+            peer.draining = True
+            peer._probe_inflight = False
+
+    def reprobe(self):
+        """Synchronous /ready sweep over every out-of-rotation peer —
+        run when pick() comes up empty, so a recovered process or a
+        cancelled drain re-enters the pool before a request gives up
+        on it. A ready answer ends an open breaker's cooldown early
+        (the next data-path attempt is still the half-open probe that
+        decides); it never closes the breaker outright."""
+        from ..router.server import probe_backend
+        now = time.monotonic()
+        for p in self.peers:
+            with self._lock:
+                if p.selectable(now):
+                    continue
+            healthy, draining = probe_backend(p.url, timeout=2.0)
+            with self._lock:
+                p.draining = draining
+                if healthy and not draining:
+                    p.healthy = True
+                    if p.cb_state == "open":
+                        p.cb_open_until = now
+                    p._probe_inflight = False
+
+
 class RemotePrefillEngine:
     """Engine facade for PD decode nodes: prefill() fetches KV from the
     prefill pool; insert/decode run on the local engine untouched.
 
     Scheduler-compatible drop-in — with overlap mode the remote fetch
     happens on the admission thread, so the decode cadence never waits
-    on the network.
+    on the network, and a fetch retrying across the pool stalls ONE
+    admission, never the decode loop.
     """
 
     # network/peer faults fail ONE request, not the scheduler
     # (engine/scheduler.py admission-thread contract)
     transient_prefill_errors = (PDError, urllib.error.URLError,
                                 TimeoutError, OSError)
+    # the scheduler passes deadline=/trace= into prefill() so the
+    # fetch can cap per-attempt timeouts and correlate reqlog records
+    pd_request_context = True
 
-    def __init__(self, engine, peer_url: str, timeout: float = 120.0):
+    def __init__(self, engine, peer_url: Optional[str] = None,
+                 timeout: float = 120.0, *,
+                 peer_urls: Sequence[str] = (),
+                 local_fallback: bool = False,
+                 max_attempts: Optional[int] = None,
+                 request_log=None,
+                 cb_threshold: int = 2, cb_cooldown: float = 0.5,
+                 cb_max_cooldown: float = 15.0):
+        from ..telemetry.reqlog import coerce
         self._engine = engine
-        self.peer_url = peer_url.rstrip("/")
+        urls = ([peer_url] if peer_url else []) + list(peer_urls)
+        self.pool = PrefillPool(urls, cb_threshold=cb_threshold,
+                                cb_cooldown=cb_cooldown,
+                                cb_max_cooldown=cb_max_cooldown)
+        # per-ATTEMPT timeout cap; the request deadline caps it
+        # further (a flat timeout must never outlive the deadline)
         self.timeout = timeout
+        self.local_fallback = local_fallback
+        # bounded retry: once around the pool plus one attempt for a
+        # peer the empty-pool reprobe just re-admitted
+        self.max_attempts = max_attempts or max(
+            2, len(self.pool.peers) + 1)
+        self.request_log = coerce(request_log)
+        # plain-int mirrors of the registry counters so tests (and
+        # registry-less schedulers) can assert without telemetry
+        self.failovers = 0
+        self.local_fallbacks = 0
+        self._c_failovers = None
+        self._c_fallbacks = None
+        self._g_peers = None
+        self._last_peer = self.pool.urls[0]
+
+    @property
+    def peer_url(self) -> str:
+        # back-compat: the single-peer attribute older callers read
+        return self.pool.urls[0]
 
     def __getattr__(self, name):
         return getattr(self._engine, name)
@@ -133,9 +278,55 @@ class RemotePrefillEngine:
     def new_state(self):
         return self._engine.new_state()
 
+    # -- telemetry -----------------------------------------------------
+
+    def bind_registry(self, registry) -> None:
+        """Attach PD pool metrics to the process's shared registry
+        (the Scheduler calls this with its own)."""
+        if registry is None:
+            return
+        self._c_failovers = registry.counter(
+            "ome_engine_pd_failovers_total",
+            "Failed /pd/prefill fetch attempts; each fails over to "
+            "the next healthy peer or the local fallback")
+        self._c_fallbacks = registry.counter(
+            "ome_engine_pd_local_fallbacks_total",
+            "PD prefills computed locally because the whole prefill "
+            "pool was out of rotation")
+        self._g_peers = registry.gauge(
+            "ome_engine_pd_peers_healthy",
+            "Prefill peers currently selectable (breaker closed/"
+            "half-open, not draining)")
+        self.update_pd_gauges()
+
+    def update_pd_gauges(self) -> None:
+        if self._g_peers is not None:
+            self._g_peers.set(self.pool.healthy_count())
+
+    def _note_failover(self):
+        self.failovers += 1
+        if self._c_failovers is not None:
+            self._c_failovers.inc()
+
+    def _log_peer_failure(self, peer_url: str, trace, error: str):
+        """JSONL reqlog record for a failed peer fetch, carrying the
+        request's trace id — what makes a chaos replay joinable
+        across the router/engine/prefill process logs."""
+        self.request_log.write({
+            "component": "pd-client",
+            "event": "pd_fetch_failed",
+            "peer": peer_url,
+            "trace_id": getattr(trace, "trace_id", None),
+            "span_id": getattr(trace, "span_id", None),
+            "error": error,
+        })
+
+    # -- the fetch path ------------------------------------------------
+
     def prefill_blob(self, prompt_ids, temperature: float = 0.0,
                      top_k: int = 0, top_p: float = 1.0,
-                     first_mask=None, adapter=None) -> bytes:
+                     first_mask=None, adapter=None, deadline=None,
+                     trace=None) -> bytes:
         """The raw wire blob — multi-host leaders replicate it to
         followers verbatim (engine/multihost.py), so the whole decode
         group inserts bit-identical KV from ONE fetch. `first_mask`
@@ -143,13 +334,18 @@ class RemotePrefillEngine:
         token of a structured request (the decode node never re-draws
         it); `adapter` (a LoRA adapter name registered on BOTH pools)
         makes the prefill node compute the prefix with that adapter's
-        deltas."""
+        deltas.
+
+        `deadline` (monotonic, the request's own) caps each attempt's
+        timeout; `trace` rides the traceparent header so the prefill
+        node's logs join the request's trace. A failed attempt fails
+        over to the next healthy peer (bounded by max_attempts); a
+        draining peer is skipped for free. With every peer out and
+        `local_fallback` set, the prefix is computed locally."""
         from .. import faults
+        from ..telemetry import tracing
         from .structured import pack_mask
 
-        # deterministic fault injection: a dropped PD handoff is a
-        # TRANSIENT error (fails one request, scheduler stays up)
-        faults.fire("pd_fetch", key=self.peer_url, exc=PDError)
         body = json.dumps({
             "ids": list(map(int, prompt_ids)),
             "temperature": float(temperature), "top_k": int(top_k),
@@ -157,22 +353,132 @@ class RemotePrefillEngine:
             "first_mask": pack_mask(first_mask),
             "adapter": adapter,
         }).encode()
-        req = urllib.request.Request(
-            self.peer_url + "/pd/prefill", data=body,
-            headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return resp.read()
+        headers = {"Content-Type": "application/json"}
+        if trace is not None:
+            try:
+                headers[tracing.TRACEPARENT_HEADER] = \
+                    trace.child().header()
+            except Exception:  # noqa: BLE001 — tracing must never
+                pass           # fail a fetch
+        errors: List[str] = []
+        tried: set = set()
+        attempts = 0
+        reprobed = False
+        deadline_hit = False
+        while attempts < self.max_attempts:
+            peer = self.pool.pick(exclude=tried)
+            if peer is None and not reprobed:
+                # whole pool looks down/draining: one synchronous
+                # /ready sweep lets a recovered peer (or a cancelled
+                # drain) re-enter before this request gives up
+                reprobed = True
+                self.pool.reprobe()
+                self.update_pd_gauges()
+                tried.clear()  # a recovered peer is worth retrying
+                peer = self.pool.pick()
+            if peer is None:
+                break
+            attempts += 1
+            per_attempt = self.timeout
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    deadline_hit = True
+                    errors.append("request deadline exhausted before "
+                                  "the fetch")
+                    break
+                per_attempt = min(per_attempt, remaining)
+            try:
+                # deterministic fault injection: a dropped PD handoff
+                # is a TRANSIENT error (fails one request after the
+                # pool is exhausted; the scheduler stays up)
+                faults.fire("pd_peer_connect", key=peer.url,
+                            exc=PDError)
+                faults.fire("pd_fetch", key=peer.url, exc=PDError)
+                req = urllib.request.Request(
+                    peer.url + "/pd/prefill", data=body,
+                    headers=headers)
+                with urllib.request.urlopen(
+                        req, timeout=per_attempt) as resp:
+                    data = resp.read()
+                self.pool.note_success(peer)
+                self.update_pd_gauges()
+                self._last_peer = peer.url
+                return data
+            except urllib.error.HTTPError as e:
+                draining = bool(
+                    e.headers.get("X-OME-Draining")) if e.headers \
+                    else False
+                e.close()
+                tried.add(peer.url)
+                if e.code == 503 and draining:
+                    # deliberate drain: free failover, no breaker
+                    # charge, and the attempt is not spent
+                    self.pool.note_draining(peer)
+                    self.update_pd_gauges()
+                    self._log_peer_failure(peer.url, trace, "draining")
+                    attempts -= 1
+                    continue
+                self.pool.note_failure(peer)
+                self.update_pd_gauges()
+                msg = f"{peer.url}: HTTP {e.code}"
+                errors.append(msg)
+                self._log_peer_failure(peer.url, trace, msg)
+                self._note_failover()
+            except (PDError, urllib.error.URLError, TimeoutError,
+                    OSError) as e:
+                tried.add(peer.url)
+                self.pool.note_failure(peer)
+                self.update_pd_gauges()
+                msg = f"{peer.url}: {e}"
+                errors.append(msg)
+                self._log_peer_failure(peer.url, trace, msg)
+                self._note_failover()
+        if self.local_fallback and not deadline_hit:
+            self.local_fallbacks += 1
+            if self._c_fallbacks is not None:
+                self._c_fallbacks.inc()
+            self.request_log.write({
+                "component": "pd-client",
+                "event": "pd_local_fallback",
+                "trace_id": getattr(trace, "trace_id", None),
+                "errors": errors[-3:],
+            })
+            kw = {}
+            if first_mask is not None:
+                kw["first_mask"] = first_mask
+            if adapter is not None:
+                kw["adapter"] = adapter
+            token, (k, v), true_len, bucket = self._engine.prefill(
+                prompt_ids, temperature, top_k, top_p, **kw)
+            self._last_peer = "local"
+            return serialize_kv(token, gather_kv(k), gather_kv(v),
+                                true_len, bucket)
+        raise PDError(
+            f"prefill pool exhausted after {attempts} attempt(s): "
+            + ("; ".join(errors[-3:]) if errors
+               else "no selectable peer"))
 
     def prefill(self, prompt_ids, temperature: float = 0.0,
                 top_k: int = 0, top_p: float = 1.0, first_mask=None,
-                adapter=None):
+                adapter=None, deadline=None, trace=None):
+        from .. import faults
         data = self.prefill_blob(prompt_ids, temperature, top_k, top_p,
-                                 first_mask=first_mask, adapter=adapter)
+                                 first_mask=first_mask, adapter=adapter,
+                                 deadline=deadline, trace=trace)
+        # a corrupt/truncated blob fails this one request, exactly
+        # like the fetch it came from
+        faults.fire("pd_deserialize", key=self._last_peer, exc=PDError)
         token, k, v, true_len, bucket = deserialize_kv(data)
         return token, (k, v), true_len, bucket
 
     def insert(self, state, kv, slot, true_len, token, bucket,
                adapter=None):
+        # a failed insert of fetched KV is the same transient,
+        # per-request failure as a failed fetch (the scheduler's
+        # insert paths check transient_prefill_errors)
+        from .. import faults
+        faults.fire("pd_insert", key=self._last_peer, exc=PDError)
         kw = {} if adapter is None else {"adapter": adapter}
         return self._engine.insert(state, kv, slot, true_len, token,
                                    bucket, **kw)

@@ -230,6 +230,12 @@ class Scheduler:
         self.registry = registry or Registry()
         if self.journal is not None:
             self.journal.bind(self.registry)
+        # engines with their own metrics (the PD prefill pool) attach
+        # them to the shared registry; getattr resolves through
+        # delegating wrappers (ReplicatedEngine) on purpose
+        bind = getattr(engine, "bind_registry", None)
+        if callable(bind):
+            bind(self.registry)
         # crash recovery: consecutive engine-fault restarts tolerated
         # before going permanently dead (0 = first fault is fatal, the
         # pre-recovery fail-fast behavior)
@@ -470,6 +476,22 @@ class Scheduler:
                 "ome_engine_kv_block_utilization_ratio",
                 "Occupied fraction of the paged-KV pool").set(
                 (total - free) / total if total else 0.0)
+            conserve = getattr(self.engine, "kv_conservation", None)
+            if callable(conserve):
+                ok, owned = conserve()
+                self.registry.gauge(
+                    "ome_engine_kv_blocks_owned",
+                    "Paged-KV blocks held by live slots").set(owned)
+                # authoritative at quiescence; a concurrent
+                # insert/free can briefly read as 0 mid-scrape
+                self.registry.gauge(
+                    "ome_engine_kv_conservation_ok",
+                    "1 when free + owned blocks account for the whole "
+                    "pool (checked per scrape; authoritative when "
+                    "idle)").set(1 if ok else 0)
+        pd = getattr(self.engine, "update_pd_gauges", None)
+        if callable(pd):
+            pd()
 
     # -- public --------------------------------------------------------
 
@@ -828,9 +850,13 @@ class Scheduler:
                     self._requeue.appendleft(req)
                     self._free_slots.release()
                     continue
-                if isinstance(e, UnknownAdapterError):
-                    # adapter hot-unloaded between prefill and insert:
-                    # this request fails, the node stays up
+                transient = (UnknownAdapterError,) + tuple(
+                    getattr(self.engine, "transient_prefill_errors",
+                            ()))
+                if isinstance(e, transient):
+                    # adapter hot-unloaded between prefill and insert,
+                    # or a PD insert of fetched KV failed: this
+                    # request fails, the node stays up
                     req.finish("error")
                     self._free_slots.release()
                     continue
@@ -896,9 +922,13 @@ class Scheduler:
                         # after running streams have freed blocks
                         self._requeue.appendleft(req)
                         break
-                    if isinstance(e, UnknownAdapterError):
-                        # racing a hot adapter unload fails ONE
-                        # request
+                    transient = (UnknownAdapterError,) + tuple(
+                        getattr(self.engine,
+                                "transient_prefill_errors", ()))
+                    if isinstance(e, transient):
+                        # racing a hot adapter unload — or a PD
+                        # fetch/insert failure on a synchronous-step
+                        # node — fails ONE request, not the engine
                         req.finish("error")
                         continue
                     # req is out of the queue but not yet slotted, so
@@ -1206,6 +1236,12 @@ class Scheduler:
             kw["first_mask"] = req.masker.mask(
                 self.engine.cfg.vocab_size,
                 remaining=req.max_new_tokens)
+        if getattr(self.engine, "pd_request_context", False):
+            # PD decode nodes cap each remote-fetch attempt at the
+            # request's own deadline and stamp its traceparent on the
+            # wire (engine/pd.py)
+            kw["deadline"] = req.deadline
+            kw["trace"] = req.trace
         return self.engine.prefill(req.prompt_ids, req.temperature,
                                    req.top_k, req.top_p, **kw)
 

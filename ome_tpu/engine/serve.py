@@ -88,8 +88,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "KV from --prefill-peer instead of computing "
                         "prefill locally")
     p.add_argument("--prefill-peer", default=None,
-                   help="prefill pool URL (required for "
-                        "--disaggregation-mode decode)")
+                   help="single prefill peer URL (back-compat alias "
+                        "for --prefill-url; merged first into the "
+                        "pool)")
+    p.add_argument("--prefill-url", action="append", default=None,
+                   metavar="URL",
+                   help="prefill pool peer URL; repeatable. A decode "
+                        "node tracks per-peer health (the router's "
+                        "breaker/draining discipline) and fails a "
+                        "dropped /pd/prefill fetch over to the next "
+                        "healthy peer (docs/pd-disaggregation.md). At "
+                        "least one of --prefill-url/--prefill-peer is "
+                        "required for --disaggregation-mode decode")
+    p.add_argument("--pd-local-fallback", action="store_true",
+                   help="decode role: when every prefill peer is out "
+                        "of rotation, compute the prefill locally "
+                        "instead of failing the request (costs decode-"
+                        "node FLOPs; keeps availability)")
+    p.add_argument("--pd-attempt-timeout", type=float, default=120.0,
+                   metavar="SECONDS",
+                   help="per-attempt /pd/prefill fetch timeout; each "
+                        "attempt is further capped by the request's "
+                        "own deadline")
     p.add_argument("--max-restarts", type=int, default=3,
                    help="consecutive engine-fault recovery attempts "
                         "before the scheduler goes permanently dead "
@@ -467,8 +487,11 @@ def main(argv=None) -> int:
         log.error("--task embed does not support multi-host serving "
                   "(unset JAX_COORDINATOR_ADDRESS or use one process)")
         return 2
-    if args.disaggregation_mode == "decode" and not args.prefill_peer:
-        log.error("--disaggregation-mode decode requires --prefill-peer")
+    prefill_urls = ([args.prefill_peer] if args.prefill_peer else []) \
+        + list(args.prefill_url or [])
+    if args.disaggregation_mode == "decode" and not prefill_urls:
+        log.error("--disaggregation-mode decode requires at least one "
+                  "--prefill-url (or --prefill-peer)")
         return 2
 
     if dist is not None and not dist.is_leader:
@@ -490,6 +513,7 @@ def main(argv=None) -> int:
     embedder = None
     pd_prefill = None
     journal = None
+    reqlog = None
     if args.journal and (args.task == "embed"
                          or args.disaggregation_mode == "prefill"):
         log.warning("--journal only applies to generation/decode "
@@ -512,9 +536,21 @@ def main(argv=None) -> int:
     else:
         engine = load_engine(args, dist)
         if args.disaggregation_mode == "decode":
+            from ..telemetry.reqlog import coerce
             from .pd import RemotePrefillEngine
-            engine = RemotePrefillEngine(engine, args.prefill_peer)
-            log.info("PD decode node: prefill via %s", args.prefill_peer)
+            # one shared JSONL reqlog: the server's request records
+            # and the PD client's peer-failure records interleave in
+            # the same file, joinable by trace id
+            reqlog = coerce(args.request_log)
+            engine = RemotePrefillEngine(
+                engine, peer_urls=prefill_urls,
+                timeout=args.pd_attempt_timeout,
+                local_fallback=args.pd_local_fallback,
+                request_log=reqlog)
+            log.info("PD decode node: prefill pool %s%s",
+                     prefill_urls,
+                     " (local fallback)" if args.pd_local_fallback
+                     else "")
         if dist is not None:
             pub = multihost.OpPublisher(dist.num_processes - 1,
                                         port=control_port)
@@ -532,9 +568,17 @@ def main(argv=None) -> int:
             return 2
         if args.journal:
             from .journal import RequestJournal
+            provenance = None
+            if args.disaggregation_mode == "decode":
+                # admit records carry the PD topology, so a resumed
+                # process (and the chaos harness) can tell these
+                # requests re-prefill over the pool on replay
+                provenance = {"mode": "pd-decode",
+                              "peers": prefill_urls}
             journal = RequestJournal(
                 args.journal, fsync=args.journal_fsync,
-                compact_bytes=args.journal_compact_mb << 20)
+                compact_bytes=args.journal_compact_mb << 20,
+                provenance=provenance)
             log.info("request journal at %s (fsync=%s)",
                      journal.path, args.journal_fsync)
         scheduler = Scheduler(engine, overlap=dist is None,
@@ -548,7 +592,8 @@ def main(argv=None) -> int:
     server = EngineServer(scheduler, tokenizer=tok, model_name=name,
                           host=args.host, port=args.port,
                           embedder=embedder, pd_prefill=pd_prefill,
-                          request_log=args.request_log,
+                          request_log=(reqlog if reqlog is not None
+                                       else args.request_log),
                           profile_dir=args.profile_dir,
                           # structured outputs work in every generation
                           # mode: masks ship inside the replicated op

@@ -39,8 +39,18 @@ Wired sites:
     (http/raise/slow);
   * ``router_forward`` — router -> backend forward, key=backend URL
     (raise surfaces as URLError, i.e. a connection failure);
-  * ``pd_fetch``       — PD decode node's remote KV fetch (raise
-    surfaces as PDError: transient, fails one request);
+  * ``pd_peer_connect`` — PD decode node's connection to one prefill
+    peer, key=peer URL (raise surfaces as PDError BEFORE the request
+    body is sent: the fetch fails over to the next healthy peer);
+  * ``pd_fetch``       — PD decode node's remote KV fetch, key=peer
+    URL (raise surfaces as PDError: transient, fails over across the
+    pool, then fails one request);
+  * ``pd_deserialize`` — decoding a fetched KV wire blob, key=the
+    peer that served it (raise surfaces as PDError: a corrupt blob
+    fails one request);
+  * ``pd_insert``      — inserting fetched KV into the local cache,
+    key=serving peer (raise surfaces as PDError: transient,
+    per-request; the scheduler's insert paths classify it);
   * ``journal_append`` — request-journal record write (raise degrades
     the journal: serving continues, durability is lost);
   * ``journal_fsync``  — request-journal fsync (raise degrades, as
@@ -60,7 +70,8 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 __all__ = ["InjectedFault", "Rule", "FaultInjector", "parse_spec",
-           "install", "reset", "fire", "http", "active"]
+           "spec_points", "install", "reset", "fire", "http",
+           "active"]
 
 
 class InjectedFault(RuntimeError):
@@ -126,6 +137,13 @@ def parse_spec(spec: str) -> List[Rule]:
         rules.append(Rule(point=point, kind=kind, param=param,
                           start=start, count=count))
     return rules
+
+
+def spec_points(spec: str) -> set:
+    """The set of injection-site names a spec references, keys
+    stripped — what the chaos harness checks against the documented
+    fault-point catalog before it will run a schedule."""
+    return {r.point.split("|", 1)[0] for r in parse_spec(spec)}
 
 
 class FaultInjector:

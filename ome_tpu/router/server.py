@@ -157,6 +157,45 @@ class Backend:
                f"{', draining' if self.draining else ''})"
 
 
+def probe_backend(url: str, timeout: float = 5.0):
+    """Probe /ready (falling back to /health for pre-readiness
+    backends). Returns (healthy, draining): a draining replica
+    answers /ready with 503 + {"draining": true} while still
+    finishing in-flight work — it is HEALTHY but must leave the
+    rotation, and re-enters it if a later probe sees 200 again.
+
+    Shared by the router's health loop and the PD decode node's
+    prefill pool (engine/pd.py), so every pool in the system applies
+    one draining/readiness discipline."""
+    url = url.rstrip("/")
+    try:
+        with urllib.request.urlopen(url + "/ready",
+                                    timeout=timeout) as resp:
+            return resp.status == 200, False
+    except urllib.error.HTTPError as e:
+        if e.code == 503:
+            try:
+                info = json.loads(e.read() or b"{}")
+            except ValueError:
+                info = {}
+            e.close()
+            if info.get("draining"):
+                return True, True
+            return False, False  # not ready for another reason
+        e.close()
+        if e.code == 404:
+            # old backend without /ready: fall back to /health
+            try:
+                with urllib.request.urlopen(url + "/health",
+                                            timeout=timeout) as resp:
+                    return resp.status == 200, False
+            except Exception:
+                return False, False
+        return False, False
+    except Exception:
+        return False, False
+
+
 class Router:
     def __init__(self, backends: List[Backend],
                  policy: str = "cache_aware",
@@ -315,37 +354,7 @@ class Router:
 
     @staticmethod
     def _probe_backend(b: Backend):
-        """Probe /ready (falling back to /health for pre-readiness
-        backends). Returns (healthy, draining): a draining replica
-        answers /ready with 503 + {"draining": true} while still
-        finishing in-flight work — it is HEALTHY but must leave the
-        rotation, and re-enters it if a later probe sees 200 again."""
-        try:
-            with urllib.request.urlopen(b.url + "/ready",
-                                        timeout=5) as resp:
-                return resp.status == 200, False
-        except urllib.error.HTTPError as e:
-            if e.code == 503:
-                try:
-                    info = json.loads(e.read() or b"{}")
-                except ValueError:
-                    info = {}
-                e.close()
-                if info.get("draining"):
-                    return True, True
-                return False, False  # not ready for another reason
-            e.close()
-            if e.code == 404:
-                # old backend without /ready: fall back to /health
-                try:
-                    with urllib.request.urlopen(b.url + "/health",
-                                                timeout=5) as resp:
-                        return resp.status == 200, False
-                except Exception:
-                    return False, False
-            return False, False
-        except Exception:
-            return False, False
+        return probe_backend(b.url)
 
     def start_health_loop(self):
         def loop():

@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Chaos soak runner — thin launcher for ome_tpu.chaos.
+
+    python scripts/chaos_soak.py --seed 7 --episodes 50
+    python scripts/chaos_soak.py --seed 7 --episode 23   # replay
+
+See docs/README.md and the module docstring of ome_tpu/chaos.py for
+the topology flags and the invariants checked after every episode.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ome_tpu.chaos import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

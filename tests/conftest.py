@@ -19,3 +19,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from __graft_entry__ import _force_cpu_devices  # noqa: E402
 
 _force_cpu_devices(8)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running (chaos soak / multi-node) tests, excluded "
+        "from the tier-1 `-m 'not slow'` run")
