@@ -559,6 +559,58 @@ def main() -> None:
         f"({100*spec_tps/spec_plain_tps-100:+.0f}%, accept rate "
         f"{100*spec_rate:.0f}%)")
 
+    # -- scheduler step-phase attribution -------------------------------
+    # Drives the SERVING scheduler (pipelined decode, depth 1) over the
+    # real engine and reads back its ome_engine_step_phase_seconds
+    # histograms — the same per-phase attribution an operator scrapes
+    # from /metrics, here reduced to a mean-ms-per-step table. The
+    # phases partition decode_step + step_gap: dispatch (the compiled
+    # decode call), mask_apply (grammar masks; zero in this unmasked
+    # workload), device_wait (blocking at the lag-queue token read),
+    # host_sample (emit/finish bookkeeping after the read).
+    def bench_step_phases(p):
+        from ome_tpu.engine.core import InferenceEngine
+        from ome_tpu.engine.scheduler import Request, Scheduler
+
+        SLOTS = 8
+        eng = InferenceEngine(p, cfg, max_slots=SLOTS,
+                              max_seq=CACHE_LEN, prefill_buckets=[16])
+        sched = Scheduler(eng, overlap=True, pipeline_depth=1)
+        sched.start()
+        rng = np.random.default_rng(11)
+        reqs = []
+        for _ in range(SLOTS):
+            ids = [int(x) for x in
+                   rng.integers(0, cfg.vocab_size, size=16)]
+            reqs.append(sched.submit(
+                Request(prompt_ids=ids, max_new_tokens=48)))
+        for r in reqs:
+            r.done.wait(timeout=300)
+        phases = {}
+        for name in ("dispatch", "mask_apply", "device_wait",
+                     "host_sample"):
+            child = sched._h_step_phase.labels(phase=name)
+            phases[name] = (child.sum, child.count)
+        step_sum = sched._h_decode_step.sum + sched._h_step_gap.sum
+        steps = max(sched._h_decode_step.count, 1)
+        sched.stop()
+        return phases, step_sum, steps
+
+    phase_raw, phase_step_sum, phase_steps = bench_step_phases(params)
+    phase_total = sum(s for s, _ in phase_raw.values())
+    step_phase_ms = {}
+    log(f"bench: [phases] per-step attribution over {phase_steps} "
+        f"scheduler steps (ome_engine_step_phase_seconds):")
+    log(f"bench:   {'phase':<12} {'mean ms':>9} {'share':>7}")
+    for name, (s, _count) in phase_raw.items():
+        mean_ms = s / phase_steps * 1000
+        step_phase_ms[name] = round(mean_ms, 3)
+        share = s / phase_total if phase_total else 0.0
+        log(f"bench:   {name:<12} {mean_ms:9.3f} {100*share:6.1f}%")
+    phase_cov = phase_total / max(phase_step_sum, 1e-9)
+    log(f"bench:   phase sum covers {100*phase_cov:.0f}% of "
+        f"decode_step + step_gap")
+
     # -- rooflines ------------------------------------------------------
     # Per decode step the chip must read all weights once (amortized
     # across the batch) + each sequence's KV cache.
@@ -607,6 +659,8 @@ def main() -> None:
         "prefill_ms_batch32x128": round(pbest * 1000, 1),
         "prefill_mfu": round(mfu, 3),
         "dispatch_ms": round(disp_ms, 2),
+        "step_phase_ms": step_phase_ms,
+        "step_phase_coverage": round(phase_cov, 3),
         "decode_step_gap_ms": {"sync": round(gap_sync, 2),
                                "pipelined": round(gap_pipe, 2)},
         "achievable_gbps": round(bw_ach, 1),

@@ -534,12 +534,17 @@ class ChaosRunner:
     def __init__(self, topo: Topology, base_dir: pathlib.Path,
                  model_dir: Optional[str] = None,
                  keep_logs: bool = False,
-                 journal_drain_timeout: float = 90.0):
+                 journal_drain_timeout: float = 90.0,
+                 force_violation: bool = False):
         self.topo = topo
         self.base = base_dir
         self.base.mkdir(parents=True, exist_ok=True)
         self.keep_logs = keep_logs
         self.journal_drain_timeout = journal_drain_timeout
+        # append a synthetic violation to every episode so the bundle
+        # pipeline (flight dumps + merged trace) can be exercised
+        # end-to-end without waiting for a real invariant to break
+        self.force_violation = force_violation
         # empty model dir + --random-weights = the deterministic
         # tiny_test config with ByteTokenizer: every engine in the
         # topology (and the oracle) inits IDENTICAL weights from
@@ -559,8 +564,10 @@ class ChaosRunner:
                      journal_dir: Optional[pathlib.Path] = None,
                      role: Optional[str] = None,
                      prefill_urls: Sequence[str] = (),
-                     reqlog: Optional[pathlib.Path] = None
-                     ) -> List[str]:
+                     reqlog: Optional[pathlib.Path] = None,
+                     span_log: Optional[pathlib.Path] = None,
+                     flight_dump_dir: Optional[pathlib.Path] = None,
+                     debug: bool = False) -> List[str]:
         args = ["--model-dir", self.model_dir, "--random-weights",
                 "--dtype", "float32", "--host", "127.0.0.1",
                 "--port", str(port),
@@ -586,6 +593,15 @@ class ChaosRunner:
                      "--journal-fsync", "always"]
         if reqlog is not None:
             args += ["--request-log", str(reqlog)]
+        # timeline + flight-recorder capture for the violation bundle:
+        # every serving child spans its requests and exposes the
+        # guarded /debug/events tail (the oracle stays bare)
+        if span_log is not None:
+            args += ["--span-log", str(span_log)]
+        if flight_dump_dir is not None:
+            args += ["--flight-dump-dir", str(flight_dump_dir)]
+        if debug:
+            args += ["--debug-endpoints"]
         return args
 
     def start_oracle(self) -> ManagedProc:
@@ -642,7 +658,9 @@ class ChaosRunner:
             name = f"prefill{i}"
             prefills.append(ManagedProc(
                 name, "engine",
-                self._engine_args(port, topo, role="prefill"),
+                self._engine_args(port, topo, role="prefill",
+                                  span_log=epdir / f"{name}.spans.jsonl",
+                                  flight_dump_dir=epdir, debug=True),
                 port, epdir / f"{name}.log"))
         prefill_urls = [p.url for p in prefills]
 
@@ -658,7 +676,9 @@ class ChaosRunner:
                 self._engine_args(port, topo, journal_dir=jdir,
                                   role="decode",
                                   prefill_urls=prefill_urls,
-                                  reqlog=epdir / f"{name}.reqlog"),
+                                  reqlog=epdir / f"{name}.reqlog",
+                                  span_log=epdir / f"{name}.spans.jsonl",
+                                  flight_dump_dir=epdir, debug=True),
                 port, epdir / f"{name}.log"))
         for i in range(topo.unified):
             port = free_port()
@@ -668,7 +688,9 @@ class ChaosRunner:
             serving.append(ManagedProc(
                 name, "engine",
                 self._engine_args(port, topo, journal_dir=jdir,
-                                  reqlog=epdir / f"{name}.reqlog"),
+                                  reqlog=epdir / f"{name}.reqlog",
+                                  span_log=epdir / f"{name}.spans.jsonl",
+                                  flight_dump_dir=epdir, debug=True),
                 port, epdir / f"{name}.log"))
 
         router = None
@@ -676,7 +698,8 @@ class ChaosRunner:
             rport = free_port()
             rargs = ["--bind", "127.0.0.1", "--port", str(rport),
                      "--policy", "round_robin",
-                     "--health-interval", "1.0"]
+                     "--health-interval", "1.0",
+                     "--span-log", str(epdir / "router.spans.jsonl")]
             for s in serving:
                 rargs += ["--backend", s.url]
             router = ManagedProc("router", "router", rargs, rport,
@@ -737,12 +760,93 @@ class ChaosRunner:
             watch.poll_once()
             ep.violations.extend(watch.violations)
             watch = None
+            if self.force_violation:
+                ep.violations.append(
+                    "forced violation (--force-violation)")
+            if ep.violations:
+                # grab the bundle while the children are still alive —
+                # /debug/events only answers from a live process
+                self.collect_bundle(ep, epdir, procs)
         finally:
             if watch is not None:
                 watch.stop()
             for p in procs:
                 p.stop()
         return ep
+
+    # -- violation bundle --------------------------------------------
+
+    def collect_bundle(self, ep: Episode, epdir: pathlib.Path,
+                       procs: Sequence[ManagedProc]
+                       ) -> Optional[pathlib.Path]:
+        """Violation replay bundle under ``<epdir>/bundle``: the
+        schedule + violations, a flight-recorder dump per live engine
+        child (via the guarded ``/debug/events`` tail), any crash
+        auto-dumps the children already wrote into the episode dir,
+        and every span log merged into one exported Perfetto trace
+        (telemetry/export.py). Best-effort by design — a half-dead
+        topology must not turn a violation report into a second
+        failure."""
+        bundle = epdir / "bundle"
+        try:
+            bundle.mkdir(parents=True, exist_ok=True)
+        except OSError:
+            return None
+
+        flight_paths: List[pathlib.Path] = []
+        for p in procs:
+            if p.role != "engine" or not p.alive():
+                continue
+            try:
+                status, doc = _http(p.url + "/debug/events?n=0",
+                                    timeout=5.0)
+            except (urllib.error.URLError, OSError):
+                continue
+            if status != 200 or not isinstance(doc, dict):
+                continue
+            # shape the endpoint doc like a FlightRecorder.dump()
+            # file so the exporter (and a human) reads both the same
+            doc.setdefault("pid", p.proc.pid if p.proc else 0)
+            doc.setdefault("reason", "chaos_violation")
+            doc["component"] = p.name
+            path = bundle / f"flight-{p.name}.json"
+            try:
+                path.write_text(
+                    json.dumps(doc, separators=(",", ":"),
+                               default=str) + "\n", encoding="utf-8")
+            except OSError:
+                continue
+            flight_paths.append(path)
+        # crash recovery inside a child auto-dumps into the episode
+        # dir (--flight-dump-dir): fold those lives in too
+        flight_paths.extend(sorted(epdir.glob("flight-*.json")))
+
+        span_paths = sorted(epdir.glob("*.spans.jsonl"))
+        try:
+            from .telemetry import export as trace_export
+            spans = trace_export.load_spans(span_paths)
+            flights = trace_export.load_flight_dumps(flight_paths)
+            doc = trace_export.build_trace(spans, flights)
+            (bundle / "trace.json").write_text(
+                json.dumps(doc, separators=(",", ":")) + "\n",
+                encoding="utf-8")
+        except Exception as e:  # noqa: BLE001 — see docstring
+            ep.violations.append(
+                f"bundle: trace export failed: "
+                f"{type(e).__name__}: {e}")
+        try:
+            (bundle / "violation.json").write_text(
+                json.dumps({"schedule": ep.schedule(),
+                            "violations": ep.violations,
+                            "replay": ep.replay_command(),
+                            "span_logs": [str(s) for s in span_paths],
+                            "flight_dumps": [str(f)
+                                             for f in flight_paths]},
+                           indent=2) + "\n", encoding="utf-8")
+        except OSError:
+            return None
+        print(f"[chaos] violation bundle: {bundle}", flush=True)
+        return bundle
 
     # -- invariants --------------------------------------------------
 
@@ -851,7 +955,8 @@ class ChaosRunner:
 def run_soak(seed: int, episodes: Sequence[int], topo: Topology,
              base_dir: pathlib.Path, n_requests: int, spread: float,
              keep_logs: bool = False,
-             journal_drain_timeout: float = 90.0) -> int:
+             journal_drain_timeout: float = 90.0,
+             force_violation: bool = False) -> int:
     from .telemetry import Registry
     registry = Registry()
     c_episodes = registry.counter("ome_chaos_episodes_total",
@@ -862,7 +967,8 @@ def run_soak(seed: int, episodes: Sequence[int], topo: Topology,
         "ome_chaos_invariant_failures_total",
         "Invariant violations detected across the soak")
     runner = ChaosRunner(topo, base_dir, keep_logs=keep_logs,
-                         journal_drain_timeout=journal_drain_timeout)
+                         journal_drain_timeout=journal_drain_timeout,
+                         force_violation=force_violation)
     failed = []
     try:
         for index in episodes:
@@ -949,6 +1055,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(default: a fresh temp dir)")
     p.add_argument("--keep-logs", action="store_true",
                    help="do not delete the scratch directory")
+    p.add_argument("--force-violation", action="store_true",
+                   help="append a synthetic violation to every "
+                        "episode, exercising the replay bundle "
+                        "(flight dumps + merged trace) end to end")
     return p
 
 
@@ -982,7 +1092,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         rc = run_soak(args.seed, episodes, topo, base,
                       n_requests=args.requests, spread=args.spread,
                       keep_logs=args.keep_logs,
-                      journal_drain_timeout=args.journal_drain_timeout)
+                      journal_drain_timeout=args.journal_drain_timeout,
+                      force_violation=args.force_violation)
     finally:
         if cleanup:
             import shutil

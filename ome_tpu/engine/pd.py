@@ -240,10 +240,11 @@ class RemotePrefillEngine:
                  peer_urls: Sequence[str] = (),
                  local_fallback: bool = False,
                  max_attempts: Optional[int] = None,
-                 request_log=None,
+                 request_log=None, span_log=None,
                  cb_threshold: int = 2, cb_cooldown: float = 0.5,
                  cb_max_cooldown: float = 15.0):
         from ..telemetry.reqlog import coerce
+        from ..telemetry.tracing import coerce_span_log
         self._engine = engine
         urls = ([peer_url] if peer_url else []) + list(peer_urls)
         self.pool = PrefillPool(urls, cb_threshold=cb_threshold,
@@ -258,6 +259,11 @@ class RemotePrefillEngine:
         self.max_attempts = max_attempts or max(
             2, len(self.pool.peers) + 1)
         self.request_log = coerce(request_log)
+        # per-attempt peer-attributed spans (pd.fetch) — the attempt's
+        # span id IS the forwarded traceparent child, so the prefill
+        # node's own records nest under the attempt on the timeline
+        self.span_log = coerce_span_log(span_log, component="pd-client")
+        self.flight = None  # scheduler attaches its ring (bind_flight)
         # plain-int mirrors of the registry counters so tests (and
         # registry-less schedulers) can assert without telemetry
         self.failovers = 0
@@ -303,10 +309,18 @@ class RemotePrefillEngine:
         if self._g_peers is not None:
             self._g_peers.set(self.pool.healthy_count())
 
-    def _note_failover(self):
+    def bind_flight(self, flight) -> None:
+        """Attach the scheduler's flight recorder so peer failovers
+        land in the lifecycle event ring (/debug/events)."""
+        self.flight = flight
+
+    def _note_failover(self, peer_url: str = "", error: str = ""):
         self.failovers += 1
         if self._c_failovers is not None:
             self._c_failovers.inc()
+        if self.flight is not None:
+            self.flight.record("pd_failover", peer=peer_url,
+                               error=error[:160])
 
     def _log_peer_failure(self, peer_url: str, trace, error: str):
         """JSONL reqlog record for a failed peer fetch, carrying the
@@ -354,12 +368,6 @@ class RemotePrefillEngine:
             "adapter": adapter,
         }).encode()
         headers = {"Content-Type": "application/json"}
-        if trace is not None:
-            try:
-                headers[tracing.TRACEPARENT_HEADER] = \
-                    trace.child().header()
-            except Exception:  # noqa: BLE001 — tracing must never
-                pass           # fail a fetch
         errors: List[str] = []
         tried: set = set()
         attempts = 0
@@ -388,6 +396,27 @@ class RemotePrefillEngine:
                                   "the fetch")
                     break
                 per_attempt = min(per_attempt, remaining)
+            # a FRESH traceparent child per attempt: each peer's own
+            # records carry a distinct span id, and the attempt span
+            # below reuses that id so the timeline nests peer work
+            # under the exact attempt that caused it
+            hdrs = dict(headers)
+            child = None
+            if trace is not None:
+                try:
+                    child = trace.child()
+                    hdrs[tracing.TRACEPARENT_HEADER] = child.header()
+                except Exception:  # noqa: BLE001 — tracing must
+                    child = None   # never fail a fetch
+            span = None
+            if self.span_log.enabled:
+                span = tracing.Span(
+                    "pd.fetch",
+                    trace_id=getattr(trace, "trace_id", None),
+                    parent_id=getattr(trace, "span_id", None),
+                    span_id=(child.span_id if child is not None
+                             else None))
+                span.set(peer=peer.url, attempt=attempts)
             try:
                 # deterministic fault injection: a dropped PD handoff
                 # is a TRANSIENT error (fails one request after the
@@ -397,13 +426,16 @@ class RemotePrefillEngine:
                 faults.fire("pd_fetch", key=peer.url, exc=PDError)
                 req = urllib.request.Request(
                     peer.url + "/pd/prefill", data=body,
-                    headers=headers)
+                    headers=hdrs)
                 with urllib.request.urlopen(
                         req, timeout=per_attempt) as resp:
                     data = resp.read()
                 self.pool.note_success(peer)
                 self.update_pd_gauges()
                 self._last_peer = peer.url
+                if span is not None:
+                    self.span_log.write(
+                        span.set(status="ok", bytes=len(data)))
                 return data
             except urllib.error.HTTPError as e:
                 draining = bool(
@@ -417,6 +449,8 @@ class RemotePrefillEngine:
                     self.pool.note_draining(peer)
                     self.update_pd_gauges()
                     self._log_peer_failure(peer.url, trace, "draining")
+                    if span is not None:
+                        self.span_log.write(span.set(status="draining"))
                     attempts -= 1
                     continue
                 self.pool.note_failure(peer)
@@ -424,7 +458,10 @@ class RemotePrefillEngine:
                 msg = f"{peer.url}: HTTP {e.code}"
                 errors.append(msg)
                 self._log_peer_failure(peer.url, trace, msg)
-                self._note_failover()
+                self._note_failover(peer.url, msg)
+                if span is not None:
+                    self.span_log.write(
+                        span.set(status="error", error=msg))
             except (PDError, urllib.error.URLError, TimeoutError,
                     OSError) as e:
                 tried.add(peer.url)
@@ -433,7 +470,10 @@ class RemotePrefillEngine:
                 msg = f"{peer.url}: {e}"
                 errors.append(msg)
                 self._log_peer_failure(peer.url, trace, msg)
-                self._note_failover()
+                self._note_failover(peer.url, msg)
+                if span is not None:
+                    self.span_log.write(
+                        span.set(status="error", error=msg))
         if self.local_fallback and not deadline_hit:
             self.local_fallbacks += 1
             if self._c_fallbacks is not None:
@@ -449,11 +489,22 @@ class RemotePrefillEngine:
                 kw["first_mask"] = first_mask
             if adapter is not None:
                 kw["adapter"] = adapter
+            span = None
+            if self.span_log.enabled:
+                span = tracing.Span(
+                    "pd.fetch",
+                    trace_id=getattr(trace, "trace_id", None),
+                    parent_id=getattr(trace, "span_id", None))
+                span.set(peer="local", status="fallback",
+                         attempts=attempts)
             token, (k, v), true_len, bucket = self._engine.prefill(
                 prompt_ids, temperature, top_k, top_p, **kw)
             self._last_peer = "local"
-            return serialize_kv(token, gather_kv(k), gather_kv(v),
+            blob = serialize_kv(token, gather_kv(k), gather_kv(v),
                                 true_len, bucket)
+            if span is not None:
+                self.span_log.write(span)
+            return blob
         raise PDError(
             f"prefill pool exhausted after {attempts} attempt(s): "
             + ("; ".join(errors[-3:]) if errors

@@ -33,6 +33,7 @@ from __future__ import annotations
 import collections
 import itertools
 import math
+import os
 import queue
 import threading
 import time
@@ -44,6 +45,9 @@ import numpy as np
 
 from .. import faults
 from ..telemetry import Registry
+from ..telemetry.flight import FlightRecorder
+from ..telemetry.tracing import Span, SpanContext, coerce_span_log, \
+    new_trace
 from . import spec as spec_drafter
 from .core import DecodeState, InferenceEngine
 
@@ -199,8 +203,34 @@ class Scheduler:
                  pipeline_depth: int = 1,
                  spec_tokens: int = 0,
                  registry: Optional[Registry] = None,
-                 journal=None):
+                 journal=None,
+                 span_log=None,
+                 flight: Optional[FlightRecorder] = None,
+                 flight_dump_dir: Optional[str] = None,
+                 span_chunk_steps: int = 8):
         self.engine = engine
+        # span timeline (docs/tracing-timeline.md): per-phase spans
+        # (queue, prefill, chunked decode, spec verify, journal
+        # replay) written to the `--span-log` JSONL; a None path is a
+        # no-op, so the hot path pays one `enabled` check when off
+        self.span_log = coerce_span_log(span_log, component="engine")
+        # decode spans are CHUNKED — one span per up-to-N drained
+        # steps per request — so span volume scales with N, not with
+        # every token, and no extra host sync is ever introduced
+        # (timestamps come from points the loop already crosses)
+        self.span_chunk_steps = max(int(span_chunk_steps), 1)
+        # scheduler-lifetime trace for spans that belong to no single
+        # request (spec verify batches, journal replay)
+        self._span_ctx = new_trace()
+        # flight recorder (telemetry/flight.py): always-on bounded
+        # ring of lifecycle events; served at /debug/events, dumped
+        # into flight_dump_dir on crash recovery
+        self.flight = flight if flight is not None else FlightRecorder()
+        self.flight_dump_dir = flight_dump_dir
+        self._flight_dumps = 0
+        # (proposed, accepted) of the most recently drained verify
+        # step, read by the spec-verify span right after the drain
+        self._spec_last = (0, 0)
         # durable requests (engine/journal.py, docs/durability.md):
         # when set, every unmasked admission is journaled, progress
         # records append at each step boundary, and restart resume
@@ -236,6 +266,11 @@ class Scheduler:
         bind = getattr(engine, "bind_registry", None)
         if callable(bind):
             bind(self.registry)
+        # the PD fetch path logs its peer failovers into the same
+        # lifecycle ring as the scheduler's own events
+        bindf = getattr(engine, "bind_flight", None)
+        if callable(bindf):
+            bindf(self.flight)
         # crash recovery: consecutive engine-fault restarts tolerated
         # before going permanently dead (0 = first fault is fatal, the
         # pre-recovery fail-fast behavior)
@@ -379,6 +414,30 @@ class Scheduler:
         self._g_pc_bytes = R.gauge(
             "ome_engine_prefix_cache_bytes",
             "Device bytes resident in the prefix cache")
+        # step-phase attribution (ROADMAP open item 2): where a decode
+        # step + its host-side gap actually go, measured ONLY from
+        # timestamps the pipelined loop already crosses — dispatch
+        # (the compiled decode call), mask_apply (grammar mask build),
+        # device_wait (blocking at the lag-queue read), host_sample
+        # (token emit/offload after the read). Their sum tracks
+        # decode_step + step_gap within bookkeeping tolerance.
+        self._h_step_phase = R.histogram(
+            "ome_engine_step_phase_seconds",
+            "Decode step time attributed by phase (dispatch / "
+            "mask_apply / device_wait / host_sample)",
+            labelnames=("phase",), buckets=STEP_BUCKETS)
+        self._ph_dispatch = self._h_step_phase.labels(phase="dispatch")
+        self._ph_mask = self._h_step_phase.labels(phase="mask_apply")
+        self._ph_wait = self._h_step_phase.labels(phase="device_wait")
+        self._ph_sample = self._h_step_phase.labels(phase="host_sample")
+        self._c_flight_events = R.counter(
+            "ome_engine_flight_events_total",
+            "Scheduler lifecycle events recorded by the flight ring")
+        self._c_flight_dumps = R.counter(
+            "ome_engine_flight_dumps_total",
+            "Flight-recorder dumps written on crash recovery")
+        self._journal_compactions_seen = (
+            self.journal.compactions if self.journal is not None else 0)
 
     @property
     def status(self) -> str:
@@ -428,6 +487,14 @@ class Scheduler:
         going away and a restart resumes the work; every other reason
         means the request is DONE and tombstones it."""
         self._observe_finish(req)
+        self._flush_decode_chunk(req, final=True)
+        span = getattr(req, "_span", None)
+        if span is not None and self.span_log.enabled:
+            span.end(req.finished_at)
+            span.set(request=req.id, finish_reason=req.finish_reason,
+                     prompt_tokens=len(req.prompt_ids),
+                     output_tokens=len(req.output_ids))
+            self.span_log.write(span)
         if self.journal is not None:
             resumable = req.finish_reason == "shutdown" or (
                 req.finish_reason == "engine_fault"
@@ -441,6 +508,152 @@ class Scheduler:
         if req.scheduled_at is None:
             req.scheduled_at = time.monotonic()
             self._h_queue_wait.observe(req.scheduled_at - req.created)
+            span = getattr(req, "_span", None)
+            if span is not None and self.span_log.enabled:
+                now_wall = time.time()
+                q = Span("engine.queue", trace_id=span.trace_id,
+                         parent_id=span.span_id,
+                         start_mono=req.created,
+                         start_wall=now_wall - (req.scheduled_at
+                                                - req.created))
+                q.end(req.scheduled_at).set(request=req.id)
+                self.span_log.write(q)
+
+    # -- flight recorder + span plumbing -------------------------------
+
+    def _flight_event(self, event: str, **fields):
+        self.flight.record(event, **fields)
+        self._c_flight_events.inc()
+
+    def _flight_autodump(self, reason: str) -> Optional[str]:
+        """Dump the event ring to flight_dump_dir (crash recovery /
+        dead transitions) so the lead-up to a fault survives the
+        process. Best-effort: a failed dump never worsens recovery."""
+        if self.flight_dump_dir is None:
+            return None
+        self._flight_dumps += 1
+        path = os.path.join(
+            self.flight_dump_dir,
+            f"flight-{os.getpid()}-{self._flight_dumps}.json")
+        try:
+            os.makedirs(self.flight_dump_dir, exist_ok=True)
+            self.flight.dump(path, reason=reason)
+        except OSError:
+            return None
+        self._c_flight_dumps.inc()
+        return path
+
+    def _note_slot_assign(self, slot: int, req: Request):
+        """Flight event + decode-chunk window start for a request
+        entering a decode slot (fresh admission or preempt resume)."""
+        self._flight_event("slot_assign", slot=slot, request=req.id)
+        if self.span_log.enabled and getattr(req, "_span", None) \
+                is not None:
+            req._chunk = [time.monotonic(), time.time(), 0, 0,
+                          getattr(req, "_chunk_base", 0)]
+
+    def _begin_prefill_span(self, req: Request) -> Optional[Span]:
+        """Minted BEFORE the prefill call so a PD remote fetch can
+        parent its per-peer attempt spans on this span's id (the
+        traceparent forwarded to `/pd/prefill` is a child of it)."""
+        span = getattr(req, "_span", None)
+        if span is None or not self.span_log.enabled:
+            return None
+        return Span("engine.prefill", trace_id=span.trace_id,
+                    parent_id=span.span_id)
+
+    def _end_prefill_span(self, req: Request, pspan: Optional[Span]):
+        if pspan is None:
+            return
+        pspan.end().set(request=req.id,
+                        prompt_tokens=len(req.prompt_ids))
+        self.span_log.write(pspan)
+
+    def _note_decode_progress(self, req: Request, tokens: int = 1):
+        """Advance the request's decode-chunk accounting by one
+        drained step; flushes a chunk span every span_chunk_steps.
+        Called only from the drain path — never adds a host sync."""
+        ch = getattr(req, "_chunk", None)
+        if ch is None:
+            return
+        ch[2] += 1
+        ch[3] += tokens
+        if ch[2] >= self.span_chunk_steps:
+            self._flush_decode_chunk(req)
+
+    def _flush_decode_chunk(self, req: Request, final: bool = False):
+        """Write the pending decode-chunk span (if any steps were
+        drained since the last flush) and roll the chunk window
+        forward so consecutive chunks tile without overlap."""
+        ch = getattr(req, "_chunk", None)
+        if ch is None:
+            return
+        span = getattr(req, "_span", None)
+        if ch[2] > 0 and span is not None and self.span_log.enabled:
+            end_mono = time.monotonic()
+            s = Span("engine.decode", trace_id=span.trace_id,
+                     parent_id=span.span_id,
+                     start_mono=ch[0], start_wall=ch[1])
+            s.end(end_mono)
+            s.set(steps=ch[2], tokens=ch[3], chunk=ch[4],
+                  request=req.id)
+            self.span_log.write(s)
+            ch[0] = end_mono
+            ch[1] += s.dur_s
+            ch[2] = 0
+            ch[3] = 0
+            ch[4] += 1
+        if final:
+            # remember where the numbering got to, so a preempted
+            # request re-admitted later continues its chunk sequence
+            req._chunk_base = ch[4]
+            req._chunk = None
+
+    def debug_state(self) -> dict:
+        """Point-in-time JSON snapshot behind GET /debug/state: live
+        slots, queue/pool/journal counters, flight-ring state. Reads
+        are lock-free on purpose (the scheduler thread owns the
+        structures); a concurrent mutation can skew one field by one
+        request, which is fine for a debug surface."""
+        slots = []
+        owned = getattr(self.engine, "_owned", None)
+        for slot, req in enumerate(list(self.slots)):
+            if req is None:
+                continue
+            entry = {"slot": slot, "request": req.id,
+                     "journal_id": req.journal_id,
+                     "prompt_tokens": len(req.prompt_ids),
+                     "committed_tokens": len(req.output_ids),
+                     "adapter": req.adapter}
+            if owned is not None:
+                try:
+                    entry["kv_blocks_owned"] = len(owned[slot])
+                except (IndexError, TypeError):
+                    pass
+            slots.append(entry)
+        state = {
+            "status": self._status,
+            "draining": self._draining,
+            "queue_depth": self.pending.qsize(),
+            "requeued": len(self._requeue),
+            "ready": self._ready.qsize(),
+            "inflight_steps": len(self._inflight),
+            "admitting": self._admitting,
+            "max_slots": self.engine.max_slots,
+            "active_slots": len(slots),
+            "slots": slots,
+            "flight": self.flight.state(),
+        }
+        pool = getattr(self.engine, "kv_pool_stats", None)
+        if pool and pool.get("kv_block_tokens"):
+            state["kv_pool"] = dict(pool)
+        j = self.journal
+        state["journal"] = None if j is None else {
+            "path": j.path, "appends": j.appends, "errors": j.errors,
+            "compactions": j.compactions, "replayed": j.replayed,
+            "degraded": j.degraded,
+            "bytes": getattr(j, "_bytes", None)}
+        return state
 
     def update_gauges(self):
         """Refresh point-in-time gauges (called by /metrics scrapes
@@ -534,12 +747,24 @@ class Scheduler:
                     f"pending queue saturated (depth {depth}, "
                     f"estimated wait {est if est is not None else '?'}"
                     "s)", retry_after=retry)
+            if self.span_log.enabled:
+                # the engine-side request span: parented under the span
+                # id the router forwarded in `traceparent` (so the
+                # router's attempt span encloses it); every scheduler
+                # phase span hangs off this one. Written at finish.
+                # Minted BEFORE the queue put — once the request is
+                # visible, the (overlap) admission thread may schedule
+                # it immediately, and the phase spans key off _span.
+                req._span = Span.begin("engine.request", ctx=req.trace,
+                                       start_mono=req.created)
             try:
                 self.pending.put_nowait(req)
             except queue.Full:
                 self._inc_locked("rejected_total")
                 raise SchedulerOverloaded(
                     "pending queue full", retry_after=1.0) from None
+            self._flight_event("admit", request=req.id,
+                               depth=depth + 1)
             if self.journal is not None and req.masker is None:
                 self.journal.admit(req)
         return req
@@ -570,6 +795,7 @@ class Scheduler:
         # work was fine, the process is going away. The router may
         # safely retry these, and a journal keeps them resumable.
         self._fail_all("shutdown")
+        self.span_log.close()
 
     # -- graceful drain (docs/durability.md) ---------------------------
 
@@ -580,6 +806,10 @@ class Scheduler:
         not a stop."""
         with self._lock:
             self._draining = True
+        self._flight_event("drain_begin",
+                           queue_depth=self.pending.qsize(),
+                           active=sum(r is not None
+                                      for r in self.slots))
 
     @property
     def draining(self) -> bool:
@@ -611,6 +841,8 @@ class Scheduler:
         j = self.journal
         if j is None:
             return 0
+        t0_mono = time.monotonic()
+        t0_wall = time.time()
         try:
             entries = j.replay()
         except Exception:  # noqa: BLE001 — a corrupt journal must not
@@ -651,6 +883,15 @@ class Scheduler:
         if n:
             j.note_replayed(n)
             log.info("journal: resumed %d unfinished request(s)", n)
+        self._flight_event("journal_replay", entries=len(entries),
+                           resumed=n)
+        if self.span_log.enabled:
+            s = Span("engine.journal_replay",
+                     trace_id=self._span_ctx.trace_id,
+                     parent_id=self._span_ctx.span_id,
+                     start_mono=t0_mono, start_wall=t0_wall)
+            s.end().set(entries=len(entries), resumed=n)
+            self.span_log.write(s)
         return n
 
     def _next_pending(self) -> Request:
@@ -734,6 +975,10 @@ class Scheduler:
             # step boundary, so a crash never loses a token a client
             # already saw; the batch fsync policy piggybacks here
             self.journal.poll()
+            comp = self.journal.compactions
+            if comp > self._journal_compactions_seen:
+                self._journal_compactions_seen = comp
+                self._flight_event("journal_compaction", count=comp)
         with self._lock:
             self.stats["queue_depth"] = self.pending.qsize()
             self.stats["active_slots"] = sum(
@@ -780,9 +1025,11 @@ class Scheduler:
                     time.sleep(0.01)
                     continue
                 self._mark_scheduled(req)
+                pspan = self._begin_prefill_span(req)
                 t0 = time.monotonic()
                 try:
-                    tok, kv, true_len, bucket = self._prefill_req(req)
+                    tok, kv, true_len, bucket = self._prefill_req(
+                        req, span=pspan)
                 except Exception as e:  # noqa: BLE001
                     import logging
 
@@ -814,6 +1061,7 @@ class Scheduler:
                     self._fault_event.set()
                     continue
                 self._h_prefill.observe(time.monotonic() - t0)
+                self._end_prefill_span(req, pspan)
                 self._inc("prefill_total")
                 # under _lock so a prefill that outlives stop()'s join
                 # or a scheduler-thread death (e.g. a slow remote PD
@@ -868,6 +1116,7 @@ class Scheduler:
                 raise
             self.slots[slot] = req
             self._slot_changed(slot)
+            self._note_slot_assign(slot, req)
             self._temp[slot] = req.temperature
             self._top_k[slot] = req.top_k
             self._top_p[slot] = req.top_p
@@ -905,10 +1154,13 @@ class Scheduler:
                     self._requeue.appendleft(req)
                     break
                 self._mark_scheduled(req)
+                pspan = self._begin_prefill_span(req)
                 t0 = time.monotonic()
                 try:
-                    tok, kv, true_len, bucket = self._prefill_req(req)
+                    tok, kv, true_len, bucket = self._prefill_req(
+                        req, span=pspan)
                     self._h_prefill.observe(time.monotonic() - t0)
+                    self._end_prefill_span(req, pspan)
                     ikw = {} if req.adapter is None \
                         else {"adapter": req.adapter}
                     self.state = self.engine.insert(
@@ -938,6 +1190,7 @@ class Scheduler:
                     raise
                 self.slots[slot] = req
                 self._slot_changed(slot)
+                self._note_slot_assign(slot, req)
                 self._temp[slot] = req.temperature
                 self._top_k[slot] = req.top_k
                 self._top_p[slot] = req.top_p
@@ -981,13 +1234,21 @@ class Scheduler:
         it runs AFTER the next step was dispatched, and the async copy
         decode() started is usually already complete."""
         did = False
+        drained = 0
         while len(self._inflight) > keep:
             toks, snap_slots, snap_gens = self._inflight.popleft()
             if isinstance(toks, _SpecStep):
                 self._drain_spec(toks, snap_slots, snap_gens)
                 did = True
+                drained += 1
                 continue
+            # phase attribution: the block below is the lag-queue
+            # read — the only point the host waits on the device —
+            # and the emit loop after it is host-side sampling/offload
+            t_read = time.monotonic()
             host_toks = np.asarray(toks)
+            t_fetched = time.monotonic()
+            self._ph_wait.observe(t_fetched - t_read)
             for slot, req in enumerate(snap_slots):
                 if (req is None or self.slots[slot] is not req
                         or self._slot_gen[slot] != snap_gens[slot]):
@@ -995,8 +1256,14 @@ class Scheduler:
                 tok = int(host_toks[slot])
                 req.emit(tok)
                 self._inc("tokens_generated_total")
+                self._note_decode_progress(req)
                 self._maybe_finish(slot, tok)
+            self._ph_sample.observe(time.monotonic() - t_fetched)
             did = True
+            drained += 1
+        if drained:
+            self._flight_event("pipeline_drain", steps=drained,
+                               kept=keep)
         return did
 
     def _drain_spec(self, step: _SpecStep, snap_slots, snap_gens):
@@ -1009,10 +1276,14 @@ class Scheduler:
         never have run without speculation; the usual generation
         check discards whole slots that changed occupant since
         dispatch."""
+        t_read = time.monotonic()
         host_out = np.asarray(step.out)
         host_acc = np.asarray(step.accepted)
+        t_fetched = time.monotonic()
+        self._ph_wait.observe(t_fetched - t_read)
         dlen = step.draft_len
         proposed = int(dlen.sum())
+        accepted = 0
         if proposed:
             # acceptance accounting covers every drafting slot, even
             # ones whose tokens are later discarded — the drafter/
@@ -1022,6 +1293,9 @@ class Scheduler:
             for slot in np.nonzero(dlen)[0]:
                 self._h_spec_accepted.observe(int(host_acc[slot]))
             self._inc("spec_accepted_tokens_total", accepted)
+        self._spec_last = (proposed, accepted)
+        self._flight_event("spec_accept", proposed=proposed,
+                           accepted=accepted)
         commit = getattr(self.engine, "commit_spec", None)
         for slot, req in enumerate(snap_slots):
             if (req is None or self.slots[slot] is not req
@@ -1032,12 +1306,14 @@ class Scheduler:
                 # paged KV: reconcile the host length mirror and
                 # return the speculative surplus blocks to the pool
                 commit(slot, n)
+            self._note_decode_progress(req, tokens=n)
             for tok in host_out[slot, :n]:
                 req.emit(int(tok))
                 self._inc("tokens_generated_total")
                 self._maybe_finish(slot, int(tok))
                 if self.slots[slot] is not req:
                     break  # finished mid-prefix: drop the tail
+        self._ph_sample.observe(time.monotonic() - t_fetched)
 
     def _decode(self) -> bool:
         if not any(r is not None for r in self.slots):
@@ -1061,7 +1337,11 @@ class Scheduler:
             self._drain_inflight()
             if not any(r is not None for r in self.slots):
                 return True  # draining finished every slot
-        mask = self._build_mask() if masked else None
+        mask = None
+        if masked:
+            tm0 = time.monotonic()
+            mask = self._build_mask()
+            self._ph_mask.observe(time.monotonic() - tm0)
         # speculative decoding: draft with the host-side n-gram
         # matcher and verify the whole batch in one multi-token
         # forward. Masked batches stay non-speculative (the grammar
@@ -1116,6 +1396,7 @@ class Scheduler:
         self._ewma_step_s = dt if self._ewma_step_s is None \
             else 0.9 * self._ewma_step_s + 0.1 * dt
         self._h_decode_step.observe(dt)
+        self._ph_dispatch.observe(dt)
         self._inc("decode_steps_total")
         if drafts is not None:
             self._inc("spec_steps_total")
@@ -1148,12 +1429,28 @@ class Scheduler:
             # preempted
             req.prompt_ids = list(req.prompt_ids) + list(
                 req.output_ids[int(self._base_out[slot]):])
+            self._flush_decode_chunk(req, final=True)
+            self._flight_event("preempt_fold", slot=slot,
+                               request=req.id,
+                               folded=len(req.output_ids)
+                               - int(self._base_out[slot]))
             self._requeue.appendleft(req)
             self._inc("preemptions_total")
             if self.overlap:
                 self._free_slots.release()
         if depth == 0:
             self._drain_inflight()
+        if drafts is not None and self.span_log.enabled:
+            # one span per verify round, timed over dispatch + drain
+            # (depth 0 forces the drain above, so _spec_last is fresh)
+            prop, acc = self._spec_last
+            s = Span("engine.spec_verify",
+                     trace_id=self._span_ctx.trace_id,
+                     parent_id=self._span_ctx.span_id,
+                     start_mono=t0,
+                     start_wall=time.time() - (time.monotonic() - t0))
+            s.end().set(proposed=prop, accepted=acc)
+            self.span_log.write(s)
         return True
 
     def _spec_headroom(self, k: int) -> bool:
@@ -1226,7 +1523,7 @@ class Scheduler:
         stats = self.engine.kv_pool_stats
         return stats["kv_blocks_free"] >= need
 
-    def _prefill_req(self, req: Request):
+    def _prefill_req(self, req: Request, span: Optional[Span] = None):
         """Engine prefill for one request; constrained requests pass
         the grammar mask for their FIRST sampled token."""
         kw = {}
@@ -1241,7 +1538,15 @@ class Scheduler:
             # request's own deadline and stamp its traceparent on the
             # wire (engine/pd.py)
             kw["deadline"] = req.deadline
-            kw["trace"] = req.trace
+            trace = req.trace
+            if span is not None:
+                # hand PD the PREFILL span as the context, so its
+                # per-peer attempt spans (and the peer's own engine
+                # span, via the forwarded header) nest under the
+                # prefill phase rather than the whole request
+                trace = SpanContext(trace_id=span.trace_id,
+                                    span_id=span.span_id)
+            kw["trace"] = trace
         return self.engine.prefill(req.prompt_ids, req.temperature,
                                    req.top_k, req.top_p, **kw)
 
@@ -1335,6 +1640,8 @@ class Scheduler:
                 self._free_slots.release()
 
     def _go_dead(self) -> bool:
+        self._flight_event("dead", restarts=self._restarts)
+        self._flight_autodump("dead")
         with self._lock:
             self._status = "dead"
         # `engine_fault` (vs `shutdown`): the replica crashed out from
@@ -1353,6 +1660,13 @@ class Scheduler:
         import logging
         log = logging.getLogger("ome.engine")
         self._inc("engine_faults_total")
+        # narrate the fault into the ring, then persist the ring: the
+        # dump carries every event that LED INTO this fault even if
+        # the process never recovers far enough to serve /debug/events
+        self._flight_event("crash_recovery",
+                           restart=self._restarts + 1,
+                           error=str(err)[:160])
+        self._flight_autodump("engine_fault")
         with self._lock:
             self._status = "degraded"
         self._restarts += 1

@@ -171,6 +171,30 @@ def build_parser() -> argparse.ArgumentParser:
                    help="enable POST /debug/profile?seconds=N: "
                         "on-demand jax.profiler captures into this "
                         "directory (no-op off-TPU; off when unset)")
+    p.add_argument("--span-log", default=None, metavar="PATH",
+                   help="span-timeline JSONL path: one record per "
+                        "finished phase span (queue, prefill, decode "
+                        "chunks, spec verify, drain ...) joinable "
+                        "across processes by trace id and merged into "
+                        "a Perfetto timeline by "
+                        "scripts/trace_export.py "
+                        "(docs/tracing-timeline.md)")
+    p.add_argument("--debug-endpoints", action="store_true",
+                   help="enable GET /debug/events (flight-recorder "
+                        "ring) and GET /debug/state (scheduler "
+                        "snapshot); 403 when off — these expose "
+                        "request ids and internals, keep them off "
+                        "public listeners")
+    p.add_argument("--flight-events", type=int, default=2048,
+                   metavar="N",
+                   help="flight-recorder ring capacity: the last N "
+                        "scheduler lifecycle events kept in memory "
+                        "for /debug/events and crash dumps")
+    p.add_argument("--flight-dump-dir", default=None, metavar="DIR",
+                   help="auto-dump the flight-recorder ring into DIR "
+                        "as flight-<pid>-<n>.json on engine-fault "
+                        "recovery and permanent death (the chaos "
+                        "harness reads these into violation bundles)")
     return p
 
 
@@ -421,6 +445,7 @@ class DrainController:
         dur = time.monotonic() - t0
         if self._g_duration is not None:
             self._g_duration.set(dur)
+        self._record_drain_span(t0, dur, drained)
         if drained:
             log.info("drain complete in %.2fs (all requests "
                      "finished)", dur)
@@ -432,6 +457,26 @@ class DrainController:
                         if self.journal is not None else "")
         self.drained = drained
         return drained
+
+    def _record_drain_span(self, t0: float, dur: float,
+                           drained: bool) -> None:
+        """Timeline + flight-recorder marks for the drain window (the
+        scheduler's span_log/flight, when it has them)."""
+        flight = getattr(self.scheduler, "flight", None)
+        if flight is not None:
+            flight.record("drain_end", drained=drained,
+                          dur_s=round(dur, 3), forced=self._force.is_set())
+        span_log = getattr(self.scheduler, "span_log", None)
+        if span_log is None or not span_log.enabled:
+            return
+        from ..telemetry.tracing import Span
+        ctx = getattr(self.scheduler, "_span_ctx", None)
+        span = Span.begin("engine.drain", ctx=ctx, start_mono=t0,
+                          start_wall=time.time() - dur)
+        span.set(drained=drained, forced=self._force.is_set(),
+                 grace_s=self.grace)
+        span.end(t0 + dur)
+        span_log.write(span)
 
 
 class _PrefillNodeScheduler(_NullScheduler):
@@ -514,6 +559,11 @@ def main(argv=None) -> int:
     pd_prefill = None
     journal = None
     reqlog = None
+    span_log = None
+    if args.span_log:
+        from ..telemetry.tracing import SpanLog
+        span_log = SpanLog(args.span_log, component="engine")
+        log.info("span timeline at %s", args.span_log)
     if args.journal and (args.task == "embed"
                          or args.disaggregation_mode == "prefill"):
         log.warning("--journal only applies to generation/decode "
@@ -546,7 +596,8 @@ def main(argv=None) -> int:
                 engine, peer_urls=prefill_urls,
                 timeout=args.pd_attempt_timeout,
                 local_fallback=args.pd_local_fallback,
-                request_log=reqlog)
+                request_log=reqlog,
+                span_log=span_log)
             log.info("PD decode node: prefill pool %s%s",
                      prefill_urls,
                      " (local fallback)" if args.pd_local_fallback
@@ -581,12 +632,17 @@ def main(argv=None) -> int:
                 provenance=provenance)
             log.info("request journal at %s (fsync=%s)",
                      journal.path, args.journal_fsync)
+        from ..telemetry.flight import FlightRecorder
+        flight = FlightRecorder(capacity=max(args.flight_events, 16))
         scheduler = Scheduler(engine, overlap=dist is None,
                               max_restarts=args.max_restarts,
                               max_queue_wait=args.max_queue_wait,
                               pipeline_depth=args.pipeline_depth,
                               spec_tokens=args.spec_tokens,
-                              journal=journal)
+                              journal=journal,
+                              span_log=span_log,
+                              flight=flight,
+                              flight_dump_dir=args.flight_dump_dir)
     tok = load_tokenizer(args.model_dir)
     name = args.model_name or args.model_dir.rstrip("/").rsplit("/", 1)[-1]
     server = EngineServer(scheduler, tokenizer=tok, model_name=name,
@@ -595,6 +651,7 @@ def main(argv=None) -> int:
                           request_log=(reqlog if reqlog is not None
                                        else args.request_log),
                           profile_dir=args.profile_dir,
+                          debug_endpoints=args.debug_endpoints,
                           # structured outputs work in every generation
                           # mode: masks ship inside the replicated op
                           # stream (multi-host) and the first token's
@@ -619,6 +676,8 @@ def main(argv=None) -> int:
     finally:
         server.stop()
         scheduler.stop()
+        if span_log is not None:
+            span_log.close()  # idempotent (Scheduler.stop also closes)
         if journal is not None:
             # stop() evicted leftovers with finish_reason=shutdown,
             # which flushed their final progress WITHOUT tombstones —

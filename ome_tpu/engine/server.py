@@ -32,7 +32,8 @@ from .tokenizer import load_tokenizer
 _KNOWN_PATHS = frozenset((
     "/health", "/healthz", "/ready", "/metrics", "/v1/models",
     "/v1/completions", "/v1/chat/completions", "/v1/embeddings",
-    "/v1/adapters", "/pd/prefill", "/debug/profile"))
+    "/v1/adapters", "/pd/prefill", "/debug/profile",
+    "/debug/events", "/debug/state"))
 
 
 def _path_label(path: str) -> str:
@@ -49,7 +50,8 @@ class EngineServer:
                  structured: bool = True,
                  ready_queue_limit: Optional[int] = None,
                  registry: Optional[Registry] = None,
-                 request_log=None, profile_dir: Optional[str] = None):
+                 request_log=None, profile_dir: Optional[str] = None,
+                 debug_endpoints: bool = False):
         self.scheduler = scheduler
         self.tokenizer = tokenizer or load_tokenizer()
         self.model_name = model_name
@@ -66,6 +68,10 @@ class EngineServer:
         # on-demand jax.profiler captures are opt-in (--profile-dir);
         # without it POST /debug/profile answers 403
         self.profile_dir = profile_dir
+        # GET /debug/events + /debug/state are the same kind of
+        # operator opt-in (--debug-endpoints): they expose request ids
+        # and scheduler internals, so they answer 403 by default
+        self.debug_endpoints = debug_endpoints
         self._http_requests = self.registry.counter(
             "ome_engine_http_requests_total",
             "HTTP requests served, by (bounded) path",
@@ -181,8 +187,56 @@ class EngineServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path.split("?", 1)[0] == "/debug/events":
+                    self._debug_events()
+                elif self.path.split("?", 1)[0] == "/debug/state":
+                    self._debug_state()
                 else:
                     self._json(404, {"error": "not found"})
+
+            def _debug_guard(self) -> bool:
+                """Shared 403 gate for the debug introspection
+                surfaces — same opt-in discipline as /debug/profile."""
+                if outer.debug_endpoints:
+                    return True
+                self._json(403, {
+                    "error": "debug endpoints disabled (launch with "
+                             "--debug-endpoints to enable)"})
+                return False
+
+            def _debug_events(self):
+                """GET /debug/events?n=K — the tail of the scheduler's
+                flight-recorder ring (telemetry/flight.py), newest
+                last."""
+                if not self._debug_guard():
+                    return
+                fl = getattr(outer.scheduler, "flight", None)
+                if fl is None:
+                    return self._json(404, {
+                        "error": "scheduler has no flight recorder"})
+                qs = urllib.parse.urlparse(self.path).query
+                params = urllib.parse.parse_qs(qs)
+                try:
+                    n = int(params.get("n", ["256"])[0])
+                except ValueError:
+                    return self._json(400, {
+                        "error": "n must be an integer"})
+                doc = fl.state()
+                doc["events"] = fl.snapshot(n)
+                return self._json(200, doc)
+
+            def _debug_state(self):
+                """GET /debug/state — live scheduler snapshot (slots,
+                queue, KV pool, journal, drain), the point-in-time
+                complement to the flight recorder's history."""
+                if not self._debug_guard():
+                    return
+                state_fn = getattr(outer.scheduler, "debug_state",
+                                   None)
+                if state_fn is None:
+                    return self._json(404, {
+                        "error": "scheduler has no debug_state"})
+                return self._json(200, state_fn())
 
             # -- POST -------------------------------------------------
             def do_POST(self):

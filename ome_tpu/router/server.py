@@ -413,13 +413,20 @@ class RouterServer:
                  port: int = 0, retries: int = 2,
                  retry_backoff: float = 0.05,
                  retry_budget_ratio: float = 0.2,
-                 request_log=None):
+                 request_log=None, span_log=None):
         self.router = router
         self.retries = retries
         self.retry_backoff = retry_backoff
         self.budget = RetryBudget(ratio=retry_budget_ratio)
         self._jitter = random.Random(1)
         self.request_log = _coerce_reqlog(request_log)
+        # span timeline (docs/tracing-timeline.md): one router.request
+        # root span per proxied request plus one router.attempt span
+        # per forward — the attempt's span id IS the traceparent child
+        # the backend receives, so engine spans nest under the exact
+        # attempt that carried them
+        self.span_log = tracing.coerce_span_log(span_log,
+                                                component="router")
         self._h_request = router.registry.histogram(
             "ome_router_request_seconds",
             "End-to-end proxied request seconds (retries included)")
@@ -503,12 +510,29 @@ class RouterServer:
                 t0 = time.monotonic()
                 outcome = {"backend": None, "pool": None,
                            "status": "error", "retries": 0}
+                # root timeline span: reuses the context's span id, so
+                # per-attempt child spans (and through them the engine
+                # spans) all parent on this one record
+                span = None
+                if outer.span_log.enabled:
+                    span = tracing.Span("router.request",
+                                        trace_id=ctx.trace_id,
+                                        span_id=ctx.span_id,
+                                        start_mono=t0)
+                    span.set(path=self.path)
                 try:
                     return self._route(body, stream, affinity, ctx,
                                        outcome)
                 finally:
                     dur = time.monotonic() - t0
                     outer._h_request.observe(dur)
+                    if span is not None:
+                        span.set(pool=outcome["pool"],
+                                 backend=outcome["backend"],
+                                 status=outcome["status"],
+                                 retries=outcome["retries"])
+                        span.end(t0 + dur)
+                        outer.span_log.write(span)
                     if outer.request_log.enabled:
                         outer.request_log.write({
                             "component": "router",
@@ -564,12 +588,26 @@ class RouterServer:
                     tried.add(backend.url)
                     outcome["backend"] = backend.url
                     outcome["retries"] = failures
+                    # the child context is minted BEFORE the forward so
+                    # the attempt span can claim its span id — engine
+                    # records parenting on the forwarded traceparent
+                    # then nest under this exact attempt
+                    child = ctx.child()
+                    aspan = None
+                    if outer.span_log.enabled:
+                        aspan = tracing.Span("router.attempt",
+                                             trace_id=ctx.trace_id,
+                                             parent_id=ctx.span_id,
+                                             span_id=child.span_id)
+                        aspan.set(backend=backend.url,
+                                  retries=failures)
                     try:
                         result = self._forward(backend, body, stream,
-                                               deadline,
-                                               trace=ctx.child())
+                                               deadline, trace=child)
                         outer.router.note_result(backend, ok=True)
                         outcome["status"] = "ok"
+                        if aspan is not None:
+                            outer.span_log.write(aspan.set(status="ok"))
                         return result
                     except _BackendDraining:
                         # deliberate shutdown, not a fault: take the
@@ -579,6 +617,9 @@ class RouterServer:
                         outer.router.inc("draining_skips_total")
                         log.info("backend %s draining; redirecting",
                                  backend.url)
+                        if aspan is not None:
+                            outer.span_log.write(
+                                aspan.set(status="draining"))
                         continue
                     except _ClientGone:
                         # the CLIENT went away: nothing to retry, and
@@ -586,6 +627,9 @@ class RouterServer:
                         # its half-open probe slot if this was a probe
                         outer.router.probe_aborted(backend)
                         outcome["status"] = "client_gone"
+                        if aspan is not None:
+                            outer.span_log.write(
+                                aspan.set(status="client_gone"))
                         return None
                     except _ResponseStarted as e:
                         # bytes already reached the client: a retry
@@ -599,6 +643,9 @@ class RouterServer:
                             pass
                         self.close_connection = True
                         outcome["status"] = "stream_abort"
+                        if aspan is not None:
+                            outer.span_log.write(
+                                aspan.set(status="stream_abort"))
                         return None
                     except (urllib.error.URLError, OSError,
                             ConnectionError) as e:
@@ -607,6 +654,9 @@ class RouterServer:
                         outer.router.inc("retries_total")
                         log.warning("backend %s failed (%s); retrying",
                                     backend.url, e)
+                        if aspan is not None:
+                            outer.span_log.write(aspan.set(
+                                status="error", error=str(e)))
                         failures += 1
                         need_backoff = True
                 outer.router.inc("no_backend_total")
@@ -733,6 +783,7 @@ class RouterServer:
         if self._thread:
             self._thread.join(timeout=5)
         self.request_log.close()
+        self.span_log.close()
 
 
 def discover_backends(client, namespace: str, selector: Dict[str, str],
@@ -789,6 +840,12 @@ def main(argv=None) -> int:
                    help="JSONL request-log path (one record per "
                         "proxied request with trace id, backend, "
                         "retries, duration; docs/observability.md)")
+    p.add_argument("--span-log", default=None,
+                   help="span-timeline JSONL path (router.request / "
+                        "router.attempt spans, joinable with engine "
+                        "span logs by trace id via "
+                        "scripts/trace_export.py; "
+                        "docs/tracing-timeline.md)")
     p.add_argument("--engine-selector", default=None,
                    help="k8s label selector for engine Services "
                         "(k=v[,k=v]); requires --in-cluster/--kube-*")
@@ -834,7 +891,8 @@ def main(argv=None) -> int:
     srv = RouterServer(router, host=args.bind, port=args.port,
                        retries=args.retries,
                        retry_backoff=args.retry_backoff,
-                       request_log=args.request_log).start()
+                       request_log=args.request_log,
+                       span_log=args.span_log).start()
     log.info("router on :%d over %d backends (policy=%s)", srv.port,
              len(backends), args.policy)
     try:

@@ -2,13 +2,18 @@
 
 What the reference's operator assumes its engines provide (scrapeable
 Prometheus metrics for KEDA autoscaling, probe-able latency signals)
-but dependency-free and shared across every in-repo binary. Four
+but dependency-free and shared across every in-repo binary. Five
 pieces, each usable alone:
 
   * registry  — labeled Counters/Gauges/Histograms + text 0.0.4
                 exposition (`Registry.render()` IS the /metrics body);
   * tracing   — W3C traceparent SpanContext minted at the router and
-                propagated router→engine→scheduler;
+                propagated router→engine→scheduler, plus Span/SpanLog
+                timed-phase records (`--span-log`) that
+                scripts/trace_export.py merges into a Perfetto
+                timeline;
+  * flight    — bounded in-memory ring of scheduler lifecycle events
+                (`GET /debug/events?n=`, crash-dumped on recovery);
   * reqlog    — per-request JSONL records (`--request-log`) carrying
                 the trace id, phase latencies, and finish reason;
   * profiler  — guarded on-demand jax.profiler capture
@@ -18,16 +23,19 @@ Metric catalog + contracts: docs/observability.md. Naming rules are
 linted by scripts/check_metrics.py (tier-1).
 """
 
+from .flight import FlightRecorder
 from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                        MetricFamily, Registry, escape_label_value,
                        format_value)
 from .reqlog import RequestLog
-from .tracing import (TRACEPARENT_HEADER, SpanContext, from_headers,
-                      new_trace, parse_traceparent)
+from .tracing import (TRACEPARENT_HEADER, Span, SpanContext, SpanLog,
+                      coerce_span_log, from_headers, new_trace,
+                      parse_traceparent)
 
 __all__ = [
-    "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "MetricFamily",
-    "Registry", "RequestLog", "SpanContext", "TRACEPARENT_HEADER",
-    "escape_label_value", "format_value", "from_headers", "new_trace",
-    "parse_traceparent",
+    "DEFAULT_BUCKETS", "Counter", "FlightRecorder", "Gauge",
+    "Histogram", "MetricFamily", "Registry", "RequestLog", "Span",
+    "SpanContext", "SpanLog", "TRACEPARENT_HEADER",
+    "coerce_span_log", "escape_label_value", "format_value",
+    "from_headers", "new_trace", "parse_traceparent",
 ]

@@ -19,15 +19,26 @@ constants allow; a name whose static prefix already violates the
 rules fails, one that is entirely dynamic is reported (loudly) but
 not failed — the runtime registry still enforces `_total`.
 
-Usage: python scripts/check_metrics.py [root-dir]    (default: ome_tpu)
+In default (whole-repo) mode the lint ALSO cross-checks the metric
+catalog in docs/observability.md both ways: every statically
+resolvable `ome_*` declaration must have a catalog row, and every
+catalogued `ome_*` name must still be declared somewhere — so the
+docs cannot silently drift from the code. F-string names whose single
+placeholder iterates a module-level dict (the `_COUNTER_HELP`
+pattern) are expanded key by key for this comparison. `model_agent_*`
+names are exempt (that catalog section is prose by design).
+
+Usage: python scripts/check_metrics.py [root-dir]    (default: ome_tpu
++ the docs drift check)
 """
 
 from __future__ import annotations
 
 import ast
 import pathlib
+import re
 import sys
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 ALLOWED_PREFIXES = ("ome_", "model_agent_")
 DECL_METHODS = ("counter", "gauge", "histogram")
@@ -82,6 +93,82 @@ def _static_prefix(node, consts: Dict[str, str]
     return "", False
 
 
+def _module_str_dicts(tree: ast.Module) -> Dict[str, List[str]]:
+    """Module-level `NAME = {"k": ..., ...}` dicts with all-string
+    keys — the `_COUNTER_HELP` declaration pattern."""
+    dicts: Dict[str, List[str]] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Dict)):
+            keys = [k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)]
+            if len(keys) == len(node.value.keys):
+                dicts[node.targets[0].id] = keys
+    return dicts
+
+
+def _loop_bindings(tree: ast.Module,
+                   str_dicts: Dict[str, List[str]]
+                   ) -> Dict[str, List[str]]:
+    """{loop_var: possible values} for every `for VAR, ... in
+    D.items()` — statement or comprehension — over a module-level
+    string-keyed dict D. Lets the drift check expand
+    `f"ome_engine_{key}"` into one name per dict key."""
+    binds: Dict[str, List[str]] = {}
+
+    def note(target, it):
+        if not (isinstance(it, ast.Call)
+                and isinstance(it.func, ast.Attribute)
+                and it.func.attr == "items"
+                and isinstance(it.func.value, ast.Name)
+                and it.func.value.id in str_dicts):
+            return
+        if isinstance(target, ast.Tuple) and target.elts:
+            target = target.elts[0]
+        if isinstance(target, ast.Name):
+            binds.setdefault(target.id, []).extend(
+                str_dicts[it.func.value.id])
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.For):
+            note(node.target, node.iter)
+        elif isinstance(node, ast.comprehension):
+            note(node.target, node.iter)
+    return binds
+
+
+def _resolved_names(arg, consts: Dict[str, str],
+                    binds: Dict[str, List[str]]) -> List[str]:
+    """Every metric name a declaration's first argument can evaluate
+    to: one entry for a static name, the expanded set for an f-string
+    whose placeholders resolve through constants or .items() loop
+    variables, [] when unresolvable."""
+    text, fully = _static_prefix(arg, consts)
+    if fully:
+        return [text]
+    if isinstance(arg, ast.JoinedStr):
+        names = [""]
+        for piece in arg.values:
+            if isinstance(piece, ast.Constant):
+                names = [n + str(piece.value) for n in names]
+            elif (isinstance(piece, ast.FormattedValue)
+                    and isinstance(piece.value, ast.Name)):
+                var = piece.value.id
+                if var in consts:
+                    names = [n + consts[var] for n in names]
+                elif var in binds:
+                    names = [n + k for n in names
+                             for k in binds[var]]
+                else:
+                    return []
+            else:
+                return []
+        return names
+    return []
+
+
 def _labelnames(call: ast.Call) -> Optional[ast.expr]:
     for kw in call.keywords:
         if kw.arg == "labelnames":
@@ -128,47 +215,94 @@ def _check_call(call: ast.Call, kind: str, consts: Dict[str, str],
                     "put it in the request log, not a label"))
 
 
-def check_file(path: pathlib.Path) -> Tuple[List[Violation], List[str]]:
+def check_file(path: pathlib.Path
+               ) -> Tuple[List[Violation], List[str], Set[str]]:
     tree = ast.parse(path.read_text(encoding="utf-8"),
                      filename=str(path))
     consts = _module_str_consts(tree)
+    binds = _loop_bindings(tree, _module_str_dicts(tree))
     violations: List[Violation] = []
     dynamic: List[str] = []
+    declared: Set[str] = set()
     for node in ast.walk(tree):
         if (isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
                 and node.func.attr in DECL_METHODS):
             _check_call(node, node.func.attr, consts, path,
                         violations, dynamic)
-    return violations, dynamic
+            if node.args:
+                declared.update(
+                    _resolved_names(node.args[0], consts, binds))
+    return violations, dynamic, declared
+
+
+def documented_names(md_path: pathlib.Path) -> Set[str]:
+    """Metric names from the docs/observability.md catalog tables:
+    rows of the form `| \\`name{labels}\\` | type | meaning |` (the
+    `{labels}` suffix is display-only and stripped)."""
+    rx = re.compile(r"^\|\s*`([A-Za-z_][A-Za-z0-9_]*)"
+                    r"(?:\{[^}]*\})?`\s*\|")
+    names: Set[str] = set()
+    for line in md_path.read_text(encoding="utf-8").splitlines():
+        m = rx.match(line)
+        if m:
+            names.add(m.group(1))
+    return names
+
+
+def docs_drift(declared: Set[str], doc_path: pathlib.Path) -> List[str]:
+    """Both directions of catalog drift, scoped to `ome_*` names."""
+    documented = documented_names(doc_path)
+    in_scope = lambda ns: {n for n in ns if n.startswith("ome_")}  # noqa: E731
+    drift = []
+    for name in sorted(in_scope(declared) - documented):
+        drift.append(f"{name}: declared in source but missing from "
+                     f"{doc_path.name} catalog")
+    for name in sorted(in_scope(documented) - declared):
+        drift.append(f"{name}: documented in {doc_path.name} but "
+                     "declared nowhere in the tree")
+    return drift
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    root = pathlib.Path(argv[0]) if argv else \
-        pathlib.Path(__file__).resolve().parents[1] / "ome_tpu"
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    # the docs cross-check only applies to the repo's own tree — an
+    # explicit root (tests linting a scratch dir) skips it
+    drift_mode = not argv
+    root = pathlib.Path(argv[0]) if argv else repo / "ome_tpu"
     if not root.exists():
         print(f"check_metrics: no such directory {root}",
               file=sys.stderr)
         return 2
     violations: List[Violation] = []
     dynamic: List[str] = []
+    declared: Set[str] = set()
     files = sorted(root.rglob("*.py"))
     # the registry implementation itself manipulates generic names;
     # its internal calls are not declarations
     files = [f for f in files
              if "telemetry" not in f.parts or f.name != "registry.py"]
     for f in files:
-        v, d = check_file(f)
+        v, d, names = check_file(f)
         violations.extend(v)
         dynamic.extend(d)
+        declared.update(names)
+    drift: List[str] = []
+    if drift_mode:
+        doc = repo / "docs" / "observability.md"
+        if doc.exists():
+            drift = docs_drift(declared, doc)
     for note in dynamic:
         print(f"note: {note}")
     for v in violations:
         print(f"VIOLATION: {v}")
+    for d in drift:
+        print(f"DRIFT: {d}")
     print(f"check_metrics: {len(files)} files, "
-          f"{len(violations)} violation(s)")
-    return 1 if violations else 0
+          f"{len(violations)} violation(s)"
+          + (f", {len(drift)} drift" if drift_mode else ""))
+    return 1 if violations or drift else 0
 
 
 if __name__ == "__main__":

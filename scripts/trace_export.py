@@ -1,0 +1,22 @@
+#!/usr/bin/env python3
+"""Merge span logs (+ flight dumps) into Perfetto-loadable JSON.
+
+Thin CLI over `ome_tpu.telemetry.export` (kept importable so the
+chaos harness can build violation bundles in-process):
+
+    python scripts/trace_export.py router.spans engine.spans \
+        --flight flight-1234.json -o trace.json --split-by-trace out/
+
+Open the result at https://ui.perfetto.dev or chrome://tracing.
+Span model + walkthrough: docs/tracing-timeline.md.
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from ome_tpu.telemetry.export import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,7 @@ output, not by luck):
 Everything heavier (multi-node pools behind a router) is `slow`.
 """
 
+import json
 import pathlib
 
 import pytest
@@ -88,6 +89,48 @@ def test_fixed_seed_pd_episode(tmp_path):
     ep = _run_one(tmp_path, topo, seed=4)
     assert any(act == "kill_prefill" for _, act, _ in ep.events)
     assert ep.fault_specs.get("decode0", "").startswith("pd_")
+
+
+def test_forced_violation_collects_bundle(tmp_path):
+    """A violating episode leaves a replay bundle: the schedule +
+    violations, one flight-recorder dump per live engine child
+    (grabbed over /debug/events while the topology is still up), and
+    every span log merged into an exported Perfetto trace. Seed 5
+    derives an empty fault/event schedule for this topology, so the
+    only violation is the forced one and the episode stays fast."""
+    topo = chaos.Topology(prefill=0, decode=0, unified=1, router=False,
+                          kv_block=16, kv_blocks=40)
+    runner = chaos.ChaosRunner(topo, pathlib.Path(tmp_path),
+                               journal_drain_timeout=60.0,
+                               force_violation=True)
+    try:
+        ep = chaos._plan_episode(5, 0, topo, 2, 0.5)
+        assert not ep.fault_specs and not ep.events
+        runner.run_episode(ep)
+    finally:
+        runner.close()
+    assert any("forced violation" in v for v in ep.violations)
+
+    bundle = pathlib.Path(tmp_path) / "ep0" / "bundle"
+    assert bundle.is_dir()
+    # the manifest replays the episode and indexes the artifacts
+    manifest = json.loads((bundle / "violation.json").read_text())
+    assert manifest["schedule"]["seed"] == 5
+    assert any("forced violation" in v
+               for v in manifest["violations"])
+    assert "--episode 0" in manifest["replay"]
+    # per-child flight dump, shaped like FlightRecorder.dump() output
+    flight = json.loads((bundle / "flight-unified0.json").read_text())
+    assert flight["component"] == "unified0"
+    events = [e["event"] for e in flight["events"]]
+    assert "admit" in events and "slot_assign" in events
+    # the merged trace is valid Chrome Trace JSON with the engine's
+    # request spans and the flight marks folded in
+    trace = json.loads((bundle / "trace.json").read_text())
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "engine.request" in names
+    assert any(n.startswith("flight:") for n in names)
+    assert trace["otherData"]["span_count"] > 0
 
 
 @pytest.mark.slow

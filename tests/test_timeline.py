@@ -1,0 +1,490 @@
+"""Span timeline + flight recorder + Perfetto export (ISSUE 7).
+
+Covers the introspection layer end to end: span parenting across
+router -> engine -> PD prefill within one trace, chunked decode spans
+tiling a request's stream without overlap, the flight ring's bounds
+and eviction, the crash auto-dump on engine-fault recovery, the
+guarded /debug/events + /debug/state surfaces, and the Chrome Trace
+Event exporter (telemetry/export.py) producing monotonic-consistent
+JSON that Perfetto can load.
+"""
+
+import json
+import pathlib
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ome_tpu.engine.scheduler import Request, Scheduler
+from ome_tpu.engine.server import EngineServer
+from ome_tpu.engine.tokenizer import ByteTokenizer
+from ome_tpu.telemetry import export
+from ome_tpu.telemetry.flight import FlightRecorder
+from ome_tpu.telemetry.tracing import Span, SpanLog, new_trace
+
+from test_faults import FakeEngine, _get, _post
+
+
+def _wait_spans(path, want, timeout=15.0):
+    """Spans from a JSONL log once at least `want(spans)` holds —
+    writers flush after the response bytes, so reads can race."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        spans = export.load_spans([path])
+        if want(spans):
+            return spans
+        time.sleep(0.05)
+    raise AssertionError(
+        f"span log {path} never satisfied the predicate; "
+        f"have {[s['name'] for s in export.load_spans([path])]}")
+
+
+# -- span record unit behavior ---------------------------------------
+
+
+class TestSpan:
+    def test_begin_under_context_keeps_trace_new_span(self):
+        ctx = new_trace()
+        span = Span.begin("x", ctx=ctx)
+        assert span.trace_id == ctx.trace_id
+        assert span.parent_id == ctx.span_id
+        assert span.span_id != ctx.span_id
+
+    def test_record_schema_and_monotonic_duration(self):
+        span = Span.begin("phase")
+        span.set(k="v")
+        span.end()
+        rec = span.record()
+        assert rec["kind"] == "span"
+        assert rec["name"] == "phase"
+        assert rec["dur_s"] >= 0
+        assert rec["t_start"] > 0
+        assert rec["attrs"] == {"k": "v"}
+        for key in ("trace_id", "span_id"):
+            assert rec[key]
+
+    def test_attrs_bounded_and_truncated(self):
+        span = Span.begin("x")
+        for i in range(32):
+            span.set(**{f"a{i:02d}": "y" * 1000})
+        span.end()
+        attrs = span.record()["attrs"]
+        assert len(attrs) == 16
+        assert all(len(v) <= 256 for v in attrs.values())
+
+    def test_spanlog_writes_component_and_autoends(self, tmp_path):
+        p = tmp_path / "s.jsonl"
+        log = SpanLog(str(p), component="t")
+        log.write(Span.begin("open"))  # never .end()ed: log ends it
+        log.close()
+        (rec,) = export.load_spans([p])
+        assert rec["component"] == "t"
+        assert rec["dur_s"] >= 0
+        assert isinstance(rec["pid"], int)
+
+
+# -- flight recorder -------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_eviction(self):
+        fl = FlightRecorder(capacity=4, component="t")
+        for i in range(10):
+            fl.record("ev", i=i)
+        events = fl.snapshot()
+        assert len(events) == 4
+        assert [e["i"] for e in events] == [6, 7, 8, 9]  # newest kept
+        assert [e["seq"] for e in events] == [7, 8, 9, 10]
+        assert fl.recorded == 10
+        assert fl.dropped == 6
+        assert [e["i"] for e in fl.snapshot(2)] == [8, 9]
+        st = fl.state()
+        assert st["capacity"] == 4 and st["buffered"] == 4
+        assert st["recorded"] == 10 and st["dropped"] == 6
+
+    def test_capacity_must_be_positive(self):
+        with pytest.raises(ValueError):
+            FlightRecorder(capacity=0)
+
+    def test_dump_writes_loadable_doc(self, tmp_path):
+        fl = FlightRecorder(capacity=8, component="t")
+        fl.record("admit", request="r1")
+        path = tmp_path / "dump.json"
+        fl.dump(str(path), reason="test")
+        doc = json.loads(path.read_text())
+        assert doc["reason"] == "test"
+        assert doc["component"] == "t"
+        assert isinstance(doc["pid"], int)
+        assert [e["event"] for e in doc["events"]] == ["admit"]
+        # the exporter accepts the same file
+        assert export.load_flight_dumps([path]) == [doc]
+
+
+# -- chunked decode spans tile the stream ----------------------------
+
+
+def test_decode_chunks_tile_without_overlap(tmp_path):
+    log_path = tmp_path / "engine.jsonl"
+    sched = Scheduler(FakeEngine(max_slots=1), span_log=str(log_path),
+                      span_chunk_steps=3)
+    sched.start()
+    req = sched.submit(Request(prompt_ids=[1, 2, 3],
+                               max_new_tokens=10))
+    assert req.done.wait(timeout=30)
+    sched.stop()
+
+    spans = export.load_spans([log_path])
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    (root,) = by_name["engine.request"]
+    (q,) = by_name["engine.queue"]
+    (pre,) = by_name["engine.prefill"]
+    chunks = sorted(by_name["engine.decode"],
+                    key=lambda s: s["attrs"]["chunk"])
+    # every phase span hangs off the request span
+    for s in (q, pre, *chunks):
+        assert s["trace_id"] == root["trace_id"]
+        assert s["parent_id"] == root["span_id"]
+    # 10 tokens = 1 prefill + 9 decode steps -> chunks of 3/3/3
+    assert [c["attrs"]["chunk"] for c in chunks] == [0, 1, 2]
+    assert sum(c["attrs"]["steps"] for c in chunks) == 9
+    assert sum(c["attrs"]["tokens"] for c in chunks) == 9
+    # consecutive chunks tile: next start == previous end, so the
+    # chunk spans cover the decode stream with no gaps or overlap
+    for prev, nxt in zip(chunks, chunks[1:]):
+        assert nxt["t_start"] == pytest.approx(
+            prev["t_start"] + prev["dur_s"], abs=1e-4)
+    # and the whole tiling nests inside the request span's window
+    assert chunks[0]["t_start"] >= root["t_start"] - 1e-4
+    end = chunks[-1]["t_start"] + chunks[-1]["dur_s"]
+    assert end <= root["t_start"] + root["dur_s"] + 1e-4
+
+
+# -- crash dump on engine-fault recovery -----------------------------
+
+
+class _FaultyEngine(FakeEngine):
+    """Raises on the second decode step, then behaves."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.decode_calls = 0
+
+    def decode(self, state, t, k, p):
+        self.decode_calls += 1
+        if self.decode_calls == 2:
+            raise RuntimeError("injected decode fault")
+        return super().decode(state, t, k, p)
+
+
+def test_engine_fault_recovery_autodumps_flight_ring(tmp_path):
+    sched = Scheduler(_FaultyEngine(max_slots=1),
+                      flight_dump_dir=str(tmp_path),
+                      restart_backoff=0.01)
+    sched.start()
+    req = sched.submit(Request(prompt_ids=[1, 2], max_new_tokens=6))
+    assert req.done.wait(timeout=30)
+    assert req.finish_reason == "engine_fault"
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        dumps = sorted(tmp_path.glob("flight-*.json"))
+        if dumps:
+            break
+        time.sleep(0.05)
+    sched.stop()
+    assert dumps, "no flight auto-dump after engine-fault recovery"
+    doc = json.loads(dumps[0].read_text())
+    assert doc["reason"] == "engine_fault"
+    events = [e["event"] for e in doc["events"]]
+    assert "admit" in events
+    assert "crash_recovery" in events
+    assert sched.registry.get("ome_engine_flight_dumps_total") >= 1
+    assert sched.registry.get("ome_engine_flight_events_total") >= \
+        len(doc["events"])
+
+
+# -- guarded debug endpoints -----------------------------------------
+
+
+class TestDebugEndpoints:
+    def test_403_when_disabled(self):
+        srv = EngineServer(Scheduler(FakeEngine(max_slots=1)),
+                           tokenizer=ByteTokenizer(), model_name="t",
+                           port=0)  # debug_endpoints defaults off
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            for path in ("/debug/events", "/debug/state"):
+                status, body = _get(base + path)
+                assert status == 403
+                assert "--debug-endpoints" in body["error"]
+        finally:
+            srv.stop()
+
+    def test_events_and_state_schema_when_enabled(self):
+        sched = Scheduler(FakeEngine(max_slots=2))
+        srv = EngineServer(sched, tokenizer=ByteTokenizer(),
+                           model_name="t", port=0,
+                           debug_endpoints=True)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            status, _, out = _post(base + "/v1/completions",
+                                   {"prompt": "hi", "max_tokens": 3})
+            assert status == 200
+
+            status, doc = _get(base + "/debug/events")
+            assert status == 200
+            assert doc["component"] == "engine"
+            assert doc["recorded"] >= len(doc["events"]) > 0
+            names = [e["event"] for e in doc["events"]]
+            assert "admit" in names and "slot_assign" in names
+            for e in doc["events"]:
+                assert e["seq"] > 0 and e["t_wall"] > 0
+
+            status, one = _get(base + "/debug/events?n=1")
+            assert status == 200 and len(one["events"]) == 1
+            assert one["events"][0]["seq"] == doc["events"][-1]["seq"]
+            status, _ = _get(base + "/debug/events?n=bogus")
+            assert status == 400
+
+            status, state = _get(base + "/debug/state")
+            assert status == 200
+            assert state["status"] == "ok"
+            assert state["max_slots"] == 2
+            assert state["queue_depth"] == 0
+            assert state["flight"]["recorded"] == doc["recorded"]
+            assert isinstance(state["slots"], list)
+        finally:
+            srv.stop()
+
+
+# -- exporter --------------------------------------------------------
+
+
+def _span_rec(name, trace, span, parent, t0, dur, component="c",
+              pid=1, **attrs):
+    rec = {"kind": "span", "name": name, "trace_id": trace,
+           "span_id": span, "parent_id": parent,
+           "t_start": t0, "dur_s": dur, "component": component,
+           "pid": pid}
+    if attrs:
+        rec["attrs"] = attrs
+    return rec
+
+
+class TestExporter:
+    def test_load_spans_skips_torn_and_foreign_lines(self, tmp_path):
+        p = tmp_path / "s.jsonl"
+        p.write_text(
+            json.dumps(_span_rec("a", "t1", "s1", None, 10.0, 0.5))
+            + "\n"
+            + '{"kind": "other", "x": 1}\n'
+            + json.dumps({"kind": "span", "name": "no-times"}) + "\n"
+            + '{"kind": "span", "na')  # torn tail
+        spans = export.load_spans([p])
+        assert [s["name"] for s in spans] == ["a"]
+        assert export.load_spans([tmp_path / "absent.jsonl"]) == []
+
+    def test_build_trace_is_valid_and_monotonic(self):
+        spans = [
+            _span_rec("router.request", "t1", "r", None, 100.0, 2.0,
+                      component="router", pid=10),
+            _span_rec("engine.request", "t1", "e", "r", 100.5, 1.0,
+                      component="engine", pid=20),
+            _span_rec("engine.request", "t2", "e2", None, 101.0, 0.5,
+                      component="engine", pid=20),
+        ]
+        flight = {"component": "engine", "pid": 20, "events": [
+            {"event": "admit", "t_wall": 100.6, "seq": 1}]}
+        doc = export.build_trace(spans, [flight])
+        events = doc["traceEvents"]
+        xs = [e for e in events if e["ph"] == "X"]
+        metas = [e for e in events if e["ph"] == "M"]
+        marks = [e for e in events if e["ph"] == "i"]
+        # every event well-formed; complete events rebased to t=0 in
+        # ascending order with positive duration
+        for e in events:
+            assert {"name", "ph", "pid"} <= set(e)
+        ts = [e["ts"] for e in xs]
+        assert ts == sorted(ts) and ts[0] == 0.0
+        assert all(e["dur"] >= 1.0 for e in xs)
+        assert doc["otherData"]["epoch_us"] == 100.0 * 1e6
+        assert doc["otherData"]["span_count"] == 3
+        # one process track per (component, pid); one thread per trace
+        proc_names = {m["args"]["name"] for m in metas
+                      if m["name"] == "process_name"}
+        assert proc_names == {"router (pid 10)", "engine (pid 20)"}
+        engine_pid = next(e["pid"] for e in xs
+                          if e["name"] == "engine.request")
+        engine_tids = {e["tid"] for e in xs if e["pid"] == engine_pid}
+        assert len(engine_tids) == 2  # t1 and t2 rows
+        # span links survive into args; flight marks are instants
+        x = next(e for e in xs if e["args"]["span_id"] == "e")
+        assert x["args"]["parent_id"] == "r"
+        assert [m["name"] for m in marks] == ["flight:admit"]
+        assert marks[0]["ts"] == pytest.approx(0.6 * 1e6)
+
+    def test_trace_filter_and_ids(self):
+        spans = [_span_rec("a", "t1", "s1", None, 1.0, 0.1),
+                 _span_rec("b", "t2", "s2", None, 2.0, 0.1)]
+        assert export.trace_ids(spans) == ["t1", "t2"]
+        doc = export.build_trace(spans, trace_id="t2")
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert [e["name"] for e in xs] == ["b"]
+
+    def test_cli_writes_merged_and_split_traces(self, tmp_path):
+        log = tmp_path / "s.jsonl"
+        log.write_text(
+            json.dumps(_span_rec("a", "t1", "s1", None, 1.0, 0.1))
+            + "\n"
+            + json.dumps(_span_rec("b", "t2", "s2", None, 2.0, 0.1))
+            + "\n")
+        out = tmp_path / "trace.json"
+        per = tmp_path / "per"
+        rc = export.main([str(log), "-o", str(out),
+                          "--split-by-trace", str(per)])
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert doc["otherData"]["span_count"] == 2
+        assert sorted(p.name for p in per.glob("trace-*.json")) == \
+            ["trace-t1.json", "trace-t2.json"]
+        # no spans at all -> rc 1 (a trace of nothing is a user error)
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert export.main([str(empty), "-o",
+                            str(tmp_path / "e.json")]) == 1
+
+    def test_script_shim_resolves(self):
+        repo = pathlib.Path(__file__).resolve().parents[1]
+        assert (repo / "scripts" / "trace_export.py").exists()
+
+
+# -- the acceptance path: router -> engine -> PD in one trace --------
+
+
+@pytest.fixture(scope="module")
+def world():
+    import jax
+    import jax.numpy as jnp
+    from ome_tpu.models import config as cfgs
+    from ome_tpu.models import llama
+    cfg = cfgs.tiny_test().replace(max_seq_len=128, dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def test_router_engine_pd_spans_share_one_trace(world, tmp_path):
+    """Fault-free two-request run through router + PD pair: the span
+    logs merge into one trace per request with the nesting the ISSUE
+    promises — router.request > router.attempt > engine.request >
+    {queue, prefill > pd.fetch (peer-attributed), decode chunks}."""
+    from ome_tpu.engine import InferenceEngine
+    from ome_tpu.engine.pd import (RemotePrefillEngine,
+                                   make_pd_prefill_handler)
+    from ome_tpu.engine.serve import _PrefillNodeScheduler
+    from ome_tpu.router.server import Backend, Router, RouterServer
+    cfg, params = world
+
+    def engine():
+        return InferenceEngine(params, cfg, max_slots=2,
+                               prefill_buckets=[16, 32])
+
+    pre_engine = engine()
+    pre_srv = EngineServer(_PrefillNodeScheduler(pre_engine),
+                           model_name="m",
+                           pd_prefill=make_pd_prefill_handler(
+                               pre_engine))
+    pre_srv.start()
+    pre_url = f"http://127.0.0.1:{pre_srv.port}"
+
+    engine_spans = tmp_path / "engine.spans.jsonl"
+    router_spans = tmp_path / "router.spans.jsonl"
+    slog = SpanLog(str(engine_spans), component="engine")
+    sched = Scheduler(RemotePrefillEngine(engine(), pre_url,
+                                          span_log=slog),
+                      overlap=True, span_log=slog, span_chunk_steps=4)
+    esrv = EngineServer(sched, model_name="m", port=0)
+    esrv.start()
+    router = Router([Backend(f"http://127.0.0.1:{esrv.port}")])
+    rsrv = RouterServer(router, host="127.0.0.1", port=0,
+                        span_log=str(router_spans)).start()
+    try:
+        base = f"http://127.0.0.1:{rsrv.port}"
+        for prompt in ("hi there", "second request"):
+            status, _, out = _post(base + "/v1/completions",
+                                   {"model": "m", "prompt": prompt,
+                                    "max_tokens": 6,
+                                    "temperature": 0}, timeout=120)
+            assert status == 200
+            assert out["usage"]["completion_tokens"] == 6
+        r_spans = _wait_spans(
+            router_spans,
+            lambda s: sum(x["name"] == "router.request"
+                          for x in s) >= 2)
+        e_spans = _wait_spans(
+            engine_spans,
+            lambda s: sum(x["name"] == "engine.request"
+                          for x in s) >= 2)
+    finally:
+        rsrv.stop()
+        esrv.stop()
+        pre_srv.stop()
+
+    spans = r_spans + e_spans
+    traces = export.trace_ids([s for s in spans
+                               if s["name"] == "router.request"])
+    assert len(traces) == 2  # one trace per request
+    for tid in traces:
+        mine = [s for s in spans if s["trace_id"] == tid]
+        by = {}
+        for s in mine:
+            by.setdefault(s["name"], []).append(s)
+        (rroot,) = by["router.request"]
+        (attempt,) = by["router.attempt"]
+        (ereq,) = by["engine.request"]
+        (queue,) = by["engine.queue"]
+        (prefill,) = by["engine.prefill"]
+        fetches = by["pd.fetch"]
+        chunks = by["engine.decode"]
+        # the parent chain the timeline hangs on
+        assert attempt["parent_id"] == rroot["span_id"]
+        assert ereq["parent_id"] == attempt["span_id"]
+        for s in (queue, prefill, *chunks):
+            assert s["parent_id"] == ereq["span_id"]
+        for f in fetches:
+            assert f["parent_id"] == prefill["span_id"]
+            assert f["attrs"]["peer"] == pre_url  # peer-attributed
+            assert f["attrs"]["status"] == "ok"
+        assert rroot["attrs"]["status"] == "ok"
+        assert attempt["attrs"]["status"] == "ok"
+        assert ereq["attrs"]["finish_reason"] == "length"
+        assert sum(c["attrs"]["tokens"] for c in chunks) == 5
+        # wall-clock nesting: the router span encloses the engine
+        # span, which encloses every phase span (same host, so the
+        # cross-process comparison is meaningful here)
+        def window(s):
+            return s["t_start"], s["t_start"] + s["dur_s"]
+        r0, r1 = window(rroot)
+        e0, e1 = window(ereq)
+        assert r0 - 1e-3 <= e0 and e1 <= r1 + 1e-3
+        for s in (queue, prefill, *fetches, *chunks):
+            s0, s1 = window(s)
+            assert e0 - 1e-3 <= s0 and s1 <= e1 + 1e-3
+
+        # and the exporter turns it into a loadable per-request doc
+        doc = export.build_trace(spans, trace_id=tid)
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert {e["name"] for e in xs} >= {
+            "router.request", "router.attempt", "engine.request",
+            "engine.queue", "engine.prefill", "pd.fetch",
+            "engine.decode"}
+        assert min(e["ts"] for e in xs) == 0.0
+        assert all(e["dur"] >= 1.0 for e in xs)
+        # router and engine land on separate process tracks
+        assert len({e["pid"] for e in doc["traceEvents"]
+                    if e["ph"] == "M"
+                    and e["name"] == "process_name"}) == 2
