@@ -1,0 +1,20 @@
+# Developer entry points. The same commands the CI tiers run — no
+# extra tooling, everything here works with the stdlib + the baked-in
+# JAX toolchain.
+
+PYTHON ?= python
+
+.PHONY: lint test
+
+# omelint: the repo's static-analysis gate (docs/static-analysis.md).
+# Runs every registered analyzer over ome_tpu/ and fails on any
+# finding that is neither inline-suppressed (with a reason) nor
+# grandfathered in lint-baseline.json.
+lint:
+	$(PYTHON) scripts/omelint.py --all
+
+# tier-1: the fast correctness suite (see ROADMAP.md for the exact
+# CI invocation with log capture)
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider

@@ -252,6 +252,7 @@ class ReplicatedEngine:
                             "ids": list(map(int, prompt_ids)),
                             "temperature": float(temperature),
                             "top_k": int(top_k), "top_p": float(top_p),
+                            # omelint: disable=lock-discipline -- the host-built mask IS the op payload; _oplock serializes whole ops by design
                             "first_mask": pack_mask(first_mask),
                             "adapter": adapter})
             return self._engine.prefill(prompt_ids, temperature, top_k,
@@ -300,10 +301,13 @@ class ReplicatedEngine:
         from .structured import pack_mask
         with self._oplock:
             self._pub.send({"op": "decode",
+                            # omelint: disable=lock-discipline -- sampling params ship host-side in the op; _oplock serializes whole ops by design
                             "temperature": np.asarray(
                                 temperature, np.float32).tolist(),
+                            # omelint: disable=lock-discipline -- sampling params ship host-side in the op; _oplock serializes whole ops by design
                             "top_k": np.asarray(top_k,
                                                 np.int32).tolist(),
+                            # omelint: disable=lock-discipline -- sampling params ship host-side in the op; _oplock serializes whole ops by design
                             "top_p": np.asarray(top_p,
                                                 np.float32).tolist(),
                             # structured outputs: the leader's host-
@@ -311,6 +315,7 @@ class ReplicatedEngine:
                             # ~V/8 bytes per constrained slot) so
                             # followers run the IDENTICAL masked
                             # program — no recompute drift
+                            # omelint: disable=lock-discipline -- the host-built mask IS the op payload; _oplock serializes whole ops by design
                             "mask": pack_mask(mask)})
             if mask is not None:
                 state, toks = self._engine.decode(
@@ -318,6 +323,7 @@ class ReplicatedEngine:
             else:
                 state, toks = self._engine.decode(state, temperature,
                                                   top_k, top_p)
+            # omelint: disable=lock-discipline -- the local-replica fetch completes the op; _oplock serializes whole ops by design
             return state, host_value(toks)
 
 

@@ -572,6 +572,7 @@ def make_pd_prefill_handler(engine):
             # the gather collectives stay INSIDE the lock: followers
             # replay prefill->gather(k)->gather(v) strictly serially,
             # so a second thread's allgather must not interleave
+            # omelint: disable=lock-discipline -- the gather/serialize round-trip IS the guarded op (see comment above)
             return serialize_kv(token, gather_kv(k), gather_kv(v),
                                 true_len, bucket)
 

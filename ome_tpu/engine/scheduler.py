@@ -38,7 +38,7 @@ import queue
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -757,17 +757,57 @@ class Scheduler:
                 # it immediately, and the phase spans key off _span.
                 req._span = Span.begin("engine.request", ctx=req.trace,
                                        start_mono=req.created)
-            try:
-                self.pending.put_nowait(req)
-            except queue.Full:
-                self._inc_locked("rejected_total")
-                raise SchedulerOverloaded(
-                    "pending queue full", retry_after=1.0) from None
-            self._flight_event("admit", request=req.id,
-                               depth=depth + 1)
-            if self.journal is not None and req.masker is None:
-                self.journal.admit(req)
+            journal_it = self.journal is not None and \
+                req.masker is None
+        # journal the admit with the scheduler lock RELEASED: the
+        # append fsyncs (policy "always"), and the decode thread takes
+        # self._lock per emitted token — an fsync inside the region
+        # stalls every inflight decode. Writing before the queue put
+        # also pins the replay ordering: once the request is visible,
+        # a fast finish may call journal.finish immediately, and the
+        # tombstone must land after an admit record, not before one.
+        if journal_it:
+            self.journal.admit(req)
+        reject: Optional[Tuple[str, Exception]] = None
+        with self._lock:
+            # re-check what can have flipped while the journal synced;
+            # the submit-vs-stop atomicity now holds at THIS region
+            if self._stop.is_set() or self._status == "dead":
+                reject = ("shutdown",
+                          RuntimeError("scheduler unavailable"))
+            elif self._draining:
+                reject = ("draining", SchedulerDraining(
+                    "scheduler draining (shutdown signal received); "
+                    "resubmit to another replica"))
+            else:
+                depth = self.pending.qsize()
+                try:
+                    self.pending.put_nowait(req)
+                except queue.Full:
+                    self._inc_locked("rejected_total")
+                    reject = ("rejected", SchedulerOverloaded(
+                        "pending queue full", retry_after=1.0))
+                else:
+                    self._flight_event("admit", request=req.id,
+                                       depth=depth + 1)
+        if reject is not None:
+            # tombstone OUTSIDE the lock too — it appends + fsyncs
+            self._journal_tombstone(req, journal_it, reject[0])
+            raise reject[1]
         return req
+
+    def _journal_tombstone(self, req: Request, journal_it: bool,
+                           reason: str):
+        """A request was journaled as admitted but then rejected in
+        the re-check window (stop/drain/queue-full raced the journal
+        fsync). Without the tombstone the admit record stays live and
+        the next process would replay a request the client was told
+        to retry elsewhere — a duplicate."""
+        if not journal_it or self.journal is None:
+            return
+        if req.finish_reason is None:
+            req.finish_reason = reason
+        self.journal.finish(req, resumable=False)
 
     def start(self):
         # idempotent: EngineServer.start() also starts its scheduler, so

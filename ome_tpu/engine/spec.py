@@ -38,6 +38,7 @@ def propose(ctx: Sequence[int], k: int, *, ngram_max: int = NGRAM_MAX,
     Returns an int32 array of length in [0, k] — empty means "no
     match, decode plainly".
     """
+    # omelint: disable=hot-path-sync -- ctx is a host-side int list (the committed token stream), not a device array
     arr = np.asarray(ctx, np.int32)
     L = arr.shape[0]
     if k <= 0 or L < ngram_min + 1:

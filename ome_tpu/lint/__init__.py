@@ -1,0 +1,33 @@
+"""omelint — call-graph-aware static analysis for repo invariants.
+
+A plugin framework (docs/static-analysis.md) replacing the three
+ad-hoc AST lints that used to live as standalone scripts. The shared
+infrastructure layer parses every file ONCE (`core.Project`), builds a
+project-wide call graph with reachability queries (`callgraph`), and
+models lock regions — statements syntactically under `with
+self._lock:` or acquire/release pairs (`lockmodel`). On top of it the
+`plugins` package ships the analyzers:
+
+  * ``hot-path-sync``    — no host-blocking device fetch between decode
+                           dispatches, function set derived by
+                           reachability from ``Scheduler.step`` (not a
+                           hardcoded list);
+  * ``lock-discipline``  — no blocking I/O while a ``threading.Lock``
+                           is held; lock-acquisition-order cycles;
+  * ``thread-shared-state`` — attributes mutated on one thread domain
+                           and read on another with no common lock;
+  * ``fault-catalog`` / ``metrics-naming`` — the catalog-drift checks
+                           (fault points vs failure-semantics.md,
+                           metric naming + observability.md drift).
+
+Findings suppress inline with ``# omelint: disable=<rule> -- reason``
+(the reason is mandatory) or grandfather into the checked-in baseline
+(``lint-baseline.json``). ``scripts/omelint.py`` is the CLI; the old
+script names remain as thin shims over the matching plugin.
+"""
+
+from .core import (Baseline, Finding, Project, SourceFile,  # noqa: F401
+                   Suppression)
+
+__all__ = ["Baseline", "Finding", "Project", "SourceFile",
+           "Suppression"]

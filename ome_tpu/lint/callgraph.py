@@ -1,0 +1,399 @@
+"""Project-wide call graph with reachability queries.
+
+Nodes are ``"<rel path>::<qualname>"`` (e.g.
+``ome_tpu/engine/scheduler.py::Scheduler._decode``). Edge resolution
+is deliberately syntactic — no type inference — with these rules, in
+order:
+
+  * ``self.meth(...)`` / ``cls.meth(...)``  -> a method ``meth`` on the
+    enclosing class, or on any project class related to it by name
+    inheritance (a base or subclass found anywhere in the project);
+  * ``name(...)``       -> a function ``name`` in the same module,
+    else a project-unique definition of that name;
+  * ``mod.attr(...)``   -> ``attr`` in the module imported as ``mod``
+    (``import x.y as mod`` / ``from pkg import mod``);
+  * ``obj.meth(...)``   -> every project definition named ``meth``,
+    but ONLY when the name is defined in at most
+    ``ambiguity_limit`` places — a name like ``get`` or ``read``
+    defined everywhere would otherwise connect the whole repo;
+  * ``target=fn`` / ``target=self.meth`` keywords (thread spawns) and
+    bare function references passed as call arguments add the same
+    edges — a function handed to ``threading.Thread`` is as called as
+    any other.
+
+The graph intentionally over-approximates a little (name-based edges
+can link unrelated same-named methods) and under-approximates a
+little (dynamic dispatch through variables is invisible). Both biases
+are the right ones for invariant linting: reachability-based rules
+stay sound under refactors that rename or split hot-path helpers,
+which is exactly where hardcoded function lists went stale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Project, SourceFile
+
+# names so generic that cross-file name matching would connect
+# everything to everything; calls through them simply don't create
+# pure name-based edges (self-calls and module-local calls still do)
+_GENERIC_NAMES = frozenset((
+    "get", "put", "read", "write", "close", "open", "run", "start",
+    "stop", "set", "add", "pop", "append", "items", "keys", "values",
+    "join", "wait", "send", "main", "update", "clear", "copy", "next",
+    "encode", "decode", "flush", "state", "build", "info", "warning",
+    "error", "exception", "debug", "release", "acquire", "list"))
+
+
+def node_key(sf: SourceFile, qual: str) -> str:
+    return f"{sf.rel}::{qual}"
+
+
+def body_walk(root: ast.AST):
+    """ast.walk that does NOT descend into nested function/class
+    definitions: yields only the nodes belonging to `root`'s own
+    body, so statements of a nested Handler method are never
+    attributed to the enclosing __init__."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+class CallGraph:
+    def __init__(self, project: Project, ambiguity_limit: int = 3):
+        self.project = project
+        self.ambiguity_limit = ambiguity_limit
+        # node -> set of callee nodes
+        self.edges: Dict[str, Set[str]] = {}
+        # function/method name -> [(file, qualname)] across the project
+        self._by_name: Dict[str, List[Tuple[SourceFile, str]]] = {}
+        # class name -> [(file, class qualname)]
+        self._classes: Dict[str, List[Tuple[SourceFile, str]]] = {}
+        # class qualname per file -> direct base class NAMES
+        self._bases: Dict[str, List[str]] = {}
+        # rel path -> import alias map (filled lazily / by _link)
+        self._imports: Dict[str, Dict[str, str]] = {}
+        # (rel, class qual) -> {attr: class name} from constructor
+        # assignments
+        self._attr_types: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self._index()
+        self._link()
+
+    # -- indexing ------------------------------------------------------
+
+    def _index(self):
+        for sf in self.project.files:
+            for qual, node in sf.defs.items():
+                if isinstance(node, ast.ClassDef):
+                    self._classes.setdefault(node.name, []).append(
+                        (sf, qual))
+                    bases = []
+                    for b in node.bases:
+                        if isinstance(b, ast.Name):
+                            bases.append(b.id)
+                        elif isinstance(b, ast.Attribute):
+                            bases.append(b.attr)
+                    self._bases[node_key(sf, qual)] = bases
+                else:
+                    name = qual.rsplit(".", 1)[-1]
+                    self._by_name.setdefault(name, []).append(
+                        (sf, qual))
+        # `self.X = Cls(...)` constructor assignments give receiver
+        # types for `self.X.meth()` calls
+        for sf in self.project.files:
+            for qual, node in sf.defs.items():
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                types: Dict[str, str] = {}
+                for sub in ast.walk(node):
+                    if not (isinstance(sub, ast.Assign)
+                            and isinstance(sub.value, ast.Call)):
+                        continue
+                    func = sub.value.func
+                    cname = func.attr if isinstance(
+                        func, ast.Attribute) else getattr(
+                            func, "id", None)
+                    if cname not in self._classes:
+                        continue
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self":
+                            types[tgt.attr] = cname
+                self._attr_types[(sf.rel, qual)] = types
+
+    def _module_imports(self, sf: SourceFile) -> Dict[str, str]:
+        """local alias -> dotted module name, for mod.attr() calls."""
+        imports: Dict[str, str] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    # `from .. import faults` has module=None; the
+                    # bare name still identifies the project module
+                    imports[a.asname or a.name] = (
+                        f"{node.module}.{a.name}" if node.module
+                        else a.name)
+        return imports
+
+    # -- linking -------------------------------------------------------
+
+    def _related_classes(self, sf: SourceFile, class_qual: str
+                         ) -> List[Tuple[SourceFile, str]]:
+        """The class plus project classes connected by name-level
+        inheritance in either direction (subclasses may override the
+        hot-path helper a base's step() calls, and vice versa)."""
+        name = class_qual.rsplit(".", 1)[-1]
+        out = [(sf, class_qual)]
+        me = node_key(sf, class_qual)
+        for cname, homes in self._classes.items():
+            for csf, cqual in homes:
+                ck = node_key(csf, cqual)
+                if ck == me:
+                    continue
+                if name in self._bases.get(ck, ()):   # subclass of me
+                    out.append((csf, cqual))
+                elif cname in self._bases.get(me, ()):  # my base
+                    out.append((csf, cqual))
+        return out
+
+    def _resolve_method(self, sf: SourceFile, caller_qual: str,
+                        meth: str) -> List[str]:
+        parts = caller_qual.split(".")
+        # enclosing class chain: the nearest ancestor qual that names
+        # a ClassDef (methods of nested Handler classes resolve to the
+        # Handler, not the outer server class)
+        for i in range(len(parts) - 1, 0, -1):
+            cls_qual = ".".join(parts[:i])
+            node = sf.defs.get(cls_qual)
+            if isinstance(node, ast.ClassDef):
+                out = []
+                for csf, cqual in self._related_classes(sf, cls_qual):
+                    cand = f"{cqual}.{meth}"
+                    if cand in csf.defs:
+                        out.append(node_key(csf, cand))
+                return out
+        return []
+
+    def _enclosing_class_qual(self, sf: SourceFile,
+                              caller_qual: str) -> Optional[str]:
+        parts = caller_qual.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            cand = ".".join(parts[:i])
+            if isinstance(sf.defs.get(cand), ast.ClassDef):
+                return cand
+        return None
+
+    def _resolve_typed_attr(self, sf: SourceFile, caller_qual: str,
+                            attr: str, meth: str) -> List[str]:
+        """`self.journal.admit()` -> RequestJournal.admit: first via
+        a `self.journal = RequestJournal(...)` assignment, else via
+        name similarity when exactly one project class matches the
+        attribute name (dependency-injected collaborators like
+        `self.journal = journal`)."""
+        cls_qual = self._enclosing_class_qual(sf, caller_qual)
+        tname = None
+        if cls_qual is not None:
+            tname = self._attr_types.get(
+                (sf.rel, cls_qual), {}).get(attr)
+        if tname:
+            candidates = self._classes.get(tname, [])
+        else:
+            key = attr.replace("_", "").lower()
+            if len(key) < 4:
+                return []
+            # every name-similar class that actually defines `meth`;
+            # unambiguous only (JournalEntry vs RequestJournal both
+            # match "journal", but only one has .admit)
+            candidates = [
+                (csf, cqual) for cname, homes
+                in self._classes.items() if key in cname.lower()
+                for csf, cqual in homes
+                if f"{cqual}.{meth}" in csf.defs]
+            if len(candidates) != 1:
+                return []
+        out = []
+        for csf, cqual in candidates:
+            cand = f"{cqual}.{meth}"
+            if cand in csf.defs:
+                out.append(node_key(csf, cand))
+        return out
+
+    def _resolve_name(self, sf: SourceFile, name: str) -> List[str]:
+        # same module first (any nesting level)
+        local = [q for q in sf.defs
+                 if q == name or q.endswith("." + name)]
+        local = [q for q in local
+                 if not isinstance(sf.defs[q], ast.ClassDef)]
+        if local:
+            return [node_key(sf, q) for q in local]
+        if name in _GENERIC_NAMES:
+            return []
+        homes = self._by_name.get(name, [])
+        if 0 < len(homes) <= self.ambiguity_limit:
+            return [node_key(f, q) for f, q in homes]
+        return []
+
+    def _resolve_call(self, sf: SourceFile, caller_qual: str,
+                      func: ast.expr,
+                      imports: Dict[str, str]) -> List[str]:
+        if isinstance(func, ast.Name):
+            return self._resolve_name(sf, func.id)
+        if isinstance(func, ast.Attribute):
+            meth = func.attr
+            recv = func.value
+            if isinstance(recv, ast.Name) and recv.id in ("self",
+                                                          "cls"):
+                hits = self._resolve_method(sf, caller_qual, meth)
+                if hits:
+                    return hits
+                # fall through: mixin methods may live off-class
+            if isinstance(recv, ast.Name) and recv.id in imports:
+                mod = imports[recv.id]
+                tail = mod.rsplit(".", 1)[-1]
+                for target in self.project.files:
+                    if target.rel.endswith(f"{tail}.py") or \
+                            target.rel.endswith(f"{tail}/__init__.py"):
+                        if meth in target.defs:
+                            return [node_key(target, meth)]
+            if isinstance(recv, ast.Attribute) and \
+                    isinstance(recv.value, ast.Name) and \
+                    recv.value.id == "self":
+                hits = self._resolve_typed_attr(sf, caller_qual,
+                                                recv.attr, meth)
+                if hits:
+                    return hits
+            if meth in _GENERIC_NAMES:
+                return []
+            # other receivers: PROJECT-UNIQUE method names only — a
+            # name defined twice (Request.finish vs
+            # RequestJournal.finish) would wire unrelated classes
+            # together and every lock analysis downstream would
+            # chase phantom chains
+            homes = self._by_name.get(meth, [])
+            if len(homes) == 1:
+                return [node_key(f, q) for f, q in homes]
+        return []
+
+    def _sites(self, sf: SourceFile, qual: str, node: ast.AST,
+               imports: Dict[str, str]
+               ) -> List[Tuple[int, Set[str]]]:
+        sites: List[Tuple[int, Set[str]]] = []
+        for sub in body_walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            targets: Set[str] = set(self._resolve_call(
+                sf, qual, sub.func, imports))
+            # function references passed as arguments (thread
+            # targets, callbacks) are as called as anything else
+            for arg in list(sub.args) + [kw.value
+                                         for kw in sub.keywords]:
+                targets.update(self.resolve_ref(sf, qual, arg))
+            if targets:
+                sites.append((sub.lineno, targets))
+        return sites
+
+    def call_sites(self, sf: SourceFile, qual: str
+                   ) -> List[Tuple[int, Set[str]]]:
+        """[(line, resolved callee node keys)] for every call in the
+        body of `qual` (nested defs excluded — they are their own
+        nodes)."""
+        node = sf.defs.get(qual)
+        if node is None or isinstance(node, ast.ClassDef):
+            return []
+        imports = self._imports.get(sf.rel)
+        if imports is None:
+            imports = self._imports[sf.rel] = self._module_imports(sf)
+        return self._sites(sf, qual, node, imports)
+
+    def _link(self):
+        for sf in self.project.files:
+            imports = self._imports[sf.rel] = self._module_imports(sf)
+            for qual, node in sf.defs.items():
+                if isinstance(node, ast.ClassDef):
+                    continue
+                src = node_key(sf, qual)
+                out = self.edges.setdefault(src, set())
+                for _line, targets in self._sites(sf, qual, node,
+                                                  imports):
+                    out.update(targets)
+                # a directly nested def is conservatively reachable
+                # from its definer even when only returned/stored —
+                # over-approximation is the safe direction here
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        nested = sf.qualname(child)
+                        if nested:
+                            out.add(node_key(sf, nested))
+
+    def resolve_ref(self, sf: SourceFile, caller_qual: str,
+                    expr: ast.expr) -> List[str]:
+        """A bare function reference used as a value (not called):
+        links like a call so `Thread(target=self._run)` reaches
+        `_run`."""
+        if isinstance(expr, ast.Name):
+            if expr.id in _GENERIC_NAMES:
+                return []
+            local = [q for q in sf.defs
+                     if (q == expr.id or q.endswith("." + expr.id))
+                     and not isinstance(sf.defs[q], ast.ClassDef)]
+            return [node_key(sf, q) for q in local]
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls"):
+            return self._resolve_method(sf, caller_qual, expr.attr)
+        return []
+
+    # -- queries -------------------------------------------------------
+
+    def reachable(self, roots: Iterable[str],
+                  stop: Optional[Set[str]] = None) -> Set[str]:
+        """Transitive closure from `roots` along call edges; traversal
+        enters but does not pass THROUGH nodes whose final name
+        segment is in `stop` (sanctioned sinks like _drain_inflight:
+        they are excluded from the result AND their callees are only
+        reached via other paths)."""
+        stop = stop or set()
+        seen: Set[str] = set()
+        frontier = list(roots)
+        result: Set[str] = set()
+        while frontier:
+            node = frontier.pop()
+            if node in seen:
+                continue
+            seen.add(node)
+            name = node.rsplit(".", 1)[-1].split("::")[-1]
+            if name in stop:
+                continue
+            result.add(node)
+            frontier.extend(self.edges.get(node, ()))
+        return result
+
+    def resolve_spec(self, spec: str) -> List[str]:
+        """A root spec ``"<path suffix>::<qualname>"`` (or bare
+        ``qualname``) to concrete node keys present in the project."""
+        if "::" in spec:
+            suffix, qual = spec.split("::", 1)
+            return [node_key(sf, qual)
+                    for sf in self.project.find_files(suffix)
+                    if qual in sf.defs]
+        out = []
+        for sf in self.project.files:
+            for qual, node in sf.defs.items():
+                if isinstance(node, ast.ClassDef):
+                    continue
+                if qual == spec or qual.endswith("." + spec):
+                    out.append(node_key(sf, qual))
+        return out

@@ -1,0 +1,29 @@
+"""Shared analysis context: the expensive project-wide indexes
+(call graph, lock model) built at most once per run and handed to
+every plugin — adding an analyzer costs an AST walk, not a re-parse
+or a graph rebuild."""
+
+from __future__ import annotations
+
+from .callgraph import CallGraph
+from .core import Project
+from .lockmodel import LockModel
+
+
+class Context:
+    def __init__(self, project: Project):
+        self.project = project
+        self._graph = None
+        self._locks = None
+
+    @property
+    def graph(self) -> CallGraph:
+        if self._graph is None:
+            self._graph = CallGraph(self.project)
+        return self._graph
+
+    @property
+    def locks(self) -> LockModel:
+        if self._locks is None:
+            self._locks = LockModel(self.project)
+        return self._locks

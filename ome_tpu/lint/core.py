@@ -1,0 +1,300 @@
+"""omelint infrastructure: one parse per file, suppressions, baseline.
+
+`Project` loads a source tree once — every analyzer shares the same
+`SourceFile` objects (text, AST, qualified definition index,
+per-line suppressions), so adding a plugin costs one AST walk, not
+one parse.
+
+Suppression syntax (reason MANDATORY — an unjustified disable is
+itself a finding):
+
+    something_racy()  # omelint: disable=thread-shared-state -- why
+
+A suppression comment on its own line applies to the next line of
+code; trailing a statement, it applies to that statement's line (and,
+for a multi-line statement, to the statement's first line).
+
+Baseline: ``lint-baseline.json`` at the repo root grandfathers
+pre-existing findings so the repo gates on NEW findings only. Entries
+match on (rule, path, symbol, message) — not line numbers, which churn
+with every edit — and each carries a human justification (`why`).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+DEFAULT_BASELINE = "lint-baseline.json"
+
+_SUPPRESS_RX = re.compile(
+    r"#\s*omelint:\s*disable=(?P<rules>[A-Za-z0-9_,\- ]+?)"
+    r"\s*(?:--\s*(?P<reason>.*\S))?\s*$")
+
+
+class Finding:
+    """One analyzer report, stable enough to baseline: `symbol` is the
+    enclosing qualified definition (or "<module>") so the fingerprint
+    survives unrelated line churn."""
+
+    __slots__ = ("rule", "path", "line", "message", "symbol")
+
+    def __init__(self, rule: str, path, line: int, message: str,
+                 symbol: str = "<module>"):
+        self.rule = rule
+        self.path = str(path)
+        self.line = int(line)
+        self.message = message
+        self.symbol = symbol
+
+    def key(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.path, self.symbol, self.message)
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def __repr__(self):
+        return f"Finding({self})"
+
+
+class Suppression:
+    __slots__ = ("line", "rules", "reason")
+
+    def __init__(self, line: int, rules: Sequence[str],
+                 reason: Optional[str]):
+        self.line = line
+        self.rules = tuple(rules)
+        self.reason = reason
+
+    def covers(self, rule: str) -> bool:
+        return rule in self.rules or "all" in self.rules
+
+
+def parse_suppressions(text: str) -> Dict[int, Suppression]:
+    """{effective line -> Suppression}. A comment-only line shifts its
+    suppression onto the next line, so the disable can sit above long
+    statements without breaking line length."""
+    out: Dict[int, Suppression] = {}
+    lines = text.splitlines()
+    for i, raw in enumerate(lines, start=1):
+        m = _SUPPRESS_RX.search(raw)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group("rules").split(",")
+                      if r.strip())
+        target = i
+        if raw.lstrip().startswith("#"):
+            target = i + 1
+        out[target] = Suppression(target, rules, m.group("reason"))
+    return out
+
+
+class SourceFile:
+    """One parsed source file plus the per-file indexes every
+    analyzer needs: qualified definitions and suppressions."""
+
+    def __init__(self, path: pathlib.Path, rel: str, text: str):
+        self.path = path
+        self.rel = rel  # repo-relative posix path (baseline key)
+        self.text = text
+        self.tree = ast.parse(text, filename=str(path))
+        self.suppressions = parse_suppressions(text)
+        # qualname -> def node, e.g. "Scheduler.step", "helper",
+        # "EngineServer.__init__.Handler.do_GET" (defs nested in
+        # functions keep the full chain so closures resolve)
+        self.defs: Dict[str, ast.AST] = {}
+        # def node id -> qualname (reverse index for enclosing-symbol
+        # lookups)
+        self._qual_by_node: Dict[int, str] = {}
+        self._index_defs(self.tree, prefix="")
+        # sorted (start_line, qualname) for enclosing-symbol lookup
+        self._spans = sorted(
+            (node.lineno, getattr(node, "end_lineno", node.lineno), q)
+            for q, node in self.defs.items())
+
+    def _index_defs(self, node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qual = prefix + child.name
+                self.defs[qual] = child
+                self._qual_by_node[id(child)] = qual
+                self._index_defs(child, prefix=qual + ".")
+            else:
+                self._index_defs(child, prefix=prefix)
+
+    def qualname(self, node: ast.AST) -> Optional[str]:
+        return self._qual_by_node.get(id(node))
+
+    def enclosing_symbol(self, line: int) -> str:
+        """Innermost def/class containing `line` ("<module>" when
+        none) — the baseline's line-churn-resistant anchor."""
+        best = "<module>"
+        best_span = None
+        for start, end, qual in self._spans:
+            if start <= line <= end:
+                span = end - start
+                if best_span is None or span <= best_span:
+                    best, best_span = qual, span
+        return best
+
+    def suppressed(self, rule: str, line: int) -> Optional[Suppression]:
+        s = self.suppressions.get(line)
+        if s is not None and s.covers(rule):
+            return s
+        return None
+
+
+class Project:
+    """A lazily-built view over a source tree: every ``*.py`` under
+    `root` parsed exactly once, shared by all analyzers. `repo` is the
+    directory baseline paths are relative to (defaults to root)."""
+
+    def __init__(self, root, repo=None,
+                 exclude: Sequence[str] = ("__pycache__",)):
+        self.root = pathlib.Path(root)
+        self.repo = pathlib.Path(repo) if repo is not None else self.root
+        self.exclude = tuple(exclude)
+        self.files: List[SourceFile] = []
+        self.errors: List[str] = []
+        self._by_rel: Dict[str, SourceFile] = {}
+        self._load()
+
+    def _load(self):
+        paths: Iterable[pathlib.Path]
+        if self.root.is_file():
+            paths = [self.root]
+        else:
+            paths = sorted(self.root.rglob("*.py"))
+        for path in paths:
+            if any(part in self.exclude for part in path.parts):
+                continue
+            try:
+                rel = path.resolve().relative_to(
+                    self.repo.resolve()).as_posix()
+            except ValueError:
+                rel = path.name
+            try:
+                sf = SourceFile(path, rel,
+                                path.read_text(encoding="utf-8"))
+            except (SyntaxError, UnicodeDecodeError) as e:
+                self.errors.append(f"{path}: unparseable: {e}")
+                continue
+            self.files.append(sf)
+            self._by_rel[rel] = sf
+
+    def file(self, rel: str) -> Optional[SourceFile]:
+        return self._by_rel.get(rel)
+
+    def find_files(self, suffix: str) -> List[SourceFile]:
+        """Files whose repo-relative path ends with `suffix` (used to
+        anchor root specs like ``engine/scheduler.py::Scheduler.step``
+        without hardcoding the tree layout)."""
+        return [f for f in self.files if f.rel.endswith(suffix)]
+
+
+class Baseline:
+    """Checked-in grandfather list. Each entry mirrors Finding.key()
+    plus a mandatory `why` justification; `match()` consumes entries
+    so `unused()` can report stale ones."""
+
+    def __init__(self, path=None):
+        self.path = pathlib.Path(path) if path is not None else None
+        self.entries: List[dict] = []
+        self._index: Dict[Tuple[str, str, str, str], dict] = {}
+        self._hits: set = set()
+        if self.path is not None and self.path.exists():
+            doc = json.loads(self.path.read_text(encoding="utf-8"))
+            self.entries = list(doc.get("findings", []))
+            self._reindex()
+
+    def _reindex(self):
+        self._index = {
+            (e["rule"], e["path"], e.get("symbol", "<module>"),
+             e["message"]): e
+            for e in self.entries}
+
+    def match(self, finding: Finding) -> bool:
+        key = finding.key()
+        if key in self._index:
+            self._hits.add(key)
+            return True
+        return False
+
+    def unused(self) -> List[dict]:
+        return [e for key, e in self._index.items()
+                if key not in self._hits]
+
+    @staticmethod
+    def from_findings(findings: Sequence[Finding],
+                      why: str = "grandfathered") -> "Baseline":
+        b = Baseline()
+        b.entries = [
+            {"rule": f.rule, "path": f.path, "symbol": f.symbol,
+             "message": f.message, "why": why}
+            for f in sorted(findings,
+                            key=lambda f: (f.rule, f.path, f.line))]
+        b._reindex()
+        return b
+
+    def save(self, path=None):
+        path = pathlib.Path(path) if path is not None else self.path
+        doc = {"version": 1,
+               "comment": "omelint grandfathered findings; every entry "
+                          "carries a `why` justification. Regenerate "
+                          "with scripts/omelint.py --write-baseline "
+                          "(then re-justify).",
+               "findings": self.entries}
+        path.write_text(json.dumps(doc, indent=1, sort_keys=False)
+                        + "\n", encoding="utf-8")
+
+
+class Rule:
+    """Analyzer plugin interface: subclasses set `name` and implement
+    run(project) -> findings. `check_suppressions` adds a finding for
+    every reason-less disable mentioning this rule, so justifications
+    stay mandatory without each plugin re-implementing the walk."""
+
+    name = "rule"
+    description = ""
+
+    def run(self, project: Project) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, sf: SourceFile, line: int, message: str
+                ) -> Finding:
+        return Finding(self.name, sf.rel, line, message,
+                       symbol=sf.enclosing_symbol(line))
+
+
+def apply_suppressions(project: Project, findings: List[Finding]
+                       ) -> Tuple[List[Finding], List[Finding]]:
+    """Split (kept, suppressed). A reason-LESS disable never
+    suppresses — instead it surfaces as a `bad-suppression` finding,
+    added to `kept`, so the justification requirement is enforced by
+    the framework, not by reviewer vigilance."""
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        sf = project.file(f.path)
+        s = sf.suppressed(f.rule, f.line) if sf is not None else None
+        if s is None:
+            kept.append(f)
+        elif not s.reason:
+            kept.append(f)
+        else:
+            suppressed.append(f)
+    # every disable comment without a reason is itself a violation,
+    # whether or not it matched a finding
+    for sf in project.files:
+        for line, s in sorted(sf.suppressions.items()):
+            if not s.reason:
+                kept.append(Finding(
+                    "bad-suppression", sf.rel, line,
+                    "omelint disable without a reason (use "
+                    "`# omelint: disable=<rule> -- <reason>`)",
+                    symbol=sf.enclosing_symbol(line)))
+    return kept, suppressed

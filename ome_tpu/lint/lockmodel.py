@@ -1,0 +1,318 @@
+"""Lock-region model: which statements run with which locks held.
+
+Lock identity is normalized so analyzers can talk about "the
+scheduler lock" across call sites:
+
+  * ``self._lock`` inside class C       -> ``C._lock``
+  * a module-level lock name            -> ``<module stem>.<name>``
+  * anything else (parameters, nested attributes) -> the source text
+    of the receiver expression — still usable for region extraction,
+    too weak for the order graph.
+
+Discovery: an attribute/name is a lock when it is ever assigned from
+``threading.Lock()`` / ``RLock()`` / ``Condition()`` (including
+aliased imports such as ``import threading as _threading``). Regions:
+
+  * ``with self._lock:`` — the with-body;
+  * ``lock.acquire()`` … ``lock.release()`` — statements between the
+    pair within one straight-line suite (try/finally bodies count);
+
+Each region records the lock, the line span, and the enclosing
+function, which gives analyzers two primitives:
+
+  * ``held_at(sf, line)``  — locks held at a source line (syntactic);
+  * ``order_edges()``      — (outer, inner, site) for every region
+    opened while another is held — the lock-acquisition-order graph;
+    interprocedural edges come from the analyzer driving
+    ``CallGraph`` with ``entry_locks``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import Project, SourceFile
+
+_LOCK_FACTORIES = frozenset(("Lock", "RLock", "Condition", "Semaphore",
+                             "BoundedSemaphore"))
+
+
+def _call_name(node: ast.expr) -> Optional[str]:
+    """Final attribute/name of a call target: Lock for
+    threading.Lock / _threading.Lock / Lock."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class LockRegion:
+    __slots__ = ("lock", "start", "end", "func", "site_line")
+
+    def __init__(self, lock: str, start: int, end: int,
+                 func: str, site_line: int):
+        self.lock = lock        # normalized identity
+        self.start = start      # first guarded line
+        self.end = end          # last guarded line
+        self.func = func        # enclosing qualname
+        self.site_line = site_line  # the with/acquire line
+
+    def __repr__(self):
+        return (f"LockRegion({self.lock}, {self.start}-{self.end}, "
+                f"in {self.func})")
+
+
+class LockModel:
+    def __init__(self, project: Project):
+        self.project = project
+        # rel path -> regions
+        self.regions: Dict[str, List[LockRegion]] = {}
+        # normalized lock id -> defining (rel, line)
+        self.locks: Dict[str, Tuple[str, int]] = {}
+        for sf in project.files:
+            self._discover(sf)
+        for sf in project.files:
+            self.regions[sf.rel] = self._extract(sf)
+
+    # -- discovery -----------------------------------------------------
+
+    def _module_stem(self, sf: SourceFile) -> str:
+        return sf.rel.rsplit("/", 1)[-1].rsplit(".", 1)[0]
+
+    def _discover(self, sf: SourceFile):
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            if _call_name(node.value.func) not in _LOCK_FACTORIES:
+                continue
+            for tgt in node.targets:
+                ident = self._normalize_target(sf, tgt)
+                if ident:
+                    self.locks.setdefault(ident, (sf.rel, node.lineno))
+
+    def _enclosing_class(self, sf: SourceFile, line: int
+                         ) -> Optional[str]:
+        best = None
+        best_span = None
+        for qual, node in sf.defs.items():
+            if not isinstance(node, ast.ClassDef):
+                continue
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= line <= end:
+                span = end - node.lineno
+                if best_span is None or span <= best_span:
+                    best, best_span = node.name, span
+        return best
+
+    def _normalize_target(self, sf: SourceFile,
+                          tgt: ast.expr) -> Optional[str]:
+        if isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and \
+                tgt.value.id == "self":
+            cls = self._enclosing_class(sf, tgt.lineno)
+            return f"{cls or '?'}.{tgt.attr}"
+        if isinstance(tgt, ast.Name):
+            return f"{self._module_stem(sf)}.{tgt.id}"
+        return None
+
+    def normalize_expr(self, sf: SourceFile, expr: ast.expr
+                       ) -> Optional[str]:
+        """A lock expression at a use site -> normalized identity, or
+        None when the expression doesn't look like a lock we know."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self":
+            cls = self._enclosing_class(sf, expr.lineno)
+            cand = f"{cls or '?'}.{expr.attr}"
+            if cand in self.locks:
+                return cand
+            # self._lock on a class whose lock is created elsewhere
+            # (e.g. assigned in a helper): match by attribute name
+            for ident in self.locks:
+                if ident.endswith(f".{expr.attr}"):
+                    return cand if expr.attr.endswith("lock") else None
+            return cand if "lock" in expr.attr.lower() else None
+        if isinstance(expr, ast.Name):
+            cand = f"{self._module_stem(sf)}.{expr.id}"
+            if cand in self.locks:
+                return cand
+            return cand if "lock" in expr.id.lower() else None
+        if isinstance(expr, ast.Attribute) and \
+                "lock" in expr.attr.lower():
+            # a lock reached through an attribute chain
+            # (`self._family._lock`): identity is the textual chain
+            # scoped to the enclosing class — weaker than a resolved
+            # owner but consistent across uses in the same class, so
+            # region extraction and common-lock checks still work
+            cls = self._enclosing_class(sf, expr.lineno)
+            try:
+                text = ast.unparse(expr)
+            except Exception:  # pragma: no cover - unparse is total
+                return None
+            return f"{cls or self._module_stem(sf)}:{text}"
+        return None
+
+    # -- region extraction ---------------------------------------------
+
+    def _extract(self, sf: SourceFile) -> List[LockRegion]:
+        regions: List[LockRegion] = []
+        for qual, fn in sf.defs.items():
+            if isinstance(fn, ast.ClassDef):
+                continue
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.With):
+                    for item in sub.items:
+                        ident = self.normalize_expr(
+                            sf, item.context_expr)
+                        if ident is None:
+                            continue
+                        if not sub.body:
+                            continue
+                        start = sub.body[0].lineno
+                        end = max(getattr(n, "end_lineno", n.lineno)
+                                  for n in sub.body)
+                        regions.append(LockRegion(
+                            ident, start, end, qual, sub.lineno))
+            regions.extend(self._acquire_release(sf, qual, fn))
+        return regions
+
+    def _acquire_release(self, sf: SourceFile, qual: str,
+                         fn: ast.AST) -> List[LockRegion]:
+        """lock.acquire() ... lock.release() pairs inside one suite.
+        A `try: ... finally: lock.release()` guards the try-body."""
+        out: List[LockRegion] = []
+        if isinstance(fn, ast.ClassDef):
+            return out
+
+        def expr_of(call: ast.Call) -> Optional[ast.expr]:
+            if isinstance(call.func, ast.Attribute):
+                return call.func.value
+            return None
+
+        def scan(body: Sequence[ast.stmt]):
+            open_at: Dict[str, int] = {}
+            for stmt in body:
+                # acquire as a bare expression statement
+                if isinstance(stmt, ast.Expr) and \
+                        isinstance(stmt.value, ast.Call) and \
+                        isinstance(stmt.value.func, ast.Attribute):
+                    meth = stmt.value.func.attr
+                    recv = expr_of(stmt.value)
+                    ident = (self.normalize_expr(sf, recv)
+                             if recv is not None else None)
+                    if ident:
+                        if meth == "acquire":
+                            open_at.setdefault(ident, stmt.lineno)
+                            continue
+                        if meth == "release" and ident in open_at:
+                            site = open_at.pop(ident)
+                            if stmt.lineno - 1 >= site + 1:
+                                out.append(LockRegion(
+                                    ident, site + 1,
+                                    stmt.lineno - 1, qual, site))
+                            continue
+                # acquire(); try: ... finally: release()
+                if isinstance(stmt, ast.Try) and open_at:
+                    released = set()
+                    for fin in stmt.finalbody:
+                        if isinstance(fin, ast.Expr) and \
+                                isinstance(fin.value, ast.Call) and \
+                                isinstance(fin.value.func,
+                                           ast.Attribute) and \
+                                fin.value.func.attr == "release":
+                            recv = expr_of(fin.value)
+                            ident = (self.normalize_expr(sf, recv)
+                                     if recv is not None else None)
+                            if ident and ident in open_at:
+                                released.add(ident)
+                    for ident in released:
+                        site = open_at.pop(ident)
+                        start = (stmt.body[0].lineno
+                                 if stmt.body else stmt.lineno)
+                        end = max(getattr(n, "end_lineno", n.lineno)
+                                  for n in stmt.body) \
+                            if stmt.body else stmt.lineno
+                        out.append(LockRegion(ident, start, end,
+                                              qual, site))
+            # trailing unmatched acquires: guard to end of suite
+            for ident, site in open_at.items():
+                end = max(getattr(n, "end_lineno", n.lineno)
+                          for n in body)
+                if end > site:
+                    out.append(LockRegion(ident, site + 1, end,
+                                          qual, site))
+
+        for sub in ast.walk(fn):
+            if sub is not fn and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                suite = getattr(sub, field, None)
+                if isinstance(suite, list) and suite and \
+                        isinstance(suite[0], ast.stmt):
+                    scan(suite)
+        return out
+
+    # -- queries -------------------------------------------------------
+
+    def held_at(self, sf: SourceFile, line: int) -> List[LockRegion]:
+        return [r for r in self.regions.get(sf.rel, ())
+                if r.start <= line <= r.end]
+
+    def regions_in(self, sf: SourceFile, qual: str
+                   ) -> List[LockRegion]:
+        return [r for r in self.regions.get(sf.rel, ())
+                if r.func == qual]
+
+    def order_edges(self) -> List[Tuple[str, str, str]]:
+        """(outer lock, inner lock, "rel:line") for every region whose
+        with/acquire site sits inside another lock's region in the
+        same file. RLock re-entry on the SAME lock is not an edge."""
+        edges: List[Tuple[str, str, str]] = []
+        for rel, regions in self.regions.items():
+            for inner in regions:
+                for outer in regions:
+                    if outer is inner:
+                        continue
+                    if outer.start <= inner.site_line <= outer.end \
+                            and outer.lock != inner.lock:
+                        edges.append((outer.lock, inner.lock,
+                                      f"{rel}:{inner.site_line}"))
+        return edges
+
+
+def find_cycles(edges: Iterable[Tuple[str, str, str]]
+                ) -> List[List[str]]:
+    """Simple cycles in the lock-order graph (lock names only); each
+    returned cycle lists the locks in order, first == last."""
+    adj: Dict[str, Set[str]] = {}
+    for a, b, _site in edges:
+        adj.setdefault(a, set()).add(b)
+    cycles: List[List[str]] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str],
+            visited: Set[str]):
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start and len(path) > 0:
+                cyc = path + [start]
+                # canonical rotation for dedup
+                body = cyc[:-1]
+                i = body.index(min(body))
+                canon = tuple(body[i:] + body[:i])
+                if canon not in seen_cycles:
+                    seen_cycles.add(canon)
+                    cycles.append(list(canon) + [canon[0]])
+            elif nxt not in visited:
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for start in sorted(adj):
+        dfs(start, start, [start], {start})
+    return cycles

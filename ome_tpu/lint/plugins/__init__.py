@@ -1,0 +1,39 @@
+"""omelint analyzer plugins.
+
+Each plugin subclasses `ome_tpu.lint.core.Rule` and implements
+``run(project, ctx)`` against the shared `Context` (call graph +
+lock model built once). Register new analyzers in `ALL_RULES`; the
+CLI (`scripts/omelint.py`) and the test suite discover them from
+here.
+"""
+
+from .catalog_drift import FaultCatalogRule, MetricsNamingRule
+from .hot_path_sync import HotPathSyncRule
+from .lock_discipline import LockDisciplineRule
+from .thread_shared_state import ThreadSharedStateRule
+
+ALL_RULES = (
+    HotPathSyncRule,
+    LockDisciplineRule,
+    ThreadSharedStateRule,
+    FaultCatalogRule,
+    MetricsNamingRule,
+)
+
+
+def rule_names():
+    return [r.name for r in ALL_RULES]
+
+
+def make_rule(name: str):
+    for r in ALL_RULES:
+        if r.name == name:
+            return r()
+    raise KeyError(f"unknown omelint rule {name!r} "
+                   f"(known: {', '.join(rule_names())})")
+
+
+__all__ = ["ALL_RULES", "rule_names", "make_rule",
+           "HotPathSyncRule", "LockDisciplineRule",
+           "ThreadSharedStateRule", "FaultCatalogRule",
+           "MetricsNamingRule"]

@@ -1,0 +1,381 @@
+"""catalog-drift: the repo's docs-vs-code consistency checks,
+re-homed from scripts/check_fault_points.py and check_metrics.py so
+one runner owns every invariant.
+
+``fault-catalog`` — every literal ``faults.fire("<point>")`` /
+``faults.http("<point>")`` site must have a row in the fault-point
+catalog table of docs/failure-semantics.md (one-directional by
+design: documenting ahead of landing is allowed, firing undocumented
+points is not).
+
+``metrics-naming`` — registry declarations (``.counter`` /
+``.gauge`` / ``.histogram``) must carry an approved prefix, counters
+must end in ``_total``, scalars must not squat on histogram-reserved
+suffixes, and label names must not imply per-request cardinality. In
+repo mode it also cross-checks the docs/observability.md catalog in
+both directions. F-string names are EXPANDED — through module string
+constants and loop variables bound by iterating a module-level
+string-keyed dict (``.items()``, ``.keys()``, or the dict itself) —
+and every expansion is held to the same naming rules in every mode;
+the old script only expanded for the default-mode drift compare, so
+``reg.counter(f"ome_x_{k}")`` (no ``_total``) passed the lint.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..context import Context
+from ..core import Finding, Project, Rule, SourceFile
+
+# ---------------------------------------------------------------- fault
+
+FAULT_METHODS = ("fire", "http")
+CATALOG_HEADING = "fault-point catalog"
+
+
+def catalog_points(doc: pathlib.Path) -> Set[str]:
+    """Backticked names in the fault-point catalog section's table
+    rows (first cell of each row)."""
+    points: Set[str] = set()
+    in_section = False
+    section_level = 0
+    for line in doc.read_text(encoding="utf-8").splitlines():
+        m = re.match(r"(#+)\s+(.*)", line)
+        if m:
+            level, title = len(m.group(1)), m.group(2).strip().lower()
+            if CATALOG_HEADING in title:
+                in_section, section_level = True, level
+                continue
+            if in_section and level <= section_level:
+                in_section = False
+            continue
+        if in_section and line.lstrip().startswith("|"):
+            cells = [c.strip() for c in line.strip().strip("|")
+                     .split("|")]
+            if cells:
+                points.update(re.findall(r"`([A-Za-z0-9_]+)`",
+                                         cells[0]))
+    return points
+
+
+class FaultCatalogRule(Rule):
+    name = "fault-catalog"
+    description = ("fault-injection points fired in code but missing "
+                   "from the failure-semantics.md catalog")
+
+    def __init__(self, doc: Optional[pathlib.Path] = None):
+        self.doc = doc
+        self.error: Optional[str] = None
+        self.dynamic: List[str] = []
+        self.site_count = 0
+        self.documented_count = 0
+
+    def run(self, project: Project, ctx: Context = None
+            ) -> List[Finding]:
+        self.error, self.dynamic = None, []
+        doc = self.doc or (project.repo / "docs" /
+                           "failure-semantics.md")
+        if not doc.exists():
+            self.error = f"no such doc {doc}"
+            return []
+        documented = catalog_points(doc)
+        self.documented_count = len(documented)
+        if not documented:
+            self.error = (f"no fault-point catalog table found in "
+                          f"{doc} (looked for a "
+                          f"'{CATALOG_HEADING}' heading)")
+            return []
+        findings: List[Finding] = []
+        self.site_count = 0
+        for sf in project.files:
+            if sf.path.name == "faults.py":
+                continue  # the harness itself, not an injection site
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in FAULT_METHODS
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "faults"
+                        and node.args):
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    self.site_count += 1
+                    if arg.value not in documented:
+                        findings.append(self.finding(
+                            sf, node.lineno,
+                            f"faults point {arg.value!r} is not "
+                            f"documented in {doc.name}'s "
+                            "fault-point catalog"))
+                else:
+                    self.dynamic.append(
+                        f"{sf.path}:{node.lineno}: dynamic "
+                        "fault-point name (cannot be checked "
+                        "against the catalog)")
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings
+
+
+# -------------------------------------------------------------- metrics
+
+ALLOWED_PREFIXES = ("ome_", "model_agent_")
+DECL_METHODS = ("counter", "gauge", "histogram")
+RESERVED_SUFFIXES = ("_bucket", "_sum", "_count")
+# label names whose VALUES are per-request/per-user unique — one time
+# series per value is a cardinality explosion, keep them in the
+# request log instead
+BANNED_LABELS = frozenset((
+    "id", "request_id", "requestid", "req_id", "trace_id", "span_id",
+    "prompt", "user", "user_id", "session_id", "token"))
+
+
+def _module_str_consts(tree: ast.Module) -> Dict[str, str]:
+    consts: Dict[str, str] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            consts[node.targets[0].id] = node.value.value
+    return consts
+
+
+def _static_prefix(node, consts: Dict[str, str]) -> Tuple[str, bool]:
+    """(longest statically-known leading string, fully-static?)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, True
+    if isinstance(node, ast.Name) and node.id in consts:
+        return consts[node.id], True
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(str(piece.value))
+                continue
+            if (isinstance(piece, ast.FormattedValue)
+                    and isinstance(piece.value, ast.Name)
+                    and piece.value.id in consts):
+                parts.append(consts[piece.value.id])
+                continue
+            return "".join(parts), False
+        return "".join(parts), True
+    return "", False
+
+
+def _module_str_dicts(tree: ast.Module) -> Dict[str, List[str]]:
+    """Module-level `NAME = {"k": ..., ...}` dicts with all-string
+    keys — the `_COUNTER_HELP` declaration pattern."""
+    dicts: Dict[str, List[str]] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Dict)):
+            keys = [k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)]
+            if len(keys) == len(node.value.keys):
+                dicts[node.targets[0].id] = keys
+    return dicts
+
+
+def _loop_bindings(tree: ast.Module,
+                   str_dicts: Dict[str, List[str]]
+                   ) -> Dict[str, List[str]]:
+    """{loop_var: possible values} for every loop — statement or
+    comprehension — whose iterable is a module-level string-keyed
+    dict D, via ``D.items()``, ``D.keys()``, or D itself. The old
+    script only recognized ``.items()``, so ``for k in D:`` names
+    escaped expansion."""
+    binds: Dict[str, List[str]] = {}
+
+    def note(target, it):
+        dict_name = None
+        if (isinstance(it, ast.Call)
+                and isinstance(it.func, ast.Attribute)
+                and it.func.attr in ("items", "keys")
+                and isinstance(it.func.value, ast.Name)
+                and it.func.value.id in str_dicts):
+            dict_name = it.func.value.id
+            if it.func.attr == "items" and \
+                    isinstance(target, ast.Tuple) and target.elts:
+                target = target.elts[0]
+        elif isinstance(it, ast.Name) and it.id in str_dicts:
+            dict_name = it.id
+        if dict_name is None:
+            return
+        if isinstance(target, ast.Name):
+            binds.setdefault(target.id, []).extend(
+                str_dicts[dict_name])
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.For):
+            note(node.target, node.iter)
+        elif isinstance(node, ast.comprehension):
+            note(node.target, node.iter)
+    return binds
+
+
+def _resolved_names(arg, consts: Dict[str, str],
+                    binds: Dict[str, List[str]]) -> List[str]:
+    """Every metric name a declaration's first argument can evaluate
+    to; [] when unresolvable."""
+    text, fully = _static_prefix(arg, consts)
+    if fully:
+        return [text]
+    if isinstance(arg, ast.JoinedStr):
+        names = [""]
+        for piece in arg.values:
+            if isinstance(piece, ast.Constant):
+                names = [n + str(piece.value) for n in names]
+            elif (isinstance(piece, ast.FormattedValue)
+                    and isinstance(piece.value, ast.Name)):
+                var = piece.value.id
+                if var in consts:
+                    names = [n + consts[var] for n in names]
+                elif var in binds:
+                    names = [n + k for n in names
+                             for k in binds[var]]
+                else:
+                    return []
+            else:
+                return []
+        return names
+    return []
+
+
+def _labelnames(call: ast.Call) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == "labelnames":
+            return kw.value
+    if len(call.args) >= 3:
+        return call.args[2]
+    return None
+
+
+def documented_names(md_path: pathlib.Path) -> Set[str]:
+    """Metric names from the docs/observability.md catalog tables
+    (the `{labels}` display suffix is stripped)."""
+    rx = re.compile(r"^\|\s*`([A-Za-z_][A-Za-z0-9_]*)"
+                    r"(?:\{[^}]*\})?`\s*\|")
+    names: Set[str] = set()
+    for line in md_path.read_text(encoding="utf-8").splitlines():
+        m = rx.match(line)
+        if m:
+            names.add(m.group(1))
+    return names
+
+
+class MetricsNamingRule(Rule):
+    name = "metrics-naming"
+    description = ("metric naming rules (prefix/_total/reserved "
+                   "suffixes/label cardinality) and observability.md "
+                   "catalog drift")
+
+    def __init__(self, doc: Optional[pathlib.Path] = None,
+                 drift: bool = True):
+        self.doc = doc
+        self.drift_enabled = drift
+        self.dynamic: List[str] = []
+        self.drift: List[str] = []
+        self.file_count = 0
+
+    def run(self, project: Project, ctx: Context = None
+            ) -> List[Finding]:
+        self.dynamic, self.drift = [], []
+        findings: List[Finding] = []
+        declared: Set[str] = set()
+        files = [sf for sf in project.files
+                 if not ("telemetry" in sf.rel.split("/")
+                         and sf.path.name == "registry.py")]
+        self.file_count = len(files)
+        for sf in files:
+            consts = _module_str_consts(sf.tree)
+            binds = _loop_bindings(sf.tree,
+                                   _module_str_dicts(sf.tree))
+            for node in ast.walk(sf.tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in DECL_METHODS):
+                    self._check_call(node, node.func.attr, consts,
+                                     binds, sf, findings)
+                    if node.args:
+                        declared.update(_resolved_names(
+                            node.args[0], consts, binds))
+        if self.drift_enabled:
+            doc = self.doc or (project.repo / "docs" /
+                               "observability.md")
+            if doc.exists():
+                documented = documented_names(doc)
+                scoped_decl = {n for n in declared
+                               if n.startswith("ome_")}
+                scoped_doc = {n for n in documented
+                              if n.startswith("ome_")}
+                for name in sorted(scoped_decl - scoped_doc):
+                    self.drift.append(
+                        f"{name}: declared in source but missing "
+                        f"from {doc.name} catalog")
+                for name in sorted(scoped_doc - scoped_decl):
+                    self.drift.append(
+                        f"{name}: documented in {doc.name} but "
+                        "declared nowhere in the tree")
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings
+
+    def _check_call(self, call: ast.Call, kind: str,
+                    consts: Dict[str, str],
+                    binds: Dict[str, List[str]],
+                    sf: SourceFile, out: List[Finding]):
+        if not call.args:
+            return
+        line = call.lineno
+        names = _resolved_names(call.args[0], consts, binds)
+        if names:
+            # every name the declaration can evaluate to is held to
+            # the full rule set — including f-string expansions the
+            # old script only used for drift comparison
+            for name in names:
+                if not name.startswith(ALLOWED_PREFIXES):
+                    out.append(self.finding(
+                        sf, line,
+                        f"{kind} {name!r}: missing subsystem prefix "
+                        f"(one of {ALLOWED_PREFIXES})"))
+                if kind == "counter" and not name.endswith("_total"):
+                    out.append(self.finding(
+                        sf, line,
+                        f"counter {name!r} must end in '_total'"))
+                if kind != "histogram" and \
+                        name.endswith(RESERVED_SUFFIXES):
+                    out.append(self.finding(
+                        sf, line,
+                        f"{kind} {name!r} ends in a histogram-"
+                        f"reserved suffix {RESERVED_SUFFIXES}"))
+            display = names[0]
+        else:
+            prefix, _fully = _static_prefix(call.args[0], consts)
+            if not prefix:
+                self.dynamic.append(
+                    f"{sf.path}:{line}: fully dynamic {kind} name "
+                    "(runtime registry rules still apply)")
+            elif not prefix.startswith(ALLOWED_PREFIXES):
+                out.append(self.finding(
+                    sf, line,
+                    f"{kind} {prefix!r}: missing subsystem prefix "
+                    f"(one of {ALLOWED_PREFIXES})"))
+            display = prefix
+        labels = _labelnames(call)
+        if labels is not None and isinstance(labels,
+                                             (ast.Tuple, ast.List)):
+            for el in labels.elts:
+                if isinstance(el, ast.Constant) and \
+                        str(el.value).lower() in BANNED_LABELS:
+                    out.append(self.finding(
+                        sf, line,
+                        f"label {el.value!r} on "
+                        f"{display or kind!r} implies unbounded "
+                        "cardinality (one series per request); put "
+                        "it in the request log, not a label"))

@@ -1,0 +1,110 @@
+"""hot-path-sync: no host-blocking device fetch on the decode or
+router-forwarding hot path.
+
+Reimplements scripts/check_decode_sync.py on the call graph: the
+function set is REACHABILITY from the configured roots (default
+``Scheduler.step`` and the router Handler's ``_forward``), not a
+hardcoded frozenset — so the step-plan refactor (ROADMAP item 1) can
+rename or split step helpers without silently un-linting them. The
+sanctioned drain sinks (``_drain_inflight`` / ``_drain_spec``) are a
+reachability stop-set: they are the one place a device->host fetch is
+allowed, because by construction they run only after the next step
+was dispatched.
+
+When none of the configured roots resolve — the shim linting a
+fixture file that has no ``step`` — the legacy step-path names seed
+the roots instead, which preserves the original script's contract on
+existing fixtures.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from ..callgraph import body_walk
+from ..context import Context
+from ..core import Finding, Project, Rule
+
+ROOT_SPECS = (
+    "engine/scheduler.py::Scheduler.step",
+    "router/server.py::RouterServer.__init__.Handler._forward",
+)
+# fallback seeds for single-file runs whose file lacks the real
+# roots (the legacy check_decode_sync fixture contract)
+LEGACY_ROOTS = (
+    "step", "_decode", "_insert_ready", "_admit", "_build_mask",
+    "_maybe_finish", "_sampling", "_spec_headroom", "_build_drafts")
+ALLOWED = frozenset(("_drain_inflight", "_drain_spec"))
+
+_SYNC_MODULE_CALLS = frozenset((
+    ("np", "asarray"), ("np", "array"),
+    ("numpy", "asarray"), ("numpy", "array"),
+    ("jax", "device_get"),
+))
+_SYNC_METHODS = frozenset(("block_until_ready", "copy_to_host"))
+_SYNC_NAMES = frozenset(("host_value",))
+
+
+def sync_call_label(call: ast.Call) -> str:
+    """Non-empty label when `call` is a host-sync primitive."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        if isinstance(func.value, ast.Name) and \
+                (func.value.id, func.attr) in _SYNC_MODULE_CALLS:
+            return f"{func.value.id}.{func.attr}"
+        if func.attr in _SYNC_METHODS:
+            return f".{func.attr}"
+    if isinstance(func, ast.Name) and func.id in _SYNC_NAMES:
+        return func.id
+    return ""
+
+
+class HotPathSyncRule(Rule):
+    name = "hot-path-sync"
+    description = ("host-blocking device fetches in functions "
+                   "reachable from the decode step / router forward "
+                   "roots (sanctioned drains excepted)")
+
+    def __init__(self, root_specs: Sequence[str] = ROOT_SPECS,
+                 legacy_roots: Sequence[str] = LEGACY_ROOTS,
+                 allowed: Sequence[str] = tuple(ALLOWED)):
+        self.root_specs = tuple(root_specs)
+        self.legacy_roots = tuple(legacy_roots)
+        self.allowed = frozenset(allowed)
+
+    def run(self, project: Project, ctx: Context = None
+            ) -> List[Finding]:
+        ctx = ctx or Context(project)
+        graph = ctx.graph
+        roots: List[str] = []
+        for spec in self.root_specs:
+            roots.extend(graph.resolve_spec(spec))
+        if not roots:
+            for name in self.legacy_roots:
+                roots.extend(graph.resolve_spec(name))
+        reach = graph.reachable(roots, stop=set(self.allowed))
+        findings: List[Finding] = []
+        for node in sorted(reach):
+            rel, qual = node.split("::", 1)
+            sf = project.file(rel)
+            fn = sf.defs.get(qual) if sf is not None else None
+            if fn is None or isinstance(fn, ast.ClassDef):
+                continue
+            short = qual.rsplit(".", 1)[-1]
+            if short in self.allowed:
+                continue
+            for sub in body_walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                label = sync_call_label(sub)
+                if label:
+                    findings.append(self.finding(
+                        sf, sub.lineno,
+                        f"{label}(...) in step-path function "
+                        f"{short!r} forces a device->host sync "
+                        "between decode dispatches; fetch tokens in "
+                        "_drain_inflight (after the next dispatch) "
+                        "instead"))
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings

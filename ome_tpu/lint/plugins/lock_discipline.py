@@ -1,0 +1,175 @@
+"""lock-discipline: no blocking operations while a threading lock is
+held, and no cycles in the lock-acquisition-order graph.
+
+Two finding shapes:
+
+  * a blocking call — ``os.fsync``, ``urlopen``, socket connect /
+    resolve, ``subprocess.*``, ``time.sleep``, or a jitted-call
+    result fetch (``np.asarray`` / ``.block_until_ready`` / ...) —
+    textually inside a lock region, OR reachable through the call
+    graph from a call made inside one. The interprocedural case is
+    the one reviews miss: ``submit()`` holding the scheduler lock
+    through ``journal.admit`` -> ``_append`` -> ``os.fsync`` shows no
+    blocking token anywhere near the ``with`` block.
+
+  * a lock-order cycle: region sites nested inside other regions
+    (same file) plus call-graph edges from inside a region to
+    functions that take another lock yield a directed
+    acquired-before graph over the normalized lock identities; any
+    cycle is a potential deadlock and fails the build.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ..callgraph import body_walk
+from ..context import Context
+from ..core import Finding, Project, Rule
+from ..lockmodel import find_cycles
+
+_BLOCKING_MODULE_CALLS = {
+    ("os", "fsync"): "os.fsync",
+    ("time", "sleep"): "time.sleep",
+    ("socket", "create_connection"): "socket.create_connection",
+    ("socket", "getaddrinfo"): "socket.getaddrinfo",
+    ("subprocess", "run"): "subprocess.run",
+    ("subprocess", "call"): "subprocess.call",
+    ("subprocess", "check_call"): "subprocess.check_call",
+    ("subprocess", "check_output"): "subprocess.check_output",
+    ("subprocess", "Popen"): "subprocess.Popen",
+    ("np", "asarray"): "np.asarray",
+    ("np", "array"): "np.array",
+    ("numpy", "asarray"): "numpy.asarray",
+    ("numpy", "array"): "numpy.array",
+    ("jax", "device_get"): "jax.device_get",
+}
+# method names blocking regardless of receiver expression
+_BLOCKING_METHODS = frozenset((
+    "urlopen", "getresponse", "block_until_ready", "copy_to_host"))
+# bare names (from-imports)
+_BLOCKING_NAMES = frozenset(("urlopen", "fsync", "host_value"))
+
+
+def blocking_label(call: ast.Call) -> str:
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        if isinstance(func.value, ast.Name):
+            label = _BLOCKING_MODULE_CALLS.get(
+                (func.value.id, func.attr))
+            if label:
+                return label
+        if func.attr in _BLOCKING_METHODS:
+            return f".{func.attr}"
+    if isinstance(func, ast.Name) and func.id in _BLOCKING_NAMES:
+        return func.id
+    return ""
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = ("blocking operations executed while a "
+                   "threading.Lock/RLock is held; lock-acquisition-"
+                   "order cycles")
+
+    def run(self, project: Project, ctx: Context = None
+            ) -> List[Finding]:
+        ctx = ctx or Context(project)
+        graph, locks = ctx.graph, ctx.locks
+        findings: List[Finding] = []
+
+        # direct blocking calls per function node
+        direct: Dict[str, List[Tuple[int, str]]] = {}
+        for sf in project.files:
+            for qual, fn in sf.defs.items():
+                if isinstance(fn, ast.ClassDef):
+                    continue
+                hits = [(sub.lineno, blocking_label(sub))
+                        for sub in body_walk(fn)
+                        if isinstance(sub, ast.Call)
+                        and blocking_label(sub)]
+                if hits:
+                    direct[f"{sf.rel}::{qual}"] = hits
+
+        # functions that own lock regions, for order-edge derivation
+        region_owner: Dict[str, List[str]] = {}
+        for rel, regions in locks.regions.items():
+            for r in regions:
+                region_owner.setdefault(
+                    f"{rel}::{r.func}", []).append(r.lock)
+
+        reach_cache: Dict[str, Set[str]] = {}
+
+        def reach_from(callee: str) -> Set[str]:
+            if callee not in reach_cache:
+                reach_cache[callee] = graph.reachable([callee])
+            return reach_cache[callee]
+
+        order_edges: List[Tuple[str, str, str]] = locks.order_edges()
+        for sf in project.files:
+            for region in locks.regions.get(sf.rel, ()):
+                fn = sf.defs.get(region.func)
+                if fn is None:
+                    continue
+                me = f"{sf.rel}::{region.func}"
+                # 1) blocking calls textually inside the region
+                for line, label in direct.get(me, ()):
+                    if region.start <= line <= region.end:
+                        findings.append(self.finding(
+                            sf, line,
+                            f"blocking {label}(...) while "
+                            f"{region.lock} is held"))
+                # 2) + 3) call chains leaving the region: blocking
+                # sinks and lock-order edges, anchored at the call
+                # site inside the region that reaches them
+                sites = [(line, targets) for line, targets
+                         in graph.call_sites(sf, region.func)
+                         if region.start <= line <= region.end]
+                reported: Set[Tuple[str, str, str]] = set()
+                for line, targets in sites:
+                    for target in sorted(targets):
+                        if target == me:
+                            continue
+                        for node in sorted(reach_from(target)):
+                            for _bline, label in direct.get(node,
+                                                            ()):
+                                short = node.split("::", 1)[1]
+                                key = (region.lock, short, label)
+                                if key in reported:
+                                    continue
+                                reported.add(key)
+                                findings.append(self.finding(
+                                    sf, line,
+                                    "call chain from this lock "
+                                    f"region reaches blocking "
+                                    f"{label}(...) in {short} while "
+                                    f"{region.lock} is held"))
+                            for inner in region_owner.get(node, ()):
+                                if inner != region.lock:
+                                    order_edges.append((
+                                        region.lock, inner,
+                                        f"{sf.rel}:{line}"))
+
+        for cycle in find_cycles(order_edges):
+            chain = " -> ".join(cycle)
+            involved = set(cycle)
+            site = next((s for a, b, s in order_edges
+                         if a in involved and b in involved),
+                        "?:0")
+            rel, _, line = site.partition(":")
+            sf = project.file(rel)
+            if sf is not None:
+                findings.append(self.finding(
+                    sf, int(line or 1),
+                    f"lock-order cycle {chain} (potential "
+                    "deadlock): acquire these locks in one global "
+                    "order"))
+            else:
+                findings.append(Finding(
+                    self.name, rel or "<project>", int(line or 1),
+                    f"lock-order cycle {chain} (potential "
+                    "deadlock): acquire these locks in one global "
+                    "order"))
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings

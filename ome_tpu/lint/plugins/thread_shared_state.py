@@ -1,0 +1,319 @@
+"""thread-shared-state: attributes mutated on one thread domain and
+read on another with no common lock region.
+
+Domains are seeded structurally, not by file list:
+
+  * ``http``       — ``do_*`` methods of ``BaseHTTPRequestHandler``
+                     subclasses, plus everything they reach through
+                     the call graph (many concurrent threads: the
+                     servers are ThreadingHTTPServer);
+  * ``background`` — every function passed as a ``Thread(target=…)``
+                     plus its reachability closure (scheduler loop,
+                     admission loop, health loop, drain timers).
+
+For each class attribute the analyzer records reads, writes, and
+read-modify-writes per domain together with the locks held at each
+access — syntactically (inside a ``with self._lock`` region) or at
+function entry (the intersection of locks held at every call site,
+a small interprocedural fixpoint). Two finding shapes:
+
+  * a cross-domain attribute — written in one domain, touched in the
+    other — whose accesses share NO common lock (the
+    ``_probe_inflight`` / span-minting race shape);
+  * an unlocked read-modify-write (``+=``) reached from the http
+    domain, racy among the handler threads alone (the
+    ``Backend.inflight`` shape) — including RMWs on non-``self``
+    receivers, attributed to the owning class when the attribute
+    name is unambiguous in the project.
+
+``__init__`` writes are construction, not mutation, and are ignored.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..callgraph import CallGraph, body_walk
+from ..context import Context
+from ..core import Finding, Project, Rule, SourceFile
+
+_HANDLER_BASES = frozenset(
+    ("BaseHTTPRequestHandler", "SimpleHTTPRequestHandler"))
+
+
+class _Access:
+    __slots__ = ("kind", "line", "sf", "node", "locks", "domains")
+
+    def __init__(self, kind: str, line: int, sf: SourceFile,
+                 node: str):
+        self.kind = kind          # "read" | "write" | "rmw"
+        self.line = line
+        self.sf = sf
+        self.node = node          # function node key
+        self.locks: Set[str] = set()
+        self.domains: Set[str] = set()
+
+
+class ThreadSharedStateRule(Rule):
+    name = "thread-shared-state"
+    description = ("attributes shared between HTTP-handler and "
+                   "background threads without a common lock")
+
+    def run(self, project: Project, ctx: Context = None
+            ) -> List[Finding]:
+        ctx = ctx or Context(project)
+        graph, locks = ctx.graph, ctx.locks
+
+        http_roots = self._http_roots(project)
+        bg_roots = self._thread_targets(project, graph)
+        http_nodes = graph.reachable(http_roots)
+        bg_nodes = graph.reachable(bg_roots)
+        interesting = http_nodes | bg_nodes
+
+        # class node key -> attr names it ever assigns via self.X
+        class_attrs: Dict[str, Set[str]] = {}
+        # attr name -> owning class node keys (for non-self receivers)
+        attr_owner: Dict[str, Set[str]] = {}
+        for sf in project.files:
+            for qual, node in sf.defs.items():
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                ckey = f"{sf.rel}::{qual}"
+                attrs = set()
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Attribute) and \
+                            isinstance(sub.ctx, ast.Store) and \
+                            isinstance(sub.value, ast.Name) and \
+                            sub.value.id == "self":
+                        attrs.add(sub.attr)
+                class_attrs[ckey] = attrs
+                for a in attrs:
+                    attr_owner.setdefault(a, set()).add(ckey)
+
+        entry_locks = self._entry_locks(
+            project, graph, locks, http_roots | bg_roots,
+            interesting)
+
+        # (class key, attr) -> accesses
+        accesses: Dict[Tuple[str, str], List[_Access]] = {}
+        for node in sorted(interesting):
+            rel, qual = node.split("::", 1)
+            sf = project.file(rel)
+            fn = sf.defs.get(qual) if sf is not None else None
+            if fn is None or isinstance(fn, ast.ClassDef):
+                continue
+            own_class = self._enclosing_class_key(sf, qual)
+            in_init = qual.endswith(".__init__") or qual == "__init__"
+            for kind, line, ckey, attr in self._attr_accesses(
+                    sf, fn, own_class, class_attrs, attr_owner):
+                if in_init and kind != "read" and ckey == own_class:
+                    continue  # construction, not mutation
+                acc = _Access(kind, line, sf, node)
+                acc.locks = {r.lock for r in locks.held_at(sf, line)}
+                acc.locks |= entry_locks.get(node, set())
+                if node in http_nodes:
+                    acc.domains.add("http")
+                if node in bg_nodes:
+                    acc.domains.add("background")
+                accesses.setdefault((ckey, attr), []).append(acc)
+
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, str, str]] = set()
+        for (ckey, attr), accs in sorted(accesses.items()):
+            cls_short = ckey.split("::", 1)[1].rsplit(".", 1)[-1]
+            writes = [a for a in accs if a.kind in ("write", "rmw")]
+            if not writes:
+                continue
+            # locks, private-by-convention sync objects, and the
+            # attributes that ARE locks don't race
+            if attr.endswith("lock") or attr.endswith("_cond") or \
+                    attr.endswith("_event"):
+                continue
+            # shape 1: cross-domain with no common lock
+            wd = set().union(*(a.domains for a in writes))
+            ad = set().union(*(a.domains for a in accs))
+            if "http" in ad and "background" in ad and wd:
+                common = None
+                for a in accs:
+                    common = (set(a.locks) if common is None
+                              else common & a.locks)
+                # an access with SOME lock on every path is treated
+                # as instance-consistent locking (a Backend guarded
+                # by Router._lock in one owner and PrefillPool._lock
+                # in another is fine — different instances); only a
+                # fully unguarded access somewhere makes the race
+                unguarded = any(not a.locks for a in accs)
+                if not common and unguarded:
+                    anchor = min(writes, key=lambda a: a.line)
+                    key = (ckey, attr, "xdomain")
+                    if key not in seen:
+                        seen.add(key)
+                        findings.append(self.finding(
+                            anchor.sf, anchor.line,
+                            f"attribute {cls_short}.{attr} is "
+                            "written on "
+                            f"{'/'.join(sorted(wd))} thread(s) and "
+                            "accessed from both http-handler and "
+                            "background threads with no common lock "
+                            "region"))
+            # shape 2: unlocked RMW on http threads
+            for a in accs:
+                if a.kind == "rmw" and "http" in a.domains \
+                        and not a.locks:
+                    key = (ckey, attr, f"rmw:{a.node}")
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(self.finding(
+                        a.sf, a.line,
+                        f"unlocked read-modify-write of "
+                        f"{cls_short}.{attr} on concurrent "
+                        "HTTP-handler threads (lost updates); hold "
+                        "the owning lock"))
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings
+
+    # -- seeding -------------------------------------------------------
+
+    def _http_roots(self, project: Project) -> Set[str]:
+        roots: Set[str] = set()
+        for sf in project.files:
+            for qual, node in sf.defs.items():
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                bases = set()
+                for b in node.bases:
+                    if isinstance(b, ast.Name):
+                        bases.add(b.id)
+                    elif isinstance(b, ast.Attribute):
+                        bases.add(b.attr)
+                if not (bases & _HANDLER_BASES):
+                    continue
+                for mqual in sf.defs:
+                    if mqual.startswith(qual + ".") and \
+                            mqual.rsplit(".", 1)[-1].startswith("do_"):
+                        roots.add(f"{sf.rel}::{mqual}")
+        return roots
+
+    def _thread_targets(self, project: Project, graph: CallGraph
+                        ) -> Set[str]:
+        roots: Set[str] = set()
+        for sf in project.files:
+            for qual, fn in sf.defs.items():
+                if isinstance(fn, ast.ClassDef):
+                    continue
+                for sub in body_walk(fn):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    callee = sub.func
+                    cname = callee.attr if isinstance(
+                        callee, ast.Attribute) else getattr(
+                            callee, "id", "")
+                    if cname not in ("Thread", "Timer"):
+                        continue
+                    for kw in sub.keywords:
+                        if kw.arg == "target":
+                            roots.update(graph.resolve_ref(
+                                sf, qual, kw.value))
+        return roots
+
+    # -- access extraction ---------------------------------------------
+
+    def _enclosing_class_key(self, sf: SourceFile, qual: str
+                             ) -> Optional[str]:
+        parts = qual.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            cand = ".".join(parts[:i])
+            if isinstance(sf.defs.get(cand), ast.ClassDef):
+                return f"{sf.rel}::{cand}"
+        return None
+
+    def _attr_accesses(self, sf: SourceFile, fn: ast.AST,
+                       own_class: Optional[str],
+                       class_attrs: Dict[str, Set[str]],
+                       attr_owner: Dict[str, Set[str]]):
+        """yield (kind, line, class key, attr) for every self.X and
+        unambiguous other.X access in fn's own body."""
+
+        def owner_of(node: ast.Attribute) -> Optional[str]:
+            recv = node.value
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                if own_class and node.attr in class_attrs.get(
+                        own_class, ()):
+                    return own_class
+                return None
+            if isinstance(recv, ast.Name):
+                owners = attr_owner.get(node.attr, set())
+                if len(owners) != 1:
+                    return None
+                owner = next(iter(owners))
+                cls_short = owner.rsplit(".", 1)[-1].lower()
+                var = recv.id.lstrip("_").replace("_", "").lower()
+                # only attribute `backend.inflight` to Backend when
+                # the variable is recognizably an instance of it —
+                # a unique attr name alone is too weak a signal, and
+                # one-letter locals match everything
+                if len(var) >= 3 and (var in cls_short
+                                      or cls_short in var):
+                    return owner
+            return None
+
+        rmw_targets = set()
+        for sub in body_walk(fn):
+            if isinstance(sub, ast.AugAssign) and isinstance(
+                    sub.target, ast.Attribute):
+                rmw_targets.add(id(sub.target))
+        for sub in body_walk(fn):
+            if not isinstance(sub, ast.Attribute):
+                continue
+            ckey = owner_of(sub)
+            if ckey is None:
+                continue
+            if id(sub) in rmw_targets:
+                kind = "rmw"
+            elif isinstance(sub.ctx, ast.Store):
+                kind = "write"
+            elif isinstance(sub.ctx, ast.Load):
+                kind = "read"
+            else:
+                continue
+            yield kind, sub.lineno, ckey, sub.attr
+
+    # -- interprocedural held-locks ------------------------------------
+
+    def _entry_locks(self, project: Project, graph: CallGraph,
+                     locks, roots: Set[str],
+                     interesting: Set[str]
+                     ) -> Dict[str, Set[str]]:
+        """locks guaranteed held when a function is entered: the
+        intersection over every call site that reaches it (roots
+        start lock-free). A small fixpoint — 4 rounds covers the
+        call depths in this tree."""
+        entry: Dict[str, Optional[Set[str]]] = {
+            r: set() for r in roots}
+        sites: List[Tuple[str, SourceFile, int, Set[str]]] = []
+        for node in sorted(interesting):
+            rel, qual = node.split("::", 1)
+            sf = project.file(rel)
+            if sf is None or qual not in sf.defs:
+                continue
+            for line, targets in graph.call_sites(sf, qual):
+                sites.append((node, sf, line, targets))
+        for _round in range(4):
+            changed = False
+            for caller, sf, line, targets in sites:
+                base = entry.get(caller)
+                if base is None:
+                    continue
+                held = set(base) | {
+                    r.lock for r in locks.held_at(sf, line)}
+                for t in targets:
+                    cur = entry.get(t)
+                    new = set(held) if cur is None else (cur & held)
+                    if cur is None or new != cur:
+                        entry[t] = new
+                        changed = True
+            if not changed:
+                break
+        return {k: v for k, v in entry.items() if v}

@@ -342,6 +342,15 @@ class Router:
         with self._lock:
             backend._probe_inflight = False
 
+    def adjust_inflight(self, backend: Backend, delta: int):
+        """Bump a backend's in-flight counter under the router lock.
+        Handler threads are concurrent (ThreadingHTTPServer): a bare
+        ``backend.inflight += 1`` on the forwarding path is a
+        read-modify-write that loses updates under contention and
+        drifts the counter permanently."""
+        with self._lock:
+            backend.inflight += delta
+
     # -- health --------------------------------------------------------
 
     def check_health_once(self):
@@ -693,7 +702,7 @@ class RouterServer:
                 req = urllib.request.Request(
                     backend.url + self.path, data=body or None,
                     method=self.command, headers=headers)
-                backend.inflight += 1
+                outer.router.adjust_inflight(backend, 1)
                 try:
                     resp = urllib.request.urlopen(req, timeout=timeout)
                 except urllib.error.HTTPError as e:
@@ -722,7 +731,7 @@ class RouterServer:
                     self._client_write(data)
                     return None
                 finally:
-                    backend.inflight -= 1
+                    outer.router.adjust_inflight(backend, -1)
                 with resp:
                     if stream:
                         self.send_response(resp.status)

@@ -143,6 +143,7 @@ class _RefreshingTokenSigner:
         with self._lock:
             if self._cached and time.time() < self._expiry - 60:
                 return self._cached
+            # omelint: disable=lock-discipline -- single-flight refresh: holding the lock through the fetch prevents a token stampede
             tok, ttl = self._fetch()
             self._cached, self._expiry = tok, time.time() + ttl
             return tok

@@ -132,6 +132,7 @@ class Span:
 
     def end(self, end_mono: Optional[float] = None) -> "Span":
         end_mono = time.monotonic() if end_mono is None else end_mono
+        # omelint: disable=thread-shared-state -- a span is owned by one thread until end(); readers see it only after the hand-off
         self.dur_s = max(0.0, end_mono - self.start_mono)
         return self
 

@@ -1,114 +1,50 @@
 #!/usr/bin/env python3
 """Static decode-loop sync-fetch lint (tier-1, via tests/test_pipeline.py).
 
-The pipelined decode loop's win is that no host-blocking device fetch
-sits between two decode dispatches (docs/decode-pipelining.md). This
-lint walks the scheduler's step-path functions and fails on calls that
-force a device->host sync on a jitted-call result:
-
-  * `np.asarray(...)` / `np.array(...)` / `numpy.asarray(...)`
-  * `jax.device_get(...)`
-  * `<x>.block_until_ready()` / `<x>.copy_to_host()`
-  * `host_value(...)` (the multihost local-replica fetch)
-
-anywhere except the designated drain function (`_drain_inflight`),
-which by construction runs only AFTER the next step was dispatched —
-so a synchronous fetch cannot silently creep back into the loop.
-`copy_to_host_async` is explicitly fine: starting the copy is the
-point; only completing it inline is the bubble.
+Thin shim over the omelint ``hot-path-sync`` analyzer
+(ome_tpu/lint/plugins/hot_path_sync.py): same CLI, same output lines,
+same exit codes as the original standalone script — but the function
+set is now derived from call-graph REACHABILITY (roots:
+``Scheduler.step`` and the router forward path; legacy step-path
+names seed fixture files that lack them) instead of a hardcoded
+frozenset, so renaming or splitting a step helper cannot silently
+un-lint it. The sanctioned drain fetches (`_drain_inflight` /
+`_drain_spec`) are a reachability stop-set. See
+docs/static-analysis.md.
 
 Usage: python scripts/check_decode_sync.py [scheduler.py path]
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
-from typing import List
 
-# the scheduler functions on the per-step hot path: everything that
-# runs between two decode dispatches — including the speculative
-# verify/accept path (_spec_headroom gates, _build_drafts builds the
-# n-gram drafts from HOST-side token lists; neither may touch device
-# arrays synchronously)
-STEP_PATH = frozenset((
-    "step", "_decode", "_insert_ready", "_admit", "_build_mask",
-    "_maybe_finish", "_sampling", "_spec_headroom", "_build_drafts"))
-# the sanctioned fetch points: they read a step whose successor was
-# already dispatched, so the copy they complete was already in flight
-# (_drain_spec is _drain_inflight's speculative-step arm and is only
-# called from it)
-ALLOWED = frozenset(("_drain_inflight", "_drain_spec"))
+REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
 
-_SYNC_MODULE_CALLS = frozenset((
-    ("np", "asarray"), ("np", "array"),
-    ("numpy", "asarray"), ("numpy", "array"),
-    ("jax", "device_get"),
-))
-_SYNC_METHODS = frozenset(("block_until_ready", "copy_to_host"))
-_SYNC_NAMES = frozenset(("host_value",))
-
-
-class Violation:
-    def __init__(self, path: pathlib.Path, line: int, msg: str):
-        self.path, self.line, self.msg = path, line, msg
-
-    def __str__(self):
-        return f"{self.path}:{self.line}: {self.msg}"
-
-
-def _sync_call_label(call: ast.Call) -> str:
-    """Non-empty label when `call` is a host-sync primitive."""
-    func = call.func
-    if isinstance(func, ast.Attribute):
-        if isinstance(func.value, ast.Name) and \
-                (func.value.id, func.attr) in _SYNC_MODULE_CALLS:
-            return f"{func.value.id}.{func.attr}"
-        if func.attr in _SYNC_METHODS:
-            return f".{func.attr}"
-    if isinstance(func, ast.Name) and func.id in _SYNC_NAMES:
-        return func.id
-    return ""
-
-
-def check_file(path: pathlib.Path) -> List[Violation]:
-    tree = ast.parse(path.read_text(encoding="utf-8"),
-                     filename=str(path))
-    out: List[Violation] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef,
-                                 ast.AsyncFunctionDef)):
-            continue
-        if node.name not in STEP_PATH or node.name in ALLOWED:
-            continue
-        for sub in ast.walk(node):
-            if not isinstance(sub, ast.Call):
-                continue
-            label = _sync_call_label(sub)
-            if label:
-                out.append(Violation(
-                    path, sub.lineno,
-                    f"{label}(...) in step-path function "
-                    f"{node.name!r} forces a device->host sync "
-                    "between decode dispatches; fetch tokens in "
-                    "_drain_inflight (after the next dispatch) "
-                    "instead"))
-    return out
+from ome_tpu.lint.core import Project                       # noqa: E402
+from ome_tpu.lint.plugins.hot_path_sync import HotPathSyncRule  # noqa: E402
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     target = pathlib.Path(argv[0]) if argv else \
-        pathlib.Path(__file__).resolve().parents[1] / "ome_tpu" / \
-        "engine" / "scheduler.py"
+        REPO / "ome_tpu" / "engine" / "scheduler.py"
     if not target.exists():
         print(f"check_decode_sync: no such file {target}",
               file=sys.stderr)
         return 2
-    violations = check_file(target)
+    project = Project(target, repo=REPO)
+    violations = []
+    for f in HotPathSyncRule().run(project):
+        sf = project.file(f.path)
+        s = sf.suppressed(f.rule, f.line) if sf else None
+        if s is None or not s.reason:  # reasonless never suppresses
+            violations.append(f)
     for v in violations:
-        print(f"VIOLATION: {v}")
+        print(f"VIOLATION: {target}:{v.line}: {v.message}")
     print(f"check_decode_sync: {target.name}, "
           f"{len(violations)} violation(s)")
     return 1 if violations else 0

@@ -1,18 +1,14 @@
 #!/usr/bin/env python3
 """Static fault-point catalog lint (tier-1, via tests/test_faults.py).
 
-Every deterministic fault-injection site in the source tree — a call
-of the form `faults.fire("<point>", ...)` or `faults.http("<point>",
-...)` — must be documented in the fault-point catalog table of
-docs/failure-semantics.md. An undocumented point is a recovery path
-nobody can operate: the spec grammar is useless if you cannot discover
-the point names, and the failure contract of the site is exactly what
-the catalog row records.
-
-The check is one-directional on purpose: catalog rows without a
-matching site are allowed (a point may be documented ahead of landing,
-or live in an optional component), but a fired point missing from the
-catalog fails the build.
+Thin shim over the omelint ``fault-catalog`` analyzer
+(ome_tpu/lint/plugins/catalog_drift.py): same CLI, same output lines,
+same exit codes as the original standalone script. Every literal
+``faults.fire("<point>")`` / ``faults.http("<point>")`` site must
+have a row in the fault-point catalog table of
+docs/failure-semantics.md; the check stays one-directional on
+purpose (documenting ahead of landing is allowed). See
+docs/static-analysis.md.
 
 Usage: python scripts/check_fault_points.py [src-root] [catalog-doc]
        (defaults: ome_tpu, docs/failure-semantics.md)
@@ -20,106 +16,49 @@ Usage: python scripts/check_fault_points.py [src-root] [catalog-doc]
 
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
-from typing import List, Set, Tuple
 
-FAULT_METHODS = ("fire", "http")
-CATALOG_HEADING = "fault-point catalog"
+REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
 
-
-class Site:
-    def __init__(self, path: pathlib.Path, line: int, point: str):
-        self.path, self.line, self.point = path, line, point
-
-    def __str__(self):
-        return f"{self.path}:{self.line}: faults point {self.point!r}"
-
-
-def collect_sites(root: pathlib.Path) -> Tuple[List[Site], List[str]]:
-    """(sites with literal point names, notes about dynamic ones)."""
-    sites: List[Site] = []
-    dynamic: List[str] = []
-    for path in sorted(root.rglob("*.py")):
-        if path.name == "faults.py":
-            continue  # the harness itself, not an injection site
-        tree = ast.parse(path.read_text(encoding="utf-8"),
-                         filename=str(path))
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in FAULT_METHODS
-                    and isinstance(node.func.value, ast.Name)
-                    and node.func.value.id == "faults"
-                    and node.args):
-                continue
-            arg = node.args[0]
-            if isinstance(arg, ast.Constant) and \
-                    isinstance(arg.value, str):
-                sites.append(Site(path, node.lineno, arg.value))
-            else:
-                dynamic.append(
-                    f"{path}:{node.lineno}: dynamic fault-point name "
-                    "(cannot be checked against the catalog)")
-    return sites, dynamic
-
-
-def catalog_points(doc: pathlib.Path) -> Set[str]:
-    """Backticked names in the fault-point catalog section's table
-    rows (first cell of each `| `name` | ...` row)."""
-    points: Set[str] = set()
-    in_section = False
-    section_level = 0
-    for line in doc.read_text(encoding="utf-8").splitlines():
-        m = re.match(r"(#+)\s+(.*)", line)
-        if m:
-            level, title = len(m.group(1)), m.group(2).strip().lower()
-            if CATALOG_HEADING in title:
-                in_section, section_level = True, level
-                continue
-            if in_section and level <= section_level:
-                in_section = False
-            continue
-        if in_section and line.lstrip().startswith("|"):
-            cells = [c.strip() for c in line.strip().strip("|")
-                     .split("|")]
-            if cells:
-                points.update(re.findall(r"`([A-Za-z0-9_]+)`",
-                                         cells[0]))
-    return points
+from ome_tpu.lint.core import Project                       # noqa: E402
+from ome_tpu.lint.plugins.catalog_drift import (            # noqa: E402
+    FaultCatalogRule,
+    catalog_points,  # re-exported: ome_tpu.chaos preflight imports this
+)
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    repo = pathlib.Path(__file__).resolve().parents[1]
-    root = pathlib.Path(argv[0]) if argv else repo / "ome_tpu"
+    root = pathlib.Path(argv[0]) if argv else REPO / "ome_tpu"
     doc = pathlib.Path(argv[1]) if len(argv) > 1 else \
-        repo / "docs" / "failure-semantics.md"
+        REPO / "docs" / "failure-semantics.md"
     if not root.exists():
         print(f"check_fault_points: no such directory {root}",
               file=sys.stderr)
         return 2
-    if not doc.exists():
-        print(f"check_fault_points: no such doc {doc}",
-              file=sys.stderr)
+    project = Project(root, repo=root if root.is_dir() else root.parent)
+    rule = FaultCatalogRule(doc=doc)
+    findings = rule.run(project)
+    if rule.error is not None:
+        print(f"check_fault_points: {rule.error}", file=sys.stderr)
         return 2
-    sites, dynamic = collect_sites(root)
-    documented = catalog_points(doc)
-    if not documented:
-        print(f"check_fault_points: no fault-point catalog table "
-              f"found in {doc} (looked for a '{CATALOG_HEADING}' "
-              "heading)", file=sys.stderr)
-        return 2
-    for note in dynamic:
+    for note in rule.dynamic:
         print(f"note: {note}")
-    missing = [s for s in sites if s.point not in documented]
-    for s in missing:
-        print(f"VIOLATION: {s} is not documented in {doc.name}'s "
-              "fault-point catalog")
-    print(f"check_fault_points: {len(sites)} site(s), "
-          f"{len(documented)} documented point(s), "
+    missing = []
+    for f in findings:
+        sf = project.file(f.path)
+        s = sf.suppressed(f.rule, f.line) if sf else None
+        if s is None or not s.reason:  # reasonless never suppresses
+            missing.append(f)
+    for f in missing:
+        sf = project.file(f.path)
+        shown = sf.path if sf is not None else f.path
+        print(f"VIOLATION: {shown}:{f.line}: {f.message}")
+    print(f"check_fault_points: {rule.site_count} site(s), "
+          f"{rule.documented_count} documented point(s), "
           f"{len(missing)} violation(s)")
     return 1 if missing else 0
 

@@ -1,32 +1,16 @@
 #!/usr/bin/env python3
 """Static metric-naming lint (tier-1, via tests/test_telemetry.py).
 
-Walks every registry declaration in the source tree — calls of the
-form `<registry>.counter(...)` / `.gauge(...)` / `.histogram(...)` —
-and fails on naming violations before they can reach a dashboard:
-
-  * metric name missing an approved subsystem prefix
-    (`ome_*` / `model_agent_*`);
-  * a counter whose name does not end in `_total`;
-  * a scalar metric squatting on a histogram's reserved suffixes
-    (`_bucket`/`_sum`/`_count`);
-  * label NAMES that imply unbounded per-request cardinality
-    (request ids, trace ids, raw prompts) — each distinct label value
-    is a new time series, so these melt a Prometheus server.
-
-Names built from f-strings are resolved as far as module-level string
-constants allow; a name whose static prefix already violates the
-rules fails, one that is entirely dynamic is reported (loudly) but
-not failed — the runtime registry still enforces `_total`.
-
-In default (whole-repo) mode the lint ALSO cross-checks the metric
-catalog in docs/observability.md both ways: every statically
-resolvable `ome_*` declaration must have a catalog row, and every
-catalogued `ome_*` name must still be declared somewhere — so the
-docs cannot silently drift from the code. F-string names whose single
-placeholder iterates a module-level dict (the `_COUNTER_HELP`
-pattern) are expanded key by key for this comparison. `model_agent_*`
-names are exempt (that catalog section is prose by design).
+Thin shim over the omelint ``metrics-naming`` analyzer
+(ome_tpu/lint/plugins/catalog_drift.py): same CLI, same output
+lines, same exit codes as the original standalone script — naming
+rules (approved prefixes, counter ``_total``, histogram-reserved
+suffixes, label cardinality) plus the two-way docs/observability.md
+drift check in default whole-repo mode. Unlike the original, every
+name an f-string declaration can EXPAND to (through module string
+constants and dict-iteration loop variables) is held to the full
+rule set in every mode, not just the drift compare. See
+docs/static-analysis.md.
 
 Usage: python scripts/check_metrics.py [root-dir]    (default: ome_tpu
 + the docs drift check)
@@ -34,275 +18,50 @@ Usage: python scripts/check_metrics.py [root-dir]    (default: ome_tpu
 
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
-from typing import Dict, List, Optional, Set, Tuple
 
-ALLOWED_PREFIXES = ("ome_", "model_agent_")
-DECL_METHODS = ("counter", "gauge", "histogram")
-RESERVED_SUFFIXES = ("_bucket", "_sum", "_count")
-# label names whose VALUES are per-request/per-user unique — one time
-# series per value is a cardinality explosion, keep them in the
-# request log instead
-BANNED_LABELS = frozenset((
-    "id", "request_id", "requestid", "req_id", "trace_id", "span_id",
-    "prompt", "user", "user_id", "session_id", "token"))
+REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
 
-
-class Violation:
-    def __init__(self, path: pathlib.Path, line: int, msg: str):
-        self.path, self.line, self.msg = path, line, msg
-
-    def __str__(self):
-        return f"{self.path}:{self.line}: {self.msg}"
-
-
-def _module_str_consts(tree: ast.Module) -> Dict[str, str]:
-    consts: Dict[str, str] = {}
-    for node in tree.body:
-        if (isinstance(node, ast.Assign) and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)
-                and isinstance(node.value, ast.Constant)
-                and isinstance(node.value.value, str)):
-            consts[node.targets[0].id] = node.value.value
-    return consts
-
-
-def _static_prefix(node, consts: Dict[str, str]
-                   ) -> Tuple[str, bool]:
-    """(longest statically-known leading string, fully-static?)."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value, True
-    if isinstance(node, ast.Name) and node.id in consts:
-        return consts[node.id], True
-    if isinstance(node, ast.JoinedStr):
-        parts: List[str] = []
-        for piece in node.values:
-            if isinstance(piece, ast.Constant):
-                parts.append(str(piece.value))
-                continue
-            if (isinstance(piece, ast.FormattedValue)
-                    and isinstance(piece.value, ast.Name)
-                    and piece.value.id in consts):
-                parts.append(consts[piece.value.id])
-                continue
-            return "".join(parts), False
-        return "".join(parts), True
-    return "", False
-
-
-def _module_str_dicts(tree: ast.Module) -> Dict[str, List[str]]:
-    """Module-level `NAME = {"k": ..., ...}` dicts with all-string
-    keys — the `_COUNTER_HELP` declaration pattern."""
-    dicts: Dict[str, List[str]] = {}
-    for node in tree.body:
-        if (isinstance(node, ast.Assign) and len(node.targets) == 1
-                and isinstance(node.targets[0], ast.Name)
-                and isinstance(node.value, ast.Dict)):
-            keys = [k.value for k in node.value.keys
-                    if isinstance(k, ast.Constant)
-                    and isinstance(k.value, str)]
-            if len(keys) == len(node.value.keys):
-                dicts[node.targets[0].id] = keys
-    return dicts
-
-
-def _loop_bindings(tree: ast.Module,
-                   str_dicts: Dict[str, List[str]]
-                   ) -> Dict[str, List[str]]:
-    """{loop_var: possible values} for every `for VAR, ... in
-    D.items()` — statement or comprehension — over a module-level
-    string-keyed dict D. Lets the drift check expand
-    `f"ome_engine_{key}"` into one name per dict key."""
-    binds: Dict[str, List[str]] = {}
-
-    def note(target, it):
-        if not (isinstance(it, ast.Call)
-                and isinstance(it.func, ast.Attribute)
-                and it.func.attr == "items"
-                and isinstance(it.func.value, ast.Name)
-                and it.func.value.id in str_dicts):
-            return
-        if isinstance(target, ast.Tuple) and target.elts:
-            target = target.elts[0]
-        if isinstance(target, ast.Name):
-            binds.setdefault(target.id, []).extend(
-                str_dicts[it.func.value.id])
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.For):
-            note(node.target, node.iter)
-        elif isinstance(node, ast.comprehension):
-            note(node.target, node.iter)
-    return binds
-
-
-def _resolved_names(arg, consts: Dict[str, str],
-                    binds: Dict[str, List[str]]) -> List[str]:
-    """Every metric name a declaration's first argument can evaluate
-    to: one entry for a static name, the expanded set for an f-string
-    whose placeholders resolve through constants or .items() loop
-    variables, [] when unresolvable."""
-    text, fully = _static_prefix(arg, consts)
-    if fully:
-        return [text]
-    if isinstance(arg, ast.JoinedStr):
-        names = [""]
-        for piece in arg.values:
-            if isinstance(piece, ast.Constant):
-                names = [n + str(piece.value) for n in names]
-            elif (isinstance(piece, ast.FormattedValue)
-                    and isinstance(piece.value, ast.Name)):
-                var = piece.value.id
-                if var in consts:
-                    names = [n + consts[var] for n in names]
-                elif var in binds:
-                    names = [n + k for n in names
-                             for k in binds[var]]
-                else:
-                    return []
-            else:
-                return []
-        return names
-    return []
-
-
-def _labelnames(call: ast.Call) -> Optional[ast.expr]:
-    for kw in call.keywords:
-        if kw.arg == "labelnames":
-            return kw.value
-    if len(call.args) >= 3:
-        return call.args[2]
-    return None
-
-
-def _check_call(call: ast.Call, kind: str, consts: Dict[str, str],
-                path: pathlib.Path, out: List[Violation],
-                dynamic: List[str]):
-    if not call.args:
-        return
-    name, fully_static = _static_prefix(call.args[0], consts)
-    line = call.lineno
-    if not name:
-        dynamic.append(f"{path}:{line}: fully dynamic {kind} name "
-                       "(runtime registry rules still apply)")
-    elif not name.startswith(ALLOWED_PREFIXES):
-        out.append(Violation(
-            path, line,
-            f"{kind} {name!r}: missing subsystem prefix "
-            f"(one of {ALLOWED_PREFIXES})"))
-    if fully_static and name:
-        if kind == "counter" and not name.endswith("_total"):
-            out.append(Violation(
-                path, line,
-                f"counter {name!r} must end in '_total'"))
-        if kind != "histogram" and name.endswith(RESERVED_SUFFIXES):
-            out.append(Violation(
-                path, line,
-                f"{kind} {name!r} ends in a histogram-reserved "
-                f"suffix {RESERVED_SUFFIXES}"))
-    labels = _labelnames(call)
-    if labels is not None and isinstance(labels, (ast.Tuple, ast.List)):
-        for el in labels.elts:
-            if isinstance(el, ast.Constant) and \
-                    str(el.value).lower() in BANNED_LABELS:
-                out.append(Violation(
-                    path, line,
-                    f"label {el.value!r} on {name or kind!r} implies "
-                    "unbounded cardinality (one series per request); "
-                    "put it in the request log, not a label"))
-
-
-def check_file(path: pathlib.Path
-               ) -> Tuple[List[Violation], List[str], Set[str]]:
-    tree = ast.parse(path.read_text(encoding="utf-8"),
-                     filename=str(path))
-    consts = _module_str_consts(tree)
-    binds = _loop_bindings(tree, _module_str_dicts(tree))
-    violations: List[Violation] = []
-    dynamic: List[str] = []
-    declared: Set[str] = set()
-    for node in ast.walk(tree):
-        if (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in DECL_METHODS):
-            _check_call(node, node.func.attr, consts, path,
-                        violations, dynamic)
-            if node.args:
-                declared.update(
-                    _resolved_names(node.args[0], consts, binds))
-    return violations, dynamic, declared
-
-
-def documented_names(md_path: pathlib.Path) -> Set[str]:
-    """Metric names from the docs/observability.md catalog tables:
-    rows of the form `| \\`name{labels}\\` | type | meaning |` (the
-    `{labels}` suffix is display-only and stripped)."""
-    rx = re.compile(r"^\|\s*`([A-Za-z_][A-Za-z0-9_]*)"
-                    r"(?:\{[^}]*\})?`\s*\|")
-    names: Set[str] = set()
-    for line in md_path.read_text(encoding="utf-8").splitlines():
-        m = rx.match(line)
-        if m:
-            names.add(m.group(1))
-    return names
-
-
-def docs_drift(declared: Set[str], doc_path: pathlib.Path) -> List[str]:
-    """Both directions of catalog drift, scoped to `ome_*` names."""
-    documented = documented_names(doc_path)
-    in_scope = lambda ns: {n for n in ns if n.startswith("ome_")}  # noqa: E731
-    drift = []
-    for name in sorted(in_scope(declared) - documented):
-        drift.append(f"{name}: declared in source but missing from "
-                     f"{doc_path.name} catalog")
-    for name in sorted(in_scope(documented) - declared):
-        drift.append(f"{name}: documented in {doc_path.name} but "
-                     "declared nowhere in the tree")
-    return drift
+from ome_tpu.lint.core import Project                       # noqa: E402
+from ome_tpu.lint.plugins.catalog_drift import MetricsNamingRule  # noqa: E402
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    repo = pathlib.Path(__file__).resolve().parents[1]
     # the docs cross-check only applies to the repo's own tree — an
     # explicit root (tests linting a scratch dir) skips it
     drift_mode = not argv
-    root = pathlib.Path(argv[0]) if argv else repo / "ome_tpu"
+    root = pathlib.Path(argv[0]) if argv else REPO / "ome_tpu"
     if not root.exists():
         print(f"check_metrics: no such directory {root}",
               file=sys.stderr)
         return 2
-    violations: List[Violation] = []
-    dynamic: List[str] = []
-    declared: Set[str] = set()
-    files = sorted(root.rglob("*.py"))
-    # the registry implementation itself manipulates generic names;
-    # its internal calls are not declarations
-    files = [f for f in files
-             if "telemetry" not in f.parts or f.name != "registry.py"]
-    for f in files:
-        v, d, names = check_file(f)
-        violations.extend(v)
-        dynamic.extend(d)
-        declared.update(names)
-    drift: List[str] = []
-    if drift_mode:
-        doc = repo / "docs" / "observability.md"
-        if doc.exists():
-            drift = docs_drift(declared, doc)
-    for note in dynamic:
+    repo = REPO if drift_mode else (
+        root if root.is_dir() else root.parent)
+    project = Project(root, repo=repo)
+    rule = MetricsNamingRule(drift=drift_mode)
+    findings = rule.run(project)
+    violations = []
+    for f in findings:
+        sf = project.file(f.path)
+        s = sf.suppressed(f.rule, f.line) if sf else None
+        if s is None or not s.reason:  # reasonless never suppresses
+            violations.append(f)
+    for note in rule.dynamic:
         print(f"note: {note}")
-    for v in violations:
-        print(f"VIOLATION: {v}")
-    for d in drift:
+    for f in violations:
+        sf = project.file(f.path)
+        shown = sf.path if sf is not None else f.path
+        print(f"VIOLATION: {shown}:{f.line}: {f.message}")
+    for d in rule.drift:
         print(f"DRIFT: {d}")
-    print(f"check_metrics: {len(files)} files, "
+    print(f"check_metrics: {rule.file_count} files, "
           f"{len(violations)} violation(s)"
-          + (f", {len(drift)} drift" if drift_mode else ""))
-    return 1 if violations or drift else 0
+          + (f", {len(rule.drift)} drift" if drift_mode else ""))
+    return 1 if violations or rule.drift else 0
 
 
 if __name__ == "__main__":

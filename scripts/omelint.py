@@ -1,0 +1,138 @@
+#!/usr/bin/env python3
+"""omelint — the repo's call-graph-aware static-analysis runner.
+
+One shared infrastructure (ome_tpu/lint/): every ``*.py`` parsed
+once, a project-wide call graph with reachability queries, a
+lock-region model, inline suppressions with MANDATORY reasons, and a
+checked-in baseline (lint-baseline.json) that grandfathers justified
+pre-existing findings so the gate trips on NEW findings only.
+
+Analyzers (scripts/omelint.py --list):
+
+  hot-path-sync        host-blocking device fetches reachable from
+                       Scheduler.step / the router forward path
+  lock-discipline      blocking ops under a held lock + lock-order
+                       cycle detection
+  thread-shared-state  attributes shared across thread domains with
+                       no common lock
+  fault-catalog        faults.fire points missing from the
+                       failure-semantics.md catalog
+  metrics-naming       metric naming rules + observability.md drift
+
+Exit codes: 0 clean, 1 unbaselined findings (or stale baseline
+entries, or reason-less suppressions), 2 usage/setup error.
+
+Usage:
+  python scripts/omelint.py --all                 # gate (make lint)
+  python scripts/omelint.py --rule lock-discipline
+  python scripts/omelint.py --all --write-baseline  # regenerate, then
+                                                    # justify each why
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from ome_tpu.lint.context import Context            # noqa: E402
+from ome_tpu.lint.core import (DEFAULT_BASELINE,    # noqa: E402
+                               Baseline, Project, apply_suppressions)
+from ome_tpu.lint.plugins import (ALL_RULES,        # noqa: E402
+                                  make_rule, rule_names)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="omelint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--all", action="store_true",
+                    help="run every registered analyzer")
+    ap.add_argument("--rule", action="append", default=[],
+                    metavar="NAME", help="run one analyzer "
+                    "(repeatable); see --list")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered analyzers and exit")
+    ap.add_argument("--root", default=str(REPO / "ome_tpu"),
+                    help="source tree to analyze (default: ome_tpu)")
+    ap.add_argument("--baseline", default=str(REPO / DEFAULT_BASELINE),
+                    help="baseline file (default: repo "
+                    f"{DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, grandfathered or not")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current finding set as the new "
+                    "baseline (entries need re-justifying) and exit 0")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print suppressed/baselined counts per "
+                    "rule")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for r in ALL_RULES:
+            print(f"{r.name:20s} {r.description}")
+        return 0
+
+    names = args.rule if args.rule else (
+        rule_names() if args.all else None)
+    if names is None:
+        ap.print_usage()
+        print("omelint: pick --all, --rule NAME, or --list",
+              file=sys.stderr)
+        return 2
+    try:
+        rules = [make_rule(n) for n in names]
+    except KeyError as e:
+        print(f"omelint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    root = pathlib.Path(args.root)
+    if not root.exists():
+        print(f"omelint: no such path {root}", file=sys.stderr)
+        return 2
+    project = Project(root, repo=REPO)
+    for err in project.errors:
+        print(f"omelint: {err}", file=sys.stderr)
+    ctx = Context(project)
+
+    findings = []
+    for rule in rules:
+        findings.extend(rule.run(project, ctx))
+    kept, suppressed = apply_suppressions(project, findings)
+
+    if args.write_baseline:
+        b = Baseline.from_findings(
+            kept, why="grandfathered (justify me)")
+        b.save(args.baseline)
+        print(f"omelint: wrote {len(b.entries)} entries to "
+              f"{args.baseline}")
+        return 0
+
+    baseline = Baseline(None if args.no_baseline else args.baseline)
+    new = [f for f in kept if not baseline.match(f)]
+    stale = baseline.unused()
+
+    for f in sorted(new, key=lambda f: (f.path, f.line, f.rule)):
+        print(f"VIOLATION: {f}")
+    for e in stale:
+        print(f"STALE-BASELINE: [{e['rule']}] {e['path']} "
+              f"({e.get('symbol', '<module>')}): {e['message']} — "
+              "entry no longer matches any finding; remove it")
+    if args.verbose:
+        for rule in rules:
+            mine = [f for f in findings if f.rule == rule.name]
+            print(f"omelint: {rule.name}: {len(mine)} raw finding(s)")
+        print(f"omelint: {len(suppressed)} suppressed inline, "
+              f"{len(kept) - len(new)} baselined")
+    print(f"omelint: {len(rules)} rule(s), {len(project.files)} "
+          f"files, {len(new)} violation(s), {len(stale)} stale "
+          "baseline entr(ies)")
+    return 1 if new or stale else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -509,3 +509,59 @@ class TestServeFlags:
             build_parser().parse_args(
                 ["--model-dir", "/m", "--random-weights",
                  "--journal-fsync", "sometimes"])
+
+
+# -- admit lock discipline --------------------------------------------
+
+
+class TestAdmitLocking:
+    """Regression (omelint lock-discipline): Scheduler.submit used to
+    call journal.admit — an append that fsyncs under policy `always` —
+    while holding Scheduler._lock, the lock the decode thread takes
+    per emitted token, so every admit stalled every inflight decode.
+    The admit now runs with the lock released and BEFORE the queue
+    put; a rejection raced against the journal I/O tombstones the
+    admit record so a restart cannot replay a request the client was
+    told to retry elsewhere."""
+
+    def test_admit_runs_with_scheduler_lock_released(self, tmp_path):
+        j = RequestJournal(str(tmp_path), fsync="off")
+        sched = Scheduler(SeqEngine(), journal=j)
+        lock_free = []
+        orig = j.admit
+
+        def spy(req):
+            ok = sched._lock.acquire(blocking=False)
+            if ok:
+                sched._lock.release()
+            lock_free.append(ok)
+            orig(req)
+
+        j.admit = spy
+        req = sched.submit(Request(prompt_ids=[1, 2]))
+        assert lock_free == [True]
+        assert req.journal_id is not None
+        assert sched.pending.qsize() == 1
+        j.close()
+
+    def test_raced_drain_tombstones_the_admit(self, tmp_path):
+        from ome_tpu.engine.scheduler import SchedulerDraining
+        d = str(tmp_path)
+        j = RequestJournal(d, fsync="off")
+        sched = Scheduler(SeqEngine(), journal=j)
+        orig = j.admit
+
+        def race(req):
+            orig(req)
+            sched._draining = True  # SIGTERM lands mid journal write
+
+        j.admit = race
+        with pytest.raises(SchedulerDraining):
+            sched.submit(Request(prompt_ids=[1, 2]))
+        assert sched.pending.qsize() == 0
+        j.close()
+        assert [rec["t"] for rec in _journal_lines(d)] == \
+            ["admit", "fin"]
+        j2 = RequestJournal(d)
+        assert j2.replay() == []  # nothing resumes: no duplicate
+        j2.close()

@@ -1,0 +1,474 @@
+"""omelint framework (docs/static-analysis.md): the shared
+static-analysis infrastructure and its analyzer plugins.
+
+Contracts under test:
+
+  * call graph: method/function edges resolve across a module and
+    reachability honors stop-sets; on the real tree,
+    ``Scheduler.step`` reaches helpers OUTSIDE the legacy hardcoded
+    step-path frozenset — the property the reimplemented decode-sync
+    lint rides on;
+  * lock model: ``with`` regions and acquire/try-finally-release
+    pairs extract with correct spans; opposite-order nesting is a
+    detected cycle;
+  * suppressions: the reason is MANDATORY — a reason-less disable
+    never suppresses and surfaces as a `bad-suppression` finding;
+  * baseline: save/load round-trips, matching is line-number-free,
+    stale entries are reported;
+  * one true-positive + one true-negative fixture per analyzer,
+    including the f-string metric-name expansion the old
+    check_metrics.py missed;
+  * the seeded-sync acceptance path: a ``block_until_ready()``
+    planted in a scheduler helper that is NOT in the legacy frozenset
+    still fails scripts/check_decode_sync.py, because the function
+    set is derived from reachability;
+  * the whole-repo gate: `python scripts/omelint.py --all` (the exact
+    `make lint` entry point) exits 0.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ome_tpu.lint.callgraph import CallGraph
+from ome_tpu.lint.context import Context
+from ome_tpu.lint.core import (Baseline, Finding, Project,
+                               apply_suppressions, parse_suppressions)
+from ome_tpu.lint.lockmodel import LockModel, find_cycles
+from ome_tpu.lint.plugins import ALL_RULES, make_rule, rule_names
+from ome_tpu.lint.plugins.catalog_drift import (FaultCatalogRule,
+                                                MetricsNamingRule)
+from ome_tpu.lint.plugins.hot_path_sync import HotPathSyncRule
+from ome_tpu.lint.plugins.lock_discipline import LockDisciplineRule
+from ome_tpu.lint.plugins.thread_shared_state import \
+    ThreadSharedStateRule
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+OMELINT = REPO / "scripts" / "omelint.py"
+
+
+def _project(tmp_path, name, src):
+    (tmp_path / name).write_text(textwrap.dedent(src))
+    return Project(tmp_path, repo=tmp_path)
+
+
+# -- call graph -------------------------------------------------------
+
+
+class TestCallGraph:
+    SRC = """
+    class A:
+        def start(self):
+            self.helper()
+            go()
+        def helper(self):
+            self.other.fetch_tokens()
+    class B:
+        def fetch_tokens(self):
+            pass
+    def go():
+        leaf()
+    def leaf():
+        pass
+    def unrelated():
+        leaf()
+    """
+
+    def test_reachability_follows_method_and_name_edges(self, tmp_path):
+        p = _project(tmp_path, "m.py", self.SRC)
+        g = CallGraph(p)
+        roots = g.resolve_spec("m.py::A.start")
+        assert roots
+        short = {q.split("::", 1)[1] for q in g.reachable(roots)}
+        assert {"A.start", "A.helper", "go", "leaf"} <= short
+        # project-unique method name resolves across classes
+        assert "B.fetch_tokens" in short
+        assert "unrelated" not in short
+
+    def test_stop_set_prunes_traversal(self, tmp_path):
+        p = _project(tmp_path, "m.py", self.SRC)
+        g = CallGraph(p)
+        short = {q.split("::", 1)[1]
+                 for q in g.reachable(g.resolve_spec("m.py::A.start"),
+                                      stop={"go"})}
+        assert "go" not in short
+        assert "leaf" not in short  # only reachable through the stop
+
+    def test_scheduler_step_reaches_beyond_legacy_frozenset(self):
+        """The property the hot-path-sync reimplementation rides on:
+        helpers the hardcoded STEP_PATH never listed are reachable
+        from Scheduler.step, so a sync fetch in them is now caught."""
+        p = Project(REPO / "ome_tpu" / "engine" / "scheduler.py",
+                    repo=REPO)
+        g = CallGraph(p)
+        roots = g.resolve_spec("engine/scheduler.py::Scheduler.step")
+        assert roots
+        short = {q.rsplit(".", 1)[-1] for q in g.reachable(
+            roots, stop={"_drain_inflight", "_drain_spec"})}
+        legacy = {"step", "_decode", "_insert_ready", "_admit",
+                  "_build_mask", "_maybe_finish", "_sampling",
+                  "_spec_headroom", "_build_drafts"}
+        assert legacy <= short | {"step"}
+        assert "_mark_scheduled" in short  # not in the old frozenset
+
+
+# -- lock model -------------------------------------------------------
+
+
+class TestLockModel:
+    def test_with_region_extraction_and_held_at(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        import threading
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def work(self):
+                before = 1
+                with self._lock:
+                    inside = 2
+                    also = 3
+                after = 4
+        """)
+        lm = LockModel(p)
+        sf = p.files[0]
+        assert "C._lock" in lm.locks
+        held = {r.lock for r in lm.held_at(sf, 9)}  # "inside = 2"
+        assert held == {"C._lock"}
+        assert lm.held_at(sf, 7) == []   # before
+        assert lm.held_at(sf, 11) == []  # after
+
+    def test_acquire_try_finally_release_pairs(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        import threading
+        _lock = threading.Lock()
+        def work():
+            _lock.acquire()
+            try:
+                guarded = 1
+            finally:
+                _lock.release()
+            free = 2
+        """)
+        lm = LockModel(p)
+        sf = p.files[0]
+        assert {r.lock for r in lm.held_at(sf, 7)} == {"m._lock"}
+        assert lm.held_at(sf, 10) == []
+
+    def test_opposite_nesting_is_a_cycle(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        import threading
+        a = threading.Lock()
+        b = threading.Lock()
+        def one():
+            with a:
+                with b:
+                    pass
+        def two():
+            with b:
+                with a:
+                    pass
+        """)
+        lm = LockModel(p)
+        cycles = find_cycles(lm.order_edges())
+        assert cycles
+        assert {"m.a", "m.b"} <= set(cycles[0])
+
+
+# -- suppressions -----------------------------------------------------
+
+
+class TestSuppressions:
+    def test_reason_parsed_and_comment_line_shifts_to_next(self):
+        sup = parse_suppressions(
+            "x = 1  # omelint: disable=lock-discipline -- by design\n"
+            "# omelint: disable=hot-path-sync -- host list\n"
+            "y = 2\n")
+        assert sup[1].rules == ("lock-discipline",)
+        assert sup[1].reason == "by design"
+        assert 2 not in sup          # comment-only line shifted
+        assert sup[3].covers("hot-path-sync")
+
+    def test_reasonless_disable_never_suppresses(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        x = 1  # omelint: disable=some-rule
+        """)
+        finding = Finding("some-rule", "m.py", 2, "boom")
+        kept, suppressed = apply_suppressions(p, [finding])
+        assert suppressed == []
+        assert finding in kept
+        bad = [f for f in kept if f.rule == "bad-suppression"]
+        assert len(bad) == 1 and bad[0].line == 2
+
+    def test_reasoned_disable_suppresses(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        x = 1  # omelint: disable=some-rule -- justified
+        """)
+        kept, suppressed = apply_suppressions(
+            p, [Finding("some-rule", "m.py", 2, "boom")])
+        assert kept == [] and len(suppressed) == 1
+
+
+# -- baseline ---------------------------------------------------------
+
+
+class TestBaseline:
+    def test_round_trip_match_and_stale(self, tmp_path):
+        f1 = Finding("r", "a.py", 10, "msg one", symbol="C.m")
+        f2 = Finding("r", "b.py", 20, "msg two", symbol="f")
+        path = tmp_path / "base.json"
+        Baseline.from_findings([f1, f2], why="because").save(path)
+        b = Baseline(path)
+        assert all(e["why"] == "because" for e in b.entries)
+        # line churn does not break the match
+        moved = Finding("r", "a.py", 999, "msg one", symbol="C.m")
+        assert b.match(moved)
+        assert not b.match(Finding("r", "a.py", 10, "other",
+                                   symbol="C.m"))
+        stale = b.unused()
+        assert [e["message"] for e in stale] == ["msg two"]
+
+
+# -- analyzer fixtures (one TP + one TN each) -------------------------
+
+
+class TestHotPathSyncFixtures:
+    def test_sync_in_reachable_helper_flagged(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        class S:
+            def step(self):
+                self._emit()
+            def _emit(self):
+                self.toks.block_until_ready()
+        """)
+        fs = HotPathSyncRule().run(p)
+        assert len(fs) == 1
+        assert "_emit" in fs[0].message  # found via reachability
+
+    def test_async_copy_and_drain_clean(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        import numpy as np
+        class S:
+            def step(self):
+                self.toks.copy_to_host_async()
+                self._drain_inflight()
+            def _drain_inflight(self):
+                return np.asarray(self.q.pop())
+        """)
+        assert HotPathSyncRule().run(p) == []
+
+
+class TestLockDisciplineFixtures:
+    def test_blocking_call_under_lock_flagged(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        import threading, time
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def work(self):
+                with self._lock:
+                    time.sleep(1)
+        """)
+        fs = LockDisciplineRule().run(p)
+        assert len(fs) == 1
+        assert "time.sleep" in fs[0].message
+        assert "C._lock" in fs[0].message
+
+    def test_blocking_call_outside_lock_clean(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        import threading, time
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+            def work(self):
+                with self._lock:
+                    x = 1
+                time.sleep(1)
+        """)
+        assert LockDisciplineRule().run(p) == []
+
+
+class TestThreadSharedStateFixtures:
+    def test_unlocked_rmw_on_handler_thread_flagged(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        from http.server import BaseHTTPRequestHandler
+        class Backend:
+            def __init__(self):
+                self.inflight = 0
+        class H(BaseHTTPRequestHandler):
+            def do_GET(self):
+                backend = self.server.backend
+                backend.inflight += 1
+        """)
+        fs = ThreadSharedStateRule().run(p)
+        assert len(fs) == 1
+        assert "read-modify-write" in fs[0].message
+        assert "Backend.inflight" in fs[0].message
+
+    def test_rmw_under_owning_lock_clean(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        import threading
+        from http.server import BaseHTTPRequestHandler
+        class Backend:
+            def __init__(self):
+                self.inflight = 0
+                self._lock = threading.Lock()
+        class H(BaseHTTPRequestHandler):
+            def do_GET(self):
+                backend = self.server.backend
+                with backend._lock:
+                    backend.inflight += 1
+        """)
+        assert ThreadSharedStateRule().run(p) == []
+
+
+class TestFaultCatalogFixtures:
+    DOC = """\
+## Fault-point catalog
+
+| point | effect |
+| --- | --- |
+| `known_point` | boom |
+"""
+
+    def _doc(self, tmp_path):
+        doc = tmp_path / "failure-semantics.md"
+        doc.write_text(self.DOC)
+        return doc
+
+    def test_undocumented_point_flagged(self, tmp_path):
+        doc = self._doc(tmp_path)
+        p = _project(tmp_path, "m.py", """
+        from ome_tpu import faults
+        def f():
+            faults.fire("mystery_point")
+        """)
+        fs = FaultCatalogRule(doc=doc).run(p)
+        assert len(fs) == 1
+        assert "mystery_point" in fs[0].message
+
+    def test_documented_point_clean(self, tmp_path):
+        doc = self._doc(tmp_path)
+        p = _project(tmp_path, "m.py", """
+        from ome_tpu import faults
+        def f():
+            faults.fire("known_point")
+        """)
+        assert FaultCatalogRule(doc=doc).run(p) == []
+
+
+class TestMetricsNamingFixtures:
+    def test_bad_names_flagged(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        def setup(reg):
+            reg.counter("requests_total", "no prefix")
+            reg.counter("ome_hits", "no _total")
+        """)
+        fs = MetricsNamingRule(drift=False).run(p)
+        msgs = " | ".join(f.message for f in fs)
+        assert len(fs) == 2
+        assert "missing subsystem prefix" in msgs
+        assert "must end in '_total'" in msgs
+
+    def test_clean_names_pass(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        def setup(reg):
+            reg.counter("ome_requests_total", "ok")
+            reg.histogram("ome_latency_seconds", "ok")
+        """)
+        assert MetricsNamingRule(drift=False).run(p) == []
+
+    def test_fstring_expansion_checked_in_every_mode(self, tmp_path):
+        """The check_metrics.py fix: the old script expanded f-string
+        names only for the default-mode drift compare, so a counter
+        declared per dict key with no `_total` passed the lint. Every
+        expansion is now held to the naming rules in every mode —
+        including plain `for k in D:` iteration, which the old
+        expander did not recognize at all."""
+        p = _project(tmp_path, "m.py", """
+        _HELP = {"hits": "h", "misses": "m"}
+        def setup(reg):
+            for key in _HELP:
+                reg.counter(f"ome_cache_{key}", _HELP[key])
+        """)
+        fs = MetricsNamingRule(drift=False).run(p)
+        assert sorted(f.message for f in fs) == [
+            "counter 'ome_cache_hits' must end in '_total'",
+            "counter 'ome_cache_misses' must end in '_total'",
+        ]
+
+
+# -- plugin registry --------------------------------------------------
+
+
+class TestRegistry:
+    def test_all_rules_registered(self):
+        assert set(rule_names()) == {
+            "hot-path-sync", "lock-discipline", "thread-shared-state",
+            "fault-catalog", "metrics-naming"}
+
+    def test_unknown_rule_rejected(self):
+        with pytest.raises(KeyError):
+            make_rule("nonsense")
+
+
+# -- acceptance: seeded sync + whole-repo gate ------------------------
+
+
+class TestSeededSync:
+    def test_seeded_block_until_ready_caught_via_reachability(
+            self, tmp_path):
+        """Plant a device sync in Scheduler._mark_scheduled — a
+        helper the legacy STEP_PATH frozenset never listed — and the
+        decode-sync shim must still fail, because the lint now walks
+        reachability from Scheduler.step."""
+        src = (REPO / "ome_tpu" / "engine" /
+               "scheduler.py").read_text(encoding="utf-8")
+        marker = "def _mark_scheduled(self, req: Request):"
+        assert marker in src
+        seeded = src.replace(
+            marker, marker + "\n        req.toks.block_until_ready()")
+        bad = tmp_path / "seeded_scheduler.py"
+        bad.write_text(seeded)
+        proc = subprocess.run(
+            [sys.executable,
+             str(REPO / "scripts" / "check_decode_sync.py"), str(bad)],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "_mark_scheduled" in proc.stdout
+        assert ".block_until_ready" in proc.stdout
+
+
+class TestWholeRepoGate:
+    def test_omelint_all_is_clean(self):
+        """The exact `make lint` entry point: every finding is either
+        inline-suppressed with a reason or baselined with a `why` —
+        zero unbaselined findings, zero stale baseline entries."""
+        proc = subprocess.run(
+            [sys.executable, str(OMELINT), "--all"],
+            capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "0 violation(s)" in proc.stdout
+        assert "0 stale" in proc.stdout
+
+    def test_baseline_entries_all_justified(self):
+        doc = json.loads(
+            (REPO / "lint-baseline.json").read_text(encoding="utf-8"))
+        assert doc["findings"], "baseline exists and is non-trivial"
+        for e in doc["findings"]:
+            assert e.get("why"), f"unjustified baseline entry: {e}"
+            assert "justify me" not in e["why"]
+
+    def test_list_and_bad_rule_exit_codes(self):
+        ok = subprocess.run(
+            [sys.executable, str(OMELINT), "--list"],
+            capture_output=True, text=True, timeout=60)
+        assert ok.returncode == 0
+        assert "lock-discipline" in ok.stdout
+        bad = subprocess.run(
+            [sys.executable, str(OMELINT), "--rule", "nope"],
+            capture_output=True, text=True, timeout=60)
+        assert bad.returncode == 2

@@ -392,3 +392,47 @@ class TestDiscovery:
             client, "prod", {constants.COMPONENT_LABEL: "decoder"},
             "decoder")
         assert decoders[0].pool == "decoder"
+
+
+class TestInflightAccounting:
+    """Regression (omelint thread-shared-state): backend.inflight was
+    a bare read-modify-write on the forwarding path — handler threads
+    are concurrent (ThreadingHTTPServer), so `+=` lost updates and
+    drifted the counter permanently. Accounting now goes through
+    Router.adjust_inflight under Router._lock."""
+
+    def test_concurrent_adjustments_balance(self):
+        import sys
+        r = Router([Backend("http://a")])
+        b = r.backends[0]
+        old = sys.getswitchinterval()
+        sys.setswitchinterval(1e-5)  # force thread interleaving
+        try:
+            def worker():
+                for _ in range(400):
+                    r.adjust_inflight(b, 1)
+                    r.adjust_inflight(b, -1)
+            threads = [threading.Thread(target=worker)
+                       for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            sys.setswitchinterval(old)
+        assert b.inflight == 0
+
+    def test_forward_path_has_no_bare_inflight_rmw(self):
+        """Drive the thread-shared-state analyzer over the router
+        module alone: reintroducing `backend.inflight += 1` in
+        _forward brings the finding (and this failure) back."""
+        import os
+        import ome_tpu.router.server as srv
+        from ome_tpu.lint.core import Project
+        from ome_tpu.lint.plugins.thread_shared_state import \
+            ThreadSharedStateRule
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(srv.__file__))))
+        p = Project(srv.__file__, repo=repo)
+        findings = ThreadSharedStateRule().run(p)
+        assert not [f for f in findings if "inflight" in f.message]
