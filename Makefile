@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint test
+.PHONY: lint test replay autoscale-soak
 
 # omelint: the repo's static-analysis gate (docs/static-analysis.md).
 # Runs every registered analyzer over ome_tpu/ and fails on any
@@ -18,3 +18,19 @@ lint:
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# trace replay against a self-spawned router + CPU engine: the quick
+# "does the load generator work here" check (docs/autoscaling.md);
+# point scripts/replay.py at --url/--trace for real endpoints/logs
+replay:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/replay.py --topology 1 \
+		--seed 7 --requests 10 --compress 2
+
+# the closed-loop demo: bursty replayed trace + SLO-aware scaling of
+# a live engine pool, reporting engine-seconds vs static max
+# provisioning and the full decision log
+autoscale-soak:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/autoscale.py --seed 7 \
+		--requests 30 --burst-factor 6 --min-engines 1 \
+		--max-engines 3 --slo-ttft-p99 0.5 --slo-queue-wait-p99 \
+		0.25 --queue-depth-high 2 --settle-seconds 10 --json

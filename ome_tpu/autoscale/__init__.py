@@ -1,0 +1,32 @@
+"""Closed-loop autoscaling: trace replay + SLO-aware pool scaling.
+
+The subsystem that turns the repo's plumbing — journal durability,
+graceful drain, drain-aware routing, latency histograms, joinable
+request logs — into a feedback loop (the reference operator's KEDA/
+HPA reconcilers + BenchmarkJob pairing; docs/autoscaling.md):
+
+  * ``trace``      — reqlog-derived and synthetic request traces with
+                     original inter-arrival gaps, plus time-compress /
+                     burst-amplify transforms;
+  * ``replay``     — open-loop load generator replaying a trace
+                     through the router, measuring client-side
+                     TTFT/TPOT/e2e and SLO attainment;
+  * ``scrape``     — Prometheus text-exposition client with windowed
+                     histogram-quantile estimation between scrapes;
+  * ``policy``     — pure, tick-based hysteresis deciding pool sizes
+                     from a pressure signal (Autopilot-style
+                     stabilization; PAPERS.md);
+  * ``pool``       — live engine pool: spawn + register with the
+                     router, scale down via SIGTERM drain, journal
+                     resume after a kill mid-drain;
+  * ``controller`` — the loop: scrape -> pressure -> policy -> act.
+
+No module here imports jax at module level: the CLIs must be
+importable on the controller host, and engines run as subprocesses
+(re-entered through ``ome_tpu.chaos --serve-child``).
+"""
+
+from .policy import PolicyConfig, PoolPolicy  # noqa: F401
+from .trace import (TraceRequest, amplify_bursts, compress,  # noqa: F401
+                    load_reqlog, load_trace, save_trace,
+                    synthetic_trace)

@@ -1,0 +1,159 @@
+"""Prometheus text-exposition client for the scaling controller.
+
+The controller decides from the SAME metrics a human would read on a
+dashboard — the engines' `ome_engine_ttft_seconds` /
+`ome_engine_queue_wait_seconds` histograms, the KV-utilization gauge,
+and the router's per-backend gauges — so there is no privileged side
+channel to drift from the observable truth.
+
+Histograms are cumulative since process start; a controller wants the
+RECENT distribution. ``HistogramWindow`` keeps the previous scrape's
+cumulative buckets per (backend, family) and differences them, which
+yields the distribution of observations BETWEEN two scrapes; p99 is
+estimated by linear interpolation inside the bucket containing the
+target rank (the standard histogram_quantile estimator). A counter
+reset (engine restart) makes deltas negative — the window discards
+that sample and re-bases, same discipline as chaos.MetricsWatch.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..chaos import _http
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)$')
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text: str) -> Dict[str, float]:
+    """Exposition body -> {'name{labels}': value} (labels verbatim,
+    in source order — the same keying chaos.scrape_metrics uses)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        labels = m.group("labels")
+        key = m.group("name") + ("{" + labels + "}" if labels else "")
+        out[key] = value
+    return out
+
+
+def split_key(key: str) -> Tuple[str, Dict[str, str]]:
+    """'name{a="x",b="y"}' -> ('name', {'a': 'x', 'b': 'y'})."""
+    name, brace, rest = key.partition("{")
+    if not brace:
+        return name, {}
+    return name, {m.group(1): m.group(2)
+                  for m in _LABEL_RE.finditer(rest[:-1])}
+
+
+def fetch_metrics(url: str, timeout: float = 5.0) -> Dict[str, float]:
+    """Scrape ``url``/metrics into a parsed sample dict."""
+    status, body = _http(url.rstrip("/") + "/metrics", timeout=timeout)
+    if status != 200:
+        raise OSError(f"/metrics answered {status} at {url}")
+    if isinstance(body, bytes):
+        body = body.decode("utf-8", errors="replace")
+    elif not isinstance(body, str):
+        body = str(body)
+    return parse_exposition(body)
+
+
+def bucket_counts(samples: Dict[str, float],
+                  family: str) -> List[Tuple[float, float]]:
+    """Cumulative (upper_bound, count) pairs for one histogram
+    family, summed across label children, sorted by bound (+Inf
+    last)."""
+    acc: Dict[float, float] = {}
+    prefix = family + "_bucket"
+    for key, value in samples.items():
+        name, labels = split_key(key)
+        if name != prefix or "le" not in labels:
+            continue
+        le = labels["le"]
+        bound = math.inf if le == "+Inf" else float(le)
+        acc[bound] = acc.get(bound, 0.0) + value
+    return sorted(acc.items(), key=lambda kv: kv[0])
+
+
+def quantile_from_buckets(buckets: List[Tuple[float, float]],
+                          q: float) -> Optional[float]:
+    """histogram_quantile over cumulative buckets: find the bucket
+    holding rank q*count, interpolate linearly inside it. None when
+    the histogram is empty. The +Inf bucket clamps to the last finite
+    bound (Prometheus convention)."""
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_bound, prev_count = 0.0, 0.0
+    for bound, count in buckets:
+        if count >= rank:
+            if math.isinf(bound):
+                return prev_bound  # observation beyond every bound
+            if count == prev_count:
+                return bound
+            frac = (rank - prev_count) / (count - prev_count)
+            return prev_bound + (bound - prev_bound) * frac
+        prev_bound, prev_count = (0.0 if math.isinf(bound) else bound,
+                                  count)
+    return buckets[-1][0] if not math.isinf(buckets[-1][0]) else None
+
+
+class HistogramWindow:
+    """Windowed quantiles for one histogram family across scrapes.
+
+    ``update(source, samples)`` ingests a scrape for one source
+    (backend URL); ``quantile(q)`` answers over the observations that
+    arrived between the previous update and this one, across ALL
+    sources. Counter resets re-base silently."""
+
+    def __init__(self, family: str):
+        self.family = family
+        self._prev: Dict[str, List[Tuple[float, float]]] = {}
+        self._window: Dict[str, List[Tuple[float, float]]] = {}
+
+    def update(self, source: str, samples: Dict[str, float]) -> None:
+        cur = bucket_counts(samples, self.family)
+        prev = self._prev.get(source)
+        self._prev[source] = cur
+        if prev is None or len(prev) != len(cur):
+            self._window.pop(source, None)
+            return
+        delta = []
+        for (b_cur, c_cur), (b_prev, c_prev) in zip(cur, prev):
+            if b_cur != b_prev or c_cur < c_prev:
+                self._window.pop(source, None)  # reset/restart
+                return
+            delta.append((b_cur, c_cur - c_prev))
+        self._window[source] = delta
+
+    def forget(self, source: str) -> None:
+        self._prev.pop(source, None)
+        self._window.pop(source, None)
+
+    def window_count(self) -> float:
+        return sum(d[-1][1] for d in self._window.values() if d)
+
+    def quantile(self, q: float) -> Optional[float]:
+        merged: Dict[float, float] = {}
+        for delta in self._window.values():
+            for bound, count in delta:
+                merged[bound] = merged.get(bound, 0.0) + count
+        return quantile_from_buckets(
+            sorted(merged.items(), key=lambda kv: kv[0]), q)

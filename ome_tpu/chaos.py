@@ -392,6 +392,27 @@ class ChaosRequest:
                 "top_p": self.top_p}
 
 
+def requests_from_trace(path: pathlib.Path,
+                        prompt_seed: int = 0) -> List[ChaosRequest]:
+    """Trace-driven episodes (--trace): replace the seeded synthetic
+    workload with a replay trace (autoscale/trace.py — a saved trace
+    file or an engine reqlog), keeping its inter-arrival gaps as the
+    per-request start delays. The fault/kill schedule stays seeded,
+    so one production trace can soak under many chaos schedules."""
+    from .autoscale import trace as trace_mod
+    try:
+        tr = trace_mod.load_trace(path)
+    except (KeyError, ValueError):
+        tr = trace_mod.load_reqlog(path)
+    if not tr:
+        raise ChaosError(f"no replayable records in {path}")
+    return [ChaosRequest(prompt=r.prompt_text(prompt_seed),
+                         max_tokens=r.max_tokens,
+                         temperature=r.temperature,
+                         delay=r.arrival)
+            for r in tr]
+
+
 def _gen_workload(rng: random.Random, n: int,
                   spread: float) -> List[ChaosRequest]:
     out = []
@@ -483,13 +504,23 @@ class Episode:
 
 
 def _plan_episode(seed: int, index: int, topo: Topology, n_requests: int,
-                  spread: float) -> Episode:
+                  spread: float,
+                  workload: Optional[Sequence[ChaosRequest]] = None
+                  ) -> Episode:
     """Everything random in an episode comes from this ONE generator
     seeded by (seed, index) — the whole schedule replays from the two
-    numbers a violation prints."""
+    numbers a violation prints. A --trace workload substitutes the
+    requests (fresh copies: episodes mutate outcome fields) but NOT
+    the fault/kill schedule, which stays seed-derived."""
     rng = random.Random(f"{seed}:{index}")
     ep = Episode(seed=seed, index=index, topo=topo)
-    ep.requests = _gen_workload(rng, n_requests, spread)
+    if workload is not None:
+        ep.requests = [ChaosRequest(
+            prompt=r.prompt, max_tokens=r.max_tokens,
+            temperature=r.temperature, top_k=r.top_k, top_p=r.top_p,
+            delay=r.delay) for r in workload]
+    else:
+        ep.requests = _gen_workload(rng, n_requests, spread)
 
     decode_names = [f"decode{i}" for i in range(topo.decode)]
     unified_names = [f"unified{i}" for i in range(topo.unified)]
@@ -956,7 +987,8 @@ def run_soak(seed: int, episodes: Sequence[int], topo: Topology,
              base_dir: pathlib.Path, n_requests: int, spread: float,
              keep_logs: bool = False,
              journal_drain_timeout: float = 90.0,
-             force_violation: bool = False) -> int:
+             force_violation: bool = False,
+             workload: Optional[Sequence[ChaosRequest]] = None) -> int:
     from .telemetry import Registry
     registry = Registry()
     c_episodes = registry.counter("ome_chaos_episodes_total",
@@ -972,7 +1004,8 @@ def run_soak(seed: int, episodes: Sequence[int], topo: Topology,
     failed = []
     try:
         for index in episodes:
-            ep = _plan_episode(seed, index, topo, n_requests, spread)
+            ep = _plan_episode(seed, index, topo, n_requests, spread,
+                               workload=workload)
             print(f"[chaos] episode {index}: "
                   f"{len(ep.requests)} requests, faults="
                   f"{ep.fault_specs or '{}'}, events="
@@ -1034,6 +1067,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--spread", type=float, default=4.0,
                    help="seconds the workload (and fault events) are "
                         "spread over")
+    p.add_argument("--trace", default=None,
+                   help="replay-driven episodes: drive each episode "
+                        "with this trace (autoscale save_trace JSONL "
+                        "or engine reqlog) instead of the synthetic "
+                        "workload; the fault/kill schedule stays "
+                        "seed-derived, and --spread grows to cover "
+                        "the trace duration")
     p.add_argument("--kv-block", type=int, default=16,
                    help="paged-KV block size for the engines (0 = "
                         "dense; disables the conservation invariant)")
@@ -1088,12 +1128,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         cleanup = not args.keep_logs
     episodes = ([args.episode] if args.episode is not None
                 else list(range(args.episodes)))
+    workload = None
+    spread = args.spread
+    if args.trace:
+        workload = requests_from_trace(pathlib.Path(args.trace))
+        # kill/drain events must land inside the replayed traffic
+        spread = max(spread, max(r.delay for r in workload))
     try:
         rc = run_soak(args.seed, episodes, topo, base,
-                      n_requests=args.requests, spread=args.spread,
+                      n_requests=args.requests, spread=spread,
                       keep_logs=args.keep_logs,
                       journal_drain_timeout=args.journal_drain_timeout,
-                      force_violation=args.force_violation)
+                      force_violation=args.force_violation,
+                      workload=workload)
     finally:
         if cleanup:
             import shutil

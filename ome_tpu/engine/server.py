@@ -621,11 +621,19 @@ class EngineServer:
         tpot = None
         if req.first_token_at is not None and n > 1:
             tpot = round((end - req.first_token_at) / (n - 1), 6)
+        # schema v2 (docs/autoscaling.md): the ADMIT instant on both
+        # clocks — req.created is monotonic, so the wall-clock half is
+        # recovered by rebasing against now. Trace replay reconstructs
+        # inter-arrival gaps from these instead of finish times.
+        now_mono = time.monotonic()
         self.request_log.write({
             "component": "engine",
             "trace_id": getattr(req.trace, "trace_id", None),
             "span_id": getattr(req.trace, "span_id", None),
             "request_id": req.id,
+            "admit_ts": round(time.time() - (now_mono - req.created),
+                              6),
+            "admit_mono": round(req.created, 6),
             "model": self.model_name,
             "adapter": req.adapter,
             "queue_wait_s": _delta(req.created, req.scheduled_at),

@@ -240,6 +240,15 @@ class Router:
             "ome_router_backend_draining",
             "Per-backend draining bit (1 draining)",
             labelnames=("backend", "pool"))
+        self._g_backend_inflight = self.registry.gauge(
+            "ome_router_backend_inflight",
+            "Requests currently forwarded to this backend",
+            labelnames=("backend", "pool"))
+        # (url, pool) pairs exported on the last scrape — a removed
+        # backend's gauges are zeroed once instead of lingering at
+        # their final values forever (the registry has no child
+        # removal, and a stale draining=1 would confuse autoscaling)
+        self._gauge_keys: set = set()
 
     @property
     def stats(self) -> Dict[str, float]:
@@ -260,25 +269,82 @@ class Router:
         up = 0
         draining = 0
         with self._lock:
-            views = [(b.url, b.pool, b.healthy, b.cb_state, b.draining)
-                     for b in self.backends]
-        for url, pool, healthy, cb_state, drain in views:
+            views = [(b.url, b.pool, b.healthy, b.cb_state,
+                      b.draining, b.inflight) for b in self.backends]
+        seen = set()
+        for url, pool, healthy, cb_state, drain, infl in views:
             up += bool(healthy)
             draining += bool(drain)
+            seen.add((url, pool))
             self._g_backend_healthy.labels(
                 backend=url, pool=pool).set(1 if healthy else 0)
             self._g_backend_cb.labels(backend=url, pool=pool).set(
                 _CB_STATE_VALUE.get(cb_state, 2))
             self._g_backend_draining.labels(
                 backend=url, pool=pool).set(1 if drain else 0)
+            self._g_backend_inflight.labels(
+                backend=url, pool=pool).set(infl)
+        with self._lock:
+            stale = self._gauge_keys - seen
+            self._gauge_keys = seen
+        for url, pool in stale:
+            for g in (self._g_backend_healthy, self._g_backend_cb,
+                      self._g_backend_draining,
+                      self._g_backend_inflight):
+                g.labels(backend=url, pool=pool).set(0)
         self._g_backends_up.set(up)
         self._g_backends_draining.set(draining)
+
+    # -- membership ----------------------------------------------------
+    # The autoscale controller's registration surface (POST/DELETE
+    # /backends on RouterServer). Pure list mutation under _lock —
+    # callers probe readiness BEFORE registering, so a freshly added
+    # backend enters rotation immediately and the next health sweep
+    # keeps it honest.
+
+    def add_backend(self, url: str, pool: str = "engine") -> Backend:
+        """Register a backend (idempotent on URL). Re-adding an
+        existing URL cancels any drain — the autoscale controller
+        re-registers a replica whose scale-down it aborted."""
+        u = url.rstrip("/")
+        with self._lock:
+            for b in self.backends:
+                if b.url == u:
+                    b.draining = False
+                    return b
+            b = Backend(u, pool)
+            self.backends.append(b)
+            return b
+
+    def remove_backend(self, url: str) -> bool:
+        """Drop a backend from the set (after its drain completed).
+        In-flight forwards hold their own Backend reference, so a
+        racing request finishes normally; the backend simply cannot
+        be picked again."""
+        u = url.rstrip("/")
+        with self._lock:
+            for i, b in enumerate(self.backends):
+                if b.url == u:
+                    del self.backends[i]
+                    return True
+        return False
+
+    def backend_snapshot(self) -> List[dict]:
+        """Consistent machine-readable view of the backend set (the
+        GET /backends body; what the controller polls instead of
+        parsing text exposition)."""
+        with self._lock:
+            return [{"url": b.url, "pool": b.pool,
+                     "healthy": b.healthy, "draining": b.draining,
+                     "inflight": b.inflight, "cb_state": b.cb_state}
+                    for b in self.backends]
 
     # -- selection -----------------------------------------------------
 
     def _alive(self, pool: str) -> List[Backend]:
-        return [b for b in self.backends
-                if b.pool == pool and b.healthy and not b.draining]
+        with self._lock:
+            return [b for b in self.backends
+                    if b.pool == pool and b.healthy and not b.draining]
 
     def pick(self, pool: str, affinity_key: str = "",
              exclude: Optional[set] = None) -> Optional[Backend]:
@@ -354,7 +420,9 @@ class Router:
     # -- health --------------------------------------------------------
 
     def check_health_once(self):
-        for b in list(self.backends):
+        with self._lock:
+            targets = list(self.backends)
+        for b in targets:
             healthy, draining = self._probe_backend(b)
             with self._lock:
                 b.healthy = healthy
@@ -422,10 +490,15 @@ class RouterServer:
                  port: int = 0, retries: int = 2,
                  retry_backoff: float = 0.05,
                  retry_budget_ratio: float = 0.2,
-                 request_log=None, span_log=None):
+                 request_log=None, span_log=None,
+                 debug_endpoints: bool = False):
         self.router = router
         self.retries = retries
         self.retry_backoff = retry_backoff
+        # gates the introspection/admin surface (GET/POST/DELETE
+        # /backends), same contract as the engine's /debug/state:
+        # off by default, 403 when disabled
+        self.debug_endpoints = debug_endpoints
         self.budget = RetryBudget(ratio=retry_budget_ratio)
         self._jitter = random.Random(1)
         self.request_log = _coerce_reqlog(request_log)
@@ -457,16 +530,33 @@ class RouterServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _backends_guard(self) -> bool:
+                """403 unless --debug-endpoints enabled the admin
+                surface; True when the caller may proceed."""
+                if outer.debug_endpoints:
+                    return True
+                self._json(403, {"error": "debug endpoints disabled "
+                                          "(enable --debug-endpoints)"})
+                return False
+
             def do_GET(self):
                 if self.path in ("/health", "/healthz"):
-                    up = any(b.healthy for b in outer.router.backends)
+                    snap = outer.router.backend_snapshot()
+                    up = any(b["healthy"] for b in snap)
                     return self._json(200 if up else 503, {
                         "status": "ok" if up else "no healthy backends",
                         "backends": [
-                            {"url": b.url, "pool": b.pool,
-                             "healthy": b.healthy,
-                             "draining": b.draining}
-                            for b in outer.router.backends]})
+                            {k: b[k] for k in
+                             ("url", "pool", "healthy", "draining")}
+                            for b in snap]})
+                if self.path == "/backends":
+                    # machine-readable pool membership for the
+                    # autoscale controller and tests (guarded like the
+                    # engine's /debug/state)
+                    if not self._backends_guard():
+                        return None
+                    return self._json(200, {
+                        "backends": outer.router.backend_snapshot()})
                 if self.path == "/metrics":
                     outer.router.update_gauges()
                     body = outer.router.registry.render().encode()
@@ -482,6 +572,8 @@ class RouterServer:
             def do_POST(self):
                 n = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(n)
+                if self.path == "/backends":
+                    return self._backends_mutate(body, add=True)
                 try:
                     payload = json.loads(body or b"{}")
                 except ValueError:
@@ -489,6 +581,36 @@ class RouterServer:
                 stream = bool(payload.get("stream"))
                 self._proxy(body, stream=stream,
                             affinity=affinity_from_payload(payload))
+
+            def do_DELETE(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n)
+                if self.path == "/backends":
+                    return self._backends_mutate(body, add=False)
+                return self._json(404, {"error": "not found"})
+
+            def _backends_mutate(self, body: bytes, add: bool):
+                """POST /backends {"url":..,"pool":..} registers a
+                backend; DELETE /backends {"url":..} removes one.
+                The autoscale pool calls these after spawning a ready
+                engine / after a drained engine exits."""
+                if not self._backends_guard():
+                    return None
+                try:
+                    payload = json.loads(body or b"{}")
+                except ValueError:
+                    payload = {}
+                url = payload.get("url")
+                if not url:
+                    return self._json(400, {"error": "missing 'url'"})
+                if add:
+                    b = outer.router.add_backend(
+                        url, payload.get("pool") or "engine")
+                    return self._json(200, {
+                        "ok": True, "url": b.url, "pool": b.pool})
+                removed = outer.router.remove_backend(url)
+                return self._json(200 if removed else 404, {
+                    "ok": removed, "url": url.rstrip("/")})
 
             def _pick_pool(self) -> str:
                 # explicit steer via header; else engine pool, falling
@@ -845,6 +967,11 @@ def main(argv=None) -> int:
                    help="deterministic fault-injection spec "
                         "(ome_tpu/faults.py grammar); also via "
                         "OME_FAULTS")
+    p.add_argument("--debug-endpoints", action="store_true",
+                   help="enable the guarded admin surface: GET "
+                        "/backends (machine-readable membership) and "
+                        "POST/DELETE /backends (autoscale "
+                        "registration); 403 otherwise")
     p.add_argument("--request-log", default=None,
                    help="JSONL request-log path (one record per "
                         "proxied request with trace id, backend, "
@@ -901,7 +1028,8 @@ def main(argv=None) -> int:
                        retries=args.retries,
                        retry_backoff=args.retry_backoff,
                        request_log=args.request_log,
-                       span_log=args.span_log).start()
+                       span_log=args.span_log,
+                       debug_endpoints=args.debug_endpoints).start()
     log.info("router on :%d over %d backends (policy=%s)", srv.port,
              len(backends), args.policy)
     try:

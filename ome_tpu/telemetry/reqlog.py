@@ -6,6 +6,14 @@ up to the fault. The record carries the trace id minted/adopted by
 tracing.py, which is what makes router and engine logs joinable:
 `grep <trace_id> router.jsonl engine.jsonl` reconstructs a request's
 full path. Schema documented in docs/observability.md.
+
+Schema v2 (the trace-replay contract, docs/autoscaling.md): engine
+records additionally carry the ADMIT timestamps — `admit_ts` (wall
+clock) and `admit_mono` (the process monotonic clock) — so a replay
+harness can reconstruct the original inter-arrival gaps exactly
+instead of approximating them from finish times. v1 logs (PRs 2-8)
+stay loadable: `admit_times()` derives the admit instant from
+`ts - e2e_s` when the explicit fields are absent.
 """
 
 from __future__ import annotations
@@ -13,7 +21,7 @@ from __future__ import annotations
 import json
 import threading
 import time
-from typing import IO, Optional
+from typing import IO, Optional, Tuple
 
 
 class RequestLog:
@@ -58,3 +66,25 @@ def coerce(value) -> RequestLog:
     if isinstance(value, RequestLog):
         return value
     return RequestLog(path=value)
+
+
+def admit_times(record: dict) -> Tuple[Optional[float],
+                                       Optional[float]]:
+    """(admit wall-clock, admit monotonic) for a request record.
+
+    Schema v2 records carry both explicitly (`admit_ts`,
+    `admit_mono`). For v1 records — every engine log written before
+    the replay subsystem — the wall-clock admit instant is DERIVED
+    as `ts - e2e_s` (the sink stamps `ts` at the finish write, and
+    `e2e_s` spans admission→finish), and the monotonic half is None.
+    Returns (None, None) when the record has neither form (router
+    records, torn lines)."""
+    wall = record.get("admit_ts")
+    mono = record.get("admit_mono")
+    if wall is not None:
+        return float(wall), (float(mono) if mono is not None
+                             else None)
+    ts, e2e = record.get("ts"), record.get("e2e_s")
+    if ts is not None and e2e is not None:
+        return float(ts) - float(e2e), None
+    return None, None

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Closed-loop autoscale runner — thin launcher for
+ome_tpu.autoscale.controller.
+
+    python scripts/autoscale.py --seed 7 --min-engines 1 --max-engines 3
+
+Stands up a router + engine pool, replays a bursty trace through it,
+and scales the pool against its SLOs; prints a one-line JSON report
+with SLO attainment, engine-seconds vs static max-provisioning, and
+the full decision log (--json). See docs/autoscaling.md.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ome_tpu.autoscale.controller import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Trace replay runner — thin launcher for ome_tpu.autoscale.replay.
+
+    python scripts/replay.py --url http://host:8000 --trace engine.reqlog
+    python scripts/replay.py --topology 2 --seed 7 --requests 30
+
+Replays a request trace (engine reqlog, saved trace file, or seeded
+synthetic) with its original inter-arrival gaps and prints a one-line
+JSON SLO report. See docs/autoscaling.md.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ome_tpu.autoscale.replay import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

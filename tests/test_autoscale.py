@@ -1,0 +1,751 @@
+"""Closed-loop autoscaling (ome_tpu/autoscale/, docs/autoscaling.md).
+
+Units cover the pure layers with no subprocesses: trace generation
+and transforms, reqlog schema v2 arrival reconstruction, the
+exposition parser + windowed histogram quantiles, the tick-based
+hysteresis policy, the controller's decision path with injected
+scrapes and fake pools (including run-to-run determinism), and the
+router's guarded /backends registration surface.
+
+The live layers get two tests: a tier-1 closed-loop smoke (router +
+real CPU engine pool, bursty synthetic trace, scale up then drain
+down, zero lost requests, greedy streams prefix-consistent) and the
+EnginePool kill-during-scale-down resume path. The full bursty soak
+with the engine-seconds-vs-static-provisioning acceptance check is
+`slow`.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ome_tpu.autoscale import controller as ctl_mod
+from ome_tpu.autoscale import replay as replay_mod
+from ome_tpu.autoscale import scrape as scrape_mod
+from ome_tpu.autoscale import trace as trace_mod
+from ome_tpu.autoscale.policy import PolicyConfig, PoolPolicy
+from ome_tpu.autoscale.pool import EnginePool
+from ome_tpu.chaos import ManagedProc, free_port, journal_live_entries
+from ome_tpu.telemetry import Registry
+from ome_tpu.telemetry import reqlog as reqlog_mod
+
+
+# -- traces -----------------------------------------------------------
+
+
+class TestTrace:
+    def test_synthetic_deterministic(self):
+        a = trace_mod.synthetic_trace(7, n=20)
+        b = trace_mod.synthetic_trace(7, n=20)
+        assert a == b
+        assert a != trace_mod.synthetic_trace(8, n=20)
+        assert all(x.arrival <= y.arrival for x, y in zip(a, a[1:]))
+        assert a[0].arrival == 0.0
+
+    def test_burst_window_is_denser(self):
+        tr = trace_mod.synthetic_trace(3, n=60, base_rate=2.0,
+                                       burst_factor=8.0)
+        gaps = [y.arrival - x.arrival for x, y in zip(tr, tr[1:])]
+        third = len(gaps) // 3
+        edge = gaps[:third] + gaps[-third:]
+        mid = gaps[third:-third]
+        assert (sum(mid) / len(mid)) < (sum(edge) / len(edge))
+
+    def test_compress(self):
+        tr = trace_mod.synthetic_trace(1, n=8)
+        fast = trace_mod.compress(tr, 4.0)
+        for orig, comp in zip(tr, fast):
+            assert comp.arrival == pytest.approx(orig.arrival / 4.0,
+                                                 abs=1e-5)
+            assert comp.prompt_tokens == orig.prompt_tokens
+        with pytest.raises(ValueError):
+            trace_mod.compress(tr, 0)
+
+    def test_amplify_bursts(self):
+        tr = trace_mod.synthetic_trace(2, n=20, burst_factor=6.0)
+        assert trace_mod.amplify_bursts(tr, 1) == sorted(
+            tr, key=lambda r: r.arrival)
+        amp = trace_mod.amplify_bursts(tr, 3, seed=5)
+        assert len(amp) > len(tr)
+        assert amp == trace_mod.amplify_bursts(tr, 3, seed=5)
+        assert all(x.arrival <= y.arrival
+                   for x, y in zip(amp, amp[1:]))
+        with pytest.raises(ValueError):
+            trace_mod.amplify_bursts(tr, 0)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        tr = trace_mod.synthetic_trace(4, n=10)
+        tr[0].prompt = "explicit text"
+        p = tmp_path / "trace.jsonl"
+        trace_mod.save_trace(tr, p)
+        assert trace_mod.load_trace(p) == tr
+
+    def test_prompt_text(self):
+        r = trace_mod.TraceRequest(arrival=0, prompt_tokens=8,
+                                   max_tokens=4)
+        assert r.prompt_text(0) == r.prompt_text(0)
+        assert len(r.prompt_text(0)) == 8
+        # deterministic in (seed, length) only: repeated lengths
+        # repeat prompts, so greedy oracles are comparable
+        r2 = trace_mod.TraceRequest(arrival=9, prompt_tokens=8,
+                                    max_tokens=2)
+        assert r2.prompt_text(0) == r.prompt_text(0)
+        assert r.prompt_text(1) != r.prompt_text(0)
+        r.prompt = "mine"
+        assert r.prompt_text(0) == "mine"
+
+
+# -- reqlog schema v2 -------------------------------------------------
+
+
+class TestReqlogV2:
+    def _v2(self, admit_ts, admit_mono, **kw):
+        rec = {"component": "engine", "model": "m", "ts": admit_ts + 5,
+               "admit_ts": admit_ts, "admit_mono": admit_mono,
+               "prompt_tokens": 4, "output_tokens": 3,
+               "e2e_s": 5.0, "finish_reason": "length"}
+        rec.update(kw)
+        return rec
+
+    def test_admit_times_v2_and_v1(self):
+        wall, mono = reqlog_mod.admit_times(
+            self._v2(1000.0, 50.0))
+        assert (wall, mono) == (1000.0, 50.0)
+        # v1 record: derive the admit instant as ts - e2e_s
+        wall, mono = reqlog_mod.admit_times(
+            {"ts": 1007.5, "e2e_s": 2.5})
+        assert wall == pytest.approx(1005.0)
+        assert mono is None
+        assert reqlog_mod.admit_times({"model": "m"}) == (None, None)
+
+    def test_load_reqlog_orders_by_admit_not_finish(self, tmp_path):
+        # request A admitted first but finished LAST: a finish-time
+        # ordering would invert the gap the replay must reproduce
+        recs = [self._v2(100.0, 10.0, ts=120.0, trace_id="a"),
+                self._v2(103.0, 13.0, ts=104.0, trace_id="b")]
+        p = tmp_path / "req.jsonl"
+        p.write_text("\n".join(json.dumps(r) for r in reversed(recs))
+                     + "\n" + '{"component": "router", "ts": 1}\n'
+                     + '{"torn')
+        tr = trace_mod.load_reqlog(p)
+        assert [r.trace_id for r in tr] == ["a", "b"]
+        assert tr[0].arrival == 0.0
+        assert tr[1].arrival == pytest.approx(3.0)
+        assert tr[0].max_tokens == 3
+
+    def test_requestlog_write_roundtrips_to_trace(self, tmp_path):
+        """v2 round trip through the real sink: records written by
+        RequestLog come back as a replayable trace with the original
+        gap."""
+        p = tmp_path / "req.jsonl"
+        sink = reqlog_mod.RequestLog(path=str(p))
+        sink.write(self._v2(1000.0, 50.0, trace_id="a"))
+        sink.write(self._v2(1001.25, 51.25, trace_id="b"))
+        sink.close()
+        tr = trace_mod.load_reqlog(p)
+        assert [r.trace_id for r in tr] == ["a", "b"]
+        assert tr[1].arrival == pytest.approx(1.25)
+
+
+# -- exposition parsing + windowed quantiles --------------------------
+
+
+class TestScrape:
+    def test_parse_real_render(self):
+        r = Registry()
+        h = r.histogram("ome_engine_ttft_seconds", "t",
+                        buckets=(0.1, 0.5, 1.0))
+        for v in (0.05, 0.3, 0.7):
+            h.observe(v)
+        r.gauge("ome_engine_queue_depth", "d").set(3)
+        samples = scrape_mod.parse_exposition(r.render())
+        assert samples["ome_engine_queue_depth"] == 3.0
+        buckets = scrape_mod.bucket_counts(
+            samples, "ome_engine_ttft_seconds")
+        assert buckets[-1][0] == float("inf")
+        assert buckets[-1][1] == 3.0
+        name, labels = scrape_mod.split_key(
+            'x_bucket{le="0.5",pool="engine"}')
+        assert name == "x_bucket"
+        assert labels == {"le": "0.5", "pool": "engine"}
+
+    def test_quantile_from_buckets(self):
+        # 10 obs: 5 in (0, 0.1], 4 in (0.1, 0.5], 1 beyond 1.0
+        buckets = [(0.1, 5.0), (0.5, 9.0), (1.0, 9.0),
+                   (float("inf"), 10.0)]
+        q50 = scrape_mod.quantile_from_buckets(buckets, 0.5)
+        assert 0.0 < q50 <= 0.1
+        # the +Inf bucket clamps to the last finite bound
+        assert scrape_mod.quantile_from_buckets(buckets, 0.99) == 1.0
+        assert scrape_mod.quantile_from_buckets([], 0.5) is None
+        assert scrape_mod.quantile_from_buckets(
+            [(0.1, 0.0), (float("inf"), 0.0)], 0.5) is None
+
+    def _samples(self, counts):
+        bounds = (0.1, 0.5, 1.0)
+        out = {}
+        cum = 0.0
+        for b, c in zip(bounds, counts):
+            cum += c
+            out[f'ome_engine_ttft_seconds_bucket{{le="{b}"}}'] = cum
+        out['ome_engine_ttft_seconds_bucket{le="+Inf"}'] = cum
+        return out
+
+    def test_histogram_window(self):
+        w = scrape_mod.HistogramWindow("ome_engine_ttft_seconds")
+        w.update("u1", self._samples([100, 0, 0]))
+        # one scrape = no delta yet
+        assert w.quantile(0.99) is None
+        # 10 new observations all in (0.5, 1.0]: the window sees the
+        # recent latency regression the cumulative p99 would bury
+        s2 = self._samples([100, 0, 10])
+        w.update("u1", s2)
+        assert w.window_count() == 10.0
+        assert 0.5 < w.quantile(0.99) <= 1.0
+        # counter reset (engine restart) discards and re-bases
+        w.update("u1", self._samples([1, 0, 0]))
+        assert w.quantile(0.99) is None
+        w.update("u1", self._samples([1, 2, 0]))
+        assert w.window_count() == 2.0
+        w.forget("u1")
+        assert w.quantile(0.99) is None
+
+    def test_window_merges_sources(self):
+        w = scrape_mod.HistogramWindow("ome_engine_ttft_seconds")
+        w.update("u1", self._samples([0, 0, 0]))
+        w.update("u2", self._samples([0, 0, 0]))
+        w.update("u1", self._samples([5, 0, 0]))
+        w.update("u2", self._samples([0, 0, 5]))
+        assert w.window_count() == 10.0
+        assert w.quantile(0.99) > 0.5
+
+
+# -- hysteresis policy ------------------------------------------------
+
+
+class TestPolicy:
+    CFG = dict(min_size=1, max_size=3, up_stable_ticks=2,
+               down_stable_ticks=3, cooldown_ticks=2,
+               down_threshold=0.3)
+
+    def test_validate(self):
+        with pytest.raises(ValueError):
+            PolicyConfig(min_size=2, max_size=1).validate()
+        with pytest.raises(ValueError):
+            PolicyConfig(down_threshold=1.5).validate()
+        with pytest.raises(ValueError):
+            PolicyConfig(up_stable_ticks=0).validate()
+
+    def _run(self, pressures):
+        pol = PoolPolicy(PolicyConfig(**self.CFG))
+        size, sizes = 1, []
+        for p in pressures:
+            size = pol.decide(size, p)
+            sizes.append(size)
+        return sizes
+
+    def test_decision_sequence(self):
+        # hand-simulated: up after 2 stable ticks, cooldown holds the
+        # next 2, second up at tick 5; down is slower (3 ticks) and
+        # interleaves with cooldown; min_size clamps the tail
+        sizes = self._run([2.0] * 6 + [0.1] * 10)
+        assert sizes == [1, 2, 2, 2, 3, 3,
+                         3, 3, 2, 2, 2, 1, 1, 1, 1, 1]
+
+    def test_spike_does_not_scale(self):
+        # a single-tick spike never clears up_stable_ticks
+        assert self._run([2.0, 0.6, 2.0, 0.6, 2.0, 0.6]) == [1] * 6
+
+    def test_mid_band_resets_both_counters(self):
+        pol = PoolPolicy(PolicyConfig(**self.CFG))
+        pol.decide(1, 2.0)        # above x1
+        pol.decide(1, 0.6)        # mid-band: resets
+        assert pol.decide(1, 2.0) == 1   # above x1 again, not x2
+        assert pol.decide(1, 2.0) == 2
+
+    def test_clamps(self):
+        pol = PoolPolicy(PolicyConfig(**self.CFG))
+        # never exceeds max_size even under sustained pressure
+        size = 3
+        for _ in range(10):
+            size = pol.decide(size, 5.0)
+        assert size == 3
+        # and a too-small starting size clamps up to min_size
+        assert PoolPolicy(PolicyConfig(**self.CFG)).decide(0, 0.5) == 1
+
+
+# -- controller with fakes --------------------------------------------
+
+
+class _FakePool:
+    """Stands in for EnginePool: pure counters, no subprocesses."""
+
+    def __init__(self, size=1):
+        self._size = size
+        self.spawned = 0
+        self.drained = 0
+
+    def size(self):
+        return self._size
+
+    def member_urls(self):
+        return [f"http://fake:{i}" for i in range(self._size)]
+
+    def draining_count(self):
+        return 0
+
+    def engine_seconds(self):
+        return float(self._size)
+
+    def spawn(self):
+        self._size += 1
+        self.spawned += 1
+
+    def drain_one(self):
+        if self._size == 0:
+            return None
+        self._size -= 1
+        self.drained += 1
+        return "victim"
+
+
+def _scripted_fetch(depth_by_tick):
+    """fetch_fn whose queue_depth follows a per-TICK script: every
+    member scraped in the same tick sees the same depth. The first
+    fake URL (":0", always present) advances the clock —
+    deterministic because member_urls() order is fixed."""
+    state = {"tick": -1}
+
+    def fetch(url):
+        if url.endswith(":0"):
+            state["tick"] += 1
+        i = min(max(state["tick"], 0), len(depth_by_tick) - 1)
+        depth = depth_by_tick[i]
+        if depth is None:
+            raise OSError("scrape down")
+        return {"ome_engine_queue_depth": float(depth),
+                "ome_engine_kv_block_utilization_ratio": 0.1}
+
+    return fetch
+
+
+class TestController:
+    SLO = ctl_mod.SLOConfig(ttft_p99_s=1.0, queue_wait_p99_s=1.0,
+                            kv_util_high=0.9, queue_depth_high=2.0)
+
+    def _controller(self, script, pool=None):
+        pool = pool or _FakePool()
+        pol = PoolPolicy(PolicyConfig(
+            min_size=1, max_size=3, up_stable_ticks=2,
+            down_stable_ticks=3, cooldown_ticks=2,
+            down_threshold=0.3))
+        c = ctl_mod.ScaleController(
+            {"engine": pool}, {"engine": pol}, self.SLO,
+            fetch_fn=_scripted_fetch(script))
+        return c, pool
+
+    def test_scales_up_then_down(self):
+        # depth 8 => pressure 4.0; depth 0 => pressure 0
+        c, pool = self._controller([8, 8, 8, 0, 0, 0, 0, 0, 0, 0])
+        for _ in range(10):
+            c.tick()
+        assert pool.spawned >= 1
+        assert pool.drained >= 1
+        ups = [d for d in c.decisions if d.target > d.size]
+        downs = [d for d in c.decisions if d.target < d.size]
+        assert ups and downs
+        assert ups[0].pressure == pytest.approx(4.0)
+        assert ups[0].signals["queue_depth"] == 8.0
+        reg = c.registry
+        assert reg.get("ome_autoscale_scale_ups_total",
+                       pool="engine") >= 1
+        assert reg.get("ome_autoscale_ticks_total") == 10
+        assert reg.get("ome_autoscale_pool_size", pool="engine") \
+            == pool.size()
+
+    def test_identical_decisions_run_to_run(self):
+        """The satellite determinism property: a given (trace ->
+        metrics) series maps to exactly one decision sequence."""
+        script = [1, 6, 7, 9, 9, 2, 1, 0, 0, 0, 0, 0, 0, 0]
+
+        def run():
+            c, _ = self._controller(list(script))
+            for _ in range(len(script)):
+                c.tick()
+            return [d.to_dict() for d in c.decisions]
+
+        first, second = run(), run()
+        assert first == second
+        assert any(d["target"] != d["size"] for d in first)
+
+    def test_scrape_failure_counted_not_fatal(self):
+        c, pool = self._controller([None, None])
+        c.tick()
+        c.tick()
+        assert pool.spawned == 0
+        assert c.registry.get(
+            "ome_autoscale_scrape_errors_total") == 2
+        # no signals at all -> pressure 0, which is still a decision
+        assert c.decisions[-1].pressure == 0.0
+
+    def test_failed_spawn_does_not_kill_tick(self):
+        class Exploding(_FakePool):
+            def spawn(self):
+                raise RuntimeError("no capacity")
+
+        c, pool = self._controller([9] * 5, pool=Exploding())
+        for _ in range(5):
+            c.tick()
+        assert pool.size() == 1  # wanted to scale, could not
+        assert c.tick_count == 5
+
+
+# -- router /backends registration surface ----------------------------
+
+
+class TestRouterBackends:
+    def _server(self, debug):
+        from ome_tpu.router.server import (Backend, Router,
+                                           RouterServer)
+        router = Router([Backend("http://127.0.0.1:9")],
+                        policy="round_robin")
+        srv = RouterServer(router, host="127.0.0.1", port=0,
+                           debug_endpoints=debug).start()
+        return router, srv, f"http://127.0.0.1:{srv.port}"
+
+    def _call(self, base, method, path, payload=None):
+        data = (json.dumps(payload).encode()
+                if payload is not None else None)
+        req = urllib.request.Request(
+            base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return r.status, json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            e.close()
+            return e.code, (json.loads(body) if body else {})
+
+    def test_guarded_without_flag(self):
+        router, srv, base = self._server(debug=False)
+        try:
+            for method, payload in (("GET", None),
+                                    ("POST", {"url": "http://x:1"}),
+                                    ("DELETE", {"url": "http://x:1"})):
+                status, _ = self._call(base, method, "/backends",
+                                       payload)
+                assert status == 403, method
+            assert len(router.backends) == 1
+        finally:
+            srv.stop()
+
+    def test_add_remove_and_stale_gauges(self):
+        router, srv, base = self._server(debug=True)
+        try:
+            status, body = self._call(
+                base, "POST", "/backends",
+                {"url": "http://127.0.0.1:10", "pool": "decode"})
+            assert status == 200
+            status, body = self._call(base, "GET", "/backends")
+            assert status == 200
+            urls = {b["url"]: b for b in body["backends"]}
+            assert "http://127.0.0.1:10" in urls
+            assert urls["http://127.0.0.1:10"]["pool"] == "decode"
+            assert {"healthy", "draining", "inflight",
+                    "cb_state"} <= set(urls["http://127.0.0.1:10"])
+
+            # idempotent re-add; re-add also cancels a drain
+            router.backends[-1].draining = True
+            status, _ = self._call(
+                base, "POST", "/backends",
+                {"url": "http://127.0.0.1:10", "pool": "decode"})
+            assert status == 200
+            assert len(router.backends) == 2
+            assert not router.backends[-1].draining
+
+            # the inflight gauge exists for the live backend...
+            router.update_gauges()
+            assert router.registry.get(
+                "ome_router_backend_inflight",
+                backend="http://127.0.0.1:10", pool="decode") == 0
+
+            # ...and is zeroed (not leaked) once the backend leaves
+            router.backends[-1].inflight = 7
+            router.update_gauges()
+            status, _ = self._call(base, "DELETE", "/backends",
+                                   {"url": "http://127.0.0.1:10"})
+            assert status == 200
+            router.update_gauges()
+            assert router.registry.get(
+                "ome_router_backend_inflight",
+                backend="http://127.0.0.1:10", pool="decode") == 0
+
+            status, _ = self._call(base, "DELETE", "/backends",
+                                   {"url": "http://127.0.0.1:10"})
+            assert status == 404
+            status, _ = self._call(base, "POST", "/backends", {})
+            assert status == 400
+        finally:
+            srv.stop()
+
+
+# -- live closed loop -------------------------------------------------
+
+
+def _engine_args_factory(model_dir, drain_grace=6.0):
+    def engine_args(port, name, journal_dir):
+        return ["--model-dir", str(model_dir), "--random-weights",
+                "--dtype", "float32", "--host", "127.0.0.1",
+                "--port", str(port), "--max-slots", "2",
+                "--kv-block", "16", "--kv-blocks", "40",
+                "--prefix-cache-mb", "8",
+                "--drain-grace", str(drain_grace),
+                "--journal", str(journal_dir),
+                "--journal-fsync", "always"]
+    return engine_args
+
+
+def _spawn_router(pool, base, debug=True):
+    rport = free_port()
+    rargs = ["--bind", "127.0.0.1", "--port", str(rport),
+             "--policy", "round_robin", "--health-interval", "0.5"]
+    if debug:
+        rargs.append("--debug-endpoints")
+    for url in pool.member_urls():
+        rargs += ["--backend", url]
+    router = ManagedProc("router", "router", rargs, rport,
+                         base / "router.log")
+    router.start()
+    router.wait_ready()
+    return router
+
+
+def _journal_leftover(pool):
+    return sum(len(journal_live_entries(p)) for p in pool.journals())
+
+
+def _assert_greedy_prefix_consistent(results):
+    """Greedy streams for the same prompt must agree byte-for-byte,
+    whatever engine (or scale event) served them — the chaos
+    invariant, applied across a scaling run. Same (prompt,
+    max_tokens) pairs compare exactly; different output budgets
+    compare on the common prefix, after dropping the trailing
+    replacement char a stream ending mid-multi-byte-character
+    legitimately flushes at EOS (a longer stream completes it)."""
+    by_prompt = {}
+    for r in results:
+        if r.temperature == 0.0 and r.ok:
+            by_prompt.setdefault(r.prompt, []).append(
+                (r.max_tokens, r.text))
+    compared = 0
+    for pairs in by_prompt.values():
+        pairs.sort()
+        for (mt_a, a), (mt_b, b) in zip(pairs, pairs[1:]):
+            if mt_a == mt_b:
+                assert a == b, (a, b)
+            else:
+                assert b.startswith(a.rstrip("�")), (a, b)
+            compared += 1
+    assert compared > 0  # the trace really did repeat prompts
+
+
+def _run_closed_loop(tmp_path, trace, min_engines, max_engines,
+                     on_tick=None, settle=30.0):
+    """Compose the pieces of controller.run_closed_loop directly so
+    the test keeps the per-request results and live objects."""
+    model_dir = tmp_path / "model"
+    model_dir.mkdir()
+    pool = EnginePool("engine", None,
+                      _engine_args_factory(model_dir), tmp_path,
+                      drain_exit_timeout=60.0)
+    router = None
+    ctl = None
+    try:
+        for _ in range(min_engines):
+            pool.spawn()
+        router = _spawn_router(pool, tmp_path)
+        pool.router_url = router.url
+        slo = ctl_mod.SLOConfig(ttft_p99_s=0.4,
+                                queue_wait_p99_s=0.2,
+                                queue_depth_high=1.5)
+        pol = PoolPolicy(PolicyConfig(
+            min_size=min_engines, max_size=max_engines,
+            up_stable_ticks=2, down_stable_ticks=4,
+            cooldown_ticks=3, down_threshold=0.3))
+        ctl = ctl_mod.ScaleController(
+            {"engine": pool}, {"engine": pol}, slo,
+            router_url=router.url, interval=0.5).start()
+        if on_tick is not None:
+            watcher = threading.Thread(
+                target=on_tick, args=(pool,), daemon=True)
+            watcher.start()
+        results = replay_mod.replay(router.url, trace, timeout=180)
+        # settle until the controller has shed the burst capacity and
+        # every drain has fully completed (bounded, not a fixed sleep)
+        deadline = time.monotonic() + settle
+        while time.monotonic() < deadline:
+            if (any(d.target < d.size for d in ctl.decisions)
+                    and pool.draining_count() == 0
+                    and pool.size() == min_engines):
+                break
+            time.sleep(0.5)
+        ctl.stop()
+        pool.join_drains(timeout=90.0)
+        # the finally below tears the topology down, so capture the
+        # steady-state size the controller converged to first
+        return results, ctl, pool, pool.size()
+    finally:
+        if ctl is not None:
+            ctl.stop()
+        pool.stop_all()
+        if router is not None:
+            router.stop()
+
+
+class TestClosedLoopSmoke:
+    def test_scale_up_burst_then_drain_down(self, tmp_path):
+        """The tier-1 acceptance smoke: a bursty trace pushes the
+        pool from 1 to 2 engines, the post-burst quiet drains it back
+        to 1, no admitted request is lost, and greedy streams stay
+        byte-consistent across the scale events."""
+        # max_tokens is the lever that makes the burst SUSTAIN: long
+        # decodes hold the 2 slots, so queue wait stays high across
+        # several 0.5s ticks (a single-tick spike must not scale)
+        trace = trace_mod.synthetic_trace(
+            7, n=16, base_rate=2.0, burst_factor=8.0,
+            max_tokens=(24, 48))
+        results, ctl, pool, final_size = _run_closed_loop(
+            tmp_path, trace, min_engines=1, max_engines=2)
+
+        errs = [r for r in results if not r.ok]
+        assert errs == [], [(r.trace_id, r.status, r.error)
+                            for r in errs]
+        assert any(d.target > d.size for d in ctl.decisions), \
+            [d.to_dict() for d in ctl.decisions]
+        assert any(d.target < d.size for d in ctl.decisions), \
+            [d.to_dict() for d in ctl.decisions]
+        assert pool.drains and all(d.ok for d in pool.drains)
+        assert _journal_leftover(pool) == 0
+        assert final_size == 1
+        _assert_greedy_prefix_consistent(results)
+        # every stream really decoded tokens
+        assert all(r.output_tokens > 0 for r in results)
+
+
+class TestDrainResume:
+    def test_kill_during_scale_down_resumes_journal(self, tmp_path):
+        """SIGKILL the victim mid-drain with admitted work
+        outstanding: the pool must respawn it on the same journal,
+        let restart-resume finish the request, and still end with a
+        clean (zero-leftover) drain — the scale-down guarantee under
+        the worst-case chaos event."""
+        model_dir = tmp_path / "model"
+        model_dir.mkdir()
+        pool = EnginePool(
+            "engine", None,
+            _engine_args_factory(model_dir, drain_grace=30.0),
+            tmp_path, drain_exit_timeout=60.0, resume_timeout=90.0)
+        try:
+            pool.spawn()
+            url = pool.member_urls()[0]
+            body = json.dumps({"prompt": "abcd", "max_tokens": 400,
+                               "temperature": 0.0,
+                               "stream": True}).encode()
+
+            def long_request():
+                req = urllib.request.Request(
+                    url + "/v1/completions", data=body,
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req, timeout=120) as r:
+                        for _ in r:
+                            pass
+                except (urllib.error.URLError, OSError):
+                    pass  # the kill tears this stream; that's the point
+
+            t = threading.Thread(target=long_request, daemon=True)
+            t.start()
+            with pool._lock:
+                member = pool._members[0]
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if journal_live_entries(member.journal):
+                    break
+                time.sleep(0.1)
+            assert journal_live_entries(member.journal), \
+                "request never admitted"
+
+            assert pool.drain_one() is not None
+            member.proc.kill()  # mid-drain, with journaled work live
+            pool.join_drains(timeout=180.0)
+
+            assert len(pool.drains) == 1
+            rec = pool.drains[0]
+            assert rec.resumed, vars(rec)
+            assert rec.ok, vars(rec)
+            assert _journal_leftover(pool) == 0
+            assert pool.size() == 0
+        finally:
+            pool.stop_all()
+
+
+@pytest.mark.slow
+class TestAutoscaleSoak:
+    def test_bursty_soak_with_kill_mid_drain(self, tmp_path):
+        """The acceptance run: a bigger bursty trace scales 1->N and
+        back with a chaos SIGKILL landing on the first draining
+        engine; zero admitted requests lost, greedy streams stay
+        consistent, and the elastic pool spends fewer engine-seconds
+        than static max provisioning."""
+        trace = trace_mod.amplify_bursts(
+            trace_mod.synthetic_trace(
+                11, n=40, base_rate=2.0, burst_factor=8.0,
+                max_tokens=(30, 60)),
+            3, seed=11)
+        killed = threading.Event()
+
+        def chaos_kill(pool):
+            # SIGKILL the first member that starts draining
+            while not killed.is_set():
+                with pool._lock:
+                    victims = [m for m in pool._members if m.draining]
+                if victims:
+                    victims[0].proc.kill()
+                    killed.set()
+                    return
+                time.sleep(0.2)
+
+        t0 = time.monotonic()
+        results, ctl, pool, _final = _run_closed_loop(
+            tmp_path, trace, min_engines=1, max_engines=3,
+            on_tick=chaos_kill, settle=60.0)
+        wall = time.monotonic() - t0
+
+        errs = [r for r in results if not r.ok]
+        assert errs == [], [(r.trace_id, r.status, r.error)
+                            for r in errs]
+        assert _journal_leftover(pool) == 0
+        assert any(d.target > d.size for d in ctl.decisions)
+        assert any(d.target < d.size for d in ctl.decisions)
+        assert pool.drains and all(d.ok for d in pool.drains), \
+            [vars(d) for d in pool.drains]
+        # the chaos kill really landed on a draining engine; the
+        # drain still completes cleanly (when the victim had
+        # journaled work outstanding, via the respawn/resume path —
+        # TestDrainResume pins that arm deterministically)
+        assert killed.is_set()
+        _assert_greedy_prefix_consistent(results)
+
+        # elasticity must beat static max provisioning over the run
+        static_max = 3 * wall
+        assert pool.engine_seconds() < static_max, \
+            (pool.engine_seconds(), static_max)
+
+        # the replayed burst held a (generous, CPU-engine) TTFT SLO
+        rep = replay_mod.report(results, slo_ttft_s=5.0)
+        assert rep["slo_ttft_attainment"] >= 0.9, rep
