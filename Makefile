@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint test replay autoscale-soak
+.PHONY: lint test replay autoscale-soak noisy-neighbor
 
 # omelint: the repo's static-analysis gate (docs/static-analysis.md).
 # Runs every registered analyzer over ome_tpu/ and fails on any
@@ -25,6 +25,16 @@ test:
 replay:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/replay.py --topology 1 \
 		--seed 7 --requests 10 --compress 2
+
+# multi-tenant isolation under overload (docs/multi-tenancy.md): a
+# seeded batch-class flood at 5x slot capacity with steady
+# interactive traffic and a mid-episode SIGKILL, checked against the
+# noisy-neighbor invariants (no admitted class starves, weighted
+# shares hold, interactive is never shed)
+noisy-neighbor:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_soak.py --seed 7 \
+		--episodes 1 --noisy-neighbor --prefill 0 --decode 0 \
+		--unified 1 --spread 4
 
 # the closed-loop demo: bursty replayed trace + SLO-aware scaling of
 # a live engine pool, reporting engine-seconds vs static max

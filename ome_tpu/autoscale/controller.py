@@ -42,6 +42,7 @@ import urllib.error
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..priority import PRIORITY_CLASSES, highest_class
 from ..telemetry import Registry
 from . import scrape
 from .policy import PolicyConfig, PoolPolicy
@@ -53,12 +54,20 @@ log = logging.getLogger("ome.autoscale")
 class SLOConfig:
     """The objectives pressure is normalized against. 1.0 pressure ==
     "exactly at objective"; the policy's up_threshold is in these
-    units."""
+    units.
+
+    ``priority_class`` keys the latency windows to ONE tenant class
+    (default: the highest, interactive) — under a noisy-neighbor
+    flood, scaling must react to the latency of the traffic the SLO
+    protects, not the since-boot average the batch flood dominates.
+    The global histograms stay as fallback when the class window has
+    no observations."""
 
     ttft_p99_s: float = 2.0
     queue_wait_p99_s: float = 1.0
     kv_util_high: float = 0.9
     queue_depth_high: float = 4.0
+    priority_class: str = highest_class()
 
 
 @dataclass
@@ -102,11 +111,21 @@ class ScaleController:
         self.registry = registry or Registry()
         self.decisions: List[Decision] = []
         self.tick_count = 0
+        cls_filter = ({"class": slo.priority_class}
+                      if getattr(slo, "priority_class", None) else None)
         self._windows: Dict[str, Dict[str, scrape.HistogramWindow]] = {
             name: {"ttft": scrape.HistogramWindow(
                        "ome_engine_ttft_seconds"),
                    "queue_wait": scrape.HistogramWindow(
-                       "ome_engine_queue_wait_seconds")}
+                       "ome_engine_queue_wait_seconds"),
+                   # per-class windows answer first; the global pair
+                   # is the fallback when the class saw no traffic
+                   "class_ttft": scrape.HistogramWindow(
+                       "ome_engine_class_ttft_seconds",
+                       labels=cls_filter),
+                   "class_queue_wait": scrape.HistogramWindow(
+                       "ome_engine_class_queue_wait_seconds",
+                       labels=cls_filter)}
             for name in pools}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -177,10 +196,14 @@ class ScaleController:
             if depth is not None:
                 depths.append(depth)
         signals: Dict[str, float] = {}
-        ttft = windows["ttft"].quantile(0.99)
+        ttft = windows["class_ttft"].quantile(0.99)
+        if ttft is None:
+            ttft = windows["ttft"].quantile(0.99)
         if ttft is not None:
             signals["ttft_p99"] = round(ttft, 4)
-        qw = windows["queue_wait"].quantile(0.99)
+        qw = windows["class_queue_wait"].quantile(0.99)
+        if qw is None:
+            qw = windows["queue_wait"].quantile(0.99)
         if qw is not None:
             signals["queue_wait_p99"] = round(qw, 4)
         if kv_utils:
@@ -305,6 +328,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="controller tick seconds")
     p.add_argument("--slo-ttft-p99", type=float, default=2.0)
     p.add_argument("--slo-queue-wait-p99", type=float, default=1.0)
+    p.add_argument("--slo-class", default=highest_class(),
+                   choices=list(PRIORITY_CLASSES),
+                   help="priority class the latency SLO windows key "
+                        "to (default: the highest class); the global "
+                        "histograms are the fallback when that class "
+                        "saw no traffic in a window")
     p.add_argument("--queue-depth-high", type=float, default=3.0)
     p.add_argument("--up-stable-ticks", type=int, default=2)
     p.add_argument("--down-stable-ticks", type=int, default=6)
@@ -394,7 +423,8 @@ def run_closed_loop(args) -> dict:
 
         slo = SLOConfig(ttft_p99_s=args.slo_ttft_p99,
                         queue_wait_p99_s=args.slo_queue_wait_p99,
-                        queue_depth_high=args.queue_depth_high)
+                        queue_depth_high=args.queue_depth_high,
+                        priority_class=args.slo_class)
         policy = PoolPolicy(PolicyConfig(
             min_size=args.min_engines, max_size=args.max_engines,
             up_stable_ticks=args.up_stable_ticks,

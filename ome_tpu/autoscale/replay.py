@@ -42,6 +42,7 @@ class ReplayResult:
     prompt: str
     max_tokens: int
     temperature: float
+    priority: Optional[str] = None
     status: Optional[int] = None
     ttft_s: Optional[float] = None
     tpot_s: Optional[float] = None
@@ -58,12 +59,18 @@ class ReplayResult:
 
 def _stream_one(url: str, result: ReplayResult,
                 timeout: float) -> None:
-    body = json.dumps({
+    payload = {
         "prompt": result.prompt, "max_tokens": result.max_tokens,
-        "temperature": result.temperature, "stream": True}).encode()
+        "temperature": result.temperature, "stream": True}
+    headers = {"Content-Type": "application/json"}
+    if result.priority:
+        # class in BOTH forms: the payload survives router
+        # passthrough, the header is what the engine prefers
+        payload["priority"] = result.priority
+        headers["X-OME-Priority"] = result.priority
+    body = json.dumps(payload).encode()
     req = urllib.request.Request(
-        url + "/v1/completions", data=body,
-        headers={"Content-Type": "application/json"})
+        url + "/v1/completions", data=body, headers=headers)
     t0 = time.monotonic()
     first = last = None
     try:
@@ -119,7 +126,8 @@ def replay(url: str, trace: Sequence[TraceRequest],
     results = [ReplayResult(trace_id=r.trace_id, arrival=r.arrival,
                             prompt=r.prompt_text(prompt_seed),
                             max_tokens=r.max_tokens,
-                            temperature=r.temperature)
+                            temperature=r.temperature,
+                            priority=getattr(r, "priority", None))
                for r in trace]
 
     def one(r: ReplayResult):
@@ -147,10 +155,8 @@ def _pct(xs: List[float], p: float) -> Optional[float]:
     return round(xs[i], 6)
 
 
-def report(results: Sequence[ReplayResult],
-           slo_ttft_s: float = 2.0,
-           slo_e2e_s: Optional[float] = None) -> dict:
-    """Percentiles + SLO attainment over a replay's results."""
+def _stats(results: Sequence[ReplayResult], slo_ttft_s: float,
+           slo_e2e_s: Optional[float]) -> dict:
     ok = [r for r in results if r.ok]
     ttfts = [r.ttft_s for r in ok if r.ttft_s is not None]
     tpots = [r.tpot_s for r in ok if r.tpot_s is not None]
@@ -176,6 +182,25 @@ def report(results: Sequence[ReplayResult],
         out["slo_e2e_s"] = slo_e2e_s
         out["slo_e2e_attainment"] = (round(e2e_ok / len(e2es), 4)
                                      if e2es else None)
+    return out
+
+
+def report(results: Sequence[ReplayResult],
+           slo_ttft_s: float = 2.0,
+           slo_e2e_s: Optional[float] = None) -> dict:
+    """Percentiles + SLO attainment over a replay's results. When any
+    request carried a priority class, the report also breaks the same
+    stats out per class under ``classes`` — the view that shows a
+    batch flood hurting batch latency while interactive holds."""
+    out = _stats(results, slo_ttft_s, slo_e2e_s)
+    by_class: dict = {}
+    for r in results:
+        if r.priority is not None:
+            by_class.setdefault(r.priority, []).append(r)
+    if by_class:
+        out["classes"] = {
+            cls: _stats(rs, slo_ttft_s, slo_e2e_s)
+            for cls, rs in sorted(by_class.items())}
     return out
 
 

@@ -72,16 +72,22 @@ def fetch_metrics(url: str, timeout: float = 5.0) -> Dict[str, float]:
     return parse_exposition(body)
 
 
-def bucket_counts(samples: Dict[str, float],
-                  family: str) -> List[Tuple[float, float]]:
+def bucket_counts(samples: Dict[str, float], family: str,
+                  label_filter: Optional[Dict[str, str]] = None
+                  ) -> List[Tuple[float, float]]:
     """Cumulative (upper_bound, count) pairs for one histogram
     family, summed across label children, sorted by bound (+Inf
-    last)."""
+    last). ``label_filter`` restricts to children matching every
+    given label pair (e.g. {"class": "interactive"} narrows a
+    per-class histogram to one tenant class)."""
     acc: Dict[float, float] = {}
     prefix = family + "_bucket"
     for key, value in samples.items():
         name, labels = split_key(key)
         if name != prefix or "le" not in labels:
+            continue
+        if label_filter and any(labels.get(k) != v
+                                for k, v in label_filter.items()):
             continue
         le = labels["le"]
         bound = math.inf if le == "+Inf" else float(le)
@@ -121,15 +127,18 @@ class HistogramWindow:
     ``update(source, samples)`` ingests a scrape for one source
     (backend URL); ``quantile(q)`` answers over the observations that
     arrived between the previous update and this one, across ALL
-    sources. Counter resets re-base silently."""
+    sources. Counter resets re-base silently. ``labels`` narrows the
+    family to matching children (per-class SLO windows)."""
 
-    def __init__(self, family: str):
+    def __init__(self, family: str,
+                 labels: Optional[Dict[str, str]] = None):
         self.family = family
+        self.labels = dict(labels) if labels else None
         self._prev: Dict[str, List[Tuple[float, float]]] = {}
         self._window: Dict[str, List[Tuple[float, float]]] = {}
 
     def update(self, source: str, samples: Dict[str, float]) -> None:
-        cur = bucket_counts(samples, self.family)
+        cur = bucket_counts(samples, self.family, self.labels)
         prev = self._prev.get(source)
         self._prev[source] = cur
         if prev is None or len(prev) != len(cur):

@@ -47,6 +47,9 @@ class TraceRequest:
     temperature: float = 0.0
     trace_id: Optional[str] = None
     prompt: Optional[str] = None
+    # priority class (ome_tpu/priority.py); None replays as the
+    # engine default so pre-v3 traces behave unchanged
+    priority: Optional[str] = None
 
     def prompt_text(self, seed: int = 0) -> str:
         if self.prompt is not None:
@@ -91,7 +94,8 @@ def load_reqlog(path: Union[str, pathlib.Path]) -> List[TraceRequest]:
             prompt_tokens=int(rec["prompt_tokens"]),
             max_tokens=max(1, int(rec.get("output_tokens") or 1)),
             temperature=float(rec.get("temperature") or 0.0),
-            trace_id=rec.get("trace_id")))
+            trace_id=rec.get("trace_id"),
+            priority=rec.get("class")))
     return out
 
 
@@ -135,7 +139,8 @@ def compress(trace: Sequence[TraceRequest],
                          prompt_tokens=r.prompt_tokens,
                          max_tokens=r.max_tokens,
                          temperature=r.temperature,
-                         trace_id=r.trace_id, prompt=r.prompt)
+                         trace_id=r.trace_id, prompt=r.prompt,
+                         priority=r.priority)
             for r in trace]
 
 
@@ -177,7 +182,8 @@ def amplify_bursts(trace: Sequence[TraceRequest], factor: int,
                 temperature=r.temperature,
                 trace_id=(f"{r.trace_id}-amp{k}"
                           if r.trace_id else None),
-                prompt=r.prompt))
+                prompt=r.prompt,
+                priority=r.priority))
     out.sort(key=lambda r: r.arrival)
     return out
 
@@ -203,6 +209,7 @@ def load_trace(path: Union[str, pathlib.Path]) -> List[TraceRequest]:
             prompt_tokens=int(rec["prompt_tokens"]),
             max_tokens=int(rec["max_tokens"]),
             temperature=float(rec.get("temperature", 0.0)),
-            trace_id=rec.get("trace_id"), prompt=rec.get("prompt")))
+            trace_id=rec.get("trace_id"), prompt=rec.get("prompt"),
+            priority=rec.get("priority")))
     out.sort(key=lambda r: r.arrival)
     return out

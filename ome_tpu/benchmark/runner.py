@@ -101,6 +101,7 @@ def _one_request(api_base: str, model: str, n_in: int, n_out: int,
     t0 = time.monotonic()
     ttft = None
     tokens = 0
+    usage_tokens = None
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             for raw in resp:
@@ -118,11 +119,18 @@ def _one_request(api_base: str, model: str, n_in: int, n_out: int,
                         if choice.get("text") or choice.get(
                                 "delta", {}).get("content"):
                             tokens += 1
+                    usage = chunk.get("usage") or {}
+                    if "completion_tokens" in usage:
+                        usage_tokens = int(usage["completion_tokens"])
                 except ValueError:
                     pass
+        # prefer the server-reported count: delta counting undercounts
+        # when a token yields no complete codepoint (and merges when
+        # several tokens arrive in one flush)
         return RequestResult(ok=True, ttft_s=ttft,
                              e2e_s=time.monotonic() - t0,
-                             output_tokens=tokens)
+                             output_tokens=usage_tokens
+                             if usage_tokens is not None else tokens)
     except (urllib.error.URLError, OSError, TimeoutError) as e:
         return RequestResult(ok=False, e2e_s=time.monotonic() - t0,
                              error=str(e))

@@ -25,6 +25,22 @@ cannot:
   4. **/metrics stays consistent**: counters are monotone within one
      process incarnation, and draining gauges return to zero once the
      episode's drains complete.
+  5. **No admitted class starves** (multi-tenancy,
+     docs/multi-tenancy.md): every priority class with journaled
+     admits also finishes requests, and in a noisy-neighbor episode
+     the interactive class is never shed (429) — admission must shed
+     the lowest class first.
+  6. **Weighted shares hold**: over contended polls (two or more
+     classes active with at least one queued), every class with
+     QUEUED demand decodes at least a tolerance fraction of its
+     weighted-fair entitlement (read from
+     ``ome_engine_class_tokens_total``); classes that are merely
+     demand-limited are out of scope.
+
+Invariants 5 and 6 get their workload from the ``--noisy-neighbor``
+episode kind: a seeded best-effort (batch-class) flood of at least
+``--flood-factor``x the topology's slot capacity, steady interactive
+traffic throughout, and a mid-episode SIGKILL of a serving engine.
 
 Every schedule derives from ``random.Random(f"{seed}:{episode}")`` —
 a violation prints the seed, the exact schedule, and a one-command
@@ -63,6 +79,9 @@ import urllib.request
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from .priority import (DEFAULT_CLASS_WEIGHTS, PRIORITY_CLASSES,
+                       highest_class)
+
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 CATALOG_DOC = REPO_ROOT / "docs" / "failure-semantics.md"
 
@@ -74,6 +93,12 @@ ENGINE_FAULT_MENU = ("engine_step",)
 PD_FAULT_MENU = ("pd_peer_connect", "pd_fetch", "pd_deserialize",
                  "pd_insert")
 ROUTER_FAULT_MENU = ("router_forward",)
+
+# invariant 6 (weighted shares): a class's share of contended-window
+# tokens must stay above this fraction of its weighted entitlement;
+# the window itself must hold at least this many tokens to be judged
+SHARE_TOLERANCE = 0.35
+MIN_CONTENDED_TOKENS = 30.0
 
 
 class ChaosError(RuntimeError):
@@ -124,15 +149,17 @@ def free_port() -> int:
 
 
 def _http(url: str, payload: Optional[dict] = None,
-          timeout: float = 10.0) -> Tuple[int, object]:
+          timeout: float = 10.0,
+          headers: Optional[Dict[str, str]] = None
+          ) -> Tuple[int, object]:
     """GET (payload None) or POST json; returns (status, parsed body).
     Raises URLError/OSError on transport failure."""
     data = None
-    headers = {}
+    hdrs = dict(headers) if headers else {}
     if payload is not None:
         data = json.dumps(payload).encode()
-        headers["Content-Type"] = "application/json"
-    req = urllib.request.Request(url, data=data, headers=headers)
+        hdrs["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, headers=hdrs)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             raw = resp.read()
@@ -341,6 +368,92 @@ class MetricsWatch:
             self._stop.wait(self.interval)
 
 
+class ShareSampler:
+    """Background poller feeding invariant 6 (weighted shares).
+
+    Each poll reads the per-class token counters and queue-depth
+    gauges on every serving engine. A poll is CONTENDED on an engine
+    when at least two classes are active (queued, or decoded tokens
+    since the previous poll) and at least one of them is queued —
+    i.e. the weighted scheduler actually had an allocation decision to
+    make. Within a contended poll, only classes with QUEUED demand are
+    judged: a class that is not queueing is demand-limited, not
+    starved, and must not be held to its entitlement (the interactive
+    trickle often has exactly one in-flight request). For each queued
+    class the poll accumulates the tokens it actually decoded
+    (``got``) and its weight share of the poll's total token delta
+    (``entitled``); counter resets (restarts) re-base via the
+    (name, incarnation) key, same discipline as MetricsWatch."""
+
+    def __init__(self, procs: Sequence[ManagedProc],
+                 interval: float = 0.25):
+        self.procs = list(procs)
+        self.interval = interval
+        self.got: Dict[str, float] = {c: 0.0
+                                      for c in PRIORITY_CLASSES}
+        self.entitled: Dict[str, float] = {c: 0.0
+                                           for c in PRIORITY_CLASSES}
+        self.contended_polls = 0
+        self._last: Dict[Tuple[str, int], Dict[str, float]] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    @staticmethod
+    def _per_class(sample: Dict[str, float], family: str
+                   ) -> Dict[str, float]:
+        return {c: sample.get(f'{family}{{class="{c}"}}', 0.0)
+                for c in PRIORITY_CLASSES}
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def poll_once(self):
+        for p in self.procs:
+            inc = p.incarnation
+            if not p.alive():
+                continue
+            try:
+                sample = scrape_metrics(p.url, timeout=2.0)
+            except (ChaosError, urllib.error.URLError, OSError):
+                continue
+            if p.incarnation != inc or not p.alive():
+                continue
+            toks = self._per_class(sample,
+                                   "ome_engine_class_tokens_total")
+            depth = self._per_class(sample,
+                                    "ome_engine_class_queue_depth")
+            prev = self._last.get((p.name, inc))
+            self._last[(p.name, inc)] = toks
+            if prev is None:
+                continue
+            delta = {c: max(0.0, toks[c] - prev[c])
+                     for c in PRIORITY_CLASSES}
+            active = {c for c in PRIORITY_CLASSES
+                      if depth[c] > 0 or delta[c] > 0}
+            queued = {c for c in PRIORITY_CLASSES if depth[c] > 0}
+            if len(active) >= 2 and queued:
+                self.contended_polls += 1
+                total_delta = sum(delta.values())
+                if total_delta <= 0:
+                    continue
+                wsum = sum(DEFAULT_CLASS_WEIGHTS.get(c, 1)
+                           for c in active)
+                for c in queued:
+                    self.got[c] += delta[c]
+                    self.entitled[c] += total_delta * (
+                        DEFAULT_CLASS_WEIGHTS.get(c, 1) / wsum)
+
+    def _run(self):
+        while not self._stop.is_set():
+            self.poll_once()
+            self._stop.wait(self.interval)
+
+
 # -- journal inspection ----------------------------------------------
 
 
@@ -380,6 +493,8 @@ class ChaosRequest:
     top_k: int = 0
     top_p: float = 1.0
     delay: float = 0.0
+    # priority class (ome_tpu/priority.py); None = engine default
+    priority: Optional[str] = None
     # filled by the client thread:
     status: Optional[int] = None
     text: Optional[str] = None
@@ -387,9 +502,19 @@ class ChaosRequest:
     error: Optional[str] = None
 
     def payload(self) -> dict:
-        return {"prompt": self.prompt, "max_tokens": self.max_tokens,
-                "temperature": self.temperature, "top_k": self.top_k,
-                "top_p": self.top_p}
+        out = {"prompt": self.prompt, "max_tokens": self.max_tokens,
+               "temperature": self.temperature, "top_k": self.top_k,
+               "top_p": self.top_p}
+        if self.priority:
+            out["priority"] = self.priority
+        return out
+
+    def headers(self) -> Dict[str, str]:
+        # the header path is what the router forwards verbatim, so
+        # noisy-neighbor episodes exercise it alongside the payload
+        # field (the engine lets the header win)
+        return ({"X-OME-Priority": self.priority}
+                if self.priority else {})
 
 
 def requests_from_trace(path: pathlib.Path,
@@ -409,7 +534,8 @@ def requests_from_trace(path: pathlib.Path,
     return [ChaosRequest(prompt=r.prompt_text(prompt_seed),
                          max_tokens=r.max_tokens,
                          temperature=r.temperature,
-                         delay=r.arrival)
+                         delay=r.arrival,
+                         priority=r.priority)
             for r in tr]
 
 
@@ -430,6 +556,42 @@ def _gen_workload(rng: random.Random, n: int,
     return out
 
 
+def _gen_noisy_workload(rng: random.Random, topo: "Topology",
+                        spread: float,
+                        flood_factor: int) -> List[ChaosRequest]:
+    """Noisy-neighbor workload: a batch-class flood of at least
+    ``flood_factor``x the topology's concurrent-slot capacity lands in
+    the first 40% of the episode, while a steady trickle of
+    interactive requests spans the whole spread. Everything is greedy
+    so invariant 2 (byte-identity vs the oracle) still applies to the
+    tenant traffic under preemption and weighted scheduling."""
+    serving = max(1, topo.decode + topo.unified)
+    capacity = max(1, topo.max_slots) * serving
+    flood_n = max(flood_factor * capacity, 2 * flood_factor)
+    out = []
+    for _ in range(flood_n):
+        prompt = "".join(rng.choice("abcdefgh ") for _ in
+                         range(rng.randint(4, 12)))
+        out.append(ChaosRequest(
+            prompt=prompt,
+            max_tokens=rng.randint(8, 16),
+            temperature=0.0,
+            delay=rng.uniform(0.0, spread * 0.4),
+            priority="batch"))
+    n_interactive = max(4, capacity + 2)
+    for i in range(n_interactive):
+        prompt = "".join(rng.choice("abcdefgh ") for _ in
+                         range(rng.randint(3, 8)))
+        at = spread * (i + 0.5) / n_interactive
+        out.append(ChaosRequest(
+            prompt=prompt,
+            max_tokens=rng.randint(4, 8),
+            temperature=0.0,
+            delay=max(0.0, at + rng.uniform(-0.1, 0.1)),
+            priority=highest_class()))
+    return out
+
+
 def _drive(url: str, reqs: Sequence[ChaosRequest],
            timeout: float = 60.0) -> None:
     """Send every request against `url` on client threads, honoring
@@ -439,7 +601,7 @@ def _drive(url: str, reqs: Sequence[ChaosRequest],
         time.sleep(r.delay)
         try:
             status, body = _http(url + "/v1/completions", r.payload(),
-                                 timeout=timeout)
+                                 timeout=timeout, headers=r.headers())
             r.status = status
             if status == 200 and isinstance(body, dict):
                 choice = (body.get("choices") or [{}])[0]
@@ -485,6 +647,7 @@ class Episode:
     seed: int
     index: int
     topo: Topology
+    kind: str = "mixed"        # "mixed" | "noisy"
     requests: List[ChaosRequest] = field(default_factory=list)
     fault_specs: Dict[str, str] = field(default_factory=dict)
     events: List[Tuple[float, str, str]] = field(default_factory=list)
@@ -492,6 +655,7 @@ class Episode:
 
     def schedule(self) -> dict:
         return {"seed": self.seed, "episode": self.index,
+                "kind": self.kind,
                 "faults": self.fault_specs,
                 "events": [{"at": round(at, 3), "action": act,
                             "target": tgt}
@@ -499,32 +663,46 @@ class Episode:
                 "requests": len(self.requests)}
 
     def replay_command(self) -> str:
+        extra = " --noisy-neighbor" if self.kind == "noisy" else ""
         return (f"python scripts/chaos_soak.py --seed {self.seed} "
-                f"--episode {self.index}")
+                f"--episode {self.index}{extra}")
 
 
 def _plan_episode(seed: int, index: int, topo: Topology, n_requests: int,
                   spread: float,
-                  workload: Optional[Sequence[ChaosRequest]] = None
-                  ) -> Episode:
+                  workload: Optional[Sequence[ChaosRequest]] = None,
+                  kind: str = "mixed",
+                  flood_factor: int = 5) -> Episode:
     """Everything random in an episode comes from this ONE generator
     seeded by (seed, index) — the whole schedule replays from the two
     numbers a violation prints. A --trace workload substitutes the
     requests (fresh copies: episodes mutate outcome fields) but NOT
     the fault/kill schedule, which stays seed-derived."""
     rng = random.Random(f"{seed}:{index}")
-    ep = Episode(seed=seed, index=index, topo=topo)
+    ep = Episode(seed=seed, index=index, topo=topo, kind=kind)
     if workload is not None:
         ep.requests = [ChaosRequest(
             prompt=r.prompt, max_tokens=r.max_tokens,
             temperature=r.temperature, top_k=r.top_k, top_p=r.top_p,
-            delay=r.delay) for r in workload]
+            delay=r.delay, priority=r.priority) for r in workload]
+    elif kind == "noisy":
+        ep.requests = _gen_noisy_workload(rng, topo, spread,
+                                          flood_factor)
     else:
         ep.requests = _gen_workload(rng, n_requests, spread)
 
     decode_names = [f"decode{i}" for i in range(topo.decode)]
     unified_names = [f"unified{i}" for i in range(topo.unified)]
     prefill_names = [f"prefill{i}" for i in range(topo.prefill)]
+
+    if kind == "noisy":
+        # overload IS the chaos: no injected fault points, just one
+        # seeded mid-episode SIGKILL of a serving engine so the
+        # isolation invariants must survive kill-and-resume too
+        serving = decode_names + unified_names
+        ep.events.append((rng.uniform(0.35, 0.6) * spread, "sigkill",
+                          rng.choice(serving)))
+        return ep
 
     # fault-point schedules: at most one rule per serving proc so an
     # episode stays interpretable; hits land in the episode's early
@@ -739,6 +917,7 @@ class ChaosRunner:
         procs = prefills + serving + ([router] if router else [])
         by_name = {p.name: p for p in procs}
         watch = None
+        sampler = None
         try:
             for p in prefills + serving:
                 p.start(ep.fault_specs.get(p.name))
@@ -749,6 +928,8 @@ class ChaosRunner:
                 router.wait_ready()
 
             watch = MetricsWatch(procs).start()
+            if ep.kind == "noisy":
+                sampler = ShareSampler(serving).start()
             front = (router or serving[0]).url
 
             # workload client threads + the kill/term schedule run
@@ -783,10 +964,16 @@ class ChaosRunner:
                 victim.wait_ready()
 
             self._await_journal_drain(ep, journals, by_name)
+            if sampler is not None:
+                sampler.stop()
+                sampler.poll_once()
             self._check_journals(ep, journals)
+            self._check_class_starvation(ep, journals)
             self._check_greedy(ep)
             self._check_kv_conservation(ep, serving)
             self._check_draining_zero(ep, router)
+            if sampler is not None:
+                self._check_weighted_shares(ep, sampler)
             watch.stop()
             watch.poll_once()
             ep.violations.extend(watch.violations)
@@ -801,6 +988,8 @@ class ChaosRunner:
         finally:
             if watch is not None:
                 watch.stop()
+            if sampler is not None:
+                sampler.stop()
             for p in procs:
                 p.stop()
         return ep
@@ -915,6 +1104,81 @@ class ChaosRunner:
                     f"{len(live)} admitted request(s) never finished "
                     f"(jids {sorted(live)[:8]})")
 
+    def _check_class_starvation(self, ep: Episode,
+                                journals: Dict[str, pathlib.Path]
+                                ) -> None:
+        """Invariant 5: no admitted class starves. Per class, admits
+        across the topology's journals must be matched by finishes —
+        a class-wide zero means the weighted scheduler never ran that
+        class at all (individual stragglers are invariant 1's job).
+        In a noisy-neighbor episode, additionally: the interactive
+        class is never shed (429). Admission sheds the lowest class
+        first, and the episode's interactive demand is modest by
+        construction, so any interactive 429 is a shedding-order
+        violation."""
+        admits: Dict[str, int] = {}
+        fins: Dict[str, int] = {}
+        for path in journals.values():
+            if not path.exists():
+                continue
+            cls_of: Dict[int, str] = {}
+            for line in path.read_text(encoding="utf-8",
+                                       errors="replace").splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail
+                t, jid = rec.get("t"), rec.get("jid")
+                if t == "admit":
+                    cls = rec.get("cls", "standard")
+                    cls_of[jid] = cls
+                    admits[cls] = admits.get(cls, 0) + 1
+                elif t == "fin" and jid in cls_of:
+                    cls = cls_of[jid]
+                    fins[cls] = fins.get(cls, 0) + 1
+        for cls in sorted(admits):
+            if admits[cls] and not fins.get(cls):
+                ep.violations.append(
+                    f"class starvation: class {cls!r} admitted "
+                    f"{admits[cls]} request(s) but finished none")
+        if ep.kind != "noisy":
+            return
+        shed = [r for r in ep.requests
+                if r.priority == highest_class() and r.status == 429]
+        if shed:
+            ep.violations.append(
+                f"shedding-order violation: {len(shed)} interactive "
+                f"request(s) got 429 during a batch flood — admission "
+                f"must shed the lowest class first")
+
+    def _check_weighted_shares(self, ep: Episode,
+                               sampler: ShareSampler) -> None:
+        """Invariant 6: a class with QUEUED demand during contended
+        polls must decode at least SHARE_TOLERANCE of its weighted
+        entitlement over those polls. Judging only queued classes
+        keeps demand-limited traffic out of scope (an interactive
+        trickle with one in-flight request is not starved just
+        because batch fills the other slots), while a queued class
+        that the scheduler ignores sits near 0% and is caught. The
+        floor is loose on purpose: sampling is coarse (0.25s polls vs
+        per-step allocation) and slot granularity skews short
+        windows."""
+        for cls in PRIORITY_CLASSES:
+            entitled = sampler.entitled[cls]
+            if entitled < MIN_CONTENDED_TOKENS:
+                continue  # not enough queued demand to judge
+            got = sampler.got[cls]
+            if got < entitled * SHARE_TOLERANCE:
+                ep.violations.append(
+                    f"weighted-share violation: class {cls!r} "
+                    f"decoded {int(got)} tokens against a weighted "
+                    f"entitlement of {int(entitled)} while queued "
+                    f"(floor {SHARE_TOLERANCE:.0%}, "
+                    f"{sampler.contended_polls} contended polls)")
+
     def _check_greedy(self, ep: Episode) -> None:
         """Invariant 2: greedy completions match the fault-free
         oracle byte-for-byte. Only cleanly finished responses compare
@@ -988,7 +1252,8 @@ def run_soak(seed: int, episodes: Sequence[int], topo: Topology,
              keep_logs: bool = False,
              journal_drain_timeout: float = 90.0,
              force_violation: bool = False,
-             workload: Optional[Sequence[ChaosRequest]] = None) -> int:
+             workload: Optional[Sequence[ChaosRequest]] = None,
+             kind: str = "mixed", flood_factor: int = 5) -> int:
     from .telemetry import Registry
     registry = Registry()
     c_episodes = registry.counter("ome_chaos_episodes_total",
@@ -1005,8 +1270,9 @@ def run_soak(seed: int, episodes: Sequence[int], topo: Topology,
     try:
         for index in episodes:
             ep = _plan_episode(seed, index, topo, n_requests, spread,
-                               workload=workload)
-            print(f"[chaos] episode {index}: "
+                               workload=workload, kind=kind,
+                               flood_factor=flood_factor)
+            print(f"[chaos] episode {index} ({ep.kind}): "
                   f"{len(ep.requests)} requests, faults="
                   f"{ep.fault_specs or '{}'}, events="
                   f"{[(round(a, 2), b, c) for a, b, c in ep.events]}",
@@ -1099,6 +1365,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="append a synthetic violation to every "
                         "episode, exercising the replay bundle "
                         "(flight dumps + merged trace) end to end")
+    p.add_argument("--noisy-neighbor", action="store_true",
+                   help="noisy-neighbor episodes: a batch-class "
+                        "flood of --flood-factor x slot capacity "
+                        "plus steady interactive traffic and one "
+                        "mid-episode SIGKILL, checked against the "
+                        "multi-tenant isolation invariants (no "
+                        "admitted class starves, weighted shares "
+                        "hold, interactive never shed)")
+    p.add_argument("--flood-factor", type=int, default=5,
+                   help="noisy-neighbor flood size as a multiple of "
+                        "the topology's concurrent slot capacity")
     return p
 
 
@@ -1140,7 +1417,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                       keep_logs=args.keep_logs,
                       journal_drain_timeout=args.journal_drain_timeout,
                       force_violation=args.force_violation,
-                      workload=workload)
+                      workload=workload,
+                      kind="noisy" if args.noisy_neighbor else "mixed",
+                      flood_factor=args.flood_factor)
     finally:
         if cleanup:
             import shutil

@@ -534,6 +534,14 @@ class InferenceEngine:
         # draw keys; the counter bump must be atomic for distinct keys
         import threading
         self._rng_lock = threading.Lock()
+        # optional policy hook: the scheduler ranks preemption victims
+        # (priority class, quota overage); None = least progress only
+        self._preempt_rank_fn = None
+        # the slot whose block growth triggered the current preemption
+        # scan; excluded from victim candidates while alternatives
+        # exist (a near-pool-size batch request must not livelock as
+        # its own repeated victim)
+        self._growing_slot: Optional[int] = None
 
     def _next_key(self):
         with self._rng_lock:
@@ -596,14 +604,32 @@ class InferenceEngine:
         out, self._preempted = list(self._preempted), []
         return out
 
+    def set_preempt_rank(self, fn) -> None:
+        """Install a victim-ranking hook: fn(slot) -> sortable key,
+        lower = preempt first. The scheduler uses it to rank by
+        (quota overage, priority class); ties and the no-hook case
+        fall back to least progress (cheapest to re-prefill)."""
+        self._preempt_rank_fn = fn
+
     def _preempt_victim(self) -> bool:
-        """Free the blocks of the active sequence with the least
-        progress (cheapest to re-prefill); False when none remain."""
+        """Free the blocks of one active sequence to relieve pool
+        pressure; False when none remain. Victim order: the installed
+        rank hook first (class-aware), then least progress. The slot
+        whose growth started the scan (`_growing_slot`) is only
+        eligible when it is the sole candidate — otherwise a request
+        near pool size could repeatedly evict itself (livelock)."""
         cands = [b for b in range(self.max_slots)
                  if self._owned[b] and b not in self._preempted]
         if not cands:
             return False
-        victim = min(cands, key=lambda b: int(self._host_len[b]))
+        if (self._growing_slot in cands and len(cands) > 1):
+            cands = [b for b in cands if b != self._growing_slot]
+        rank = self._preempt_rank_fn
+        if rank is not None:
+            victim = min(cands, key=lambda b: (rank(b),
+                                               int(self._host_len[b])))
+        else:
+            victim = min(cands, key=lambda b: int(self._host_len[b]))
         self._preempted.append(victim)
         self.free_slot(victim)
         return True
@@ -621,9 +647,11 @@ class InferenceEngine:
                 continue
             j = w // self.kv_block
             if j >= len(self._owned[b]) and j < self.max_blocks:
+                self._growing_slot = b
                 while not self._free_blocks:
                     if not self._preempt_victim():
                         break
+                self._growing_slot = None
                 if not self._owned[b]:
                     continue  # b itself was the victim
                 if not self._free_blocks:
@@ -659,9 +687,11 @@ class InferenceEngine:
             need = min(-(-top // self.kv_block), self.max_blocks)
             while len(self._owned[b]) < need:
                 j = len(self._owned[b])
+                self._growing_slot = b
                 while not self._free_blocks:
                     if not self._preempt_victim():
                         break
+                self._growing_slot = None
                 if not self._owned[b]:
                     break  # b itself was the victim
                 if not self._free_blocks:

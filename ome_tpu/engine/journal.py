@@ -60,6 +60,9 @@ class JournalEntry:
     top_p: float = 1.0
     stop_ids: List[int] = field(default_factory=list)
     adapter: Optional[str] = None
+    # priority class (docs/multi-tenancy.md): restored on resume so a
+    # kill -9 cannot launder a batch request into a higher class
+    cls: str = "standard"
     # absolute EPOCH seconds (time.time clock): monotonic deadlines do
     # not survive a process restart, so the journal stores wall-clock
     # and the resume path converts back
@@ -235,6 +238,7 @@ class RequestJournal:
                     top_p=float(rec.get("top_p", 1.0)),
                     stop_ids=[int(t) for t in rec.get("stop", [])],
                     adapter=rec.get("adapter"),
+                    cls=rec.get("cls", "standard"),
                     deadline_epoch=rec.get("deadline"),
                     trace_id=rec.get("trace"),
                     output_ids=[int(t) for t in rec.get("toks", [])],
@@ -315,6 +319,7 @@ class RequestJournal:
                    "top_p": float(req.top_p),
                    "stop": [int(t) for t in req.stop_ids],
                    "adapter": req.adapter,
+                   "cls": getattr(req, "priority", "standard"),
                    "deadline": deadline_epoch,
                    "trace": getattr(req.trace, "trace_id", None)}
             if self.provenance is not None:

@@ -340,7 +340,7 @@ class RemotePrefillEngine:
     def prefill_blob(self, prompt_ids, temperature: float = 0.0,
                      top_k: int = 0, top_p: float = 1.0,
                      first_mask=None, adapter=None, deadline=None,
-                     trace=None) -> bytes:
+                     trace=None, priority=None) -> bytes:
         """The raw wire blob — multi-host leaders replicate it to
         followers verbatim (engine/multihost.py), so the whole decode
         group inserts bit-identical KV from ONE fetch. `first_mask`
@@ -366,8 +366,13 @@ class RemotePrefillEngine:
             "top_p": float(top_p),
             "first_mask": pack_mask(first_mask),
             "adapter": adapter,
+            "priority": priority,
         }).encode()
         headers = {"Content-Type": "application/json"}
+        if priority:
+            # the class rides the PD handoff too, so prefill-node
+            # logs/metrics attribute the work to the right tenant
+            headers["X-OME-Priority"] = str(priority)
         errors: List[str] = []
         tried: set = set()
         attempts = 0
@@ -512,11 +517,13 @@ class RemotePrefillEngine:
 
     def prefill(self, prompt_ids, temperature: float = 0.0,
                 top_k: int = 0, top_p: float = 1.0, first_mask=None,
-                adapter=None, deadline=None, trace=None):
+                adapter=None, deadline=None, trace=None,
+                priority=None):
         from .. import faults
         data = self.prefill_blob(prompt_ids, temperature, top_k, top_p,
                                  first_mask=first_mask, adapter=adapter,
-                                 deadline=deadline, trace=trace)
+                                 deadline=deadline, trace=trace,
+                                 priority=priority)
         # a corrupt/truncated blob fails this one request, exactly
         # like the fetch it came from
         faults.fire("pd_deserialize", key=self._last_peer, exc=PDError)

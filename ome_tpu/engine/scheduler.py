@@ -44,6 +44,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import faults
+from ..priority import CLASS_LEVEL, DEFAULT_PRIORITY, PRIORITY_CLASSES
+from ..priority import class_wait_caps as _wait_caps_table
+from ..priority import class_weights as _weights_table
 from ..telemetry import Registry
 from ..telemetry.flight import FlightRecorder
 from ..telemetry.tracing import Span, SpanContext, coerce_span_log, \
@@ -93,6 +96,136 @@ class _SpecStep:
         self.draft_len = draft_len
 
 
+# WDRR quantum: deficit credit per class visit is weight x this many
+# tokens — large enough that one visit usually covers a typical head
+# request in one accumulation, small enough that a giant
+# max_new_tokens request cannot monopolize a rotation
+QUANTUM_TOKENS = 64
+
+
+class ClassQueues:
+    """Per-priority-class pending queues with a weighted deficit
+    round-robin pick order (Shreedhar & Varghese DRR), presenting the
+    queue.Queue surface the scheduler and its callers already use:
+    `maxsize` (per-class bound), `qsize()`, `empty()`, `put_nowait()`
+    raising queue.Full, `get(timeout)`/`get_nowait()` raising
+    queue.Empty, and a flat `.queue` snapshot view.
+
+    Each pick visits classes in a fixed rotation; a visit credits the
+    class's deficit counter with weight x QUANTUM_TOKENS and the head
+    request is served once the deficit covers its cost (its
+    max_new_tokens budget), staying on the class while credit lasts
+    so a large deficit serves a burst before the rotation moves on. A
+    class that empties forfeits its banked deficit, so an idle class
+    cannot hoard credit and later burst past its share. With a single
+    class enqueued — or with ``enabled=False`` — every pick
+    degenerates to plain FIFO, which keeps single-class streams
+    byte-identical to the pre-priority scheduler."""
+
+    def __init__(self, maxsize: int, weights=None,
+                 enabled: bool = True):
+        self.maxsize = maxsize
+        self.enabled = bool(enabled)
+        self.weights = _weights_table(weights)
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._q: Dict[str, "collections.deque[Request]"] = {
+            c: collections.deque() for c in PRIORITY_CLASSES}
+        self._deficit = {c: 0.0 for c in PRIORITY_CLASSES}
+        self._cursor = 0
+        # True when the cursor has just ARRIVED at a class: the DRR
+        # quantum is credited once per arrival, not once per pick —
+        # crediting per pick would let the cursor's class refill
+        # forever and serve to empty, which is strict priority, not
+        # weighted sharing
+        self._fresh = True
+
+    def _cls(self, req) -> str:
+        if not self.enabled:
+            return DEFAULT_PRIORITY
+        cls = getattr(req, "priority", DEFAULT_PRIORITY)
+        return cls if cls in self._q else DEFAULT_PRIORITY
+
+    def qsize(self, cls: Optional[str] = None) -> int:
+        with self._lock:
+            if cls is not None:
+                return len(self._q.get(cls, ()))
+            return sum(len(d) for d in self._q.values())
+
+    def depths(self) -> Dict[str, int]:
+        with self._lock:
+            return {c: len(d) for c, d in self._q.items()}
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    @property
+    def queue(self) -> List["Request"]:
+        """Flat snapshot (highest class first, FIFO within class) —
+        the `pending.queue` view debug surfaces and tests read."""
+        with self._lock:
+            out: List[Request] = []
+            for c in PRIORITY_CLASSES:
+                out.extend(self._q[c])
+            return out
+
+    def put_nowait(self, req: "Request") -> None:
+        with self._lock:
+            dq = self._q[self._cls(req)]
+            if self.maxsize and len(dq) >= self.maxsize:
+                raise queue.Full
+            dq.append(req)
+            self._not_empty.notify()
+
+    def _pick_locked(self) -> Optional["Request"]:
+        if all(not d for d in self._q.values()):
+            return None
+        n = len(PRIORITY_CLASSES)
+        while True:
+            cls = PRIORITY_CLASSES[self._cursor % n]
+            dq = self._q[cls]
+            if not dq:
+                # an empty class forfeits banked credit (classic DRR)
+                self._deficit[cls] = 0.0
+                self._cursor += 1
+                self._fresh = True
+                continue
+            cost = max(int(dq[0].max_new_tokens), 1)
+            if self._fresh:
+                self._deficit[cls] += (self.weights[cls]
+                                       * QUANTUM_TOKENS)
+                self._fresh = False
+            if self._deficit[cls] >= cost:
+                self._deficit[cls] -= cost
+                return dq.popleft()
+            # credit exhausted (or one quantum is still short of an
+            # oversized head request — it accumulates across rounds):
+            # move to the next class
+            self._cursor += 1
+            self._fresh = True
+
+    def get_nowait(self) -> "Request":
+        with self._lock:
+            req = self._pick_locked()
+        if req is None:
+            raise queue.Empty
+        return req
+
+    def get(self, timeout: Optional[float] = None) -> "Request":
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._not_empty:
+            while True:
+                req = self._pick_locked()
+                if req is not None:
+                    return req
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise queue.Empty
+                self._not_empty.wait(remaining)
+
+
 class SchedulerOverloaded(RuntimeError):
     """The pending queue would exceed a bounded wait; the client
     should back off for `retry_after` seconds (HTTP 429/Retry-After
@@ -126,6 +259,10 @@ class Request:
     masker: Optional[object] = None
     # multi-LoRA: adapter name (engine register_adapter); None = base
     adapter: Optional[str] = None
+    # multi-tenant priority class (docs/multi-tenancy.md): drives the
+    # WDRR pick order, per-class admission caps, and preemption
+    # victim ranking; journaled so kill-resume restores it
+    priority: str = DEFAULT_PRIORITY
     # absolute time.monotonic() deadline; an expired request is shed
     # at admission (never occupies a slot) or finished mid-decode
     # with finish_reason="timeout"
@@ -207,7 +344,10 @@ class Scheduler:
                  span_log=None,
                  flight: Optional[FlightRecorder] = None,
                  flight_dump_dir: Optional[str] = None,
-                 span_chunk_steps: int = 8):
+                 span_chunk_steps: int = 8,
+                 class_weights=None,
+                 class_wait_caps=None,
+                 priority_scheduling: bool = True):
         self.engine = engine
         # span timeline (docs/tracing-timeline.md): per-phase spans
         # (queue, prefill, chunked decode, spec verify, journal
@@ -279,8 +419,27 @@ class Scheduler:
         # admission control: reject (429) when the estimated queue
         # wait exceeds this many seconds
         self.max_queue_wait = max_queue_wait
+        # multi-tenant priority scheduling (docs/multi-tenancy.md):
+        # per-class WDRR queues, per-class queue-wait caps (standard
+        # keeps exactly the global cap so single-class behavior is
+        # unchanged), and class-aware preemption ranking. Disabled =
+        # every request rides the standard FIFO, the pre-priority
+        # scheduler bit for bit.
+        self.priority_scheduling = bool(priority_scheduling)
+        self.class_weights = _weights_table(class_weights)
+        self.class_wait_caps = _wait_caps_table(max_queue_wait,
+                                               class_wait_caps)
         self.state: DecodeState = engine.new_state()
-        self.pending: "queue.Queue[Request]" = queue.Queue(max_pending)
+        self.pending: "ClassQueues" = ClassQueues(
+            max_pending, weights=self.class_weights,
+            enabled=self.priority_scheduling)
+        # class-aware KV-pressure preemption: the engine picks
+        # victims through this rank hook (over-quota classes first,
+        # then lowest class; the engine's own least-progress
+        # tie-break preserves the single-class victim choice)
+        setr = getattr(engine, "set_preempt_rank", None)
+        if callable(setr):
+            setr(self._preempt_rank)
         self.slots: List[Optional[Request]] = [None] * engine.max_slots
         B = engine.max_slots
         self.overlap = overlap
@@ -436,6 +595,41 @@ class Scheduler:
         self._c_flight_dumps = R.counter(
             "ome_engine_flight_dumps_total",
             "Flight-recorder dumps written on crash recovery")
+        # per-class observability (docs/multi-tenancy.md): children
+        # are pre-created for the fixed class enum ONLY, so label
+        # cardinality is bounded by construction (the
+        # metrics-label-cardinality lint enforces this pattern)
+        def _by_class(fam):
+            return {c: fam.labels(**{"class": c})
+                    for c in PRIORITY_CLASSES}
+        self._c_class_requests = _by_class(R.counter(
+            "ome_engine_class_requests_total",
+            "Requests submitted, by priority class",
+            labelnames=("class",)))
+        self._c_class_rejected = _by_class(R.counter(
+            "ome_engine_class_rejected_total",
+            "Admission rejections (429), by priority class",
+            labelnames=("class",)))
+        self._c_class_preempt = _by_class(R.counter(
+            "ome_engine_class_preemptions_total",
+            "KV-pressure preemptions, by priority class",
+            labelnames=("class",)))
+        self._c_class_tokens = _by_class(R.counter(
+            "ome_engine_class_tokens_total",
+            "Decode tokens emitted, by priority class",
+            labelnames=("class",)))
+        self._h_class_queue_wait = _by_class(R.histogram(
+            "ome_engine_class_queue_wait_seconds",
+            "Seconds between admission and first decode slot, by "
+            "priority class", labelnames=("class",)))
+        self._h_class_ttft = _by_class(R.histogram(
+            "ome_engine_class_ttft_seconds",
+            "Time to first token by priority class",
+            labelnames=("class",)))
+        self._g_class_depth = _by_class(R.gauge(
+            "ome_engine_class_queue_depth",
+            "Pending-queue depth by priority class",
+            labelnames=("class",)))
         self._journal_compactions_seen = (
             self.journal.compactions if self.journal is not None else 0)
 
@@ -465,6 +659,40 @@ class Scheduler:
         with self._lock:
             self._inc_locked(key, by)
 
+    def _class_of(self, req: Request) -> str:
+        """The request's priority class, coerced onto the fixed enum
+        (per-class metric children and caps exist only for it)."""
+        cls = getattr(req, "priority", DEFAULT_PRIORITY)
+        return cls if cls in self._c_class_requests else \
+            DEFAULT_PRIORITY
+
+    def _preempt_rank(self, slot: int):
+        """Victim-ranking hook installed on the engine (lower sorts
+        first): over-quota classes — holding more decode slots than
+        their weight share of the active batch — are preempted before
+        in-quota ones, then lowest class first. The engine breaks the
+        remaining tie by least progress, which preserves the
+        pre-priority victim choice for single-class batches. Runs on
+        the scheduler thread (inside the decode dispatch that grows
+        KV blocks), so reading self.slots needs no lock."""
+        if not self.priority_scheduling:
+            return (1, 1)
+        req = self.slots[slot] if 0 <= slot < len(self.slots) else None
+        if req is None:
+            return (1, len(PRIORITY_CLASSES))
+        cls = self._class_of(req)
+        counts: Dict[str, int] = {}
+        for r in self.slots:
+            if r is not None:
+                c = self._class_of(r)
+                counts[c] = counts.get(c, 0) + 1
+        total = sum(counts.values())
+        wsum = sum(self.class_weights[c] for c in counts)
+        fair = (total * self.class_weights[cls] / wsum) if wsum \
+            else float(total)
+        over = counts.get(cls, 0) > fair + 1e-9
+        return (0 if over else 1, CLASS_LEVEL.get(cls, 1))
+
     def _observe_finish(self, req: Request):
         """One-shot per-request latency observations, installed as
         req.on_finish at submit. Runs on whatever thread called
@@ -474,6 +702,8 @@ class Scheduler:
         self._h_e2e.observe(end - req.created)
         if req.first_token_at is not None:
             self._h_ttft.observe(req.first_token_at - req.created)
+            self._h_class_ttft[self._class_of(req)].observe(
+                req.first_token_at - req.created)
             n = len(req.output_ids)
             if n > 1:
                 self._h_tpot.observe(
@@ -508,6 +738,8 @@ class Scheduler:
         if req.scheduled_at is None:
             req.scheduled_at = time.monotonic()
             self._h_queue_wait.observe(req.scheduled_at - req.created)
+            self._h_class_queue_wait[self._class_of(req)].observe(
+                req.scheduled_at - req.created)
             span = getattr(req, "_span", None)
             if span is not None and self.span_log.enabled:
                 now_wall = time.time()
@@ -624,7 +856,8 @@ class Scheduler:
                      "journal_id": req.journal_id,
                      "prompt_tokens": len(req.prompt_ids),
                      "committed_tokens": len(req.output_ids),
-                     "adapter": req.adapter}
+                     "adapter": req.adapter,
+                     "class": req.priority}
             if owned is not None:
                 try:
                     entry["kv_blocks_owned"] = len(owned[slot])
@@ -635,6 +868,8 @@ class Scheduler:
             "status": self._status,
             "draining": self._draining,
             "queue_depth": self.pending.qsize(),
+            "queue_depths": self.pending.depths(),
+            "priority_scheduling": self.priority_scheduling,
             "requeued": len(self._requeue),
             "ready": self._ready.qsize(),
             "inflight_steps": len(self._inflight),
@@ -659,6 +894,8 @@ class Scheduler:
         """Refresh point-in-time gauges (called by /metrics scrapes
         and after each step; counters stream in continuously)."""
         self._g_queue_depth.set(self.pending.qsize())
+        for cls, depth in self.pending.depths().items():
+            self._g_class_depth[cls].set(depth)
         active = sum(r is not None for r in self.slots)
         self._g_active.set(active)
         self._g_occupancy.set(active / max(self.engine.max_slots, 1))
@@ -719,6 +956,32 @@ class Scheduler:
         waves = math.ceil(depth / self.engine.max_slots)
         return waves * self._ewma_req_steps * self._ewma_step_s
 
+    def _class_wait_estimate(self, cls: str,
+                             depth: int) -> Optional[float]:
+        """Per-class queue-wait estimate: the class's own backlog
+        drains at roughly its weight share of the active classes'
+        total weight, so the plain estimate is scaled up by the
+        inverse share. With one active class the factor is 1 — the
+        global estimate exactly, which keeps single-class admission
+        identical with priority scheduling on or off."""
+        base = self._queue_wait_estimate(depth)
+        if base is None or not self.priority_scheduling:
+            return base
+        w = self.class_weights
+        active = {c for c in PRIORITY_CLASSES
+                  if self.pending.qsize(c) > 0}
+        active.add(cls)
+        share = sum(w[c] for c in active)
+        return base * (share / w[cls]) if share else base
+
+    def retry_after_hint(self, default: float = 1.0) -> int:
+        """Seconds a rejected/bounced client should back off, from
+        the live queue-wait estimate, clamped to [1, 30] — the
+        server's Retry-After header for its 429/503 paths."""
+        est = self._queue_wait_estimate(self.pending.qsize() + 1)
+        val = est if est is not None else default
+        return int(min(max(math.ceil(val), 1), 30))
+
     def submit(self, req: Request) -> Request:
         # the lock makes submit-vs-stop atomic: a request either gets
         # queued before the shutdown drain, or is rejected here
@@ -736,17 +999,31 @@ class Scheduler:
                 self._inc_locked("timeouts_total")
                 req.finish("timeout")
                 return req
-            depth = self.pending.qsize()
-            est = self._queue_wait_estimate(depth + 1)
+            cls = self._class_of(req)
+            self._c_class_requests[cls].inc()
+            # per-class admission control: a class sheds on ITS OWN
+            # queue depth and wait cap, so a batch flood 429s batch
+            # traffic (its estimate grows with backlog and shrinks
+            # with weight) long before interactive admission feels it
+            # — shedding hits the lowest class first by construction
+            if self.priority_scheduling:
+                depth = self.pending.qsize(cls)
+                cap = self.class_wait_caps.get(cls,
+                                               self.max_queue_wait)
+            else:
+                depth = self.pending.qsize()
+                cap = self.max_queue_wait
+            est = self._class_wait_estimate(cls, depth + 1)
             if depth >= self.pending.maxsize or \
-                    (est is not None and est > self.max_queue_wait):
+                    (est is not None and est > cap):
                 self._inc_locked("rejected_total")
+                self._c_class_rejected[cls].inc()
                 retry = min(max(est if est is not None else 1.0, 0.5),
                             30.0)
                 raise SchedulerOverloaded(
-                    f"pending queue saturated (depth {depth}, "
+                    f"{cls} queue saturated (depth {depth}, "
                     f"estimated wait {est if est is not None else '?'}"
-                    "s)", retry_after=retry)
+                    f"s, cap {cap:g}s)", retry_after=retry)
             if self.span_log.enabled:
                 # the engine-side request span: parented under the span
                 # id the router forwarded in `traceparent` (so the
@@ -785,11 +1062,12 @@ class Scheduler:
                     self.pending.put_nowait(req)
                 except queue.Full:
                     self._inc_locked("rejected_total")
+                    self._c_class_rejected[cls].inc()
                     reject = ("rejected", SchedulerOverloaded(
-                        "pending queue full", retry_after=1.0))
+                        f"{cls} pending queue full", retry_after=1.0))
                 else:
                     self._flight_event("admit", request=req.id,
-                                       depth=depth + 1)
+                                       cls=cls, depth=depth + 1)
         if reject is not None:
             # tombstone OUTSIDE the lock too — it appends + fsyncs
             self._journal_tombstone(req, journal_it, reject[0])
@@ -903,6 +1181,7 @@ class Scheduler:
                 temperature=e.temperature, top_k=e.top_k,
                 top_p=e.top_p, stop_ids=list(e.stop_ids),
                 adapter=e.adapter, deadline=deadline,
+                priority=getattr(e, "cls", DEFAULT_PRIORITY),
                 journal_id=e.jid,
                 output_ids=list(e.output_ids))
             if len(req.output_ids) >= req.max_new_tokens:
@@ -1296,6 +1575,7 @@ class Scheduler:
                 tok = int(host_toks[slot])
                 req.emit(tok)
                 self._inc("tokens_generated_total")
+                self._c_class_tokens[self._class_of(req)].inc()
                 self._note_decode_progress(req)
                 self._maybe_finish(slot, tok)
             self._ph_sample.observe(time.monotonic() - t_fetched)
@@ -1350,6 +1630,7 @@ class Scheduler:
             for tok in host_out[slot, :n]:
                 req.emit(int(tok))
                 self._inc("tokens_generated_total")
+                self._c_class_tokens[self._class_of(req)].inc()
                 self._maybe_finish(slot, int(tok))
                 if self.slots[slot] is not req:
                     break  # finished mid-prefix: drop the tail
@@ -1476,6 +1757,7 @@ class Scheduler:
                                - int(self._base_out[slot]))
             self._requeue.appendleft(req)
             self._inc("preemptions_total")
+            self._c_class_preempt[self._class_of(req)].inc()
             if self.overlap:
                 self._free_slots.release()
         if depth == 0:
@@ -1576,8 +1858,10 @@ class Scheduler:
         if getattr(self.engine, "pd_request_context", False):
             # PD decode nodes cap each remote-fetch attempt at the
             # request's own deadline and stamp its traceparent on the
-            # wire (engine/pd.py)
+            # wire (engine/pd.py); the priority class rides along so
+            # prefill-pool logs attribute work to the right tenant
             kw["deadline"] = req.deadline
+            kw["priority"] = req.priority
             trace = req.trace
             if span is not None:
                 # hand PD the PREFILL span as the context, so its

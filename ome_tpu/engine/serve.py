@@ -118,6 +118,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="reject new requests (429 + Retry-After) when "
                         "the estimated pending-queue wait exceeds "
                         "this many seconds")
+    p.add_argument("--class-weights", default=None, metavar="SPEC",
+                   help="weighted-fair scheduling weights per priority "
+                        "class (docs/multi-tenancy.md), e.g. "
+                        "'interactive=8,standard=4,batch=1'; partial "
+                        "specs keep defaults, and every class keeps "
+                        "weight >= 1 so none can be starved by config")
+    p.add_argument("--class-wait-cap", action="append", default=None,
+                   metavar="CLASS=SECONDS",
+                   help="per-class queue-wait admission cap in seconds "
+                        "(repeatable); defaults derive from "
+                        "--max-queue-wait (interactive 0.25x, "
+                        "standard 1x, batch 4x) so a batch flood "
+                        "sheds batch traffic first")
+    p.add_argument("--no-priority-scheduling", action="store_true",
+                   help="disable per-class queues, weighted-fair slot "
+                        "allocation and class-ranked preemption: all "
+                        "requests schedule FIFO as one class (classes "
+                        "are still parsed and recorded in logs)")
     p.add_argument("--pipeline-depth", type=int, default=1,
                    help="decode steps dispatched ahead of token "
                         "emission: 1 overlaps the host-side token "
@@ -514,6 +532,26 @@ def main(argv=None) -> int:
                   "(incompatible with --random-weights); name=dir "
                   "multi-LoRA slots work with either")
         return 2
+    # parse the multi-tenancy flags up front so a bad spec fails fast
+    # instead of after a multi-minute checkpoint load
+    from ..priority import coerce_priority, parse_weight_spec
+    class_weights = None
+    class_wait_caps = None
+    try:
+        if args.class_weights:
+            class_weights = parse_weight_spec(args.class_weights)
+        if args.class_wait_cap:
+            class_wait_caps = {}
+            for spec in args.class_wait_cap:
+                cls, sep, secs = spec.partition("=")
+                if not sep:
+                    raise ValueError(
+                        f"bad --class-wait-cap {spec!r} "
+                        "(expected class=seconds)")
+                class_wait_caps[coerce_priority(cls)] = float(secs)
+    except ValueError as e:
+        log.error("%s", e)
+        return 2
 
     # join the cross-host rendezvous FIRST (before any jax call) when
     # the operator injected the LWS contract env (multinode.py:53-58)
@@ -642,7 +680,11 @@ def main(argv=None) -> int:
                               journal=journal,
                               span_log=span_log,
                               flight=flight,
-                              flight_dump_dir=args.flight_dump_dir)
+                              flight_dump_dir=args.flight_dump_dir,
+                              class_weights=class_weights,
+                              class_wait_caps=class_wait_caps,
+                              priority_scheduling=not
+                              args.no_priority_scheduling)
     tok = load_tokenizer(args.model_dir)
     name = args.model_name or args.model_dir.rstrip("/").rsplit("/", 1)[-1]
     server = EngineServer(scheduler, tokenizer=tok, model_name=name,

@@ -11,7 +11,9 @@ by the single scheduler thread.
 
 from __future__ import annotations
 
+import codecs
 import json
+import math
 import threading
 import time
 import urllib.parse
@@ -19,6 +21,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from .. import faults
+from ..priority import coerce_priority
 from ..telemetry import Registry, tracing
 from ..telemetry import profiler as _profiler
 from ..telemetry.reqlog import coerce as _coerce_reqlog
@@ -41,6 +44,17 @@ def _path_label(path: str) -> str:
     if base.startswith("/v1/adapters/"):
         return "/v1/adapters"
     return base if base in _KNOWN_PATHS else "other"
+
+
+def _retry_after_str(seconds) -> str:
+    """Clamp a retry hint onto the [1, 30]s Retry-After contract:
+    long enough that a retry can succeed, short enough that clients
+    do not park for minutes on a transient spike."""
+    try:
+        val = math.ceil(float(seconds))
+    except (TypeError, ValueError):
+        val = 1
+    return str(int(min(max(val, 1), 30)))
 
 
 class EngineServer:
@@ -257,7 +271,7 @@ class EngineServer:
                         "error": "replica draining (shutting down); "
                                  "retry another backend",
                         "draining": True},
-                        headers={"Retry-After": "2",
+                        headers={"Retry-After": outer._retry_after(2.0),
                                  "X-OME-Draining": "1"})
                 if self.path.split("?", 1)[0] == "/debug/profile":
                     return self._profile()
@@ -469,7 +483,18 @@ class EngineServer:
                     return self._json(400, {
                         "error": "timeout / X-Request-Deadline must "
                                  "be numeric seconds"})
+                # priority class (docs/multi-tenancy.md): the
+                # X-OME-Priority header (router-propagated) wins over
+                # the payload field; an unknown value is a 400, never
+                # a silent reclassification into another tenant class
+                try:
+                    pri = coerce_priority(
+                        self.headers.get("X-OME-Priority")
+                        or payload.get("priority"))
+                except ValueError as e:
+                    return self._json(400, {"error": str(e)})
                 req = Request(
+                    priority=pri,
                     prompt_ids=prompt if isinstance(prompt, list)
                     else tok.encode(prompt),
                     max_new_tokens=int(payload.get("max_tokens", 64)),
@@ -484,25 +509,30 @@ class EngineServer:
                 try:
                     outer.scheduler.submit(req)
                 except SchedulerOverloaded as e:
-                    # bounded-wait admission control: tell the client
-                    # (or the router's retry budget) when to come back
+                    # bounded-wait admission control: the hint is the
+                    # scheduler's estimated queue wait for this class,
+                    # so the client (or the router's retry budget)
+                    # comes back when there is actually room
                     outer._log_request(req, outcome="rejected")
                     return self._json(429, {"error": str(e)},
-                                      headers={"Retry-After": str(
-                                          int(e.retry_after) or 1)})
+                                      headers={"Retry-After":
+                                          _retry_after_str(
+                                              e.retry_after)})
                 except SchedulerDraining as e:
                     # drain began between the do_POST gate and this
                     # submit: same 503 + draining marker
                     outer._log_request(req, outcome="rejected")
                     return self._json(503, {"error": str(e),
                                             "draining": True},
-                                      headers={"Retry-After": str(
-                                          int(e.retry_after) or 1),
+                                      headers={"Retry-After":
+                                          _retry_after_str(
+                                              e.retry_after),
                                           "X-OME-Draining": "1"})
                 except Exception as e:
                     outer._log_request(req, outcome="rejected")
                     return self._json(503, {"error": str(e)},
-                                      headers={"Retry-After": "1"})
+                                      headers={"Retry-After":
+                                          outer._retry_after()})
                 if payload.get("stream"):
                     try:
                         return self._stream(req, chat)
@@ -568,11 +598,34 @@ class EngineServer:
 
                 emitted = 0
                 sent_text = ""
+                # byte-exact streaming for byte-level tokenizers: feed
+                # ONLY the new bytes of each token through an
+                # incremental UTF-8 decoder (final=False), so a
+                # codepoint split across tokens stays buffered in the
+                # decoder until its last byte arrives — it is never
+                # flushed as U+FFFD and re-sent. A tail left
+                # incomplete at EOS is dropped cleanly (it never
+                # formed a character). Tokenizers without a raw byte
+                # view (HF) keep the rstrip heuristic below.
+                decode_bytes = getattr(tok, "decode_bytes", None)
+                if decode_bytes is not None:
+                    dec = codecs.getincrementaldecoder("utf-8")(
+                        "replace")
+                    sent_bytes = 0
                 while True:
                     t = req.stream.get()
                     last = t is None
                     if not last:
                         emitted += 1
+                    if decode_bytes is not None:
+                        data = decode_bytes(req.output_ids[:emitted])
+                        delta = dec.decode(data[sent_bytes:], False)
+                        sent_bytes = len(data)
+                        if delta:
+                            send_delta(delta)
+                        if last:
+                            break
+                        continue
                     full = tok.decode(req.output_ids[:emitted])
                     if last:
                         stable = full  # flush everything at EOS
@@ -588,10 +641,19 @@ class EngineServer:
                         send_delta(delta)
                     if last:
                         break
+                # the terminal event carries usage (OpenAI
+                # include_usage shape) so clients can count output
+                # tokens authoritatively — text deltas undercount
+                # when a token contributes no complete codepoint
                 done = {"id": f"cmpl-{req.id}", "choices": [{
                     "index": 0,
                     "delta" if chat else "text": {} if chat else "",
-                    "finish_reason": req.finish_reason}]}
+                    "finish_reason": req.finish_reason}],
+                    "usage": {
+                        "prompt_tokens": len(req.prompt_ids),
+                        "completion_tokens": len(req.output_ids),
+                        "total_tokens": len(req.prompt_ids)
+                        + len(req.output_ids)}}
                 chunk(f"data: {json.dumps(done)}\n\n".encode())
                 chunk(b"data: [DONE]\n\n")
                 chunk(b"")  # terminal chunk
@@ -603,6 +665,19 @@ class EngineServer:
     def _adapter_names(self):
         eng = getattr(self.scheduler, "engine", None)
         return list(getattr(eng, "adapter_names", []) or [])
+
+    def _retry_after(self, default: float = 1.0) -> str:
+        """Retry-After derived from the scheduler's live queue-wait
+        estimate (clamped to [1, 30]s) rather than a hardcoded guess —
+        a saturated queue tells clients to back off for as long as it
+        will actually take to drain."""
+        hint = getattr(self.scheduler, "retry_after_hint", None)
+        if callable(hint):
+            try:
+                return str(hint(default))
+            except Exception:
+                pass
+        return _retry_after_str(default)
 
     def _log_request(self, req: Request, outcome: Optional[str] = None):
         """One JSONL record per finished (or rejected) request — the
@@ -621,10 +696,12 @@ class EngineServer:
         tpot = None
         if req.first_token_at is not None and n > 1:
             tpot = round((end - req.first_token_at) / (n - 1), 6)
-        # schema v2 (docs/autoscaling.md): the ADMIT instant on both
-        # clocks — req.created is monotonic, so the wall-clock half is
-        # recovered by rebasing against now. Trace replay reconstructs
-        # inter-arrival gaps from these instead of finish times.
+        # schema v3 (docs/autoscaling.md): v2 plus the priority class,
+        # so per-class SLO replay does not have to re-derive tenancy.
+        # The ADMIT instant is on both clocks — req.created is
+        # monotonic, so the wall-clock half is recovered by rebasing
+        # against now. Trace replay reconstructs inter-arrival gaps
+        # from these instead of finish times.
         now_mono = time.monotonic()
         self.request_log.write({
             "component": "engine",
@@ -636,6 +713,7 @@ class EngineServer:
             "admit_mono": round(req.created, 6),
             "model": self.model_name,
             "adapter": req.adapter,
+            "class": req.priority,
             "queue_wait_s": _delta(req.created, req.scheduled_at),
             "ttft_s": _delta(req.created, req.first_token_at),
             "tpot_s": tpot,

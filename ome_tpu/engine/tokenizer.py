@@ -31,6 +31,14 @@ class ByteTokenizer:
                      if _BYTE_OFFSET <= i < _BYTE_OFFSET + 256)
         return data.decode("utf-8", errors="replace")
 
+    def decode_bytes(self, ids: Sequence[int]) -> bytes:
+        """Raw byte view (no str decode): the streaming path feeds
+        these through an incremental UTF-8 decoder so a chunk ending
+        mid-codepoint holds its tail bytes instead of flushing
+        U+FFFD (server._stream)."""
+        return bytes(i - _BYTE_OFFSET for i in ids
+                     if _BYTE_OFFSET <= i < _BYTE_OFFSET + 256)
+
     def apply_chat_template(self, messages: List[dict]) -> str:
         parts = [f"{m.get('role', 'user')}: {m.get('content', '')}"
                  for m in messages]

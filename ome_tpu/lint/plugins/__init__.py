@@ -9,6 +9,7 @@ here.
 
 from .catalog_drift import FaultCatalogRule, MetricsNamingRule
 from .hot_path_sync import HotPathSyncRule
+from .label_cardinality import MetricsLabelCardinalityRule
 from .lock_discipline import LockDisciplineRule
 from .thread_shared_state import ThreadSharedStateRule
 
@@ -18,6 +19,7 @@ ALL_RULES = (
     ThreadSharedStateRule,
     FaultCatalogRule,
     MetricsNamingRule,
+    MetricsLabelCardinalityRule,
 )
 
 
@@ -36,4 +38,4 @@ def make_rule(name: str):
 __all__ = ["ALL_RULES", "rule_names", "make_rule",
            "HotPathSyncRule", "LockDisciplineRule",
            "ThreadSharedStateRule", "FaultCatalogRule",
-           "MetricsNamingRule"]
+           "MetricsNamingRule", "MetricsLabelCardinalityRule"]

@@ -51,6 +51,7 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
+from ..priority import DEFAULT_PRIORITY, PRIORITY_CLASSES, coerce_priority
 from ..telemetry import Registry, tracing
 from ..telemetry.reqlog import coerce as _coerce_reqlog
 
@@ -512,6 +513,15 @@ class RouterServer:
         self._h_request = router.registry.histogram(
             "ome_router_request_seconds",
             "End-to-end proxied request seconds (retries included)")
+        # per-class accounting at the front door: children are
+        # pre-created from the fixed class enum so a hostile header
+        # can never mint new label values (cardinality stays bounded)
+        _fam_class = router.registry.counter(
+            "ome_router_class_requests_total",
+            "Completion requests proxied, by priority class",
+            labelnames=("class",))
+        self._c_class = {c: _fam_class.labels(**{"class": c})
+                         for c in PRIORITY_CLASSES}
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -578,6 +588,19 @@ class RouterServer:
                     payload = json.loads(body or b"{}")
                 except ValueError:
                     payload = {}
+                if self.path in ("/v1/completions",
+                                 "/v1/chat/completions"):
+                    # account the class here but forward the request
+                    # verbatim: an unknown value counts as the default
+                    # class and the ENGINE answers the 400 (the router
+                    # never rewrites or silently drops tenant intent)
+                    try:
+                        cls = coerce_priority(
+                            self.headers.get("X-OME-Priority")
+                            or payload.get("priority"))
+                    except ValueError:
+                        cls = DEFAULT_PRIORITY
+                    outer._c_class[cls].inc()
                 stream = bool(payload.get("stream"))
                 self._proxy(body, stream=stream,
                             affinity=affinity_from_payload(payload))
@@ -814,6 +837,12 @@ class RouterServer:
                 headers = {"Content-Type": "application/json"}
                 if trace is not None:
                     headers[tracing.TRACEPARENT_HEADER] = trace.header()
+                pri = self.headers.get("X-OME-Priority")
+                if pri:
+                    # the priority class propagates like the deadline:
+                    # the engine's admission/scheduling decisions need
+                    # the tenant class the client declared
+                    headers["X-OME-Priority"] = pri
                 timeout = 600.0
                 if deadline is not None:
                     # propagate the client deadline downstream and

@@ -14,6 +14,13 @@ harness can reconstruct the original inter-arrival gaps exactly
 instead of approximating them from finish times. v1 logs (PRs 2-8)
 stay loadable: `admit_times()` derives the admit instant from
 `ts - e2e_s` when the explicit fields are absent.
+
+Schema v3 (multi-tenancy, docs/multi-tenancy.md): engine records
+carry `class` — the request's priority class (one of the fixed
+enum in ome_tpu/priority.py) — so per-class SLO replay and the
+fairness invariants read tenancy straight off the log. v1/v2
+records stay loadable; readers default a missing `class` to
+"standard".
 """
 
 from __future__ import annotations

@@ -91,6 +91,36 @@ def test_fixed_seed_pd_episode(tmp_path):
     assert ep.fault_specs.get("decode0", "").startswith("pd_")
 
 
+def test_fixed_seed_noisy_neighbor_episode(tmp_path):
+    """Noisy-neighbor episode (docs/multi-tenancy.md): a batch flood
+    at 5x slot capacity against steady interactive traffic, plus one
+    mid-episode SIGKILL. The overload IS the chaos — no injected
+    fault points — and the runner checks the multi-tenant invariants
+    on top of the usual ones: no admitted class starves, weighted
+    shares hold under contention, and interactive traffic is never
+    shed while batch floods."""
+    topo = chaos.Topology(prefill=0, decode=0, unified=1,
+                          router=False, kv_block=16, kv_blocks=40)
+    runner = chaos.ChaosRunner(topo, pathlib.Path(tmp_path),
+                               journal_drain_timeout=60.0)
+    try:
+        ep = chaos._plan_episode(7, 0, topo, 5, 2.0, kind="noisy")
+        assert ep.kind == "noisy"
+        assert not ep.fault_specs            # overload, not faults
+        assert any(act == "sigkill" for _, act, _ in ep.events)
+        classes = {r.priority for r in ep.requests}
+        assert {"batch", "interactive"} <= classes
+        # the flood really floods: far more batch than capacity
+        n_batch = sum(r.priority == "batch" for r in ep.requests)
+        assert n_batch >= 5 * topo.max_slots
+        assert "--noisy-neighbor" in ep.replay_command()
+        runner.run_episode(ep)
+    finally:
+        runner.close()
+    assert ep.violations == [], "\n".join(
+        ep.violations + [ep.replay_command()])
+
+
 def test_forced_violation_collects_bundle(tmp_path):
     """A violating episode leaves a replay bundle: the schedule +
     violations, one flight-recorder dump per live engine child

@@ -408,7 +408,8 @@ class TestRegistry:
     def test_all_rules_registered(self):
         assert set(rule_names()) == {
             "hot-path-sync", "lock-discipline", "thread-shared-state",
-            "fault-catalog", "metrics-naming"}
+            "fault-catalog", "metrics-naming",
+            "metrics-label-cardinality"}
 
     def test_unknown_rule_rejected(self):
         with pytest.raises(KeyError):
