@@ -559,6 +559,62 @@ def main() -> None:
         f"({100*spec_tps/spec_plain_tps-100:+.0f}%, accept rate "
         f"{100*spec_rate:.0f}%)")
 
+    # -- engine multi-token device decode (--steps-per-dispatch K) ------
+    # The SERVING engine's fused decode loop (InferenceEngine
+    # .decode_multi: lax.fori_loop over {forward, sample, KV append}
+    # with on-device stop/budget masking — docs/multi-step-decode.md).
+    # The raw decode_k harness above already proves the shape wins;
+    # this sweep measures the REAL engine program — jit-boundary state
+    # donation, per-iteration PRNG fold, stop-table compare — at
+    # K in {1, 4, 8}. Per-token dispatch share falls as disp_ms / K
+    # while step_ms approaches the device-bound floor; the scheduler
+    # exposes the same knob as --steps-per-dispatch.
+    def bench_multistep(p):
+        from ome_tpu.engine.core import InferenceEngine
+
+        SLOTS = BATCH
+        eng = InferenceEngine(p, cfg, max_slots=SLOTS,
+                              max_seq=CACHE_LEN, prefill_buckets=[16])
+        state = eng.new_state()
+        rng = np.random.default_rng(13)
+        for s in range(SLOTS):
+            ids = [int(x) for x in
+                   rng.integers(0, cfg.vocab_size, size=16)]
+            tok, kv, true_len, bucket = eng.prefill(ids)
+            state = eng.insert(state, kv, s, true_len, tok, bucket)
+        t = np.zeros((SLOTS,), np.float32)         # greedy
+        tk = np.zeros((SLOTS,), np.int32)
+        tp = np.ones((SLOTS,), np.float32)
+        stops = np.full((SLOTS, 1), -1, np.int32)  # never fires
+        per_k = {}
+        for k_ in (1, 4, 8):
+            budget = np.full((SLOTS,), k_, np.int32)
+            n_disp = 48 // k_      # same 48 timed tokens per K
+            # compile + warm dispatch, not timed (state donation flows
+            # through, as in the scheduler's lag queue)
+            state, toks, _adv = eng.decode_multi(
+                state, t, tk, tp, steps=k_, budget=budget,
+                stop_ids=stops)
+            sync(toks)
+            t0 = time.perf_counter()
+            for _ in range(n_disp):
+                state, toks, _adv = eng.decode_multi(
+                    state, t, tk, tp, steps=k_, budget=budget,
+                    stop_ids=stops)
+            sync(toks)
+            step_ms = (time.perf_counter() - t0) / (n_disp * k_) * 1000
+            per_k[k_] = step_ms
+            log(f"bench: [multistep] K={k_}: {step_ms:.2f} ms/token -> "
+                f"{SLOTS/(step_ms/1000):.1f} tok/s (dispatch share "
+                f"{disp_ms/k_:.3f} ms/token)")
+        return per_k
+
+    try:
+        multistep_ms = bench_multistep(params)
+    except Exception as exc:  # keep the headline alive off-TPU
+        log(f"bench: [multistep] skipped: {exc!r}")
+        multistep_ms = {}
+
     # -- scheduler step-phase attribution -------------------------------
     # Drives the SERVING scheduler (pipelined decode, depth 1) over the
     # real engine and reads back its ome_engine_step_phase_seconds
@@ -642,6 +698,15 @@ def main() -> None:
         f"(weights-stream anchor) {bw_ach:.0f} GB/s | spec {bw_spec:.0f}")
     log(f"bench: roofline vs spec {100*vs:.1f}% | vs achievable "
         f"{100*vs_ach:.1f}%")
+    multistep_json = {}
+    for k_, sm in multistep_ms.items():
+        tps_k = BATCH / (sm / 1000)
+        multistep_json[str(k_)] = {
+            "step_ms": round(sm, 2),
+            "tokens_per_sec": round(tps_k, 1),
+            "dispatch_share_ms": round(disp_ms / k_, 3),
+            "roofline_vs_spec": round(tps_k / roof_spec, 3),
+        }
     print(json.dumps({
         "metric": "decode_tokens_per_sec_1.9B_bf16_batch32",
         "value": round(bf16_tps, 1),
@@ -656,6 +721,16 @@ def main() -> None:
         "spec_accept_rate": round(spec_rate, 3),
         "spec_plain_tokens_per_sec": round(spec_plain_tps, 1),
         "spec_k": spec_k,
+        "multistep": multistep_json,
+        "int4_vs_int8": {
+            "int4_tokens_per_sec": round(int4_tps, 1),
+            "int8_tokens_per_sec": round(int8_tps, 1),
+            "int4_ahead": bool(int4_tps > int8_tps),
+            "note": ("int4 must beat int8 (0.5 vs 1 byte/weight of "
+                     "HBM traffic); parity of the two step floors "
+                     "means the fused kernel gate dropped out — see "
+                     "ops/int4_matmul._on_tpu_device (BENCH_r05)"),
+        },
         "prefill_ms_batch32x128": round(pbest * 1000, 1),
         "prefill_mfu": round(mfu, 3),
         "dispatch_ms": round(disp_ms, 2),

@@ -209,6 +209,12 @@ class PrefixCache:
 class InferenceEngine:
     """Compiled prefill/insert/decode over one model + one mesh."""
 
+    # multi-token device decode (decode_multi) is available: wrappers
+    # that delegate per-attribute (ReplicatedEngine) override this to
+    # False so the scheduler degrades to K=1 instead of dispatching a
+    # program their op stream cannot replicate
+    supports_multi_step = True
+
     def __init__(self, params: Params, cfg: ModelConfig,
                  max_slots: int = 8, max_seq: Optional[int] = None,
                  prefill_buckets: Optional[List[int]] = None,
@@ -467,6 +473,105 @@ class InferenceEngine:
                                tokens=toks,
                                adapters=state.adapters), toks
 
+        smax = self.max_seq
+
+        def _multi_body(i, carry, key, temperature, top_k, top_p,
+                        budget, stop_ids, forward_one):
+            """One fori_loop iteration of the multi-token decode
+            program: forward the batch one position, sample on device,
+            append KV, and feed the sampled token back as the next
+            iteration's input. Per-slot freeze: a slot that sampled a
+            stop-table token, spent its token budget, or reached cache
+            capacity goes inactive — its token and length are held
+            frozen (the re-written row sits past its committed length,
+            so it is never readable), keeping every shape static.
+            The freeze conditions are a conservative SUBSET of the
+            host's finish rules: the device may run long (the host
+            discards overshoot at the drain) but never stops a slot
+            the host would have continued."""
+            st, done, acc, adv = carry
+            active = (~done) & (i < budget) & (st.lengths < smax)
+            logits, nc = forward_one(st)
+            toks = sample(logits[:, -1], jax.random.fold_in(key, i),
+                          temperature, top_k, top_p)
+            toks = jnp.where(active, toks, st.tokens)
+            done = done | jnp.any(toks[:, None] == stop_ids, axis=1)
+            acc = acc.at[:, i].set(toks)
+            adv = adv + active.astype(jnp.int32)
+            st = DecodeState(
+                k=nc.k, v=nc.v,
+                lengths=jnp.where(active, nc.index, st.lengths),
+                tokens=toks, adapters=st.adapters)
+            return st, done, acc, adv
+
+        def _multi_loop(state, key, temperature, top_k, top_p, budget,
+                        stop_ids, forward_one, n: int):
+            B = state.tokens.shape[0]
+            # a slot whose INPUT token is already a stop (the previous
+            # chunk sampled it; the host finishes on every stop token)
+            # freezes for the whole chunk instead of appending the
+            # stop's KV and decoding past it
+            done0 = (budget <= 0) | jnp.any(
+                state.tokens[:, None] == stop_ids, axis=1)
+            carry = (state, done0, jnp.zeros((B, n), jnp.int32),
+                     jnp.zeros((B,), jnp.int32))
+            state, _, acc, adv = lax.fori_loop(
+                0, n, functools.partial(
+                    _multi_body, key=key, temperature=temperature,
+                    top_k=top_k, top_p=top_p, budget=budget,
+                    stop_ids=stop_ids, forward_one=forward_one),
+                carry)
+            return state, acc, adv
+
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=("n",))
+        def _decode_multi(params, state: DecodeState, temperature,
+                          top_k, top_p, key, budget, stop_ids,
+                          n: int):
+            """n decode iterations inside ONE device program (ROADMAP
+            item 2): a fori_loop over {forward → sample → KV append →
+            next-token embed} with sampling fused as the loop epilogue
+            (per-iteration keys folded from the chunk key), so the
+            host syncs once per n tokens instead of once per token.
+            budget: [B] int32 remaining-token cap per slot; stop_ids:
+            [B, NS] int32 stop table (-1 padding). Returns (state,
+            tokens [B, n], advanced [B]) — slot b's real output is
+            tokens[b, :advanced[b]], the rest is frozen filler the
+            host discards."""
+
+            def forward_one(st):
+                cache = llama.KVCache(k=st.k, v=st.v,
+                                      index=st.lengths)
+                return llama.forward(params, cfg_, st.tokens[:, None],
+                                     cache=cache,
+                                     adapter_ids=st.adapters)
+
+            return _multi_loop(state, key, temperature, top_k, top_p,
+                               budget, stop_ids, forward_one, n)
+
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=("n",))
+        def _decode_multi_paged(params, state: DecodeState, table,
+                                temperature, top_k, top_p, key,
+                                budget, stop_ids, n: int):
+            """Paged-pool multi-token decode. The block table is
+            STATIC for the whole chunk: the host pre-allocates blocks
+            covering every row the n iterations can write
+            (_grow_blocks_spec, the spec-decode discipline) and
+            commit_spec() reconciles lengths + returns the surplus
+            once `advanced` is drained."""
+
+            def forward_one(st):
+                cache = llama.PagedKVCache(k=st.k, v=st.v,
+                                           index=st.lengths,
+                                           table=table)
+                return llama.forward_paged(params, cfg_,
+                                           st.tokens[:, None], cache,
+                                           adapter_ids=st.adapters)
+
+            return _multi_loop(state, key, temperature, top_k, top_p,
+                               budget, stop_ids, forward_one, n)
+
         @functools.partial(jax.jit, donate_argnums=(1,),
                            static_argnames=("k",))
         def _verify(params, state: DecodeState, drafts, draft_len,
@@ -526,6 +631,8 @@ class InferenceEngine:
         self._insert_paged_fn = _insert_paged
         self._decode_paged_fn = _decode_paged
         self._decode_masked_paged_fn = _decode_masked_paged
+        self._decode_multi_fn = _decode_multi
+        self._decode_multi_paged_fn = _decode_multi_paged
         self._verify_fn = _verify
         self._verify_paged_fn = _verify_paged
         self._step = 0
@@ -705,17 +812,25 @@ class InferenceEngine:
                 self._table[b, j] = nid
                 self._table_dirty = True
 
-    def commit_spec(self, slot: int, advance: int) -> None:
+    def commit_spec(self, slot: int, advance: int,
+                    reserve: int = 0) -> None:
         """Reconcile a slot's host length mirror after a drained
-        verify step advanced its device length by `advance`
-        (= accepted + 1), and return speculatively-allocated blocks
-        past the new length to the pool — the paged-KV rollback of
-        rejected draft rows."""
+        verify (or multi-token decode) step advanced its device
+        length by `advance`, and return speculatively-allocated
+        blocks past the new length to the pool — the paged-KV
+        rollback of rejected draft rows. `reserve` keeps blocks
+        covering that many rows PAST the new length allocated:
+        under chunk pipelining, later chunks already dispatched will
+        write rows [len, len+reserve) — trimming those blocks here
+        would let an insert re-allocate them before the in-flight
+        writes execute."""
         if not self.kv_block or not self._owned[slot]:
             return
         self._host_len[slot] = min(
             int(self._host_len[slot]) + advance, self.max_seq)
-        need = self.blocks_needed(int(self._host_len[slot]))
+        need = self.blocks_needed(min(
+            int(self._host_len[slot]) + max(int(reserve), 0),
+            self.max_seq))
         while len(self._owned[slot]) > need:
             nid = self._owned[slot].pop()
             self._table[slot, len(self._owned[slot])] = 0
@@ -1010,6 +1125,56 @@ class InferenceEngine:
         if copy is not None:  # sharded/global arrays may not have it
             copy()
         return state, toks
+
+    def decode_multi(self, state: DecodeState, temperature, top_k,
+                     top_p, steps: int, budget, stop_ids,
+                     lookahead_rows: Optional[int] = None,
+                     ) -> Tuple[DecodeState, jax.Array, jax.Array]:
+        """`steps` decode iterations for ALL slots in ONE device
+        program — the host pays one dispatch and one sync per chunk
+        instead of per token (docs/multi-step-decode.md).
+
+        budget: [B] int32 per-slot remaining-token cap (0 freezes the
+        slot for the chunk); stop_ids: [B, NS] int32 per-slot stop
+        table, -1 padding (sampled tokens are non-negative, so -1
+        never matches). Both may be host numpy or device-cached
+        jax.Arrays, like the sampling params. lookahead_rows (paged
+        only): KV rows to pre-allocate per slot before dispatch —
+        pipelined callers pass steps × (chunks in flight + 1) so
+        every chunk's writes land in owned blocks; defaults to
+        `steps`.
+
+        Returns (state, tokens [B, steps], advanced [B]) with host
+        copies of the outputs already in flight (mirroring decode()):
+        slot b really produced tokens[b, :advanced[b]] — columns past
+        that are frozen filler the caller must discard. Paged callers
+        reconcile each drained chunk with commit_spec(slot, advanced,
+        reserve=...)."""
+        key = self._next_key()
+        sampling = (_sampling_array(temperature, np.float32),
+                    _sampling_array(top_k, np.int32),
+                    _sampling_array(top_p, np.float32))
+        budget = _sampling_array(budget, np.int32)
+        stop_ids = _sampling_array(stop_ids, np.int32)
+        n = int(steps)
+        if self.kv_block:
+            rows = n if lookahead_rows is None else int(lookahead_rows)
+            self._grow_blocks_spec(rows)
+            if self._table_dirty or self._table_dev is None:
+                self._table_dev = jnp.asarray(self._table.copy())
+                self._table_dirty = False
+            state, toks, adv = self._decode_multi_paged_fn(
+                self.params, state, self._table_dev, *sampling, key,
+                budget, stop_ids, n=n)
+        else:
+            state, toks, adv = self._decode_multi_fn(
+                self.params, state, *sampling, key, budget, stop_ids,
+                n=n)
+        for arr in (toks, adv):
+            copy = getattr(arr, "copy_to_host_async", None)
+            if copy is not None:
+                copy()
+        return state, toks, adv
 
     def verify(self, state: DecodeState, drafts: np.ndarray,
                draft_len: np.ndarray, temperature, top_k, top_p,

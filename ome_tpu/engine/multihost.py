@@ -205,6 +205,13 @@ class ReplicatedEngine:
     at a different op-stream position than its followers (divergent
     SPMD state)."""
 
+    # multi-token decode is NOT in the replicated op vocabulary yet:
+    # __getattr__ would leak the wrapped engine's decode_multi through
+    # and the leader would run a program the followers never see
+    # (divergent SPMD state). Scheduler degrades to steps_per_dispatch
+    # = 1 with a logged warning.
+    supports_multi_step = False
+
     def __init__(self, engine, publisher: OpPublisher):
         self._engine = engine
         self._pub = publisher

@@ -96,6 +96,28 @@ class _SpecStep:
         self.draft_len = draft_len
 
 
+class _MultiStep:
+    """Lag-queue payload of one multi-token decode chunk
+    (docs/multi-step-decode.md): the device-resident [B, k] sampled-
+    token matrix and [B] advanced counts (host copies in flight), the
+    chunk size, and the dispatch timestamp for the decode_chunk
+    span."""
+
+    __slots__ = ("out", "advanced", "k", "t_dispatch")
+
+    def __init__(self, out, advanced, k, t_dispatch):
+        self.out = out
+        self.advanced = advanced
+        self.k = k
+        self.t_dispatch = t_dispatch
+
+
+# fixed width of the per-slot device stop table: stop ids past this
+# count are detected on host only (the device just freezes later —
+# overshoot is discarded at the drain, so streams stay identical)
+_STOP_TABLE_WIDTH = 4
+
+
 # WDRR quantum: deficit credit per class visit is weight x this many
 # tokens — large enough that one visit usually covers a typical head
 # request in one accumulation, small enough that a giant
@@ -339,6 +361,7 @@ class Scheduler:
                  max_queue_wait: float = 30.0,
                  pipeline_depth: int = 1,
                  spec_tokens: int = 0,
+                 steps_per_dispatch: int = 1,
                  registry: Optional[Registry] = None,
                  journal=None,
                  span_log=None,
@@ -395,6 +418,25 @@ class Scheduler:
         # with structured-output (masked) slots fall back to the
         # synchronous path per step regardless.
         self.pipeline_depth = max(int(pipeline_depth), 0)
+        # multi-token device decode (docs/multi-step-decode.md): K
+        # decode iterations run inside ONE jitted program, the host
+        # syncing once per K-token chunk. 1 = one dispatch per token
+        # (the pre-multi-step behavior, and the only shape masked /
+        # spec-verify / incapable-engine batches can run — those
+        # degrade per step with a throttled warning, never an exit).
+        self.steps_per_dispatch = max(int(steps_per_dispatch), 1)
+        if self.steps_per_dispatch > 1 and not (
+                callable(getattr(engine, "decode_multi", None))
+                and getattr(engine, "supports_multi_step", False)):
+            import logging
+            logging.getLogger("ome.engine").warning(
+                "steps_per_dispatch=%d requested but engine %s has no "
+                "multi-step decode; running at 1",
+                self.steps_per_dispatch, type(engine).__name__)
+            self.steps_per_dispatch = 1
+        # per-degradation-cause warn-once latch (masked / spec), so a
+        # long structured-output stream logs one line, not one per step
+        self._multi_degraded_warned: set = set()
         # shared telemetry registry: the EngineServer scrapes it on
         # /metrics; stats-dict counters below are mirrored into it
         self.registry = registry or Registry()
@@ -473,6 +515,9 @@ class Scheduler:
         # one jnp tuple), rebuilt only when a slot's occupancy or
         # params change — not three np.asarray uploads per step
         self._sampling_dev: Optional[tuple] = None
+        # device-resident [B, NS] per-slot stop table for multi-step
+        # chunks, cached on the same invalidation rule
+        self._stops_dev = None
         # monotonic timestamp of the last dispatch RETURN; the gap to
         # the next dispatch START is the host-side bubble the
         # pipelining removes (None after idle/recovery so those pauses
@@ -589,6 +634,15 @@ class Scheduler:
         self._ph_mask = self._h_step_phase.labels(phase="mask_apply")
         self._ph_wait = self._h_step_phase.labels(phase="device_wait")
         self._ph_sample = self._h_step_phase.labels(phase="host_sample")
+        # multi-step chunks attribute their whole on-device loop here
+        # (K tokens per observation) instead of `dispatch` (1 token)
+        self._ph_device_loop = self._h_step_phase.labels(
+            phase="device_loop")
+        self._g_steps_per_dispatch = R.gauge(
+            "ome_engine_steps_per_dispatch",
+            "Decode iterations fused per device dispatch (the "
+            "--steps-per-dispatch K; 1 = per-token dispatch)")
+        self._g_steps_per_dispatch.set(self.steps_per_dispatch)
         self._c_flight_events = R.counter(
             "ome_engine_flight_events_total",
             "Scheduler lifecycle events recorded by the flight ring")
@@ -1531,6 +1585,7 @@ class Scheduler:
         dropped so the next dispatch re-uploads the new [B] params."""
         self._slot_gen[slot] += 1
         self._sampling_dev = None
+        self._stops_dev = None
 
     def _sampling(self):
         """Device-resident (temperature, top_k, top_p) for the whole
@@ -1541,6 +1596,39 @@ class Scheduler:
                                   jnp.asarray(self._top_k),
                                   jnp.asarray(self._top_p))
         return self._sampling_dev
+
+    def _stop_table(self):
+        """Device-resident [B, NS] stop table for multi-step chunks
+        (-1 padding never matches a sampled token), cached like the
+        sampling params: re-uploaded only on occupancy change. Stop
+        ids past the fixed width stay host-detected — the device
+        table being a SUBSET of each request's stop set only costs
+        discarded overshoot, never a wrong stream."""
+        if self._stops_dev is None:
+            tab = np.full((self.engine.max_slots, _STOP_TABLE_WIDTH),
+                          -1, np.int32)
+            for slot, req in enumerate(self.slots):
+                if req is None:
+                    continue
+                ids = list(req.stop_ids)[:_STOP_TABLE_WIDTH]
+                tab[slot, :len(ids)] = ids
+            self._stops_dev = jnp.asarray(tab)
+        return self._stops_dev
+
+    def _multi_budget(self, k: int) -> np.ndarray:
+        """Per-slot remaining-token cap for one chunk. Under
+        pipelining this over-counts by whatever is still in flight
+        (output_ids lags the device) — deliberately: the device may
+        only run LONG, and _maybe_finish cuts the stream at the exact
+        budget when the chunk drains, so K=1 and K=8 emit identical
+        bytes."""
+        budget = np.zeros(self.engine.max_slots, np.int32)
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            budget[slot] = min(
+                max(req.max_new_tokens - len(req.output_ids), 0), k)
+        return budget
 
     def _drain_inflight(self, keep: int = 0) -> bool:
         """Read dispatched steps older than the newest `keep`, oldest
@@ -1558,6 +1646,11 @@ class Scheduler:
             toks, snap_slots, snap_gens = self._inflight.popleft()
             if isinstance(toks, _SpecStep):
                 self._drain_spec(toks, snap_slots, snap_gens)
+                did = True
+                drained += 1
+                continue
+            if isinstance(toks, _MultiStep):
+                self._drain_multi(toks, snap_slots, snap_gens)
                 did = True
                 drained += 1
                 continue
@@ -1636,6 +1729,58 @@ class Scheduler:
                     break  # finished mid-prefix: drop the tail
         self._ph_sample.observe(time.monotonic() - t_fetched)
 
+    def _drain_multi(self, step: _MultiStep, snap_slots, snap_gens):
+        """Emit one drained multi-token chunk: slot b produced
+        step.out[b, :advanced[b]] (docs/multi-step-decode.md). Runs
+        only from _drain_inflight — the host fetch below completes
+        the async copies decode_multi() started; it is the chunk's
+        single device sync. The overshoot/discard rule: _maybe_finish
+        applies every host finish condition (full stop set, deadline,
+        exact budget, capacity) token by token, so everything the
+        device ran past a host finish is dropped here — including a
+        mid-chunk EOS tail — and the usual generation check drops
+        whole slots whose occupant changed since dispatch. Paged
+        engines reconcile allocator state per slot via commit_spec,
+        reserving rows for chunks still in flight."""
+        t_read = time.monotonic()
+        host_out = np.asarray(step.out)       # [B, k]
+        host_adv = np.asarray(step.advanced)  # [B]
+        t_fetched = time.monotonic()
+        self._ph_wait.observe(t_fetched - t_read)
+        commit = getattr(self.engine, "commit_spec", None)
+        # later chunks were dispatched against block pre-allocations
+        # covering their rows; commit must not trim those
+        reserve = step.k * len(self._inflight)
+        emitted = 0
+        for slot, req in enumerate(snap_slots):
+            if (req is None or self.slots[slot] is not req
+                    or self._slot_gen[slot] != snap_gens[slot]):
+                continue
+            n = int(host_adv[slot])
+            if commit is not None:
+                commit(slot, n, reserve=reserve)
+            if n:
+                self._note_decode_progress(req, tokens=n)
+            for tok in host_out[slot, :n]:
+                req.emit(int(tok))
+                emitted += 1
+                self._inc("tokens_generated_total")
+                self._c_class_tokens[self._class_of(req)].inc()
+                self._maybe_finish(slot, int(tok))
+                if self.slots[slot] is not req:
+                    break  # finished mid-chunk: overshoot discarded
+        if self.span_log.enabled:
+            s = Span("engine.decode_chunk",
+                     trace_id=self._span_ctx.trace_id,
+                     parent_id=self._span_ctx.span_id,
+                     start_mono=step.t_dispatch,
+                     start_wall=time.time() - (time.monotonic()
+                                               - step.t_dispatch))
+            s.end().set(steps_per_dispatch=step.k, tokens=emitted)
+            self.span_log.write(s)
+        self._flight_event("multi_chunk", k=step.k, emitted=emitted)
+        self._ph_sample.observe(time.monotonic() - t_fetched)
+
     def _decode(self) -> bool:
         if not any(r is not None for r in self.slots):
             # the batch drained while a step was still in flight: read
@@ -1698,6 +1843,22 @@ class Scheduler:
         # the accepted tokens; plain fallback steps keep pipelining.
         depth = 0 if (mask is not None or drafts is not None) \
             else self.pipeline_depth
+        # multi-token chunks compose with pipelining (the lag queue
+        # just carries [B, K] chunks) but degrade to K=1 for masked
+        # and spec-verify steps, which both need token k on host
+        # before step k+1 can run — logged once per cause, and the
+        # batch re-chunks the moment the constraint clears
+        k_steps = self.steps_per_dispatch
+        if k_steps > 1 and (mask is not None or drafts is not None):
+            cause = "masked" if mask is not None else "spec_verify"
+            if cause not in self._multi_degraded_warned:
+                self._multi_degraded_warned.add(cause)
+                import logging
+                logging.getLogger("ome.engine").warning(
+                    "steps_per_dispatch=%d degraded to 1 for %s "
+                    "steps (token k must reach the host before step "
+                    "k+1)", k_steps, cause)
+            k_steps = 1
         sampling = self._sampling()
         t0 = time.monotonic()
         if self._dispatch_end is not None:
@@ -1709,16 +1870,33 @@ class Scheduler:
             self.state, out, acc = self.engine.verify(
                 self.state, drafts, dlen, *sampling)
             toks = _SpecStep(out, acc, dlen)
+        elif k_steps > 1:
+            # paged pre-allocation must cover this chunk AND every
+            # chunk still in flight (their commits have not advanced
+            # the host length mirror yet)
+            lookahead = k_steps * (len(self._inflight) + 1)
+            self.state, out, adv = self.engine.decode_multi(
+                self.state, *sampling, steps=k_steps,
+                budget=self._multi_budget(k_steps),
+                stop_ids=self._stop_table(),
+                lookahead_rows=lookahead)
+            toks = _MultiStep(out, adv, k_steps, t0)
         else:  # engine wrappers/fakes need no mask kwarg in their API
             self.state, toks = self.engine.decode(
                 self.state, *sampling)
         self._dispatch_end = time.monotonic()
         dt = self._dispatch_end - t0
-        self._ewma_step_s = dt if self._ewma_step_s is None \
-            else 0.9 * self._ewma_step_s + 0.1 * dt
-        self._h_decode_step.observe(dt)
-        self._ph_dispatch.observe(dt)
-        self._inc("decode_steps_total")
+        # per-STEP time (the queue-wait estimator and step histogram
+        # stay per-token): a K-chunk dispatch amortizes over K steps
+        dt_step = dt / k_steps
+        self._ewma_step_s = dt_step if self._ewma_step_s is None \
+            else 0.9 * self._ewma_step_s + 0.1 * dt_step
+        self._h_decode_step.observe(dt_step)
+        if k_steps > 1:
+            self._ph_device_loop.observe(dt)
+        else:
+            self._ph_dispatch.observe(dt)
+        self._inc("decode_steps_total", k_steps)
         if drafts is not None:
             self._inc("spec_steps_total")
             self._inc("spec_proposed_tokens_total", int(dlen.sum()))

@@ -144,6 +144,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "restores the synchronous fetch-every-step "
                         "loop; structured-output batches always run "
                         "synchronously")
+    p.add_argument("--steps-per-dispatch", type=int, default=1,
+                   help="decode iterations fused into one device "
+                        "program (docs/multi-step-decode.md): the "
+                        "host dispatches and syncs once per K-token "
+                        "chunk instead of per token; greedy output "
+                        "is byte-identical to K=1. Masked "
+                        "(structured-output), spec-verify, and "
+                        "multi-host batches degrade to 1 with a "
+                        "logged warning")
     p.add_argument("--spec-tokens", type=int, default=0,
                    help="speculative decoding: max draft tokens per "
                         "slot per step proposed by the host-side "
@@ -655,6 +664,15 @@ def main(argv=None) -> int:
             log.error("--spec-tokens requires single-host serving "
                       "(the multi-host op stream has no verify op)")
             return 2
+        if dist is not None and args.steps_per_dispatch > 1:
+            # unlike spec verify this degrades instead of exiting:
+            # ReplicatedEngine publishes supports_multi_step = False,
+            # so the scheduler runs K=1 — same bytes, just per-token
+            # dispatch — and multihost deployments keep one flag set
+            log.warning("--steps-per-dispatch %d ignored under "
+                        "multi-host serving (the op stream has no "
+                        "multi-step op); running at 1",
+                        args.steps_per_dispatch)
         if args.journal:
             from .journal import RequestJournal
             provenance = None
@@ -677,6 +695,7 @@ def main(argv=None) -> int:
                               max_queue_wait=args.max_queue_wait,
                               pipeline_depth=args.pipeline_depth,
                               spec_tokens=args.spec_tokens,
+                              steps_per_dispatch=args.steps_per_dispatch,
                               journal=journal,
                               span_log=span_log,
                               flight=flight,

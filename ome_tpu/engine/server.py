@@ -156,6 +156,8 @@ class EngineServer:
                             sched, "pipeline_depth", 0),
                         "spec_tokens": getattr(
                             sched, "spec_tokens", 0),
+                        "steps_per_dispatch": getattr(
+                            sched, "steps_per_dispatch", 1),
                         "uptime_s": round(
                             time.time() - outer.started_at, 1)})
                 elif self.path == "/ready":

@@ -90,6 +90,14 @@ class ShardedInferenceEngine(InferenceEngine):
         with self._no_int4_kernel():
             return super().verify(*a, **kw)
 
+    def decode_multi(self, *a, **kw):
+        # the fori_loop carry keeps the committed shardings (KV
+        # head-sharded, tokens/lengths replicated) — GSPMD propagates
+        # them through every iteration, so only the int4-kernel gate
+        # needs the decode treatment here too
+        with self._no_int4_kernel():
+            return super().decode_multi(*a, **kw)
+
     def _kv_sharding(self) -> NamedSharding:
         # [L, B, S, K, Dh]: KV heads on tp. MLA caches ONE latent head
         # (kv_cache_heads == 1) — replicated; the latent cache is tiny

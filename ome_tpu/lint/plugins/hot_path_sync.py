@@ -6,10 +6,12 @@ function set is REACHABILITY from the configured roots (default
 ``Scheduler.step`` and the router Handler's ``_forward``), not a
 hardcoded frozenset — so the step-plan refactor (ROADMAP item 1) can
 rename or split step helpers without silently un-linting them. The
-sanctioned drain sinks (``_drain_inflight`` / ``_drain_spec``) are a
-reachability stop-set: they are the one place a device->host fetch is
-allowed, because by construction they run only after the next step
-was dispatched.
+sanctioned drain sinks (``_drain_inflight`` / ``_drain_spec`` /
+``_drain_multi``) are a reachability stop-set: they are the one place
+a device->host fetch is allowed, because by construction they run
+only after the next step was dispatched — for multi-token chunks
+(docs/multi-step-decode.md) that fetch is the once-per-chunk sync
+the fused device loop buys.
 
 When none of the configured roots resolve — the shim linting a
 fixture file that has no ``step`` — the legacy step-path names seed
@@ -34,8 +36,10 @@ ROOT_SPECS = (
 # roots (the legacy check_decode_sync fixture contract)
 LEGACY_ROOTS = (
     "step", "_decode", "_insert_ready", "_admit", "_build_mask",
-    "_maybe_finish", "_sampling", "_spec_headroom", "_build_drafts")
-ALLOWED = frozenset(("_drain_inflight", "_drain_spec"))
+    "_maybe_finish", "_sampling", "_spec_headroom", "_build_drafts",
+    "_stop_table", "_multi_budget")
+ALLOWED = frozenset(("_drain_inflight", "_drain_spec",
+                     "_drain_multi"))
 
 _SYNC_MODULE_CALLS = frozenset((
     ("np", "asarray"), ("np", "array"),

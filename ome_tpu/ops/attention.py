@@ -142,7 +142,15 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 @functools.cache
 def _on_tpu() -> bool:
+    # device_kind fallback: tunnel-transport backends report their own
+    # platform id while the attached devices are real TPUs (same rule
+    # as ops/int4_matmul._on_tpu_device — the two Pallas dispatch
+    # gates must agree, or one kernel family silently drops out, the
+    # BENCH_r05 int4-vs-int8 parity regression)
     try:
-        return jax.devices()[0].platform == "tpu"
+        dev = jax.devices()[0]
     except Exception:  # pragma: no cover - no backend at all
         return False
+    if getattr(dev, "platform", "") == "tpu":
+        return True
+    return "tpu" in str(getattr(dev, "device_kind", "")).lower()

@@ -37,6 +37,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 MAX_M = 256
 
+# pallas renamed TPUCompilerParams -> CompilerParams; accept either so
+# the kernel (and its interpret-mode tests) work across jax versions
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 # Per-context kernel gate: a tp>1 engine disables the un-partitioned
 # kernel around ITS traces only (contextvar — not a sticky process
 # global, so tp=1 engines in the same process keep the fused path).
@@ -54,6 +59,27 @@ def kernel_disabled():
         yield
     finally:
         _kernel_enabled.reset(token)
+
+
+@functools.cache
+def _on_tpu_device() -> bool:
+    """TPU detection for the kernel gate, keyed on the DEVICE rather
+    than `jax.default_backend()`: experimental transport backends
+    (device tunnels) report their own platform id even when the
+    attached devices are real TPUs, and gating on the backend name
+    silently dropped the fused kernel on such rigs — the BENCH_r05
+    int4 regression, where the int4 and int8 step floors came out
+    byte-identical because both ran the XLA dequant path. Matches
+    ops/attention.py's `_on_tpu` so the Pallas attention and int4
+    kernels engage (or not) together."""
+    try:
+        dev = jax.devices()[0]
+    except Exception:  # pragma: no cover - no backend at all
+        return False
+    if getattr(dev, "platform", "") == "tpu":
+        return True
+    # tunnel-attached TPUs keep a truthful device_kind ("TPU v5 lite")
+    return "tpu" in str(getattr(dev, "device_kind", "")).lower()
 
 
 def _kernel(xl_ref, xh_ref, qp_ref, sl_ref, sh_ref, o_ref, acc_ref, *,
@@ -124,7 +150,7 @@ def _mm4(x2, qp2, s2, gsize: int, bkp: int, bn: int, out_dtype,
         ],
         out_specs=pl.BlockSpec((m, bn), lambda i, kk: (0, i)),
         scratch_shapes=[pltpu.VMEM((m, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(x2, x2, qp2, s2, s2)
@@ -174,7 +200,7 @@ def int4_matmul(x: jax.Array, qt, out_dtype=jnp.bfloat16,
     import os
     if os.environ.get("OME_INT4_KERNEL_INTERPRET"):
         interpret = True  # tests: run the kernel path on CPU
-    if not interpret and jax.default_backend() != "tpu":
+    if not interpret and not _on_tpu_device():
         return None
     if not _kernel_enabled.get() and not interpret \
             and not os.environ.get("OME_INT4_KERNEL_FORCE"):

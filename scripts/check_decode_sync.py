@@ -9,7 +9,8 @@ set is now derived from call-graph REACHABILITY (roots:
 names seed fixture files that lack them) instead of a hardcoded
 frozenset, so renaming or splitting a step helper cannot silently
 un-lint it. The sanctioned drain fetches (`_drain_inflight` /
-`_drain_spec`) are a reachability stop-set. See
+`_drain_spec` / `_drain_multi` — the last being the once-per-chunk
+sync of multi-token device decode) are a reachability stop-set. See
 docs/static-analysis.md.
 
 Usage: python scripts/check_decode_sync.py [scheduler.py path]

@@ -28,15 +28,19 @@ def world():
 
 
 def reference_greedy(params, cfg, prompt_ids, n_steps):
-    """Straight-line greedy decode with the plain model forward."""
+    """Straight-line greedy decode with the plain model forward
+    (single-token steps jitted via test_pipeline's shared cache — the
+    eager per-token forward dominated this module's wall time)."""
+    from test_pipeline import _ref_step
     cache = llama.KVCache.create(cfg, 1, cfg.max_seq_len)
     tokens = jnp.asarray([prompt_ids], jnp.int32)
     logits, cache = llama.forward(params, cfg, tokens, cache=cache)
     out = [int(jnp.argmax(logits[0, -1]))]
+    step = _ref_step(cfg)
     for _ in range(n_steps - 1):
-        logits, cache = llama.forward(
-            params, cfg, jnp.asarray([[out[-1]]], jnp.int32), cache=cache)
-        out.append(int(jnp.argmax(logits[0, -1])))
+        tok, cache = step(params,
+                          jnp.asarray([[out[-1]]], jnp.int32), cache)
+        out.append(int(tok))
     return out
 
 

@@ -1,0 +1,466 @@
+"""Multi-token device decode (docs/multi-step-decode.md).
+
+`--steps-per-dispatch K` runs K decode iterations inside ONE jitted
+device program (InferenceEngine.decode_multi: lax.fori_loop over
+{forward, sample, KV append} with on-device stop/budget freezing) so
+the host syncs once per K tokens. Contracts under test:
+
+  * EQUIVALENCE: greedy streams are byte-identical across
+    K in {1, 4, 8} x pipeline depth {0, 1} x {dense, paged}, all
+    matching the single-sequence reference — chunking may only move
+    WHEN tokens surface, never WHICH tokens;
+  * CHUNK SEMANTICS: a stop id sampled mid-chunk freezes the slot on
+    device (advanced counts only real tokens), the host discards the
+    frozen tail; budget overshoot inside a chunk is discarded at the
+    drain; deadline expiry is detected at chunk boundaries with no
+    post-finish emission;
+  * COMPOSITION: paged pool pressure preempting between chunks and
+    journal kill-resume with a chunk in flight both preserve byte
+    identity;
+  * DEGRADATION: engines without decode_multi, masked (structured
+    output) batches, and spec-verify steps run at K=1 with a
+    logged warning — never silently wrong;
+  * SURFACES: the serve CLI flag, /health, the
+    ome_engine_steps_per_dispatch gauge, the device_loop step phase,
+    engine.decode_chunk spans, and the check_decode_sync lint's
+    sanctioned `_drain_multi` fetch.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from ome_tpu import faults
+from ome_tpu.engine import ByteTokenizer, EngineServer
+from ome_tpu.engine.core import InferenceEngine
+from ome_tpu.engine.journal import RequestJournal
+from ome_tpu.engine.scheduler import Request, Scheduler
+from ome_tpu.models import config as cfgs
+from ome_tpu.models import llama
+from ome_tpu.telemetry import export
+
+from test_pipeline import (CountingEngine, PassMasker, _drive,
+                           reference_greedy)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def world():
+    cfg = cfgs.tiny_test().replace(max_seq_len=128)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(params, cfg, max_slots=4,
+                             prefill_buckets=[16, 32, 64])
+    return cfg, params, engine
+
+
+@pytest.fixture(scope="module")
+def paged_world():
+    """Roomy paged pool: block discipline under multi-step chunks
+    WITHOUT preemption in the mix (that composition gets its own
+    undersized-pool test below)."""
+    cfg = cfgs.tiny_test().replace(max_seq_len=128)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(params, cfg, max_slots=4,
+                             prefill_buckets=[16, 32, 64],
+                             kv_block=16, kv_blocks=40)
+    return cfg, params, engine
+
+
+# -- engine layer: decode_multi against single-step decode ------------
+
+
+class TestEngineDecodeMulti:
+    @pytest.mark.parametrize("paged", [False, True],
+                             ids=["dense", "paged"])
+    def test_chunk_matches_single_steps_and_freezes(
+            self, paged, world, paged_world):
+        """One 8-chunk == 8 single steps byte-for-byte; a budget-0
+        slot never advances; a stop id sampled mid-chunk freezes the
+        slot with `advanced` counting only the real tokens. Runs on
+        the module engines (insert() frees the slot before reuse), so
+        the compiles here are the same ones the scheduler matrix
+        below exercises."""
+        cfg, params, engine = paged_world if paged else world
+        B = engine.max_slots
+        prompt = [1, 7, 3, 9]
+        temp = np.zeros(B, np.float32)
+        tk = np.zeros(B, np.int32)
+        tp = np.ones(B, np.float32)
+
+        def seeded():
+            st = engine.new_state()
+            tok, kv, tl, bucket = engine.prefill(
+                prompt, temp[:1], tk[:1], tp[:1])
+            return engine.insert(st, kv, 0, tl, tok, bucket), tok
+
+        # reference: 8 single-step dispatches; only slot 0 occupied
+        st, tok0 = seeded()
+        ref = [tok0]
+        for _ in range(8):
+            st, toks = engine.decode(st, temp, tk, tp)
+            ref.append(int(np.asarray(toks)[0]))
+
+        # one fused chunk of 8; the empty slots sit at budget 0
+        st2, tok2 = seeded()
+        budget = np.zeros(B, np.int32)
+        budget[0] = 8
+        stops = np.full((B, 4), -1, np.int32)
+        st2, out, adv = engine.decode_multi(st2, temp, tk, tp,
+                                            steps=8, budget=budget,
+                                            stop_ids=stops)
+        out, adv = np.asarray(out), np.asarray(adv)
+        assert adv.tolist() == [8] + [0] * (B - 1)
+        assert [tok2] + [int(t) for t in out[0, :8]] == ref
+        if paged:
+            # the drain-side contract: commit the advance, pool stays
+            # conserved (no leaked or double-owned blocks)
+            engine.commit_spec(0, 8)
+            ok, _ = engine.kv_conservation()
+            assert ok
+
+        # mid-chunk stop: stop id == 3rd generated token -> the loop
+        # samples it, then freezes the slot for the rest of the chunk
+        st3, _ = seeded()
+        stops3 = np.full((B, 4), -1, np.int32)
+        stops3[0, 0] = ref[3]
+        st3, out3, adv3 = engine.decode_multi(st3, temp, tk, tp,
+                                              steps=8, budget=budget,
+                                              stop_ids=stops3)
+        out3, adv3 = np.asarray(out3), np.asarray(adv3)
+        assert int(adv3[0]) == 3
+        assert [int(x) for x in out3[0, :3]] == ref[1:4]
+
+
+# -- scheduler layer: the K x depth x backend equivalence matrix ------
+
+
+PLANS = [([1, 7, 42, 99, 5], 12), ([1, 100, 200, 300], 4),
+         ([1, 250], 9), ([2, 3, 4, 5, 6, 7], 6), ([9, 8, 7], 3)]
+
+
+def _run_matrix(engine, ks=(1, 4, 8), depths=(0, 1)):
+    """Staggered admissions + slot reuse under every (K, depth)."""
+    outs = {}
+    for k in ks:
+        for depth in depths:
+            sched = Scheduler(engine, pipeline_depth=depth,
+                              steps_per_dispatch=k)
+            reqs = []
+            for i, (p, n) in enumerate(PLANS):
+                reqs.append(sched.submit(
+                    Request(prompt_ids=p, max_new_tokens=n)))
+                if i % 2:
+                    sched.step()  # stagger admissions mid-decode
+            _drive(sched, reqs, iters=2000)
+            assert all(r.finish_reason == "length" for r in reqs), \
+                [(k, depth, r.finish_reason) for r in reqs]
+            outs[(k, depth)] = [list(r.output_ids) for r in reqs]
+    return outs
+
+
+class TestSchedulerEquivalence:
+    def test_greedy_matrix_dense(self, world):
+        cfg, params, engine = world
+        want = [reference_greedy(params, cfg, p, n) for p, n in PLANS]
+        outs = _run_matrix(engine)
+        for key, got in outs.items():
+            assert got == want, key
+
+    def test_greedy_matrix_paged(self, paged_world):
+        """Chunked decode over the block-table path: the host
+        pre-grows K*(inflight+1) rows before each dispatch and commits
+        at the drain — streams must not depend on K or depth, and the
+        pool must conserve. Anchored to the K=1/depth=0 paged stream
+        (block-table attention may legitimately flip a greedy argmax
+        tie vs the DENSE reference — same discipline as
+        test_pipeline's paged equivalence)."""
+        cfg, params, engine = paged_world
+        outs = _run_matrix(engine)
+        base = outs[(1, 0)]
+        for key, got in outs.items():
+            assert got == base, key
+        ok, _ = engine.kv_conservation()
+        assert ok
+
+    @pytest.mark.parametrize("depth", [0, 1])
+    def test_midchunk_eos(self, world, depth):
+        """A stop id sampled as token 2 of an 8-chunk: the stream ends
+        at the stop token (finish_reason 'stop'), the chunk's frozen
+        tail is never emitted."""
+        cfg, params, engine = world
+        prompt = [1, 7, 42, 99, 5]
+        ref = reference_greedy(params, cfg, prompt, 8)
+        stop = ref[2]
+        want = ref[:ref.index(stop) + 1]
+        sched = Scheduler(engine, pipeline_depth=depth,
+                          steps_per_dispatch=8)
+        req = sched.submit(Request(prompt_ids=prompt,
+                                   max_new_tokens=100,
+                                   stop_ids=(stop,)))
+        _drive(sched, [req], iters=100)
+        assert req.finish_reason == "stop"
+        assert req.output_ids == want
+        n = len(req.output_ids)
+        for _ in range(5):  # frozen-tail tokens must stay discarded
+            sched.step()
+        assert len(req.output_ids) == n
+
+    def test_deadline_expiry_at_chunk_boundary(self, world):
+        """The device loop cannot observe wall-clock: a deadline
+        passing mid-chunk finishes 'timeout' at the next drain, and
+        nothing is emitted past the finish."""
+        cfg, params, engine = world
+        sched = Scheduler(engine, pipeline_depth=1,
+                          steps_per_dispatch=4)
+        req = sched.submit(Request(
+            prompt_ids=[3, 1, 4, 1, 5], max_new_tokens=10_000,
+            deadline=time.monotonic() + 0.25))
+        _drive(sched, [req], iters=10_000)
+        assert req.finish_reason == "timeout"
+        n = len(req.output_ids)
+        for _ in range(5):
+            sched.step()
+        assert len(req.output_ids) == n
+        # what WAS emitted is a clean greedy prefix
+        ref = reference_greedy(params, cfg, [3, 1, 4, 1, 5],
+                               min(n, 16))
+        assert req.output_ids[:len(ref)] == ref[:n]
+
+
+class TestPagedPreemptionBetweenChunks:
+    def test_preemption_streams_identical_across_k(self):
+        """Undersized pool (test_pipeline's paged_world shape): chunk
+        growth forces preemption between chunks; victims' in-flight
+        chunk tokens are discarded via the generation counter and the
+        resume must reproduce the same bytes at every (K, depth)."""
+        cfg = cfgs.tiny_test().replace(max_seq_len=128)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        engine = InferenceEngine(params, cfg, max_slots=4,
+                                 prefill_buckets=[32], kv_block=16,
+                                 kv_blocks=5)
+        prompts = [[i + 1, 5, 9, 13, i + 2, 40, 41, 42, 43, 44, 45,
+                    46] for i in range(4)]
+        outs, preempts = {}, {}
+        for k in (1, 4):
+            for depth in (0, 1):
+                sched = Scheduler(engine, pipeline_depth=depth,
+                                  steps_per_dispatch=k)
+                reqs = [sched.submit(Request(prompt_ids=p,
+                                             max_new_tokens=8))
+                        for p in prompts]
+                _drive(sched, reqs, iters=2000)
+                assert all(len(r.output_ids) == 8 for r in reqs)
+                outs[(k, depth)] = [list(r.output_ids) for r in reqs]
+                preempts[(k, depth)] = \
+                    sched.stats["preemptions_total"]
+        assert all(n > 0 for n in preempts.values()), preempts
+        base = outs[(1, 0)]
+        for key, got in outs.items():
+            assert got == base, key
+        ok, _ = engine.kv_conservation()
+        assert ok
+
+
+# -- journal kill-resume with a chunk in flight -----------------------
+
+
+def _wait(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline
+        time.sleep(0.005)
+
+
+class TestJournalResume:
+    def test_kill_with_chunk_in_flight_resumes_byte_identical(
+            self, world, tmp_path):
+        """Fatal engine fault at dispatch 3 (K=4, depth 1): chunk 2 is
+        in flight and its tokens are dropped unread; the resumed run
+        regenerates them and the combined stream is byte-identical to
+        the uninterrupted greedy reference."""
+        cfg, params, engine = world
+        prompt = [1, 7, 42, 99, 5]
+        want = reference_greedy(params, cfg, prompt, 12)
+
+        d = str(tmp_path)
+        faults.install("engine_step.raise@3")
+        j = RequestJournal(d, fsync="batch", fsync_interval=0.0)
+        sched = Scheduler(engine, max_restarts=0, journal=j,
+                          pipeline_depth=1, steps_per_dispatch=4)
+        sched.start()
+        req = sched.submit(Request(prompt_ids=prompt,
+                                   max_new_tokens=12))
+        assert req.done.wait(30)
+        assert req.finish_reason == "engine_fault"
+        _wait(lambda: sched.status == "dead", timeout=30)
+        got_before = list(req.output_ids)
+        # genuinely interrupted mid-stream, with a chunk discarded
+        assert 0 < len(got_before) < 12
+        assert got_before == want[:len(got_before)]
+        sched.stop()
+        j.close()
+        faults.reset()
+
+        # "new process": fresh engine + scheduler over the same dir
+        engine2 = InferenceEngine(params, cfg, max_slots=4,
+                                  prefill_buckets=[16, 32, 64])
+        j2 = RequestJournal(d)
+        sched2 = Scheduler(engine2, journal=j2, pipeline_depth=1,
+                           steps_per_dispatch=4)
+        assert sched2.resume_from_journal() == 1
+        resumed = sched2.pending.queue[0]
+        assert resumed.output_ids == got_before
+        sched2.start()
+        assert resumed.done.wait(30)
+        sched2.stop()
+        j2.close()
+        assert resumed.finish_reason == "length"
+        assert resumed.output_ids == want
+
+
+# -- degradation: never silently wrong --------------------------------
+
+
+class TestDegradation:
+    def test_engine_without_decode_multi_resets_to_one(self, caplog):
+        with caplog.at_level("WARNING", logger="ome.engine"):
+            sched = Scheduler(CountingEngine(max_slots=1),
+                              steps_per_dispatch=4)
+        assert sched.steps_per_dispatch == 1
+        assert any("multi-step" in r.message for r in caplog.records)
+        # and the degraded scheduler still serves correctly
+        req = sched.submit(Request(prompt_ids=[1], max_new_tokens=3))
+        _drive(sched, [req], iters=50)
+        assert req.finish_reason == "length"
+
+    def test_replicated_engine_opts_out(self):
+        """ReplicatedEngine's __getattr__ would leak the leader-local
+        decode_multi and desync followers — the capability flag must
+        be explicitly off."""
+        from ome_tpu.engine.multihost import ReplicatedEngine
+        assert ReplicatedEngine.supports_multi_step is False
+
+    def test_masked_batch_degrades_per_step(self, world, caplog):
+        """Structured-output slots need token k on host before mask
+        k+1: the batch runs at K=1 (synchronous, nothing in flight)
+        while masked, with a once-per-cause warning — and still emits
+        the greedy stream (the masker is permissive)."""
+        cfg, params, engine = world
+        prompt = [1, 7, 42, 99, 5]
+        want = reference_greedy(params, cfg, prompt, 6)
+        sched = Scheduler(engine, pipeline_depth=1,
+                          steps_per_dispatch=4)
+        req = sched.submit(Request(prompt_ids=prompt,
+                                   max_new_tokens=6,
+                                   masker=PassMasker()))
+        with caplog.at_level("WARNING", logger="ome.engine"):
+            for _ in range(50):
+                if req.done.is_set():
+                    break
+                sched.step()
+                assert len(sched._inflight) == 0
+        assert req.output_ids == want
+        assert "masked" in sched._multi_degraded_warned
+        # warn-once latch: exactly one degradation warning
+        assert sum("degraded" in r.message
+                   for r in caplog.records) == 1
+
+
+# -- surfaces: CLI flag, /health, telemetry, spans, lint --------------
+
+
+class TestSurfaces:
+    def test_cli_flag_default_and_parse(self):
+        from ome_tpu.engine.serve import build_parser
+        assert build_parser().parse_args(
+            ["--model-dir", "x"]).steps_per_dispatch == 1
+        args = build_parser().parse_args(
+            ["--model-dir", "x", "--steps-per-dispatch", "8"])
+        assert args.steps_per_dispatch == 8
+
+    def test_health_reports_steps_per_dispatch(self, world):
+        _, _, engine = world
+        srv = EngineServer(
+            Scheduler(engine, steps_per_dispatch=4), ByteTokenizer(),
+            model_name="tiny-test")
+        srv.start()
+        try:
+            url = f"http://127.0.0.1:{srv.port}/health"
+            with urllib.request.urlopen(url, timeout=10) as r:
+                body = json.loads(r.read())
+        finally:
+            srv.stop()
+        assert body["steps_per_dispatch"] == 4
+
+    def test_gauge_and_device_loop_phase(self, world):
+        _, _, engine = world
+        sched = Scheduler(engine, pipeline_depth=1,
+                          steps_per_dispatch=4)
+        req = sched.submit(Request(prompt_ids=[1, 2, 3],
+                                   max_new_tokens=6))
+        _drive(sched, [req], iters=100)
+        assert sched.registry.get(
+            "ome_engine_steps_per_dispatch") == 4
+        assert "ome_engine_steps_per_dispatch" in \
+            sched.registry.render()
+        # chunk dispatches attribute their device time to the
+        # device_loop phase, not the K=1 dispatch phase
+        assert sched._ph_device_loop.count > 0
+        # decode_steps_total counts TOKENS-worth of steps, not chunks
+        assert sched.stats["decode_steps_total"] >= \
+            len(req.output_ids) - 1
+
+    def test_decode_chunk_spans(self, world, tmp_path):
+        _, _, engine = world
+        log_path = tmp_path / "engine.jsonl"
+        sched = Scheduler(engine, pipeline_depth=1,
+                          steps_per_dispatch=4,
+                          span_log=str(log_path))
+        req = sched.submit(Request(prompt_ids=[1, 2, 3],
+                                   max_new_tokens=9))
+        _drive(sched, [req], iters=100)
+        sched.span_log.close()
+        chunks = [s for s in export.load_spans([log_path])
+                  if s["name"] == "engine.decode_chunk"]
+        assert chunks, "no engine.decode_chunk spans emitted"
+        assert all(s["attrs"]["steps_per_dispatch"] == 4
+                   for s in chunks)
+        # emitted tokens across chunks tile the decode stream
+        # (prefill contributes the first output token)
+        assert sum(s["attrs"]["tokens"] for s in chunks) == \
+            len(req.output_ids) - 1
+
+    def test_drain_multi_fetch_sanctioned_by_lint(self, tmp_path):
+        ok = tmp_path / "multi_sched.py"
+        ok.write_text(
+            "import numpy as np\n"
+            "class S:\n"
+            "    def _decode(self):\n"
+            "        st, out, adv = self.engine.decode_multi(\n"
+            "            self.state)\n"
+            "        self.q.append((out, adv))\n"
+            "        self._drain_multi()\n"
+            "    def _drain_multi(self):\n"
+            "        out, adv = self.q.pop()\n"
+            "        return np.asarray(out), np.asarray(adv)\n")
+        proc = subprocess.run(
+            [sys.executable,
+             str(REPO / "scripts" / "check_decode_sync.py"),
+             str(ok)],
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -23,6 +23,7 @@ The contracts under test:
     lint in test_telemetry.py).
 """
 
+import functools
 import pathlib
 import subprocess
 import sys
@@ -77,16 +78,29 @@ def paged_world():
     return cfg, params, engine
 
 
+@functools.lru_cache(maxsize=None)
+def _ref_step(cfg):
+    """Jitted single-token reference step, cached per config: the
+    reference loop is called all over the suite (here, journal, spec,
+    multistep) and an eager per-token forward dominates those tests'
+    wall time. One [1, 1] compile serves every caller."""
+    @jax.jit
+    def step(params, tok, cache):
+        logits, cache = llama.forward(params, cfg, tok, cache=cache)
+        return jnp.argmax(logits[0, -1]).astype(jnp.int32), cache
+    return step
+
+
 def reference_greedy(params, cfg, prompt_ids, n_steps):
     cache = llama.KVCache.create(cfg, 1, cfg.max_seq_len)
     tokens = jnp.asarray([prompt_ids], jnp.int32)
     logits, cache = llama.forward(params, cfg, tokens, cache=cache)
     out = [int(jnp.argmax(logits[0, -1]))]
+    step = _ref_step(cfg)
     for _ in range(n_steps - 1):
-        logits, cache = llama.forward(
-            params, cfg, jnp.asarray([[out[-1]]], jnp.int32),
-            cache=cache)
-        out.append(int(jnp.argmax(logits[0, -1])))
+        tok, cache = step(params,
+                          jnp.asarray([[out[-1]]], jnp.int32), cache)
+        out.append(int(tok))
     return out
 
 
