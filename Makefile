@@ -4,7 +4,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint test replay autoscale-soak noisy-neighbor
+.PHONY: lint test replay autoscale-soak noisy-neighbor benchgate
 
 # omelint: the repo's static-analysis gate (docs/static-analysis.md).
 # Runs every registered analyzer over ome_tpu/ and fails on any
@@ -18,6 +18,13 @@ lint:
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# bench regression gate (docs/perf-attribution.md): run bench.py
+# fresh and diff it against the newest checked-in BENCH_r*.json with
+# noise-aware per-metric bands; non-zero exit on regression. Known,
+# accepted regressions go in bench-waivers.json with a reason.
+benchgate:
+	$(PYTHON) scripts/perfgate.py --run
 
 # trace replay against a self-spawned router + CPU engine: the quick
 # "does the load generator work here" check (docs/autoscaling.md);

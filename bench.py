@@ -57,12 +57,11 @@ def sync(x):
     jax.block_until_ready(x)
     return np.asarray(jax.device_get(x))
 
-# Per-chip HBM bandwidth (GB/s) and bf16 peak (TFLOP/s) by generation;
-# CPU fallback keeps the ratios defined in dev environments.
-HBM_GBPS = {"v5 lite": 819.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1640.0,
-            "v4": 1228.0, "cpu": 50.0}
-PEAK_TFLOPS = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0,
-               "v6e": 918.0, "v4": 275.0, "cpu": 0.2}
+# Device spec tables are canonical in ome_tpu/perf/ledger.py now —
+# the engine's online roofline and this offline bench must never
+# disagree about what the hardware can do.
+from ome_tpu.perf.ledger import DEVICE_HBM_GBPS as HBM_GBPS
+from ome_tpu.perf.ledger import DEVICE_PEAK_TFLOPS as PEAK_TFLOPS
 
 import os
 

@@ -1,0 +1,57 @@
+"""jax version-compat shims.
+
+The repo targets the current jax API (`jax.shard_map`,
+`jax.set_mesh`), but CI images pin older releases where those
+spellings live elsewhere (`jax.experimental.shard_map.shard_map` with
+`check_rep=` instead of `check_vma=`; no `set_mesh` — in 0.4.x the
+`Mesh` object is itself the ambient-mesh context manager). Same
+accept-either discipline as the `TPUCompilerParams` shim in
+ops/int4_matmul.py: resolve once at import, translate keywords, keep
+call sites written against the new API.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+_new_shard_map = getattr(jax, "shard_map", None)
+if _new_shard_map is None:
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """`jax.shard_map` with the modern keyword surface on any jax.
+
+    Old releases spell the replication/varying-manual-axes check
+    `check_rep=`; the semantics callers rely on (disable the check for
+    psum-combined outputs) are the same, so the flag translates 1:1.
+    """
+    if _new_shard_map is not None:
+        return _new_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=check_vma)
+    return _old_shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
+
+
+def set_mesh(mesh):
+    """Context manager making `mesh` the ambient mesh.
+
+    New jax: `jax.set_mesh`. 0.4.x fallback: entering the `Mesh`
+    object installs it in the resource env, which is what pjit-era
+    PartitionSpec resolution reads.
+    """
+    fn = getattr(jax, "set_mesh", None)
+    if fn is not None:
+        return fn(mesh)
+    use = getattr(jax.sharding, "use_mesh", None)
+    if use is not None:
+        return use(mesh)
+    return _mesh_ctx(mesh)
+
+
+@contextlib.contextmanager
+def _mesh_ctx(mesh):
+    with mesh:
+        yield mesh

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -34,6 +35,8 @@ from ..models.config import ModelConfig
 from .sampling import sample, spec_verify
 
 Params = llama.Params
+
+log = logging.getLogger("ome.engine.core")
 
 
 @jax.tree_util.register_dataclass
@@ -220,7 +223,8 @@ class InferenceEngine:
                  prefill_buckets: Optional[List[int]] = None,
                  prefix_cache_bytes: int = 0,
                  lora_slots: int = 0, lora_rank: int = 16,
-                 kv_block: int = 0, kv_blocks: Optional[int] = None):
+                 kv_block: int = 0, kv_blocks: Optional[int] = None,
+                 ledger=None):
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -649,6 +653,65 @@ class InferenceEngine:
         # exist (a near-pool-size batch request must not livelock as
         # its own repeated victim)
         self._growing_slot: Optional[int] = None
+        # program cost ledger (perf/ledger.py): every dispatch below
+        # routes through _ledger_capture so each compiled program gets
+        # one cost entry; the default is mode "auto" (introspect on
+        # TPU, analytic model elsewhere)
+        if ledger is None:
+            from ..perf.ledger import ProgramLedger
+            ledger = ProgramLedger()
+        self.ledger = ledger
+        self._weight_bytes: Optional[int] = None
+        self._param_count: Optional[int] = None
+
+    # -- cost model (perf ledger fallback) -----------------------------
+
+    def _cost_model(self, tokens: int, kv_rows: int,
+                    weight_passes: int = 1) -> Dict[str, float]:
+        """Analytic {flops, bytes} for a program moving the whole
+        weight set `weight_passes` times while processing `tokens`
+        positions against `kv_rows` cached KV rows — the ledger's
+        estimate when compiler introspection is unavailable. Shares
+        the quantizer's byte model so ledger and checkpoint-size
+        accounting can't drift."""
+        if self._weight_bytes is None:
+            from ..models.quant import quantized_bytes
+            self._weight_bytes = quantized_bytes(self.params)
+            self._param_count = sum(
+                int(leaf.size) for leaf in jax.tree_util.tree_leaves(
+                    self.params))
+        cfg = self.cfg
+        row = (cfg.num_layers * cfg.kv_cache_heads
+               * (cfg.kv_cache_k_dim + cfg.kv_cache_v_dim)
+               * jnp.dtype(cfg.dtype).itemsize)
+        return {
+            "bytes": float(weight_passes * self._weight_bytes
+                           + kv_rows * row),
+            "flops": 2.0 * self._param_count * max(tokens, 1),
+        }
+
+    def _kv_capacity_rows(self) -> int:
+        """KV rows the decode cache can address — the bytes a decode
+        step's attention streams in the worst case."""
+        if self.kv_block:
+            return self.kv_blocks * self.kv_block
+        return self.max_slots * self.max_seq
+
+    def _ledger_capture(self, name: str, static_desc: str, fn, args,
+                        static_kwargs, *, tokens: int, kv_rows: int,
+                        weight_passes: int = 1) -> None:
+        """Record the program about to be dispatched. Never raises:
+        observability must not take down a decode step."""
+        led = self.ledger
+        if led is None or led.mode == "off":
+            return
+        try:
+            led.capture(name, static_desc, fn, args, static_kwargs,
+                        self._cost_model(tokens, kv_rows,
+                                         weight_passes))
+        except Exception:  # pragma: no cover - defensive
+            log.debug("ledger capture failed for %s", name,
+                      exc_info=True)
 
     def _next_key(self):
         with self._rng_lock:
@@ -993,27 +1056,41 @@ class InferenceEngine:
             bucket = _bucketize(plen + sbucket, self.prefill_buckets)
             padded = np.asarray(
                 [suffix + [0] * (sbucket - len(suffix))], np.int32)
-            tok, k, v = self._prefill_suffix_fn(
-                self.params, pk, pv, np.asarray(plen, np.int32),
-                padded, np.asarray([len(suffix)], np.int32),
-                *sampling, key, total_bucket=bucket,
-                keep=min(plen, bucket))
+            args = (self.params, pk, pv, np.asarray(plen, np.int32),
+                    padded, np.asarray([len(suffix)], np.int32),
+                    *sampling, key)
+            kw = dict(total_bucket=bucket, keep=min(plen, bucket))
+            self._ledger_capture(
+                "prefill_suffix", f"total={bucket},keep={kw['keep']}",
+                self._prefill_suffix_fn, args, kw,
+                tokens=sbucket, kv_rows=bucket)
+            tok, k, v = self._prefill_suffix_fn(*args, **kw)
         else:
             bucket = _bucketize(len(ids), self.prefill_buckets)
             padded = np.asarray(
                 [ids + [0] * (bucket - len(ids))], np.int32)
             aid_arr = np.asarray([aid], np.int32)
             if first_mask is not None:
-                tok, k, v = self._prefill_masked_fn(
-                    self.params, padded,
-                    np.asarray([len(ids)], np.int32), *sampling, key,
-                    np.asarray(first_mask, bool)[None, :], aid_arr,
-                    bucket=bucket)
+                args = (self.params, padded,
+                        np.asarray([len(ids)], np.int32), *sampling,
+                        key, np.asarray(first_mask, bool)[None, :],
+                        aid_arr)
+                self._ledger_capture(
+                    "prefill_masked", f"bucket={bucket}",
+                    self._prefill_masked_fn, args,
+                    dict(bucket=bucket), tokens=bucket,
+                    kv_rows=bucket)
+                tok, k, v = self._prefill_masked_fn(*args,
+                                                    bucket=bucket)
             else:
-                tok, k, v = self._prefill_fn(
-                    self.params, padded,
-                    np.asarray([len(ids)], np.int32), *sampling, key,
-                    aid_arr, bucket=bucket)
+                args = (self.params, padded,
+                        np.asarray([len(ids)], np.int32), *sampling,
+                        key, aid_arr)
+                self._ledger_capture(
+                    "prefill", f"bucket={bucket}", self._prefill_fn,
+                    args, dict(bucket=bucket), tokens=bucket,
+                    kv_rows=bucket)
+                tok, k, v = self._prefill_fn(*args, bucket=bucket)
         if aid == 0:
             self.prefix_cache.put(ids, k, v, len(ids), bucket)
         # multi-host: int() on an array spanning non-addressable
@@ -1107,20 +1184,34 @@ class InferenceEngine:
                 self._table_dev = jnp.asarray(self._table.copy())
                 self._table_dirty = False
             table = self._table_dev
+            cap = self._kv_capacity_rows()
             if mask is not None:
-                state, toks = self._decode_masked_paged_fn(
-                    self.params, state, table, *sampling, key,
-                    np.asarray(mask, bool))
+                args = (self.params, state, table, *sampling, key,
+                        np.asarray(mask, bool))
+                self._ledger_capture(
+                    "decode_masked_paged", "",
+                    self._decode_masked_paged_fn, args, {},
+                    tokens=self.max_slots, kv_rows=cap)
+                state, toks = self._decode_masked_paged_fn(*args)
             else:
-                state, toks = self._decode_paged_fn(
-                    self.params, state, table, *sampling, key)
+                args = (self.params, state, table, *sampling, key)
+                self._ledger_capture(
+                    "decode_paged", "", self._decode_paged_fn, args,
+                    {}, tokens=self.max_slots, kv_rows=cap)
+                state, toks = self._decode_paged_fn(*args)
         elif mask is not None:
-            state, toks = self._decode_masked_fn(
-                self.params, state, *sampling, key,
-                np.asarray(mask, bool))
+            args = (self.params, state, *sampling, key,
+                    np.asarray(mask, bool))
+            self._ledger_capture(
+                "decode_masked", "", self._decode_masked_fn, args, {},
+                tokens=self.max_slots, kv_rows=self._kv_capacity_rows())
+            state, toks = self._decode_masked_fn(*args)
         else:
-            state, toks = self._decode_fn(self.params, state,
-                                          *sampling, key)
+            args = (self.params, state, *sampling, key)
+            self._ledger_capture(
+                "decode", "", self._decode_fn, args, {},
+                tokens=self.max_slots, kv_rows=self._kv_capacity_rows())
+            state, toks = self._decode_fn(*args)
         copy = getattr(toks, "copy_to_host_async", None)
         if copy is not None:  # sharded/global arrays may not have it
             copy()
@@ -1163,13 +1254,22 @@ class InferenceEngine:
             if self._table_dirty or self._table_dev is None:
                 self._table_dev = jnp.asarray(self._table.copy())
                 self._table_dirty = False
-            state, toks, adv = self._decode_multi_paged_fn(
-                self.params, state, self._table_dev, *sampling, key,
-                budget, stop_ids, n=n)
+            args = (self.params, state, self._table_dev, *sampling,
+                    key, budget, stop_ids)
+            self._ledger_capture(
+                "decode_multi_paged", f"n={n}",
+                self._decode_multi_paged_fn, args, dict(n=n),
+                tokens=self.max_slots * n,
+                kv_rows=n * self._kv_capacity_rows(), weight_passes=n)
+            state, toks, adv = self._decode_multi_paged_fn(*args, n=n)
         else:
-            state, toks, adv = self._decode_multi_fn(
-                self.params, state, *sampling, key, budget, stop_ids,
-                n=n)
+            args = (self.params, state, *sampling, key, budget,
+                    stop_ids)
+            self._ledger_capture(
+                "decode_multi", f"n={n}", self._decode_multi_fn, args,
+                dict(n=n), tokens=self.max_slots * n,
+                kv_rows=n * self._kv_capacity_rows(), weight_passes=n)
+            state, toks, adv = self._decode_multi_fn(*args, n=n)
         for arr in (toks, adv):
             copy = getattr(arr, "copy_to_host_async", None)
             if copy is not None:
@@ -1207,13 +1307,23 @@ class InferenceEngine:
             if self._table_dirty or self._table_dev is None:
                 self._table_dev = jnp.asarray(self._table.copy())
                 self._table_dirty = False
-            state, out, accepted = self._verify_paged_fn(
-                self.params, state, self._table_dev, drafts,
-                draft_len, *sampling, key, k=k)
+            args = (self.params, state, self._table_dev, drafts,
+                    draft_len, *sampling, key)
+            self._ledger_capture(
+                "verify_paged", f"k={k}", self._verify_paged_fn, args,
+                dict(k=k), tokens=self.max_slots * (k + 1),
+                kv_rows=self._kv_capacity_rows()
+                + self.max_slots * (k + 1))
+            state, out, accepted = self._verify_paged_fn(*args, k=k)
         else:
-            state, out, accepted = self._verify_fn(
-                self.params, state, drafts, draft_len, *sampling,
-                key, k=k)
+            args = (self.params, state, drafts, draft_len, *sampling,
+                    key)
+            self._ledger_capture(
+                "verify", f"k={k}", self._verify_fn, args, dict(k=k),
+                tokens=self.max_slots * (k + 1),
+                kv_rows=self._kv_capacity_rows()
+                + self.max_slots * (k + 1))
+            state, out, accepted = self._verify_fn(*args, k=k)
         for arr in (out, accepted):
             copy = getattr(arr, "copy_to_host_async", None)
             if copy is not None:

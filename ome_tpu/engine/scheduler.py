@@ -103,13 +103,17 @@ class _MultiStep:
     chunk size, and the dispatch timestamp for the decode_chunk
     span."""
 
-    __slots__ = ("out", "advanced", "k", "t_dispatch")
+    __slots__ = ("out", "advanced", "k", "t_dispatch", "cost")
 
-    def __init__(self, out, advanced, k, t_dispatch):
+    def __init__(self, out, advanced, k, t_dispatch, cost=None):
         self.out = out
         self.advanced = advanced
         self.k = k
         self.t_dispatch = t_dispatch
+        # the ledger entry of the dispatched program (perf/ledger.py)
+        # — the drain attributes program/expected_ms on the
+        # decode_chunk span when present
+        self.cost = cost
 
 
 # fixed width of the per-slot device stop table: stop ids past this
@@ -370,8 +374,13 @@ class Scheduler:
                  span_chunk_steps: int = 8,
                  class_weights=None,
                  class_wait_caps=None,
-                 priority_scheduling: bool = True):
+                 priority_scheduling: bool = True,
+                 slow_step_factor: float = 4.0):
         self.engine = engine
+        # slow-step outlier threshold: a step slower than this factor
+        # times the rolling median records a slow_step flight event
+        # (docs/perf-attribution.md)
+        self.slow_step_factor = float(slow_step_factor)
         # span timeline (docs/tracing-timeline.md): per-phase spans
         # (queue, prefill, chunked decode, spec verify, journal
         # replay) written to the `--span-log` JSONL; a None path is a
@@ -453,6 +462,16 @@ class Scheduler:
         bindf = getattr(engine, "bind_flight", None)
         if callable(bindf):
             bindf(self.flight)
+        # performance attribution (ome_tpu/perf): the engine's program
+        # cost ledger exports through the scheduler's registry/flight,
+        # and a real engine gets an HBM accountant refreshed from
+        # update_gauges() (fakes in tests have no params/cfg -> None)
+        led = getattr(engine, "ledger", None)
+        if led is not None and callable(getattr(led, "bind", None)):
+            led.bind(self.registry, self.flight)
+        from ..perf.hbm import HbmAccountant
+        self.hbm = HbmAccountant.for_engine(engine, self.registry,
+                                            self.flight)
         # crash recovery: consecutive engine-fault restarts tolerated
         # before going permanently dead (0 = first fault is fatal, the
         # pre-recovery fail-fast behavior)
@@ -684,6 +703,33 @@ class Scheduler:
             "ome_engine_class_queue_depth",
             "Pending-queue depth by priority class",
             labelnames=("class",)))
+        # online roofline (docs/perf-attribution.md): the ledger's
+        # bytes-per-dispatch over the measured step time, gauged every
+        # step and distributed for the long view; only meaningful when
+        # the engine carries a ledger (fakes skip the update path)
+        self._g_roofline_eff = R.gauge(
+            "ome_engine_roofline_efficiency",
+            "Expected-over-measured time of the last decode dispatch "
+            "(1.0 = running at the device roofline)")
+        self._g_achieved_gbps = R.gauge(
+            "ome_engine_step_achieved_gbps",
+            "Ledger bytes of the last decode dispatch over its "
+            "measured wall time, in GB/s")
+        self._h_roofline_eff = R.histogram(
+            "ome_engine_roofline_step_efficiency",
+            "Per-dispatch roofline efficiency distribution",
+            buckets=(0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8,
+                     0.9, 1.0, 1.25, 1.5))
+        self._c_slow_steps = R.counter(
+            "ome_engine_slow_steps_total",
+            "Decode steps exceeding slow_step_factor x the rolling "
+            "median step time (each also records a slow_step flight "
+            "event with the phase breakdown)")
+        # rolling per-step-time window feeding the slow-step outlier
+        # detector; deque append/iterate under the GIL is safe from
+        # the single decode thread
+        self._step_window: "collections.deque[float]" = \
+            collections.deque(maxlen=64)
         self._journal_compactions_seen = (
             self.journal.compactions if self.journal is not None else 0)
 
@@ -996,6 +1042,11 @@ class Scheduler:
         pd = getattr(self.engine, "update_pd_gauges", None)
         if callable(pd):
             pd()
+        # live HBM partition (perf/hbm.py): refreshed per scrape, not
+        # per step — memory_stats() is a host call the decode loop
+        # should not pay
+        if self.hbm is not None:
+            self.hbm.update(self.engine)
 
     # -- public --------------------------------------------------------
 
@@ -1777,6 +1828,13 @@ class Scheduler:
                      start_wall=time.time() - (time.monotonic()
                                                - step.t_dispatch))
             s.end().set(steps_per_dispatch=step.k, tokens=emitted)
+            if step.cost is not None:
+                # cost attribution from the program ledger: which
+                # compiled program this chunk ran and what the
+                # roofline said it should have cost
+                s.set(program=step.cost["program"],
+                      expected_ms=round(step.cost["expected_ms"], 3),
+                      program_bytes=step.cost["bytes"])
             self.span_log.write(s)
         self._flight_event("multi_chunk", k=step.k, emitted=emitted)
         self._ph_sample.observe(time.monotonic() - t_fetched)
@@ -1804,10 +1862,12 @@ class Scheduler:
             if not any(r is not None for r in self.slots):
                 return True  # draining finished every slot
         mask = None
+        mask_s = 0.0
         if masked:
             tm0 = time.monotonic()
             mask = self._build_mask()
-            self._ph_mask.observe(time.monotonic() - tm0)
+            mask_s = time.monotonic() - tm0
+            self._ph_mask.observe(mask_s)
         # speculative decoding: draft with the host-side n-gram
         # matcher and verify the whole batch in one multi-token
         # forward. Masked batches stay non-speculative (the grammar
@@ -1861,8 +1921,10 @@ class Scheduler:
             k_steps = 1
         sampling = self._sampling()
         t0 = time.monotonic()
+        gap_s = None
         if self._dispatch_end is not None:
-            self._h_step_gap.observe(t0 - self._dispatch_end)
+            gap_s = t0 - self._dispatch_end
+            self._h_step_gap.observe(gap_s)
         if mask is not None:
             self.state, toks = self.engine.decode(
                 self.state, *sampling, mask=mask)
@@ -1880,7 +1942,10 @@ class Scheduler:
                 budget=self._multi_budget(k_steps),
                 stop_ids=self._stop_table(),
                 lookahead_rows=lookahead)
-            toks = _MultiStep(out, adv, k_steps, t0)
+            led = getattr(self.engine, "ledger", None)
+            toks = _MultiStep(
+                out, adv, k_steps, t0,
+                cost=led.last_dispatch() if led is not None else None)
         else:  # engine wrappers/fakes need no mask kwarg in their API
             self.state, toks = self.engine.decode(
                 self.state, *sampling)
@@ -1896,6 +1961,8 @@ class Scheduler:
             self._ph_device_loop.observe(dt)
         else:
             self._ph_dispatch.observe(dt)
+        self._observe_roofline(toks, dt, dt_step, k_steps,
+                               gap_s, mask_s)
         self._inc("decode_steps_total", k_steps)
         if drafts is not None:
             self._inc("spec_steps_total")
@@ -1952,6 +2019,43 @@ class Scheduler:
             s.end().set(proposed=prop, accepted=acc)
             self.span_log.write(s)
         return True
+
+    def _observe_roofline(self, toks, dt: float, dt_step: float,
+                          k_steps: int, gap_s, mask_s: float) -> None:
+        """Per-dispatch online roofline + slow-step outlier detection
+        (docs/perf-attribution.md). Both need the ledger entry of the
+        program just dispatched — engines without one (fakes, remote
+        wrappers) only feed the slow-step window."""
+        led = getattr(self.engine, "ledger", None)
+        entry = led.last_dispatch() if led is not None else None
+        if entry is not None and dt > 0:
+            self._g_achieved_gbps.set(entry["bytes"] / dt / 1e9)
+            eff = (entry["expected_ms"] / 1000.0) / dt
+            self._g_roofline_eff.set(eff)
+            self._h_roofline_eff.observe(eff)
+        # slow-step detector: compare against the rolling median of
+        # recent per-step times, not a fixed threshold — "slow" means
+        # slow relative to THIS batch shape on THIS device. Warm-up
+        # (first few steps, compiles) is excluded by requiring a
+        # half-full window before judging.
+        win = self._step_window
+        if len(win) >= win.maxlen // 2:
+            med = sorted(win)[len(win) // 2]
+            if med > 0 and dt_step > self.slow_step_factor * med:
+                self._c_slow_steps.inc()
+                fields = dict(
+                    step_ms=round(dt_step * 1e3, 3),
+                    median_ms=round(med * 1e3, 3),
+                    ratio=round(dt_step / med, 2),
+                    k_steps=k_steps,
+                    mask_ms=round(mask_s * 1e3, 3),
+                    gap_ms=round((gap_s or 0.0) * 1e3, 3))
+                if entry is not None:
+                    fields["program"] = entry["program"]
+                    fields["expected_ms"] = round(
+                        entry["expected_ms"], 3)
+                self._flight_event("slow_step", **fields)
+        win.append(dt_step)
 
     def _spec_headroom(self, k: int) -> bool:
         """True when every active slot has cache headroom for the k+1

@@ -208,10 +208,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(docs/tracing-timeline.md)")
     p.add_argument("--debug-endpoints", action="store_true",
                    help="enable GET /debug/events (flight-recorder "
-                        "ring) and GET /debug/state (scheduler "
-                        "snapshot); 403 when off — these expose "
+                        "ring), GET /debug/state (scheduler "
+                        "snapshot) and GET /debug/programs (program "
+                        "cost ledger); 403 when off — these expose "
                         "request ids and internals, keep them off "
                         "public listeners")
+    p.add_argument("--ledger-mode", default="auto",
+                   choices=("auto", "full", "model", "off"),
+                   help="program cost ledger (docs/perf-attribution"
+                        ".md): auto = XLA cost introspection on TPU, "
+                        "analytic byte model elsewhere; full/model "
+                        "force a path; off disables capture")
     p.add_argument("--flight-events", type=int, default=2048,
                    metavar="N",
                    help="flight-recorder ring capacity: the last N "
@@ -287,8 +294,10 @@ def _adapter_args(args):
 def load_engine(args, dist=None):
     import jax.numpy as jnp
 
+    from ..perf.ledger import ProgramLedger
     from .core import InferenceEngine
 
+    ledger = ProgramLedger(mode=getattr(args, "ledger_mode", "auto"))
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     params, cfg = _load_params_cfg(args, dtype)
     if dist is not None and args.tp <= 1:
@@ -329,7 +338,8 @@ def load_engine(args, dist=None):
         return ShardedInferenceEngine(params, cfg, tp=args.tp,
                                       max_slots=args.max_slots,
                                       max_seq=max_seq,
-                                      prefix_cache_bytes=args.prefix_cache_mb << 20)
+                                      prefix_cache_bytes=args.prefix_cache_mb << 20,
+                                      ledger=ledger)
     import jax
     params = jax.tree.map(jnp.asarray, params)  # one transfer
 
@@ -340,7 +350,8 @@ def load_engine(args, dist=None):
                                lora_slots=lora_slots,
                                lora_rank=args.lora_rank,
                                kv_block=kv_block,
-                               kv_blocks=kv_blocks)
+                               kv_blocks=kv_blocks,
+                               ledger=ledger)
     try:
         engine = build(args.kv_block, args.kv_blocks)
     except ValueError as e:

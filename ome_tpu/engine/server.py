@@ -36,7 +36,7 @@ _KNOWN_PATHS = frozenset((
     "/health", "/healthz", "/ready", "/metrics", "/v1/models",
     "/v1/completions", "/v1/chat/completions", "/v1/embeddings",
     "/v1/adapters", "/pd/prefill", "/debug/profile",
-    "/debug/events", "/debug/state"))
+    "/debug/events", "/debug/state", "/debug/programs"))
 
 
 def _path_label(path: str) -> str:
@@ -207,6 +207,8 @@ class EngineServer:
                     self._debug_events()
                 elif self.path.split("?", 1)[0] == "/debug/state":
                     self._debug_state()
+                elif self.path.split("?", 1)[0] == "/debug/programs":
+                    self._debug_programs()
                 else:
                     self._json(404, {"error": "not found"})
 
@@ -240,6 +242,24 @@ class EngineServer:
                 doc = fl.state()
                 doc["events"] = fl.snapshot(n)
                 return self._json(200, doc)
+
+            def _debug_programs(self):
+                """GET /debug/programs — the engine's program cost
+                ledger (perf/ledger.py): one entry per compiled
+                program with FLOPs, bytes moved, memory breakdown and
+                expected roofline ms."""
+                if not self._debug_guard():
+                    return
+                led = getattr(getattr(outer.scheduler, "engine", None),
+                              "ledger", None)
+                if led is None:
+                    return self._json(404, {
+                        "error": "engine has no program ledger"})
+                return self._json(200, {
+                    "device": led.device_spec(),
+                    "mode": led.mode,
+                    "count": len(led),
+                    "programs": led.snapshot()})
 
             def _debug_state(self):
                 """GET /debug/state — live scheduler snapshot (slots,
@@ -302,10 +322,12 @@ class EngineServer:
                                  "--profile-dir to enable)"})
                 qs = urllib.parse.urlparse(self.path).query
                 params = urllib.parse.parse_qs(qs)
+                led = getattr(getattr(outer.scheduler, "engine", None),
+                              "ledger", None)
                 try:
                     seconds = float(params.get("seconds", ["1"])[0])
                     result = _profiler.capture(outer.profile_dir,
-                                               seconds)
+                                               seconds, ledger=led)
                 except ValueError as e:
                     return self._json(400, {"error": str(e)})
                 except _profiler.ProfileInProgress as e:

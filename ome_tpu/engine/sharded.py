@@ -42,7 +42,8 @@ class ShardedInferenceEngine(InferenceEngine):
                  prefill_buckets: Optional[List[int]] = None,
                  mesh: Optional[Mesh] = None,
                  prefix_cache_bytes: int = 0,
-                 lora_slots: int = 0, lora_rank: int = 16):
+                 lora_slots: int = 0, lora_rank: int = 16,
+                 ledger=None):
         if not cfg.mla and cfg.num_kv_heads % tp != 0:
             raise ValueError(
                 f"tp={tp} must divide num_kv_heads={cfg.num_kv_heads} "
@@ -60,7 +61,8 @@ class ShardedInferenceEngine(InferenceEngine):
         super().__init__(params, cfg, max_slots=max_slots, max_seq=max_seq,
                          prefill_buckets=prefill_buckets,
                          prefix_cache_bytes=prefix_cache_bytes,
-                         lora_slots=lora_slots, lora_rank=lora_rank)
+                         lora_slots=lora_slots, lora_rank=lora_rank,
+                         ledger=ledger)
 
     # tp-sharded weights must not hit the un-partitioned int4 Pallas
     # kernel (GSPMD would replicate + all-gather the packed weight per

@@ -23,8 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from jax import shard_map
-
+from ..compat import shard_map
 from ..models.config import ModelConfig
 
 

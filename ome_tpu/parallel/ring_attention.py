@@ -28,8 +28,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ..compat import shard_map
 
 M_INIT = -1.0e30
 

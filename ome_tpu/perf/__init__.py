@@ -1,0 +1,29 @@
+"""Device-performance attribution (docs/perf-attribution.md).
+
+Three always-on, cheap observability surfaces that make ROADMAP
+item 2 ("close the decode roofline gap") chaseable from a live
+replica instead of from bench.py reruns:
+
+  * ledger  — per-compiled-program cost ledger (FLOPs, bytes,
+              expected roofline ms) captured at first dispatch,
+              served at GET /debug/programs;
+  * hbm     — live HBM occupancy partitioned against the known
+              tenants (weights / KV cache / prefix cache /
+              workspace), with a new-peak watermark flight event;
+  * the scheduler combines the ledger's bytes-per-step with its own
+    step timestamps into an online roofline-efficiency signal and a
+    slow-step outlier detector (engine/scheduler.py).
+
+scripts/perfgate.py closes the loop offline: it diffs fresh bench.py
+output against the checked-in BENCH history and emits the fitted
+per-program cost table ROADMAP item 6's fleet simulator consumes.
+"""
+
+from .hbm import HBM_TENANTS, HbmAccountant
+from .ledger import (DEVICE_HBM_GBPS, DEVICE_PEAK_TFLOPS, ProgramLedger,
+                     device_spec, roofline_ms)
+
+__all__ = [
+    "DEVICE_HBM_GBPS", "DEVICE_PEAK_TFLOPS", "HBM_TENANTS",
+    "HbmAccountant", "ProgramLedger", "device_spec", "roofline_ms",
+]

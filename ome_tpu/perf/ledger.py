@@ -1,0 +1,314 @@
+"""Program cost ledger: what each compiled device program moves.
+
+The decode roofline in bench.py is a hand-maintained bytes-per-token
+model; the compiler already knows the truth. When the engine
+dispatches a program for the first time (one ledger entry per
+(program, static-args) pair — the jit compile key), the ledger asks
+the AOT path for it: `fn.lower(...).compile()` then
+`cost_analysis()` (FLOPs, bytes accessed) and `memory_analysis()`
+(argument/output/temp bytes). Off-TPU — where a second CPU compile
+of a production-sized model would be pure waste and the analysis is
+not the one serving runs — the ledger degrades to the analytic
+byte model the quantizer already maintains (models/quant.py
+`quantized_bytes` + KV-capacity arithmetic), flagged
+`source: "model"` so a reader never mistakes an estimate for a
+measurement.
+
+Expected ms is the roofline max of the memory and compute terms
+against the device spec table bench.py shares from here. The entry
+set is bounded by construction: programs are compiled, and
+compilation is expensive — a serving process accumulates a handful
+of entries, not a stream.
+
+Surfaces: GET /debug/programs (guarded by --debug-endpoints),
+`ome_engine_program_flops` / `ome_engine_program_bytes` gauges,
+attrs on `engine.decode_chunk` spans, and the POST /debug/profile
+response body.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+# Per-chip HBM bandwidth (GB/s) and bf16 peak (TFLOP/s) by
+# generation; bench.py imports these so the offline and online
+# rooflines can never disagree about the device spec. CPU entries
+# keep the ratios defined in dev environments.
+DEVICE_HBM_GBPS = {"v5 lite": 819.0, "v5e": 819.0, "v5p": 2765.0,
+                   "v6e": 1640.0, "v4": 1228.0, "cpu": 50.0}
+DEVICE_PEAK_TFLOPS = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0,
+                      "v6e": 918.0, "v4": 275.0, "cpu": 0.2}
+
+LEDGER_MODES = ("auto", "full", "model", "off")
+
+log = logging.getLogger("ome.perf.ledger")
+
+
+def device_spec(device=None) -> Dict[str, object]:
+    """{kind, platform, hbm_gbps, peak_tflops} for `device` (default:
+    jax.devices()[0]). Matching mirrors bench.py's table lookup:
+    substring on device_kind, platform-keyed fallback."""
+    import jax
+    if device is None:
+        try:
+            device = jax.devices()[0]
+        except Exception:  # pragma: no cover - no backend at all
+            return {"kind": "unknown", "platform": "unknown",
+                    "hbm_gbps": DEVICE_HBM_GBPS["cpu"],
+                    "peak_tflops": DEVICE_PEAK_TFLOPS["cpu"]}
+    kind = str(getattr(device, "device_kind",
+                       getattr(device, "platform", "cpu"))).lower()
+    platform = str(getattr(device, "platform", "cpu"))
+
+    def _lookup(table):
+        for key, val in table.items():
+            if key in kind:
+                return val
+        return table["cpu" if platform == "cpu" else "v5e"]
+
+    return {"kind": kind, "platform": platform,
+            "hbm_gbps": _lookup(DEVICE_HBM_GBPS),
+            "peak_tflops": _lookup(DEVICE_PEAK_TFLOPS)}
+
+
+def roofline_ms(flops: float, bytes_moved: float, hbm_gbps: float,
+                peak_tflops: float) -> float:
+    """Expected program ms at the roofline: the slower of streaming
+    `bytes_moved` at spec bandwidth and computing `flops` at peak."""
+    mem_s = bytes_moved / max(hbm_gbps * 1e9, 1e-9)
+    compute_s = flops / max(peak_tflops * 1e12, 1e-9)
+    return max(mem_s, compute_s) * 1000.0
+
+
+def _on_tpu() -> bool:
+    from ..ops.int4_matmul import _on_tpu_device
+    return _on_tpu_device()
+
+
+class ProgramLedger:
+    """One entry per compiled engine program, captured at first
+    dispatch (the engine calls `capture` immediately before every
+    program call; repeats only bump the dispatch count).
+
+    mode: "auto" = full AOT introspection on TPU, analytic model
+    off-TPU (TPU-less CI must not pay a second compile of every
+    program — and its numbers would describe the CPU fallback, not
+    the device serving runs on); "full"/"model" force a path (tests
+    force "full" on tiny CPU models); "off" disables capture.
+    """
+
+    def __init__(self, mode: str = "auto", registry=None, flight=None):
+        if mode not in LEDGER_MODES:
+            raise ValueError(
+                f"ledger mode {mode!r} not in {LEDGER_MODES}")
+        self.mode = mode
+        self.flight = flight
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, dict]" = OrderedDict()
+        self._last: Optional[dict] = None
+        self._g_flops = None
+        self._g_bytes = None
+        self._spec: Optional[dict] = None
+        self._warned = False
+        if registry is not None:
+            self.bind(registry)
+
+    # -- wiring --------------------------------------------------------
+
+    def bind(self, registry, flight=None) -> None:
+        """Attach the serving registry (and optionally the flight
+        ring) after construction — the scheduler owns both and the
+        engine is built first. Entries captured before the bind are
+        exported retroactively."""
+        # program label values are compile keys — bounded by
+        # construction (entries exist only for compiled programs)
+        self._g_flops = registry.gauge(
+            "ome_engine_program_flops",
+            "FLOPs per dispatch of each compiled engine program, from "
+            "XLA cost_analysis (or the analytic model off-TPU)",
+            labelnames=("program",))
+        self._g_bytes = registry.gauge(
+            "ome_engine_program_bytes",
+            "HBM bytes moved per dispatch of each compiled engine "
+            "program, from XLA cost_analysis (or the analytic model "
+            "off-TPU)", labelnames=("program",))
+        if flight is not None:
+            self.flight = flight
+        with self._lock:
+            entries = list(self._entries.values())
+        for e in entries:
+            self._export(e)
+
+    def device_spec(self) -> Dict[str, object]:
+        if self._spec is None:
+            self._spec = device_spec()
+        return self._spec
+
+    # -- capture -------------------------------------------------------
+
+    def _resolved_mode(self) -> str:
+        if self.mode != "auto":
+            return self.mode
+        return "full" if _on_tpu() else "model"
+
+    def capture(self, name: str, static_desc: str, fn, args,
+                static_kwargs: Dict[str, object],
+                model: Dict[str, float]) -> Optional[dict]:
+        """Record program `name` (e.g. "decode_multi", static args
+        described by `static_desc`, e.g. "n=8") about to be
+        dispatched as `fn(*args, **static_kwargs)`. `model` is the
+        engine's analytic {flops, bytes} estimate — the fallback
+        when compiler introspection is off or fails. Returns the
+        (shared, mutable) entry; None when the ledger is off."""
+        if self.mode == "off":
+            return None
+        key = f"{name}[{static_desc}]" if static_desc else name
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                entry["dispatches"] += 1
+                self._last = entry
+                return entry
+        entry = self._build_entry(key, name, static_desc, fn, args,
+                                  static_kwargs, model)
+        with self._lock:
+            entry = self._entries.setdefault(key, entry)
+            entry["dispatches"] += 1
+            self._last = entry
+        self._export(entry)
+        if self.flight is not None:
+            self.flight.record(
+                "program_captured", program=key,
+                source=entry["source"],
+                expected_ms=entry["expected_ms"],
+                bytes=entry["bytes"], flops=entry["flops"])
+        return entry
+
+    def _build_entry(self, key, name, static_desc, fn, args,
+                     static_kwargs, model) -> dict:
+        spec = self.device_spec()
+        entry = {
+            "program": key,
+            "name": name,
+            "static": static_desc,
+            "source": "model",
+            "flops": float(model.get("flops", 0.0)),
+            "bytes": float(model.get("bytes", 0.0)),
+            "argument_bytes": None,
+            "output_bytes": None,
+            "temp_bytes": None,
+            "device": spec["kind"],
+            "dispatches": 0,
+            "captured_unix": time.time(),
+        }
+        if self._resolved_mode() == "full" and fn is not None:
+            self._introspect(entry, fn, args, static_kwargs)
+        entry["expected_ms"] = roofline_ms(
+            entry["flops"], entry["bytes"],
+            spec["hbm_gbps"], spec["peak_tflops"])
+        return entry
+
+    def _introspect(self, entry, fn, args, static_kwargs) -> None:
+        """AOT compiler introspection; any failure leaves the
+        analytic-model numbers in place (never break a dispatch over
+        observability)."""
+        try:
+            lowered = fn.lower(*args, **static_kwargs)
+        except Exception as e:
+            self._warn_once("lower", entry["program"], e)
+            return
+        ca = None
+        try:
+            ca = lowered.cost_analysis()
+        except Exception:
+            pass
+        try:
+            compiled = lowered.compile()
+        except Exception as e:
+            # compile failed but the pre-compile HLO cost analysis may
+            # still have real numbers — flag the weaker provenance
+            if self._apply_cost(entry, ca):
+                entry["source"] = "lowered"
+            self._warn_once("compile", entry["program"], e)
+            return
+        try:
+            cca = compiled.cost_analysis()
+            if isinstance(cca, (list, tuple)):
+                cca = cca[0] if cca else None
+        except Exception:
+            cca = None
+        if self._apply_cost(entry, cca):
+            entry["source"] = "compiled"
+        elif self._apply_cost(entry, ca):
+            entry["source"] = "lowered"
+        try:
+            ma = compiled.memory_analysis()
+        except Exception:
+            ma = None
+        if ma is not None:
+            entry["argument_bytes"] = int(
+                getattr(ma, "argument_size_in_bytes", 0))
+            entry["output_bytes"] = int(
+                getattr(ma, "output_size_in_bytes", 0))
+            entry["temp_bytes"] = int(
+                getattr(ma, "temp_size_in_bytes", 0))
+
+    @staticmethod
+    def _apply_cost(entry, analysis) -> bool:
+        if not analysis:
+            return False
+        flops = analysis.get("flops")
+        bytes_ = analysis.get("bytes accessed")
+        if flops is None and bytes_ is None:
+            return False
+        if flops is not None:
+            entry["flops"] = float(flops)
+        if bytes_ is not None:
+            entry["bytes"] = float(bytes_)
+        return True
+
+    def _warn_once(self, stage, program, exc) -> None:
+        if not self._warned:
+            self._warned = True
+            log.warning("ledger introspection (%s) failed for %s: %s "
+                        "— keeping the analytic model estimate",
+                        stage, program, exc)
+
+    # -- reads ---------------------------------------------------------
+
+    def last_dispatch(self) -> Optional[dict]:
+        """The entry of the most recently captured dispatch — the
+        scheduler reads its bytes for the online roofline right after
+        the engine call returns."""
+        return self._last
+
+    def snapshot(self) -> List[dict]:
+        """Entry copies in first-compile order (the /debug/programs
+        body)."""
+        with self._lock:
+            return [dict(e) for e in self._entries.values()]
+
+    def summary(self) -> List[dict]:
+        """Compact {program, expected_ms, source} list — rides along
+        in the POST /debug/profile response."""
+        with self._lock:
+            return [{"program": e["program"],
+                     "expected_ms": round(e["expected_ms"], 4),
+                     "source": e["source"]}
+                    for e in self._entries.values()]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def _export(self, entry) -> None:
+        if self._g_flops is None:
+            return
+        self._g_flops.labels(program=entry["program"]).set(
+            entry["flops"])
+        self._g_bytes.labels(program=entry["program"]).set(
+            entry["bytes"])

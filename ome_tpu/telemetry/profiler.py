@@ -25,12 +25,15 @@ class ProfileInProgress(RuntimeError):
     """Another capture is running; the caller should retry later."""
 
 
-def capture(out_dir: str, seconds: float = 1.0) -> dict:
+def capture(out_dir: str, seconds: float = 1.0, ledger=None) -> dict:
     """Blocking N-second device trace into `out_dir`.
 
-    Returns a summary dict (the HTTP response body). Raises
-    ProfileInProgress when a capture is already active, ValueError
-    for an unusable duration.
+    Returns a summary dict (the HTTP response body). When the engine
+    carries a program cost ledger (perf/ledger.py), its per-program
+    summary rides along under "programs" — the trace viewer shows
+    WHERE time went, the ledger says what each program SHOULD cost.
+    Raises ProfileInProgress when a capture is already active,
+    ValueError for an unusable duration.
     """
     seconds = float(seconds)
     if not (0 < seconds <= MAX_SECONDS):
@@ -39,8 +42,11 @@ def capture(out_dir: str, seconds: float = 1.0) -> dict:
     import jax
     platform = jax.default_backend()
     if platform != "tpu":
-        return {"captured": False, "platform": platform,
-                "note": "profiler capture is a no-op off-TPU"}
+        result = {"captured": False, "platform": platform,
+                  "note": "profiler capture is a no-op off-TPU"}
+        if ledger is not None:
+            result["programs"] = ledger.summary()
+        return result
     if not _capture_lock.acquire(blocking=False):
         raise ProfileInProgress("a profile capture is already running")
     try:
@@ -50,8 +56,11 @@ def capture(out_dir: str, seconds: float = 1.0) -> dict:
             time.sleep(seconds)
         finally:
             jax.profiler.stop_trace()
-        return {"captured": True, "platform": platform,
-                "dir": out_dir,
-                "seconds": round(time.monotonic() - t0, 3)}
+        result = {"captured": True, "platform": platform,
+                  "dir": out_dir,
+                  "seconds": round(time.monotonic() - t0, 3)}
+        if ledger is not None:
+            result["programs"] = ledger.summary()
+        return result
     finally:
         _capture_lock.release()

@@ -41,6 +41,14 @@ def main() -> int:
             jax.config.update("jax_num_cpu_devices", 1)
         except AttributeError:
             pass
+        # cross-process computations on the CPU backend need an
+        # explicit collectives implementation (the default "none"
+        # fails with "Multiprocess computations aren't implemented")
+        try:
+            jax.config.update(
+                "jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):
+            pass
 
     try:
         _cpu()

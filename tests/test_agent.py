@@ -12,6 +12,8 @@ import zipfile
 
 import pytest
 
+pytest.importorskip("cryptography")  # enigma's AES-GCM backend
+
 from ome_tpu.agent import (AdapterInfo, EnigmaError, LocalKMS, Replicator,
                            ServingAgent, decrypt_dir, encrypt_dir,
                            extract_metadata)

@@ -9,6 +9,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
+pytest.importorskip("cryptography")  # enigma's AES-GCM backend
+
 from ome_tpu.agent.cloudkms import GCPKMS, IMDSClient, open_kms
 from ome_tpu.agent.enigma import LocalKMS, decrypt_dir, encrypt_dir
 
