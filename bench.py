@@ -396,34 +396,42 @@ def main() -> None:
         f"{q8_bytes/1e9:.2f} GB weights) | int4 {int4_tps:.1f} tok/s "
         f"({100*int4_tps/bf16_tps-100:+.0f}%, {q4_bytes/1e9:.2f} GB)")
 
-    # -- paged-KV decode, batch 64 (r4 verdict #2 bench line) -----------
+    # -- paged-KV decode sweep: batch x pool dtype ----------------------
     # Measures the paged KERNEL PATH (ops/paged.py block-table
     # attention + pool scatter) in this bench's unrolled+multistep
-    # harness — the shape that amortizes the tunnel dispatch — at 2x
-    # the headline batch. The serving engine's compiled program
-    # (llama.forward_paged: scan over layers, token-exactness in
-    # tests/test_paged_kv.py) shares the kernels but not the unroll;
-    # this number bounds what that program reaches as its dispatch
-    # amortization improves. Pool sized to dense-equivalent rows.
-    def bench_paged(p) -> float:
+    # harness — the shape that amortizes the tunnel dispatch — across
+    # batch {64, 128, 256} and pool dtype {bf16, int8}. The serving
+    # engine's compiled program (llama.forward_paged: scan over
+    # layers, token-exactness in tests/test_paged_kv.py and
+    # tests/test_kv_int8.py) shares the kernels but not the unroll;
+    # these numbers bound what that program reaches as its dispatch
+    # amortization improves. Pool sized to dense-equivalent rows per
+    # point, so the int8 column shows the --kv-dtype int8 trade the
+    # engine offers: ~half the HBM per slot (per-token row is
+    # L*K*(Dk+Dv) int8 bytes + 2*4 f32 scale bytes/head vs
+    # L*K*(Dk+Dv)*2 bf16 — a 1.94x ratio at Dh=128) buys roughly
+    # double the resident batch at fixed pool bytes, and the sweep
+    # shows what that larger batch yields in tok/s.
+    def bench_paged(p, PB: int, quantized: bool):
+        """-> (tok/s, HBM bytes per decode slot at CACHE_LEN)."""
         from ome_tpu.ops.paged import paged_attention
-        PB, bs = 64, 128
-        nblk = PB * (CACHE_LEN // bs) + 1
+        bs = 128
+        bps = CACHE_LEN // bs               # blocks per slot
+        nblk = PB * bps + 1
         per, top = split_layers(p)
         rows = jnp.arange(PB)
-        # slot i owns blocks [1 + 2i, 1 + 2i + 1] — block 0 is trash
+        # slot i owns blocks [1 + bps*i, ...] — block 0 is trash
         table = jnp.asarray(
-            np.arange(PB * (CACHE_LEN // bs)).reshape(
-                PB, CACHE_LEN // bs) + 1, jnp.int32)
+            np.arange(PB * bps).reshape(PB, bps) + 1, jnp.int32)
 
-        def one_step_paged(per, top, tok, ks, vs, index):
+        def one_step_paged(per, top, tok, ks, vs, kss, vss, index):
             x = embed(top, tok)
             freqs = _rope_frequencies(cfg)
             positions = index[:, None]
             kv_len = index + 1
             blk = table[rows, index // bs]
             off = index % bs
-            nks, nvs = [], []
+            nks, nvs, nkss, nvss = [], [], [], []
             for l in range(cfg.num_layers):
                 lp = per[l]
                 h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
@@ -435,38 +443,64 @@ def main() -> None:
                           out_dims=(cfg.num_kv_heads, cfg.head_dim))
                 q = apply_rope(q, positions, freqs)
                 k = apply_rope(k, positions, freqs)
-                kp = ks[l].at[blk, off].set(k[:, 0])
-                vp = vs[l].at[blk, off].set(v[:, 0])
+                if quantized:
+                    # per-(row, head) amax/127 symmetric — the same
+                    # discipline as llama.forward_paged's append
+                    def qrow(x2):
+                        xf = x2[:, 0].astype(jnp.float32)
+                        amax = jnp.max(jnp.abs(xf), axis=-1)
+                        sc = jnp.maximum(amax, 1e-8) / 127.0
+                        qv = jnp.clip(jnp.round(xf / sc[..., None]),
+                                      -127, 127).astype(jnp.int8)
+                        return qv, sc
+                    kq, ksc = qrow(k)
+                    vq, vsc = qrow(v)
+                    kp = ks[l].at[blk, off].set(kq)
+                    vp = vs[l].at[blk, off].set(vq)
+                    ksp = kss[l].at[blk, :, off].set(ksc)
+                    vsp = vss[l].at[blk, :, off].set(vsc)
+                else:
+                    kp = ks[l].at[blk, off].set(k[:, 0])
+                    vp = vs[l].at[blk, off].set(v[:, 0])
+                    ksp = vsp = None
                 nks.append(kp)
                 nvs.append(vp)
-                attn = paged_attention(q, kp, vp, table, kv_len)
+                nkss.append(ksp)
+                nvss.append(vsp)
+                attn = paged_attention(q, kp, vp, table, kv_len,
+                                       k_scale=ksp, v_scale=vsp)
                 x = x + _proj(attn, lp["wo"], cfg.dtype, flatten=2)
                 h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
                 x = x + dense_mlp(h, lp, cfg)
             tok = jnp.argmax(head_logits(top, x),
                              axis=-1).astype(jnp.int32)
-            return tok, nks, nvs, index + 1
+            return tok, nks, nvs, nkss, nvss, index + 1
 
         @jax.jit
-        def paged_k(per, top, tok, ks, vs, index):
+        def paged_k(per, top, tok, ks, vs, kss, vss, index):
             def body(carry, _):
                 return one_step_paged(per, top, *carry), None
 
-            carry, _ = lax.scan(body, (tok, ks, vs, index), None,
-                                length=MULTISTEP)
+            carry, _ = lax.scan(body, (tok, ks, vs, kss, vss, index),
+                                None, length=MULTISTEP)
             return carry
 
         K, Dh = cfg.num_kv_heads, cfg.head_dim
-        ks = [jnp.zeros((nblk, bs, K, Dh), cfg.dtype)
+        pool_dt = jnp.int8 if quantized else cfg.dtype
+        ks = [jnp.zeros((nblk, bs, K, Dh), pool_dt)
               for _ in range(cfg.num_layers)]
-        vs = [jnp.zeros((nblk, bs, K, Dh), cfg.dtype)
+        vs = [jnp.zeros((nblk, bs, K, Dh), pool_dt)
               for _ in range(cfg.num_layers)]
+        kss = [jnp.zeros((nblk, K, bs), jnp.float32) if quantized
+               else None for _ in range(cfg.num_layers)]
+        vss = [jnp.zeros((nblk, K, bs), jnp.float32) if quantized
+               else None for _ in range(cfg.num_layers)]
         tok0 = jnp.zeros((PB, 1), jnp.int32)
         index0 = jnp.full((PB,), PREFILL, jnp.int32)
         n_disp = (DECODE_STEPS - 1) // MULTISTEP
         best = float("inf")
         for _ in range(2):
-            st = (tok0, ks, vs, index0)
+            st = (tok0, ks, vs, kss, vss, index0)
             st = paged_k(per, top, *st)  # compile/warm
             sync(st[0])
             t0 = time.perf_counter()
@@ -475,11 +509,44 @@ def main() -> None:
             sync(st[0])
             best = min(best, time.perf_counter() - t0)
         step_ms = best / ((n_disp - 1) * MULTISTEP) * 1000
-        return PB / (step_ms / 1000)
+        itemsize = jnp.dtype(pool_dt).itemsize
+        row_bytes = cfg.num_layers * K * 2 * Dh * itemsize
+        if quantized:
+            row_bytes += cfg.num_layers * K * 2 * 4  # f32 scales
+        return PB / (step_ms / 1000), row_bytes * bps * bs
 
-    paged_tps = bench_paged(params)
-    log(f"bench: [paged] decode batch 64: {paged_tps:.1f} tok/s "
-        f"(block-table pool attention)")
+    paged_sweep = {}
+    paged_tps = None
+    for qlabel, qz in (("bf16", False), ("int8", True)):
+        paged_sweep[qlabel] = {}
+        for PB in (64, 128, 256):
+            try:
+                tps, slot_bytes = bench_paged(params, PB, qz)
+            except Exception as exc:  # larger points may not fit HBM
+                log(f"bench: [paged {qlabel}] batch {PB} skipped: "
+                    f"{exc!r}")
+                continue
+            paged_sweep[qlabel][str(PB)] = {
+                "tokens_per_sec": round(tps, 1),
+                "hbm_per_slot_bytes": int(slot_bytes),
+            }
+            log(f"bench: [paged {qlabel}] decode batch {PB}: "
+                f"{tps:.1f} tok/s, {slot_bytes/1e6:.1f} MB/slot "
+                f"(block-table pool attention)")
+            if qlabel == "bf16" and PB == 64:
+                paged_tps = tps
+    if paged_tps is None:
+        raise RuntimeError("paged bf16 batch-64 point failed — the "
+                           "headline paged metric has no value")
+    try:
+        cap_ratio = (paged_sweep["bf16"]["64"]["hbm_per_slot_bytes"]
+                     / paged_sweep["int8"]["64"]["hbm_per_slot_bytes"])
+        paged_sweep["capacity_ratio_bf16_over_int8"] = round(
+            cap_ratio, 3)
+        log(f"bench: [paged] int8 pool fits {cap_ratio:.2f}x the "
+            f"slots of bf16 at fixed HBM bytes")
+    except (KeyError, ZeroDivisionError):
+        pass
 
     # -- self-drafting speculative decode (engine verify path) ----------
     # Measures the SERVING engine's n-gram draft + batched-verify loop
@@ -716,6 +783,7 @@ def main() -> None:
         "int8_tokens_per_sec": round(int8_tps, 1),
         "int4_tokens_per_sec": round(int4_tps, 1),
         "paged_decode_tokens_per_sec_batch64": round(paged_tps, 1),
+        "paged_sweep": paged_sweep,
         "spec_decode_tokens_per_sec": round(spec_tps, 1),
         "spec_accept_rate": round(spec_rate, 3),
         "spec_plain_tokens_per_sec": round(spec_plain_tps, 1),

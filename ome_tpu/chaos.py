@@ -21,7 +21,17 @@ cannot:
   3. **KV block-pool conservation** (the PagedAttention discipline):
      at quiescence, free + slot-owned blocks account for the whole
      pool (`ome_engine_kv_conservation_ok` — the prefix cache holds
-     separate device buffers, outside the pool by design).
+     separate device buffers, outside the pool by design). With the
+     host-DRAM prefix tier enabled (the default topology passes
+     ``--prefix-cache-host-mb``), the same gauge also folds in the
+     two-tier accounting check (PrefixCache.tier_conservation: device
+     trie + host LRU bytes exact, no double residency, host budget
+     respected), and the harness additionally asserts the exported
+     ``ome_engine_prefix_host_bytes`` gauge never exceeds the
+     configured budget. SIGKILL mid-swap is covered by invariant 2:
+     a killed engine respawns with a COLD host tier, so resumed
+     greedy streams must come out byte-identical via the recompute
+     fallback — which is exactly what the byte-compare proves.
   4. **/metrics stays consistent**: counters are monotone within one
      process incarnation, and draining gauges return to zero once the
      episode's drains complete.
@@ -634,6 +644,11 @@ class Topology:
     kv_block: int = 16
     kv_blocks: int = 40
     max_slots: int = 2
+    # host-DRAM prefix tier budget (MB) for every engine; 0 disables.
+    # On by default so soaks exercise spill/swap-in under kills —
+    # the tier is value-neutral (recompute fallback), so invariant 2
+    # must hold with it on.
+    prefix_host_mb: int = 4
     spec_tokens: int = 0
     pd_local_fallback: bool = False
     drain_grace: float = 4.0
@@ -783,6 +798,9 @@ class ChaosRunner:
                 "--max-slots", str(topo.max_slots),
                 "--prefix-cache-mb", "8",
                 "--drain-grace", str(topo.drain_grace)]
+        if topo.prefix_host_mb:
+            args += ["--prefix-cache-host-mb",
+                     str(topo.prefix_host_mb)]
         if topo.kv_block:
             args += ["--kv-block", str(topo.kv_block),
                      "--kv-blocks", str(topo.kv_blocks)]
@@ -1219,7 +1237,20 @@ class ChaosRunner:
                 ep.violations.append(
                     f"kv-conservation violated on {p.name}: free="
                     f"{sample.get('ome_engine_kv_blocks_free')} "
-                    f"owned={sample.get('ome_engine_kv_blocks_owned')}")
+                    f"owned={sample.get('ome_engine_kv_blocks_owned')} "
+                    f"host_bytes="
+                    f"{sample.get('ome_engine_prefix_host_bytes')}")
+            # host-tier budget from the exported gauge: the in-process
+            # tier_conservation check already folds into the gauge
+            # above; this asserts the same bound end to end through
+            # /metrics, the surface an operator actually alerts on
+            host = sample.get("ome_engine_prefix_host_bytes")
+            budget = ep.topo.prefix_host_mb * (1 << 20)
+            if host is not None and budget and host > budget:
+                ep.violations.append(
+                    f"host-tier over budget on {p.name}: "
+                    f"ome_engine_prefix_host_bytes={int(host)} > "
+                    f"{budget}")
 
     def _check_draining_zero(self, ep: Episode,
                              router: Optional[ManagedProc]) -> None:
@@ -1346,6 +1377,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv-blocks", type=int, default=40,
                    help="paged-KV pool size (small = pool pressure)")
     p.add_argument("--max-slots", type=int, default=2)
+    p.add_argument("--prefix-host-mb", type=int, default=4,
+                   help="host-DRAM prefix-cache tier budget (MB) on "
+                        "every engine (0 disables); the conservation "
+                        "invariant then covers both tiers and kills "
+                        "exercise the recompute fallback")
     p.add_argument("--spec-tokens", type=int, default=0,
                    help="speculative draft tokens on decode/unified "
                         "engines (greedy stays byte-identical)")
@@ -1388,6 +1424,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     unified=args.unified, router=not args.no_router,
                     kv_block=args.kv_block, kv_blocks=args.kv_blocks,
                     max_slots=args.max_slots,
+                    prefix_host_mb=args.prefix_host_mb,
                     spec_tokens=args.spec_tokens,
                     pd_local_fallback=args.pd_local_fallback,
                     drain_grace=args.drain_grace)

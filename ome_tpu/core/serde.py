@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import functools
 import typing
 from typing import Any, Optional, Type, TypeVar, Union, get_args, get_origin
 
@@ -27,6 +28,27 @@ def _json_name(f: dataclasses.Field) -> str:
     return f.metadata.get("json", camel(f.name))
 
 
+@functools.lru_cache(maxsize=None)
+def _ser_plan(tp: type):
+    """(field_name, json_key) per serializable field, computed once
+    per class — fields()/metadata lookups per instance add up on
+    deepcopy-heavy paths (catalog selection)."""
+    return tuple((f.name, _json_name(f))
+                 for f in dataclasses.fields(tp)
+                 if f.metadata.get("serialize", True))
+
+
+@functools.lru_cache(maxsize=None)
+def _deser_plan(tp: type):
+    """(field_name, json_key, resolved_type) per field.
+    typing.get_type_hints() re-evaluates every annotation string on
+    EVERY call; caching the resolved hints per class is the whole
+    win (~25x on deepcopy_resource)."""
+    hints = typing.get_type_hints(tp)
+    return tuple((f.name, _json_name(f), hints[f.name])
+                 for f in dataclasses.fields(tp))
+
+
 def to_dict(obj: Any, keep_empty: bool = False) -> Any:
     """Serialize a dataclass tree to plain dicts (camelCase keys, omitempty)."""
     if obj is None:
@@ -35,10 +57,8 @@ def to_dict(obj: Any, keep_empty: bool = False) -> Any:
         return obj.value
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         out = {}
-        for f in dataclasses.fields(obj):
-            if not f.metadata.get("serialize", True):
-                continue
-            raw = getattr(obj, f.name)
+        for name, key in _ser_plan(type(obj)):
+            raw = getattr(obj, name)
             v = to_dict(raw, keep_empty)
             if v is None and not keep_empty:
                 continue
@@ -48,7 +68,7 @@ def to_dict(obj: Any, keep_empty: bool = False) -> Any:
             if v in ({}, []) and not keep_empty \
                     and not dataclasses.is_dataclass(raw):
                 continue
-            out[_json_name(f)] = v
+            out[key] = v
         return out
     if isinstance(obj, dict):
         return {k: to_dict(v, keep_empty) for k, v in obj.items()}
@@ -87,12 +107,10 @@ def _from_value(tp: Any, data: Any) -> Any:
     if isinstance(tp, type) and issubclass(tp, enum.Enum):
         return tp(data)
     if dataclasses.is_dataclass(tp):
-        hints = typing.get_type_hints(tp)
         kwargs = {}
-        for f in dataclasses.fields(tp):
-            key = _json_name(f)
+        for name, key, ftp in _deser_plan(tp):
             if key in data:
-                kwargs[f.name] = _from_value(hints[f.name], data[key])
+                kwargs[name] = _from_value(ftp, data[key])
         return tp(**kwargs)
     if tp in (Any, object) or origin is not None:
         return data

@@ -51,6 +51,11 @@ class DecodeState:
     # [B] int32 — LoRA adapter slot per sequence (0 = base model);
     # selects the per-slot low-rank delta inside the decode matmuls
     adapters: jax.Array = None
+    # int8 paged pools only ([L, N, K, block] f32): per-(row, head)
+    # dequant scales riding next to the quantized pools; None for
+    # bf16 pools and the dense cache
+    k_scale: jax.Array = None
+    v_scale: jax.Array = None
 
 
 class UnknownAdapterError(ValueError):
@@ -103,7 +108,7 @@ class PrefixCache:
     """
 
     def __init__(self, capacity_bytes: int = 0, block: int = 32,
-                 min_prefix: int = 16):
+                 min_prefix: int = 16, host_capacity_bytes: int = 0):
         self.capacity_bytes = capacity_bytes
         self.block = block
         self.min_prefix = min_prefix
@@ -113,6 +118,27 @@ class PrefixCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # host-DRAM tier (--prefix-cache-host-mb): LRU of evicted
+        # block KV as host numpy copies, keyed by the block's full
+        # token path. A device hit that continues into host-resident
+        # blocks only ENQUEUES an async swap-in — the admitting
+        # request recomputes the remainder locally (suffix prefill is
+        # the correctness fallback), the NEXT same-prefix request
+        # hits the swapped-in device blocks. 0 disables the tier.
+        self.host_capacity_bytes = host_capacity_bytes
+        self.host_bytes = 0
+        self.host_hits = 0
+        self.host_swapins = 0
+        self.host_recomputes = 0
+        # path -> (np_k, np_v, nbytes); insertion order = LRU order
+        import collections
+        self._host: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        import queue
+        import threading
+        self._tier_lock = threading.Lock()
+        self._swap_q = queue.Queue()
+        self._swap_thread: Optional[object] = None
 
     def _leaf_bytes(self, k, v) -> int:
         return k.nbytes + v.nbytes
@@ -123,49 +149,218 @@ class PrefixCache:
         are padding and never stored)."""
         if self.capacity_bytes <= 0 or true_len < self.min_prefix:
             return
-        node_map = self._root
-        self._tick += 1
-        for off in range(0, (true_len // self.block) * self.block,
-                         self.block):
-            key = tuple(ids[off:off + self.block])
-            node = node_map.get(key)
-            if node is None:
-                ks = k[:, :, off:off + self.block]
-                vs = v[:, :, off:off + self.block]
-                node = {"kv": (ks, vs), "children": {},
-                        "last": self._tick}
-                node_map[key] = node
-                self.bytes += self._leaf_bytes(ks, vs)
-            node["last"] = self._tick
-            node_map = node["children"]
-        self._evict()
+        with self._tier_lock:
+            node_map = self._root
+            self._tick += 1
+            for off in range(0, (true_len // self.block) * self.block,
+                             self.block):
+                key = tuple(ids[off:off + self.block])
+                node = node_map.get(key)
+                if node is None:
+                    ks = k[:, :, off:off + self.block]
+                    vs = v[:, :, off:off + self.block]
+                    node = {"kv": (ks, vs), "children": {},
+                            "last": self._tick}
+                    node_map[key] = node
+                    self.bytes += self._leaf_bytes(ks, vs)
+                    # device copy is authoritative again: a stale
+                    # host-tier copy of the same path just wastes
+                    # host budget
+                    ent = self._host.pop(tuple(ids[:off + self.block]),
+                                         None)
+                    if ent is not None:
+                        self.host_bytes -= ent[2]
+                node["last"] = self._tick
+                node_map = node["children"]
+            spills = self._evict_locked()
+        self._spill(spills)
 
-    def _evict(self):
+    def _evict_locked(self):
         """Drop least-recently-used LEAF nodes until within budget
         (parents stay useful for the prompts that still share them).
         One DFS collects every current leaf; evicting a leaf can
         expose its parent as a new leaf, so loop (bounded by trie
-        depth) only if a whole pass wasn't enough."""
+        depth) only if a whole pass wasn't enough. With the host tier
+        enabled, an evicted leaf's KV is returned as [(path, kv)] for
+        the caller to spill to host DRAM AFTER releasing _tier_lock —
+        the device->host copy blocks, and a lock region must never
+        reach a blocking fetch."""
+        spills = []
         while self.bytes > self.capacity_bytes:
             leaves = []
-            stack = [self._root]
+            stack = [(self._root, ())]
             while stack:
-                node_map = stack.pop()
+                node_map, path = stack.pop()
                 for key, node in node_map.items():
                     if node["children"]:
-                        stack.append(node["children"])
+                        stack.append((node["children"], path + key))
                     else:
                         leaves.append((node["last"], node_map, key,
-                                       node))
+                                       node, path + key))
             if not leaves:
-                return
+                return spills
             leaves.sort(key=lambda t: t[0])
-            for _, parent_map, key, node in leaves:
+            for _, parent_map, key, node, path in leaves:
                 if self.bytes <= self.capacity_bytes:
-                    return
+                    return spills
                 self.bytes -= self._leaf_bytes(*node["kv"])
                 self.evictions += 1
+                if self.host_capacity_bytes > 0:
+                    spills.append((path, node["kv"]))
                 del parent_map[key]
+        return spills
+
+    def _device_resident_locked(self, path: tuple) -> bool:
+        node_map = self._root
+        for off in range(0, len(path), self.block):
+            node = node_map.get(path[off:off + self.block])
+            if node is None:
+                return False
+            node_map = node["children"]
+        return True
+
+    def _spill(self, spills) -> None:
+        """Copy evicted blocks' KV to the host tier. Runs OUTSIDE
+        _tier_lock (the jax arrays are immutable, so the fetch needs
+        no guard; admission path, never the step path), re-acquiring
+        only for the dict edits. A put() that re-created the same
+        path while the copy ran wins — its device copy is
+        authoritative, so the stale spill is dropped."""
+        for path, kv in spills:
+            ks = np.asarray(kv[0])
+            vs = np.asarray(kv[1])
+            nbytes = ks.nbytes + vs.nbytes
+            with self._tier_lock:
+                if self._device_resident_locked(path):
+                    continue
+                old = self._host.pop(path, None)
+                if old is not None:
+                    self.host_bytes -= old[2]
+                self._host[path] = (ks, vs, nbytes)
+                self.host_bytes += nbytes
+                while self.host_bytes > self.host_capacity_bytes \
+                        and self._host:
+                    _, (_, _, nb) = self._host.popitem(last=False)
+                    self.host_bytes -= nb
+
+    def _request_swapin(self, ids, eff: int) -> bool:
+        """Queue every consecutive host-resident continuation block
+        past the device hit for async swap-in. Called under
+        _tier_lock; the actual device upload happens on the swap
+        thread so admission never waits on it. Returns whether
+        anything was queued — the caller starts the swap thread
+        AFTER releasing the lock."""
+        paths = []
+        while eff + self.block <= len(ids) - 1:
+            path = tuple(ids[:eff + self.block])
+            if path not in self._host:
+                break
+            self._host.move_to_end(path)  # refresh host LRU
+            paths.append(path)
+            eff += self.block
+        if not paths:
+            return False
+        self.host_hits += len(paths)
+        # this request cannot use host blocks (the swap must never
+        # gate admission): it recomputes the remainder locally
+        self.host_recomputes += 1
+        for path in paths:
+            self._swap_q.put(path)
+        return True
+
+    def _ensure_swap_thread(self) -> None:
+        import threading
+        if self._swap_thread is not None and \
+                self._swap_thread.is_alive():
+            return
+        self._swap_thread = threading.Thread(
+            target=self._swap_loop, name="prefix-swap", daemon=True)
+        self._swap_thread.start()
+
+    def _swap_loop(self) -> None:
+        """Swap-in worker: re-attach host-tier blocks to the device
+        trie. Each upload is an async host->device transfer; trie
+        surgery holds _tier_lock only for the dict edits. A block
+        whose parent chain was evicted in the meantime stays in the
+        host tier (a later deeper hit re-queues it)."""
+        while True:
+            path = self._swap_q.get()
+            try:
+                if path is None:  # shutdown sentinel (tests)
+                    return
+                self._swapin_one(path)
+            except Exception:  # pragma: no cover — a failed swap
+                pass           # only costs a future recompute
+            finally:
+                self._swap_q.task_done()
+
+    def _swapin_one(self, path: tuple) -> None:
+        with self._tier_lock:
+            ent = self._host.get(path)
+            if ent is None:
+                return
+            # the parent chain must be device-resident for the block
+            # to be reachable by match(); otherwise leave it hosted
+            node_map = self._root
+            ok = True
+            for off in range(0, len(path) - self.block, self.block):
+                node = node_map.get(path[off:off + self.block])
+                if node is None:
+                    ok = False
+                    break
+                node_map = node["children"]
+            key = path[-self.block:]
+            if not ok or key in node_map:
+                return
+            ks, vs, nbytes = self._host.pop(path)
+            self.host_bytes -= nbytes
+            kd, vd = jnp.asarray(ks), jnp.asarray(vs)
+            self._tick += 1
+            node_map[key] = {"kv": (kd, vd), "children": {},
+                             "last": self._tick}
+            self.bytes += self._leaf_bytes(kd, vd)
+            self.host_swapins += 1
+            spills = self._evict_locked()
+        self._spill(spills)
+
+    def drain_swapins(self, timeout: float = 5.0) -> None:
+        """Block until every queued swap-in has been applied — test
+        and chaos-harness hook, never called from the serving path."""
+        import time as _time
+        q = self._swap_q
+        deadline = _time.monotonic() + timeout
+        # unfinished_tasks (not empty()): a popped path still being
+        # applied must count — queue-empty races the apply
+        while q.unfinished_tasks:
+            if _time.monotonic() >= deadline:
+                return
+            _time.sleep(0.005)
+
+    def tier_conservation(self) -> Tuple[bool, int, int]:
+        """Two-tier accounting check: recounted device-trie bytes and
+        host-tier bytes must equal the running counters, no block may
+        be resident in both tiers, and the host tier must respect its
+        budget. Returns (ok, device_blocks, host_blocks) — chaos
+        asserts this alongside the pool's kv_conservation."""
+        with self._tier_lock:
+            dev_bytes = 0
+            dev_blocks = 0
+            overlap = False
+            stack = [(self._root, ())]
+            while stack:
+                node_map, path = stack.pop()
+                for key, node in node_map.items():
+                    dev_blocks += 1
+                    dev_bytes += self._leaf_bytes(*node["kv"])
+                    if path + key in self._host:
+                        overlap = True
+                    stack.append((node["children"], path + key))
+            host_bytes = sum(e[2] for e in self._host.values())
+            ok = (dev_bytes == self.bytes
+                  and host_bytes == self.host_bytes
+                  and not overlap
+                  and host_bytes <= max(self.host_capacity_bytes, 0))
+            return ok, dev_blocks, len(self._host)
 
     def match(self, ids, usable=None) -> Optional[tuple]:
         """Longest cached STRICT prefix of `ids` in whole blocks (the
@@ -177,36 +372,55 @@ class PrefixCache:
         downstream budget cannot use (e.g. prefix + suffix bucket
         overflowing the largest prefill bucket) BEFORE the hit is
         counted and recency refreshed — shorter candidates are tried
-        block by block."""
+        block by block.
+
+        Host-tier blocks NEVER serve the current request: a match
+        that continues into the host tier queues an async swap-in and
+        returns only the device-resident prefix (possibly None) — the
+        caller recomputes the rest, the next same-prefix request hits
+        on device."""
         if self.capacity_bytes <= 0:
             return None
-        limit = len(ids) - 1
-        node_map = self._root
-        slices = []
-        eff = 0
-        self._tick += 1
-        while eff + self.block <= limit:
-            key = tuple(ids[eff:eff + self.block])
-            node = node_map.get(key)
-            if node is None:
-                break
-            node["last"] = self._tick
-            slices.append(node["kv"])
-            eff += self.block
-            node_map = node["children"]
-        while slices and usable is not None and not usable(eff):
-            slices.pop()
-            eff -= self.block
-        if eff < self.min_prefix:
-            self.misses += 1
-            return None
-        self.hits += 1
-        if len(slices) == 1:
-            k, v = slices[0]
-        else:
-            k = jnp.concatenate([s[0] for s in slices], axis=2)
-            v = jnp.concatenate([s[1] for s in slices], axis=2)
-        return (k, v, eff, eff)
+        queued = False
+        try:
+            with self._tier_lock:
+                limit = len(ids) - 1
+                node_map = self._root
+                slices = []
+                eff = 0
+                self._tick += 1
+                while eff + self.block <= limit:
+                    key = tuple(ids[eff:eff + self.block])
+                    node = node_map.get(key)
+                    if node is None:
+                        break
+                    node["last"] = self._tick
+                    slices.append(node["kv"])
+                    eff += self.block
+                    node_map = node["children"]
+                if self.host_capacity_bytes > 0:
+                    queued = self._request_swapin(ids, eff)
+                while slices and usable is not None \
+                        and not usable(eff):
+                    slices.pop()
+                    eff -= self.block
+                if eff < self.min_prefix:
+                    self.misses += 1
+                    return None
+                self.hits += 1
+                if len(slices) == 1:
+                    k, v = slices[0]
+                else:
+                    k = jnp.concatenate([s[0] for s in slices],
+                                        axis=2)
+                    v = jnp.concatenate([s[1] for s in slices],
+                                        axis=2)
+                return (k, v, eff, eff)
+        finally:
+            # thread start stays OUTSIDE the lock region (it is the
+            # edge to the swap loop, whose uploads block)
+            if queued:
+                self._ensure_swap_thread()
 
 
 class InferenceEngine:
@@ -222,8 +436,10 @@ class InferenceEngine:
                  max_slots: int = 8, max_seq: Optional[int] = None,
                  prefill_buckets: Optional[List[int]] = None,
                  prefix_cache_bytes: int = 0,
+                 prefix_host_bytes: int = 0,
                  lora_slots: int = 0, lora_rank: int = 16,
                  kv_block: int = 0, kv_blocks: Optional[int] = None,
+                 kv_dtype: Optional[str] = None,
                  ledger=None):
         self.params = params
         self.cfg = cfg
@@ -236,6 +452,21 @@ class InferenceEngine:
         # more slots with mixed-length sequences (vLLM/SGLang
         # PagedAttention, TPU-static: ops/paged.py; r4 verdict #2)
         self.kv_block = int(kv_block)
+        # int8-quantized paged pools (--kv-dtype int8): KV rows are
+        # stored as int8 + a per-(row, head) f32 scale plane, halving
+        # block-pool HBM per cached token — the same budget holds ~2x
+        # the sequences (docs/kv-hierarchy.md). Quantization happens
+        # on append inside the compiled decode/insert programs;
+        # dequantization inside the paged attention kernel.
+        kv_dtype = (kv_dtype or "").replace("bfloat16", "bf16")
+        if kv_dtype not in ("", "bf16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be bf16 or int8, got {kv_dtype!r}")
+        self.kv_quantized = kv_dtype == "int8"
+        if self.kv_quantized and not self.kv_block:
+            raise ValueError(
+                "--kv-dtype int8 quantizes the paged block pool; "
+                "enable paged KV (--kv-block) to use it")
         if self.kv_block:
             if (cfg.mla or cfg.is_moe or cfg.first_k_dense
                     or cfg.sliding_window or cfg.alt_sliding_window
@@ -282,7 +513,9 @@ class InferenceEngine:
                 b *= 2
             prefill_buckets.append(self.max_seq)
         self.prefill_buckets = prefill_buckets
-        self.prefix_cache = PrefixCache(prefix_cache_bytes)
+        self.prefix_cache = PrefixCache(
+            prefix_cache_bytes,
+            host_capacity_bytes=prefix_host_bytes)
 
         # multi-LoRA serving: preallocate `lora_slots` zeroed factor
         # stacks as extra scanned layer leaves ([L, slots+1, r, K]).
@@ -426,6 +659,7 @@ class InferenceEngine:
             return tok[0], new_cache.k, new_cache.v
 
         kvb = self.kv_block
+        kvq = self.kv_quantized
 
         @functools.partial(jax.jit, donate_argnums=(0,),
                            static_argnames=("bucket",))
@@ -435,8 +669,26 @@ class InferenceEngine:
                           adapter: jax.Array, bucket: int):
             """Scatter a prefilled [L, 1, bucket, K, D] KV slab into
             the pool blocks listed in `block_ids` (host-allocated;
-            entries past the valid length point at the trash block)."""
+            entries past the valid length point at the trash block).
+            int8 pools quantize the slab per (layer, row, head) on the
+            way in — prefill always computes at the model dtype, so
+            the quantization cost rides the (rare) insert, never the
+            decode loop."""
             k, v = state.k, state.v
+            ksc, vsc = state.k_scale, state.v_scale
+            if kvq:
+                def quant(x):
+                    amax = jnp.max(jnp.abs(x.astype(jnp.float32)),
+                                   axis=-1)      # [L, 1, bucket, K]
+                    s = jnp.maximum(amax, 1e-8) / 127.0
+                    q = jnp.clip(
+                        jnp.round(x.astype(jnp.float32)
+                                  / s[..., None]),
+                        -127, 127).astype(jnp.int8)
+                    # scale slab S-minor: [L, 1, K, bucket]
+                    return q, jnp.swapaxes(s, -1, -2)
+                kv_k, ks = quant(kv_k)
+                kv_v, vs = quant(kv_v)
             for i in range(-(-bucket // kvb)):
                 ck = kv_k[:, 0, i * kvb:(i + 1) * kvb]
                 cv = kv_v[:, 0, i * kvb:(i + 1) * kvb]
@@ -444,30 +696,44 @@ class InferenceEngine:
                     k, ck[:, None], (0, block_ids[i], 0, 0, 0))
                 v = lax.dynamic_update_slice(
                     v, cv[:, None], (0, block_ids[i], 0, 0, 0))
+                if kvq:
+                    csk = ks[:, :, :, i * kvb:(i + 1) * kvb]
+                    csv = vs[:, :, :, i * kvb:(i + 1) * kvb]
+                    ksc = lax.dynamic_update_slice(
+                        ksc, csk, (0, block_ids[i], 0, 0))
+                    vsc = lax.dynamic_update_slice(
+                        vsc, csv, (0, block_ids[i], 0, 0))
             return DecodeState(
                 k=k, v=v,
                 lengths=state.lengths.at[slot].set(true_len),
                 tokens=state.tokens.at[slot].set(token),
-                adapters=state.adapters.at[slot].set(adapter))
+                adapters=state.adapters.at[slot].set(adapter),
+                k_scale=ksc, v_scale=vsc)
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def _decode_paged(params, state: DecodeState, table,
                           temperature, top_k, top_p, key):
             cache = llama.PagedKVCache(k=state.k, v=state.v,
-                                       index=state.lengths, table=table)
+                                       index=state.lengths, table=table,
+                                       k_scale=state.k_scale,
+                                       v_scale=state.v_scale)
             logits, nc = llama.forward_paged(
                 params, cfg_, state.tokens[:, None], cache,
                 adapter_ids=state.adapters)
             toks = sample(logits[:, -1], key, temperature, top_k, top_p)
             return DecodeState(k=nc.k, v=nc.v, lengths=nc.index,
                                tokens=toks,
-                               adapters=state.adapters), toks
+                               adapters=state.adapters,
+                               k_scale=nc.k_scale,
+                               v_scale=nc.v_scale), toks
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def _decode_masked_paged(params, state: DecodeState, table,
                                  temperature, top_k, top_p, key, mask):
             cache = llama.PagedKVCache(k=state.k, v=state.v,
-                                       index=state.lengths, table=table)
+                                       index=state.lengths, table=table,
+                                       k_scale=state.k_scale,
+                                       v_scale=state.v_scale)
             logits, nc = llama.forward_paged(
                 params, cfg_, state.tokens[:, None], cache,
                 adapter_ids=state.adapters)
@@ -475,7 +741,9 @@ class InferenceEngine:
             toks = sample(masked, key, temperature, top_k, top_p)
             return DecodeState(k=nc.k, v=nc.v, lengths=nc.index,
                                tokens=toks,
-                               adapters=state.adapters), toks
+                               adapters=state.adapters,
+                               k_scale=nc.k_scale,
+                               v_scale=nc.v_scale), toks
 
         smax = self.max_seq
 
@@ -505,7 +773,9 @@ class InferenceEngine:
             st = DecodeState(
                 k=nc.k, v=nc.v,
                 lengths=jnp.where(active, nc.index, st.lengths),
-                tokens=toks, adapters=st.adapters)
+                tokens=toks, adapters=st.adapters,
+                k_scale=getattr(nc, "k_scale", None),
+                v_scale=getattr(nc, "v_scale", None))
             return st, done, acc, adv
 
         def _multi_loop(state, key, temperature, top_k, top_p, budget,
@@ -568,7 +838,9 @@ class InferenceEngine:
             def forward_one(st):
                 cache = llama.PagedKVCache(k=st.k, v=st.v,
                                            index=st.lengths,
-                                           table=table)
+                                           table=table,
+                                           k_scale=st.k_scale,
+                                           v_scale=st.v_scale)
                 return llama.forward_paged(params, cfg_,
                                            st.tokens[:, None], cache,
                                            adapter_ids=st.adapters)
@@ -614,7 +886,9 @@ class InferenceEngine:
             toks = jnp.concatenate([state.tokens[:, None], drafts],
                                    axis=1)
             cache = llama.PagedKVCache(k=state.k, v=state.v,
-                                       index=state.lengths, table=table)
+                                       index=state.lengths, table=table,
+                                       k_scale=state.k_scale,
+                                       v_scale=state.v_scale)
             logits, nc = llama.forward_paged(
                 params, cfg_, toks, cache, adapter_ids=state.adapters)
             out, accepted = spec_verify(logits, drafts, draft_len, key,
@@ -624,7 +898,9 @@ class InferenceEngine:
             return DecodeState(k=nc.k, v=nc.v,
                                lengths=state.lengths + accepted + 1,
                                tokens=new_tok,
-                               adapters=state.adapters), out, accepted
+                               adapters=state.adapters,
+                               k_scale=nc.k_scale,
+                               v_scale=nc.v_scale), out, accepted
 
         self._prefill_fn = _prefill
         self._prefill_masked_fn = _prefill_masked
@@ -666,6 +942,20 @@ class InferenceEngine:
 
     # -- cost model (perf ledger fallback) -----------------------------
 
+    def kv_row_bytes(self) -> int:
+        """HBM bytes one cached KV row (all layers, all heads) costs —
+        the single per-token byte model shared by the cost ledger and
+        the HbmAccountant kv_cache tenant (perf/hbm.py) so they can't
+        drift. int8 pools store 1 byte/element plus two f32 scales per
+        (layer, head) row."""
+        cfg = self.cfg
+        if getattr(self, "kv_quantized", False):
+            return cfg.num_layers * cfg.kv_cache_heads * (
+                cfg.kv_cache_k_dim + cfg.kv_cache_v_dim + 2 * 4)
+        return (cfg.num_layers * cfg.kv_cache_heads
+                * (cfg.kv_cache_k_dim + cfg.kv_cache_v_dim)
+                * jnp.dtype(cfg.dtype).itemsize)
+
     def _cost_model(self, tokens: int, kv_rows: int,
                     weight_passes: int = 1) -> Dict[str, float]:
         """Analytic {flops, bytes} for a program moving the whole
@@ -680,10 +970,7 @@ class InferenceEngine:
             self._param_count = sum(
                 int(leaf.size) for leaf in jax.tree_util.tree_leaves(
                     self.params))
-        cfg = self.cfg
-        row = (cfg.num_layers * cfg.kv_cache_heads
-               * (cfg.kv_cache_k_dim + cfg.kv_cache_v_dim)
-               * jnp.dtype(cfg.dtype).itemsize)
+        row = self.kv_row_bytes()
         return {
             "bytes": float(weight_passes * self._weight_bytes
                            + kv_rows * row),
@@ -735,12 +1022,21 @@ class InferenceEngine:
             self._table_dev = None
             pool = (L, self.kv_blocks, self.kv_block,
                     cfg.kv_cache_heads)
+            pool_dtype = jnp.int8 if self.kv_quantized else cfg.dtype
+            # distinct scale buffers: the jitted programs donate the
+            # whole state, and XLA refuses aliased donated arguments
+            scale_shape = (L, self.kv_blocks, cfg.kv_cache_heads,
+                           self.kv_block)
             return DecodeState(
-                k=jnp.zeros(pool + (cfg.kv_cache_k_dim,), cfg.dtype),
-                v=jnp.zeros(pool + (cfg.kv_cache_v_dim,), cfg.dtype),
+                k=jnp.zeros(pool + (cfg.kv_cache_k_dim,), pool_dtype),
+                v=jnp.zeros(pool + (cfg.kv_cache_v_dim,), pool_dtype),
                 lengths=jnp.zeros((B,), jnp.int32),
                 tokens=jnp.zeros((B,), jnp.int32),
-                adapters=jnp.zeros((B,), jnp.int32))
+                adapters=jnp.zeros((B,), jnp.int32),
+                k_scale=(jnp.zeros(scale_shape, jnp.float32)
+                         if self.kv_quantized else None),
+                v_scale=(jnp.zeros(scale_shape, jnp.float32)
+                         if self.kv_quantized else None))
         base = (L, B, S, cfg.kv_cache_heads)
         return DecodeState(
             k=jnp.zeros(base + (cfg.kv_cache_k_dim,), cfg.dtype),
@@ -931,6 +1227,12 @@ class InferenceEngine:
         ok = (len(blocks) == self.kv_blocks - 1
               and len(set(blocks)) == len(blocks)
               and 0 not in blocks)
+        # hierarchical-KV extension: the prefix cache's two tiers
+        # must also account exactly (device trie + host LRU sum, no
+        # double residency) — one gauge covers the whole KV hierarchy
+        tc = getattr(self.prefix_cache, "tier_conservation", None)
+        if callable(tc):
+            ok = ok and tc()[0]
         return ok, len(owned_all)
 
     # -- multi-LoRA registry -------------------------------------------

@@ -56,7 +56,8 @@ except Exception:  # pragma: no cover
     _BF16 = None
 
 _WIRE_DTYPES = {"bfloat16": _BF16, "float32": np.dtype(np.float32),
-                "float16": np.dtype(np.float16)}
+                "float16": np.dtype(np.float16),
+                "int8": np.dtype(np.int8)}
 
 
 class PDError(Exception):
@@ -78,13 +79,50 @@ def gather_kv(x) -> np.ndarray:
     return np.asarray(multihost_utils.process_allgather(x, tiled=True))
 
 
-def serialize_kv(token: int, k, v, true_len: int, bucket: int) -> bytes:
+def quantize_kv_plane(x) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-(row, head) int8 over the feature axis — the
+    same scale discipline as the int8 paged pool (ops/flash.py), but
+    host-side numpy for the wire. Returns (int8 plane, f32 scales
+    with a keepdims feature axis of 1)."""
+    xf = np.asarray(x, np.float32)
+    amax = np.max(np.abs(xf), axis=-1, keepdims=True)
+    sc = np.maximum(amax, 1e-8) / 127.0
+    q = np.clip(np.rint(xf / sc), -127, 127).astype(np.int8)
+    return q, sc.astype(np.float32)
+
+
+def serialize_kv(token: int, k, v, true_len: int, bucket: int,
+                 quantize: bool = False) -> bytes:
     """Pack a prefill result for the wire: 4-byte LE header length +
-    JSON header + k bytes + v bytes."""
+    JSON header + k bytes + v bytes.
+
+    `quantize=True` ships the planes as int8 + f32 per-(row, head)
+    scales — half the bytes of bf16 plus ~1.5% scale overhead. The
+    receiver dequantizes back to the original dtype, so the wire
+    format change is invisible to insert(); int8-pool engines
+    (--kv-dtype int8) re-quantize on insert with the same amax rule,
+    making the round trip value-stable."""
     k_np = np.asarray(k)
     v_np = np.asarray(v)
+    if quantize:
+        orig = {v: n for n, v in _WIRE_DTYPES.items()}.get(k_np.dtype)
+        if orig is None:
+            raise PDError(f"unsupported KV dtype {k_np.dtype}")
+        k_np, k_sc = quantize_kv_plane(k_np)
+        v_np, v_sc = quantize_kv_plane(v_np)
+        header = json.dumps({
+            "token": int(token), "true_len": int(true_len),
+            "bucket": int(bucket), "shape": list(k_np.shape),
+            "v_shape": list(v_np.shape),
+            "dtype": "int8", "orig_dtype": orig,
+            "k_scale_shape": list(k_sc.shape),
+            "v_scale_shape": list(v_sc.shape),
+        }).encode()
+        return (struct.pack("<I", len(header)) + header
+                + k_np.tobytes() + v_np.tobytes()
+                + k_sc.tobytes() + v_sc.tobytes())
     name = {v: n for n, v in _WIRE_DTYPES.items()}.get(k_np.dtype)
-    if name is None:
+    if name is None or name == "int8":
         raise PDError(f"unsupported KV dtype {k_np.dtype}")
     header = json.dumps({
         "token": int(token), "true_len": int(true_len),
@@ -100,7 +138,9 @@ def serialize_kv(token: int, k, v, true_len: int, bucket: int) -> bytes:
 
 def deserialize_kv(data: bytes) -> Tuple[int, np.ndarray, np.ndarray,
                                          int, int]:
-    """Inverse of serialize_kv -> (token, k, v, true_len, bucket)."""
+    """Inverse of serialize_kv -> (token, k, v, true_len, bucket).
+    Quantized (int8) payloads are dequantized back to their original
+    dtype here, so every caller keeps seeing float planes."""
     if len(data) < 4:
         raise PDError("short PD payload")
     (hlen,) = struct.unpack("<I", data[:4])
@@ -113,6 +153,27 @@ def deserialize_kv(data: bytes) -> Tuple[int, np.ndarray, np.ndarray,
     n = int(np.prod(shape)) * dt.itemsize
     nv = int(np.prod(v_shape)) * dt.itemsize
     body = data[4 + hlen:]
+    if header["dtype"] == "int8":
+        odt = _WIRE_DTYPES.get(header.get("orig_dtype"))
+        if odt is None:
+            raise PDError("quantized PD payload without orig_dtype")
+        ks_shape = tuple(header["k_scale_shape"])
+        vs_shape = tuple(header["v_scale_shape"])
+        nks = int(np.prod(ks_shape)) * 4
+        nvs = int(np.prod(vs_shape)) * 4
+        if len(body) != n + nv + nks + nvs:
+            raise PDError(f"PD payload size mismatch: {len(body)} != "
+                          f"{n + nv + nks + nvs}")
+        kq = np.frombuffer(body[:n], dtype=dt).reshape(shape)
+        vq = np.frombuffer(body[n:n + nv], dtype=dt).reshape(v_shape)
+        k_sc = np.frombuffer(body[n + nv:n + nv + nks],
+                             dtype=np.float32).reshape(ks_shape)
+        v_sc = np.frombuffer(body[n + nv + nks:],
+                             dtype=np.float32).reshape(vs_shape)
+        k = (kq.astype(np.float32) * k_sc).astype(odt)
+        v = (vq.astype(np.float32) * v_sc).astype(odt)
+        return (header["token"], k, v, header["true_len"],
+                header["bucket"])
     if len(body) != n + nv:
         raise PDError(
             f"PD payload size mismatch: {len(body)} != {n + nv}")
@@ -554,12 +615,17 @@ def make_pd_prefill_handler(engine):
     """The prefill node's `/pd/prefill` implementation: run a bucketed
     prefill (prefix cache included — the cache-aware router steers
     same-prefix traffic to the same prefill node) and export the KV.
+    Also the donor side of cross-replica prefix reuse
+    (docs/kv-hierarchy.md): peers fetch a hot prefix's KV through the
+    same handler. Engines with an int8 paged pool ship the blob
+    quantized — half the bytes on the wire.
 
     Serialized under a lock: concurrent prefills would race the prefix
     cache, and the chip runs one program at a time regardless.
     """
     import threading
     lock = threading.Lock()
+    quantize = bool(getattr(engine, "kv_quantized", False))
 
     def handler(payload: dict) -> bytes:
         from .structured import unpack_mask
@@ -581,6 +647,6 @@ def make_pd_prefill_handler(engine):
             # so a second thread's allgather must not interleave
             # omelint: disable=lock-discipline -- the gather/serialize round-trip IS the guarded op (see comment above)
             return serialize_kv(token, gather_kv(k), gather_kv(v),
-                                true_len, bucket)
+                                true_len, bucket, quantize=quantize)
 
     return handler

@@ -285,6 +285,11 @@ class Request:
     masker: Optional[object] = None
     # multi-LoRA: adapter name (engine register_adapter); None = base
     adapter: Optional[str] = None
+    # cross-replica prefix reuse (docs/kv-hierarchy.md): the router's
+    # fleet prefix directory names a peer replica that owns this
+    # prompt's prefix (X-OME-Prefix-Peer); admission tries fetching
+    # the prefix KV from it before computing the prefill locally
+    prefix_peer: Optional[str] = None
     # multi-tenant priority class (docs/multi-tenancy.md): drives the
     # WDRR pick order, per-class admission caps, and preemption
     # victim ranking; journaled so kill-resume restores it
@@ -400,6 +405,9 @@ class Scheduler:
         self.flight = flight if flight is not None else FlightRecorder()
         self.flight_dump_dir = flight_dump_dir
         self._flight_dumps = 0
+        # cross-replica prefix reuse (engine/peering.py): built on the
+        # first X-OME-Prefix-Peer request; holds per-peer breakers
+        self._peer_client = None
         # (proposed, accepted) of the most recently drained verify
         # step, read by the spec-verify span right after the drain
         self._spec_last = (0, 0)
@@ -637,6 +645,21 @@ class Scheduler:
         self._g_pc_bytes = R.gauge(
             "ome_engine_prefix_cache_bytes",
             "Device bytes resident in the prefix cache")
+        # host-DRAM spill tier (zeros unless --prefix-cache-host-mb)
+        self._c_pc_host_hits = R.counter(
+            "ome_engine_prefix_host_hits_total",
+            "Prefix blocks found host-resident on match (each kicks "
+            "an async swap-in; the current request recomputes)")
+        self._c_pc_host_swapins = R.counter(
+            "ome_engine_prefix_host_swapins_total",
+            "Prefix blocks promoted host -> device by the swap thread")
+        self._c_pc_host_recomputes = R.counter(
+            "ome_engine_prefix_host_recomputes_total",
+            "Requests that recomputed a host-resident prefix locally "
+            "instead of waiting for the swap-in")
+        self._g_pc_host_bytes = R.gauge(
+            "ome_engine_prefix_host_bytes",
+            "Host-DRAM bytes resident in the prefix-cache spill tier")
         # step-phase attribution (ROADMAP open item 2): where a decode
         # step + its host-side gap actually go, measured ONLY from
         # timestamps the pipelined loop already crosses — dispatch
@@ -1010,11 +1033,18 @@ class Scheduler:
             for counter, value in ((self._c_pc_hits, pc.hits),
                                    (self._c_pc_misses, pc.misses),
                                    (self._c_pc_evictions,
-                                    pc.evictions)):
+                                    pc.evictions),
+                                   (self._c_pc_host_hits,
+                                    getattr(pc, "host_hits", 0)),
+                                   (self._c_pc_host_swapins,
+                                    getattr(pc, "host_swapins", 0)),
+                                   (self._c_pc_host_recomputes,
+                                    getattr(pc, "host_recomputes", 0))):
                 delta = value - counter.value
                 if delta > 0:
                     counter.inc(delta)
             self._g_pc_bytes.set(pc.bytes)
+            self._g_pc_host_bytes.set(getattr(pc, "host_bytes", 0))
         pool = getattr(self.engine, "kv_pool_stats", None)
         if pool and pool.get("kv_block_tokens"):  # paged engines only
             total = pool.get("kv_blocks", 0)
@@ -2127,9 +2157,55 @@ class Scheduler:
         stats = self.engine.kv_pool_stats
         return stats["kv_blocks_free"] >= need
 
+    def _peer_prefill(self, req: Request, peer: str):
+        """Try fetching this prompt's prefix KV from the peer replica
+        the router's prefix directory named (X-OME-Prefix-Peer) —
+        engine.prefill-shaped result or None, in which case the
+        caller computes the prefill locally (the recompute fallback).
+        A successful fetch seeds the LOCAL prefix cache so the next
+        same-prefix request hits on device without any peer."""
+        if self._peer_client is None:
+            from .peering import PrefixPeerClient
+            self._peer_client = PrefixPeerClient(
+                registry=self.registry)
+        res = self._peer_client.fetch(
+            peer, req.prompt_ids, temperature=req.temperature,
+            top_k=req.top_k, top_p=req.top_p, deadline=req.deadline,
+            priority=req.priority, trace=req.trace)
+        if res is None:
+            if self.flight is not None:
+                self.flight.record("prefix_peer_fallback", peer=peer,
+                                   request_id=req.id)
+            return None
+        token, (k, v), true_len, bucket = res
+        import jax.numpy as jnp
+        k = jnp.asarray(k)
+        v = jnp.asarray(v)
+        pc = getattr(self.engine, "prefix_cache", None)
+        put = getattr(pc, "put", None)
+        if callable(put):
+            put(list(req.prompt_ids)[-true_len:], k, v, true_len,
+                bucket)
+        if self.flight is not None:
+            self.flight.record("prefix_peer_fetch", peer=peer,
+                               request_id=req.id,
+                               prefix_len=true_len)
+        return token, (k, v), true_len, bucket
+
     def _prefill_req(self, req: Request, span: Optional[Span] = None):
         """Engine prefill for one request; constrained requests pass
         the grammar mask for their FIRST sampled token."""
+        # cross-replica prefix reuse: fetch the prefix KV from the
+        # directory-named peer when this request is eligible (base
+        # model, unconstrained, non-PD engine); any failure falls
+        # through to the ordinary local prefill below
+        peer = getattr(req, "prefix_peer", None)
+        if (peer and req.adapter is None and req.masker is None
+                and not getattr(self.engine, "pd_request_context",
+                                False)):
+            fetched = self._peer_prefill(req, peer)
+            if fetched is not None:
+                return fetched
         kw = {}
         if req.adapter is not None:
             kw["adapter"] = req.adapter

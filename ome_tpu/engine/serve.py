@@ -77,6 +77,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv-blocks", type=int, default=None,
                    help="paged KV pool size in blocks (default: "
                         "dense-equivalent capacity)")
+    p.add_argument("--kv-dtype", choices=("bf16", "int8"),
+                   default="bf16",
+                   help="paged KV block pool storage dtype: int8 "
+                        "halves pool HBM per cached token (per-row-"
+                        "per-head scales, quantize on append, "
+                        "dequantize in the attention kernel) so the "
+                        "same budget holds ~2x the sequences "
+                        "(docs/kv-hierarchy.md); needs --kv-block")
+    p.add_argument("--prefix-cache-host-mb", type=int, default=0,
+                   help="host-DRAM byte budget (MiB) for the prefix-"
+                        "cache spill tier (0 disables): evicted radix "
+                        "blocks spill to host instead of being "
+                        "dropped and swap back in asynchronously on "
+                        "the next hit — never blocking the step path")
     p.add_argument("--control-port", type=int, default=None,
                    help="leader->follower op-replication port for "
                         "multi-host serving (default: engine/multihost "
@@ -332,6 +346,14 @@ def load_engine(args, dist=None):
                              "single-host tp=1 for now (the sharded "
                              "engine keeps the dense per-slot cache); "
                              "drop the flags with tp>1")
+        if getattr(args, "kv_dtype", "bf16") == "int8":
+            raise SystemExit("--kv-dtype int8 quantizes the paged "
+                             "block pool, which is single-host tp=1 "
+                             "for now; drop the flag with tp>1")
+        if getattr(args, "prefix_cache_host_mb", 0):
+            raise SystemExit("--prefix-cache-host-mb (host-DRAM "
+                             "prefix tier) is single-host tp=1 for "
+                             "now; drop the flag with tp>1")
         # hand the host tree straight to shard_params: materializing it
         # on one device first would OOM exactly the models tp serves
         from .sharded import ShardedInferenceEngine
@@ -343,14 +365,20 @@ def load_engine(args, dist=None):
     import jax
     params = jax.tree.map(jnp.asarray, params)  # one transfer
 
+    kv_dtype = getattr(args, "kv_dtype", "bf16")
+
     def build(kv_block, kv_blocks):
         return InferenceEngine(params, cfg, max_slots=args.max_slots,
                                max_seq=max_seq,
                                prefix_cache_bytes=args.prefix_cache_mb << 20,
+                               prefix_host_bytes=getattr(
+                                   args, "prefix_cache_host_mb", 0) << 20,
                                lora_slots=lora_slots,
                                lora_rank=args.lora_rank,
                                kv_block=kv_block,
                                kv_blocks=kv_blocks,
+                               kv_dtype=(kv_dtype
+                                         if kv_dtype != "bf16" else None),
                                ledger=ledger)
     try:
         engine = build(args.kv_block, args.kv_blocks)
@@ -367,6 +395,11 @@ def load_engine(args, dist=None):
                     "FALLING BACK to the dense per-slot cache — HBM "
                     "use is max-slots x max-seq, not tokens in flight",
                     e)
+        if kv_dtype == "int8":
+            # int8 storage rides the paged pool; the dense slab stays
+            # at the model dtype, so the HBM halving is gone too
+            log.warning("--kv-dtype int8 dropped with the paged pool")
+            kv_dtype = "bf16"
         engine = build(0, None)
     for name, path in named_adapters.items():
         engine.register_adapter(name, path)
@@ -664,6 +697,16 @@ def main(argv=None) -> int:
             pub = multihost.OpPublisher(dist.num_processes - 1,
                                         port=control_port)
             engine = multihost.ReplicatedEngine(engine, pub)
+        if (dist is None and args.disaggregation_mode == "none"
+                and args.prefix_cache_mb > 0):
+            # cross-replica prefix reuse: a replica with a live prefix
+            # cache is also a prefix DONOR — peers the router's fleet
+            # directory points at this replica fetch hot prefix KV
+            # over the same hardened /pd/prefill path PD uses
+            # (docs/kv-hierarchy.md). int8-pool engines ship blobs
+            # quantized at half the bytes.
+            from .pd import make_pd_prefill_handler
+            pd_prefill = make_pd_prefill_handler(engine)
         # prefill/decode overlap is single-host only: multi-host
         # leaders publish ops from ONE thread in execution order
         # (followers replay strictly sequentially); on PD decode nodes

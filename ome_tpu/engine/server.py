@@ -111,6 +111,20 @@ class EngineServer:
         # count a failure"; in-flight requests keep streaming
         self.draining = False
         self.started_at = time.time()
+        # cross-replica prefix reuse (docs/kv-hierarchy.md): digests
+        # of recently served prefixes, reported in the /ready body so
+        # the router's fleet prefix directory learns ownership from
+        # the health probes it already makes. Only replicas with a
+        # live prefix cache advertise (a digest from a cacheless
+        # replica would invite pointless peer fetches).
+        import collections
+        self._prefix_digests: "collections.OrderedDict[str, bool]" = \
+            collections.OrderedDict()
+        self._prefix_digest_cap = 32
+        self._prefix_digest_lock = threading.Lock()
+        _eng = getattr(scheduler, "engine", None)
+        self._report_prefixes = bool(getattr(
+            getattr(_eng, "prefix_cache", None), "capacity_bytes", 0))
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -176,7 +190,11 @@ class EngineServer:
                         "ready": ready, "status": status,
                         "draining": outer.draining,
                         "queue_depth": depth,
-                        "queue_limit": outer.ready_queue_limit})
+                        "queue_limit": outer.ready_queue_limit,
+                        # prefix-directory piggyback: the router's
+                        # health probe carries these into the fleet
+                        # prefix directory (router/server.py)
+                        "prefix_digests": outer.prefix_digests()})
                 elif self.path == "/v1/models":
                     data = [{"id": outer.model_name, "object": "model",
                              "owned_by": "ome-tpu"}]
@@ -525,6 +543,11 @@ class EngineServer:
                     temperature=float(payload.get("temperature", 0.0)),
                     top_k=int(payload.get("top_k", 0)),
                     top_p=float(payload.get("top_p", 1.0)),
+                    # router-injected donor peer for cross-replica
+                    # prefix reuse; admission fetches the prefix KV
+                    # from it (engine/peering.py) or recomputes
+                    prefix_peer=self.headers.get("X-OME-Prefix-Peer")
+                    or None,
                     masker=masker, adapter=adapter, deadline=deadline,
                     # adopt the router's trace (traceparent header) or
                     # mint one, so standalone engines still correlate
@@ -557,6 +580,9 @@ class EngineServer:
                     return self._json(503, {"error": str(e)},
                                       headers={"Retry-After":
                                           outer._retry_after()})
+                # admitted: this replica is about to hold the prompt's
+                # prefix KV — advertise its digest to the fleet
+                outer._note_prefix(payload)
                 if payload.get("stream"):
                     try:
                         return self._stream(req, chat)
@@ -689,6 +715,28 @@ class EngineServer:
     def _adapter_names(self):
         eng = getattr(self.scheduler, "engine", None)
         return list(getattr(eng, "adapter_names", []) or [])
+
+    def _note_prefix(self, payload: dict) -> None:
+        """Record the prefix digest of an admitted request (bounded
+        LRU) — the same digest the router computes from the same
+        payload, so directory lookups land on the replicas that
+        actually hold the prefix KV."""
+        if not self._report_prefixes:
+            return
+        from ..router.server import affinity_from_payload, prefix_digest
+        key = affinity_from_payload(payload)
+        if not key:
+            return
+        d = prefix_digest(key)
+        with self._prefix_digest_lock:
+            self._prefix_digests.pop(d, None)
+            self._prefix_digests[d] = True
+            while len(self._prefix_digests) > self._prefix_digest_cap:
+                self._prefix_digests.popitem(last=False)
+
+    def prefix_digests(self) -> list:
+        with self._prefix_digest_lock:
+            return list(self._prefix_digests)
 
     def _retry_after(self, default: float = 1.0) -> str:
         """Retry-After derived from the scheduler's live queue-wait

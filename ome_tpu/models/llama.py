@@ -118,6 +118,12 @@ class PagedKVCache:
     v: jax.Array
     index: jax.Array
     table: jax.Array
+    # int8 pools only: per-(row, head) f32 dequant scales, stored
+    # S-minor ([L, N, K, block]) so each block's [K, block] scale
+    # plane is lane-aligned for the Pallas kernel (ops/flash.py
+    # quantize_kv_block layout); None for bf16 pools
+    k_scale: jax.Array = None
+    v_scale: jax.Array = None
 
     @classmethod
     def create(cls, cfg: ModelConfig, batch: int, n_blocks: int,
@@ -127,10 +133,17 @@ class PagedKVCache:
         K, Dk, Dv = (cfg.kv_cache_heads, cfg.kv_cache_k_dim,
                      cfg.kv_cache_v_dim)
         L = cfg.num_layers
+        quantized = jnp.dtype(dtype) == jnp.int8
+
+        def scale():
+            # distinct buffers per plane: donation refuses aliases
+            return (jnp.zeros((L, n_blocks, K, block), jnp.float32)
+                    if quantized else None)
         return cls(k=jnp.zeros((L, n_blocks, block, K, Dk), dtype),
                    v=jnp.zeros((L, n_blocks, block, K, Dv), dtype),
                    index=jnp.zeros((batch,), jnp.int32),
-                   table=jnp.zeros((batch, max_blocks), jnp.int32))
+                   table=jnp.zeros((batch, max_blocks), jnp.int32),
+                   k_scale=scale(), v_scale=scale())
 
 
 # -- init ------------------------------------------------------------------
@@ -806,29 +819,53 @@ def forward_paged(params: Params, cfg: ModelConfig, tokens: jax.Array,
     blk = cache.table[rows[:, None],
                       jnp.minimum(positions // bs, M - 1)]  # [B, S]
     off = positions % bs
+    quantized = cache.k_scale is not None
+
+    def _append(pool, scale_pool, rows_new):
+        """Write S fresh [B, K, D] rows into the pool; int8 pools
+        quantize per (row, head) on the way in (amax/127 symmetric,
+        the ops/flash.py quantize_kv_block discipline) and store the
+        f32 scale at the same (block, offset). The S writes per slot
+        land on consecutive rows (distinct (block, offset) pairs), so
+        the unrolled scatter order doesn't matter; trash-block
+        collisions between inactive slots are never read back."""
+        if quantized:
+            amax = jnp.max(jnp.abs(rows_new.astype(jnp.float32)),
+                           axis=-1)                        # [B, S, K]
+            sc = jnp.maximum(amax, 1e-8) / 127.0
+            rows_new = jnp.clip(
+                jnp.round(rows_new.astype(jnp.float32)
+                          / sc[..., None]),
+                -127, 127).astype(jnp.int8)
+        for s in range(S):
+            pool = pool.at[blk[:, s], off[:, s]].set(
+                rows_new[:, s].astype(pool.dtype))
+            if quantized:
+                scale_pool = scale_pool.at[blk[:, s], :,
+                                           off[:, s]].set(sc[:, s])
+        return pool, scale_pool
 
     def body(x, per):
-        lp, kp, vp = per
+        if quantized:
+            lp, kp, vp, ksp, vsp = per
+        else:
+            lp, kp, vp = per
+            ksp = vsp = None
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps, uo)
         q, k, v = _qkv(h, lp, cfg, freqs, positions, uo, adapter_ids)
-        # the S writes per slot land on consecutive rows (distinct
-        # (block, offset) pairs), so the unrolled scatter order
-        # doesn't matter; trash-block collisions between inactive
-        # slots are never read back
-        for s in range(S):
-            kp = kp.at[blk[:, s], off[:, s]].set(
-                k[:, s].astype(kp.dtype))
-            vp = vp.at[blk[:, s], off[:, s]].set(
-                v[:, s].astype(vp.dtype))
+        kp, ksp = _append(kp, ksp, k)
+        vp, vsp = _append(vp, vsp, v)
         if S == 1:
             attn = paged_attention(q, kp, vp, cache.table, kv_len,
                                    scale=cfg.query_scale,
-                                   logit_softcap=cfg.attn_logit_softcap)
+                                   logit_softcap=cfg.attn_logit_softcap,
+                                   k_scale=ksp, v_scale=vsp)
         else:
             attn = paged_attention_multi(
                 q, kp, vp, cache.table, positions,
                 scale=cfg.query_scale,
-                logit_softcap=cfg.attn_logit_softcap)
+                logit_softcap=cfg.attn_logit_softcap,
+                k_scale=ksp, v_scale=vsp)
         a = _proj_lora(attn, lp, "wo", adapter_ids, cfg.dtype,
                        flatten=2)
         if cfg.post_block_norms:
@@ -839,12 +876,21 @@ def forward_paged(params: Params, cfg: ModelConfig, tokens: jax.Array,
         if cfg.post_block_norms:
             mlp_out = rms_norm(mlp_out, lp["mlp_post_norm"],
                                cfg.rms_norm_eps, uo)
-        return x + mlp_out, (kp, vp)
+        out = (x + mlp_out, ((kp, vp, ksp, vsp) if quantized
+                             else (kp, vp)))
+        return out
 
-    x, (nk, nv) = lax.scan(body, x,
-                           (params["layers"], cache.k, cache.v))
+    if quantized:
+        x, (nk, nv, nks, nvs) = lax.scan(
+            body, x, (params["layers"], cache.k, cache.v,
+                      cache.k_scale, cache.v_scale))
+    else:
+        x, (nk, nv) = lax.scan(body, x,
+                               (params["layers"], cache.k, cache.v))
+        nks = nvs = None
     new_cache = PagedKVCache(k=nk, v=nv, index=cache.index + S,
-                             table=cache.table)
+                             table=cache.table,
+                             k_scale=nks, v_scale=nvs)
     return _final_logits(params, cfg, x), new_cache
 
 

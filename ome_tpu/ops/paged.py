@@ -39,24 +39,45 @@ from jax.experimental.pallas import tpu as pltpu
 from .flash import M_INIT, _decode_block_range, _decode_kernel
 
 
+def _gather_dequant(pool: jax.Array, scale_pool: Optional[jax.Array],
+                    table: jax.Array) -> jax.Array:
+    """Gather each slot's block chain into a contiguous f32 view:
+    [B, M, bs, K, D] -> [B, M*bs, K, D]. int8 pools carry per-(row,
+    head) scales [N, K, bs] (S-minor, the flash.py quantize_kv_block
+    layout) gathered by the same table and multiplied back in — the
+    XLA numerics reference for the quantized Pallas kernel."""
+    B, M = table.shape
+    bs = pool.shape[1]
+    g = jnp.take(pool, table, axis=0).reshape(B, M * bs,
+                                              pool.shape[2], -1)
+    if scale_pool is None:
+        return g.astype(jnp.float32)
+    sg = jnp.take(scale_pool, table, axis=0)      # [B, M, K, bs]
+    sg = jnp.swapaxes(sg, 2, 3).reshape(B, M * bs, -1)  # [B, S, K]
+    return g.astype(jnp.float32) * sg[..., None]
+
+
 def paged_attention_xla(q: jax.Array, k_pool: jax.Array,
                         v_pool: jax.Array, table: jax.Array,
                         kv_len: jax.Array,
                         scale: Optional[float] = None,
                         logit_softcap: Optional[float] = None,
+                        k_scale: Optional[jax.Array] = None,
+                        v_scale: Optional[jax.Array] = None,
                         ) -> jax.Array:
     """Reference paged decode attention (XLA gather + masked softmax).
 
     q: [B, 1, H, D]; pools: [N, bs, K, D]; table: [B, M] int32;
-    kv_len: [B] valid rows per slot. Returns [B, 1, H, D].
+    kv_len: [B] valid rows per slot. int8 pools pass their scale
+    planes ([N, K, bs] f32) for dequantization. Returns [B, 1, H, D].
     """
     B, _, H, D = q.shape
     _, bs, K, _ = k_pool.shape
     M = table.shape[1]
     scale = scale if scale is not None else D ** -0.5
     # gather each slot's chain: [B, M, bs, K, D] -> [B, M*bs, K, D]
-    kg = jnp.take(k_pool, table, axis=0).reshape(B, M * bs, K, -1)
-    vg = jnp.take(v_pool, table, axis=0).reshape(B, M * bs, K, -1)
+    kg = _gather_dequant(k_pool, k_scale, table)
+    vg = _gather_dequant(v_pool, v_scale, table)
     G = H // K
     qh = q.reshape(B, K, G, D)
     logits = jnp.einsum("bkgd,bskd->bkgs", qh.astype(jnp.float32),
@@ -77,6 +98,8 @@ def paged_attention_multi(q: jax.Array, k_pool: jax.Array,
                           q_positions: jax.Array,
                           scale: Optional[float] = None,
                           logit_softcap: Optional[float] = None,
+                          k_scale: Optional[jax.Array] = None,
+                          v_scale: Optional[jax.Array] = None,
                           ) -> jax.Array:
     """Multi-query causal paged attention (speculative verify).
 
@@ -96,8 +119,8 @@ def paged_attention_multi(q: jax.Array, k_pool: jax.Array,
     _, bs, K, _ = k_pool.shape
     M = table.shape[1]
     scale = scale if scale is not None else D ** -0.5
-    kg = jnp.take(k_pool, table, axis=0).reshape(B, M * bs, K, -1)
-    vg = jnp.take(v_pool, table, axis=0).reshape(B, M * bs, K, -1)
+    kg = _gather_dequant(k_pool, k_scale, table)
+    vg = _gather_dequant(v_pool, v_scale, table)
     G = H // K
     qh = q.reshape(B, Sq, K, G, D)
     logits = jnp.einsum("bqkgd,bskd->bkgqs", qh.astype(jnp.float32),
@@ -117,14 +140,16 @@ def paged_attention_multi(q: jax.Array, k_pool: jax.Array,
 
 
 def _paged_kernel(lim_ref, tbl_ref, q_ref, k_ref, v_ref, *refs,
-                  bs: int, scale: float, softcap: Optional[float]):
+                  bs: int, scale: float, softcap: Optional[float],
+                  quantized: bool = False):
     # identical math to the dense decode kernel: `start` stays in
     # SEQUENCE space (col masking against [lo, hi)); only the DMA
     # source — chosen by the BlockSpec index maps from tbl_ref — is
-    # pool-indexed, which the body never sees.
+    # pool-indexed, which the body never sees. Quantized pools add
+    # two scale refs the dense kernel already knows how to fold in.
     del tbl_ref
     _decode_kernel(lim_ref, q_ref, k_ref, v_ref, *refs, bs=bs,
-                   scale=scale, softcap=softcap)
+                   scale=scale, softcap=softcap, quantized=quantized)
 
 
 def paged_flash_decode(q: jax.Array, k_pool: jax.Array,
@@ -132,6 +157,8 @@ def paged_flash_decode(q: jax.Array, k_pool: jax.Array,
                        kv_len: jax.Array,
                        scale: Optional[float] = None,
                        logit_softcap: Optional[float] = None,
+                       k_scale: Optional[jax.Array] = None,
+                       v_scale: Optional[jax.Array] = None,
                        interpret: bool = False
                        ) -> Optional[jax.Array]:
     """Pallas paged decode attention; None when shapes are uncovered
@@ -139,7 +166,11 @@ def paged_flash_decode(q: jax.Array, k_pool: jax.Array,
 
     Pool block size doubles as the kernel block: bs must be a multiple
     of 128 lanes-worth of rows for efficient DMA — the engine default
-    (128) satisfies this.
+    (128) satisfies this. int8 pools (k_scale/v_scale [N, K, bs] f32)
+    stream 1 byte/element plus a tiny scale plane; the kernel converts
+    raw int8 to the compute dtype for the MXU dots and multiplies the
+    scales into the small [K*G, bs] logits/probs tiles (ops/flash.py
+    quantized decode discipline).
     """
     B, Sq, H, D = q.shape
     N, bs, K, _ = k_pool.shape
@@ -147,6 +178,7 @@ def paged_flash_decode(q: jax.Array, k_pool: jax.Array,
     if Sq != 1 or H % K != 0 or H < 8 or D % 128 != 0 \
             or bs % 128 != 0:
         return None
+    quantized = k_scale is not None
     G = H // K
     scale = scale if scale is not None else D ** -0.5
     hi = kv_len.astype(jnp.int32)
@@ -159,15 +191,26 @@ def paged_flash_decode(q: jax.Array, k_pool: jax.Array,
         j = jnp.minimum(first + s, last)          # sequence block
         return (tbl[b, j], 0, 0, 0)               # pool block
 
+    def sc_index(b, s, lim, tbl):
+        first, last = _decode_block_range(lim[b, 0], lim[b, 1], bs)
+        j = jnp.minimum(first + s, last)
+        return (tbl[b, j], 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, K, G, D), lambda b, s, lim, tbl:
+                     (b, 0, 0, 0)),
+        pl.BlockSpec((1, bs, K, D), kv_index),
+        pl.BlockSpec((1, bs, K, D), kv_index),
+    ]
+    args = [limits, table.astype(jnp.int32), qh, k_pool, v_pool]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, K, bs), sc_index),
+                     pl.BlockSpec((1, K, bs), sc_index)]
+        args += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,                    # limits, table
         grid=(B, M),
-        in_specs=[
-            pl.BlockSpec((1, K, G, D), lambda b, s, lim, tbl:
-                         (b, 0, 0, 0)),
-            pl.BlockSpec((1, bs, K, D), kv_index),
-            pl.BlockSpec((1, bs, K, D), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, K, G, D), lambda b, s, lim, tbl:
                                (b, 0, 0, 0)),
         scratch_shapes=[
@@ -178,11 +221,11 @@ def paged_flash_decode(q: jax.Array, k_pool: jax.Array,
     )
     out = pl.pallas_call(
         functools.partial(_paged_kernel, bs=bs, scale=scale,
-                          softcap=logit_softcap),
+                          softcap=logit_softcap, quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, K, G, D), q.dtype),
         interpret=interpret,
-    )(limits, table.astype(jnp.int32), qh, k_pool, v_pool)
+    )(*args)
     return out.reshape(B, 1, H, D)
 
 
@@ -190,9 +233,11 @@ def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
                     table: jax.Array, kv_len: jax.Array,
                     scale: Optional[float] = None,
                     logit_softcap: Optional[float] = None,
+                    k_scale: Optional[jax.Array] = None,
+                    v_scale: Optional[jax.Array] = None,
                     backend: Optional[str] = None) -> jax.Array:
     """Dispatching entry: Pallas on TPU, XLA elsewhere (same contract
-    as ops/attention.attention)."""
+    as ops/attention.attention). int8 pools pass k_scale/v_scale."""
     import os
     if backend is None:
         backend = os.environ.get("OME_ATTN_BACKEND")
@@ -201,8 +246,10 @@ def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
             (on_tpu or backend is not None):
         out = paged_flash_decode(
             q, k_pool, v_pool, table, kv_len, scale, logit_softcap,
+            k_scale=k_scale, v_scale=v_scale,
             interpret=(backend == "pallas_interpret" or not on_tpu))
         if out is not None:
             return out
     return paged_attention_xla(q, k_pool, v_pool, table, kv_len,
-                               scale, logit_softcap)
+                               scale, logit_softcap,
+                               k_scale=k_scale, v_scale=v_scale)

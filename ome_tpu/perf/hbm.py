@@ -36,9 +36,15 @@ def kv_capacity_bytes(engine) -> int:
     cfg = getattr(engine, "cfg", None)
     if cfg is None:
         return 0
-    itemsize = jnp.dtype(cfg.dtype).itemsize
-    row = (cfg.num_layers * cfg.kv_cache_heads
-           * (cfg.kv_cache_k_dim + cfg.kv_cache_v_dim) * itemsize)
+    row_fn = getattr(engine, "kv_row_bytes", None)
+    if callable(row_fn):
+        # the engine's own byte model — int8-pool aware (quantized
+        # rows store 1 byte/element + two f32 scales per head)
+        row = int(row_fn())
+    else:
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        row = (cfg.num_layers * cfg.kv_cache_heads
+               * (cfg.kv_cache_k_dim + cfg.kv_cache_v_dim) * itemsize)
     if getattr(engine, "kv_block", 0):
         return int(engine.kv_blocks * engine.kv_block * row)
     return int(engine.max_slots * engine.max_seq * row)

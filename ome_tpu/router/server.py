@@ -69,6 +69,13 @@ _COUNTER_HELP = {
     "draining_skips_total":
         "Forwards redirected because the backend announced it was "
         "draining (free failover: no breaker hit, no retry token)",
+    "prefix_directory_hits_total":
+        "Forwarded requests whose prefix digest the fleet prefix "
+        "directory mapped to a replica",
+    "prefix_directory_peer_fetches_total":
+        "Forwards carrying an X-OME-Prefix-Peer header because the "
+        "prefix owner differed from the chosen backend (the backend "
+        "fetches the prefix KV from the peer)",
 }
 
 _CB_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}
@@ -118,38 +125,47 @@ class Backend:
         # /ready probe and by X-OME-Draining responses; cleared when
         # the probe sees it ready again (rollback / cancelled drain).
         self.draining = False
-
-    # callers hold Router._lock (selection and result notes race)
+        # breaker state is self-guarded: Backend now has three owners
+        # (Router, pd.PrefillPool, peering.PrefixPeerClient), each
+        # serializing under its OWN lock, so the state transitions
+        # take this leaf lock rather than trusting any one of them.
+        # Callers still hold their owner lock around selection so a
+        # pick and its result-note stay paired.
+        self._lock = threading.Lock()
 
     def record_success(self):
-        self.fails = 0
-        self.cb_trips = 0
-        self.cb_state = "closed"
-        self._probe_inflight = False
-        self.healthy = True
+        with self._lock:
+            self.fails = 0
+            self.cb_trips = 0
+            self.cb_state = "closed"
+            self._probe_inflight = False
+            self.healthy = True
 
     def record_failure(self, now: float):
-        self.fails += 1
-        self._probe_inflight = False
-        if self.cb_state == "half_open" or \
-                self.fails >= self.cb_threshold:
-            self.cb_trips += 1
-            self.cb_state = "open"
-            self.cb_open_until = now + min(
-                self.cb_cooldown * (2 ** (self.cb_trips - 1)),
-                self.cb_max_cooldown)
+        with self._lock:
+            self.fails += 1
+            self._probe_inflight = False
+            if self.cb_state == "half_open" or \
+                    self.fails >= self.cb_threshold:
+                self.cb_trips += 1
+                self.cb_state = "open"
+                self.cb_open_until = now + min(
+                    self.cb_cooldown * (2 ** (self.cb_trips - 1)),
+                    self.cb_max_cooldown)
 
     def selectable(self, now: float) -> bool:
-        if self.draining:
-            return False  # leaving rotation, but NOT a failure
-        if self.cb_state == "open":
-            if now < self.cb_open_until:
-                return False
-            self.cb_state = "half_open"  # cooldown over: allow probes
-        if self.cb_state == "half_open":
-            # ONE probe request at a time re-tests the backend
-            return not self._probe_inflight
-        return self.healthy
+        with self._lock:
+            if self.draining:
+                return False  # leaving rotation, but NOT a failure
+            if self.cb_state == "open":
+                if now < self.cb_open_until:
+                    return False
+                # cooldown over: allow probes
+                self.cb_state = "half_open"
+            if self.cb_state == "half_open":
+                # ONE probe request at a time re-tests the backend
+                return not self._probe_inflight
+            return self.healthy
 
     def __repr__(self):
         return f"Backend({self.url}, {self.pool}, " \
@@ -158,21 +174,27 @@ class Backend:
                f"{', draining' if self.draining else ''})"
 
 
-def probe_backend(url: str, timeout: float = 5.0):
+def probe_backend_info(url: str, timeout: float = 5.0):
     """Probe /ready (falling back to /health for pre-readiness
-    backends). Returns (healthy, draining): a draining replica
+    backends). Returns (healthy, draining, info): a draining replica
     answers /ready with 503 + {"draining": true} while still
     finishing in-flight work — it is HEALTHY but must leave the
     rotation, and re-enters it if a later probe sees 200 again.
 
-    Shared by the router's health loop and the PD decode node's
-    prefill pool (engine/pd.py), so every pool in the system applies
-    one draining/readiness discipline."""
+    `info` is the parsed /ready JSON body (None when unavailable) —
+    the piggyback channel for the fleet prefix directory: replicas
+    report the digests of prefixes they recently served
+    ("prefix_digests") on the probe the router already makes."""
     url = url.rstrip("/")
     try:
         with urllib.request.urlopen(url + "/ready",
                                     timeout=timeout) as resp:
-            return resp.status == 200, False
+            ok = resp.status == 200
+            try:
+                info = json.loads(resp.read() or b"{}")
+            except ValueError:
+                info = None
+            return ok, False, info if isinstance(info, dict) else None
     except urllib.error.HTTPError as e:
         if e.code == 503:
             try:
@@ -181,20 +203,88 @@ def probe_backend(url: str, timeout: float = 5.0):
                 info = {}
             e.close()
             if info.get("draining"):
-                return True, True
-            return False, False  # not ready for another reason
+                return True, True, info
+            return False, False, None  # not ready for another reason
         e.close()
         if e.code == 404:
             # old backend without /ready: fall back to /health
             try:
                 with urllib.request.urlopen(url + "/health",
                                             timeout=timeout) as resp:
-                    return resp.status == 200, False
+                    return resp.status == 200, False, None
             except Exception:
-                return False, False
-        return False, False
+                return False, False, None
+        return False, False, None
     except Exception:
-        return False, False
+        return False, False, None
+
+
+def probe_backend(url: str, timeout: float = 5.0):
+    """(healthy, draining) view of probe_backend_info — the contract
+    shared by the router's health loop and the PD decode node's
+    prefill pool (engine/pd.py), so every pool in the system applies
+    one draining/readiness discipline."""
+    healthy, draining, _ = probe_backend_info(url, timeout=timeout)
+    return healthy, draining
+
+
+def prefix_digest(affinity_key: str) -> str:
+    """Stable short digest of a request's prefix-affinity key — the
+    fleet prefix directory's key. Computed identically by the router
+    (from affinity_from_payload) and by replicas reporting the
+    prefixes they served, so the two sides meet without shipping raw
+    prompt text through health probes."""
+    return hashlib.blake2b(affinity_key.encode(),
+                           digest_size=8).hexdigest()
+
+
+class PrefixDirectory:
+    """Which replica owns which prefix digest — the fleet-scale half
+    of cache-aware routing (docs/kv-hierarchy.md). Entries arrive as
+    health-probe piggyback (each replica's /ready body lists the
+    digests it recently served) and are looked up per forward: when
+    the rendezvous-chosen backend differs from the digest's owner,
+    the forward carries X-OME-Prefix-Peer so the backend can fetch
+    the hot prefix KV from the owner instead of recomputing it.
+
+    LRU-bounded; last reporter wins a digest (the directory tracks
+    recency, not truth — a stale entry costs one failed peer fetch
+    that falls back to local recompute)."""
+
+    def __init__(self, max_entries: int = 4096):
+        self.max_entries = max_entries
+        import collections
+        self._owners: "collections.OrderedDict[str, str]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def update(self, url: str, digests) -> None:
+        url = url.rstrip("/")
+        if not isinstance(digests, (list, tuple)):
+            return
+        with self._lock:
+            for d in digests:
+                if not isinstance(d, str) or not d:
+                    continue
+                self._owners.pop(d, None)
+                self._owners[d] = url
+            while len(self._owners) > self.max_entries:
+                self._owners.popitem(last=False)
+
+    def forget(self, url: str) -> None:
+        """Drop every digest owned by a removed backend."""
+        url = url.rstrip("/")
+        with self._lock:
+            for d in [d for d, u in self._owners.items() if u == url]:
+                del self._owners[d]
+
+    def lookup(self, digest: str) -> Optional[str]:
+        with self._lock:
+            return self._owners.get(digest)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._owners)
 
 
 class Router:
@@ -250,6 +340,14 @@ class Router:
         # their final values forever (the registry has no child
         # removal, and a stale draining=1 would confuse autoscaling)
         self._gauge_keys: set = set()
+        # fleet prefix directory: digest -> owning replica, fed by the
+        # health probes' /ready piggyback, consulted per forward to
+        # name a KV donor peer (cross-replica prefix reuse)
+        self.prefix_directory = PrefixDirectory()
+        self._g_prefix_dir = self.registry.gauge(
+            "ome_router_prefix_directory_entries",
+            "Prefix digests currently tracked by the fleet prefix "
+            "directory")
 
     @property
     def stats(self) -> Dict[str, float]:
@@ -295,6 +393,7 @@ class Router:
                 g.labels(backend=url, pool=pool).set(0)
         self._g_backends_up.set(up)
         self._g_backends_draining.set(draining)
+        self._g_prefix_dir.set(len(self.prefix_directory))
 
     # -- membership ----------------------------------------------------
     # The autoscale controller's registration surface (POST/DELETE
@@ -327,6 +426,7 @@ class Router:
             for i, b in enumerate(self.backends):
                 if b.url == u:
                     del self.backends[i]
+                    self.prefix_directory.forget(u)
                     return True
         return False
 
@@ -424,15 +524,23 @@ class Router:
         with self._lock:
             targets = list(self.backends)
         for b in targets:
-            healthy, draining = self._probe_backend(b)
+            res = self._probe_backend(b)
+            # test overrides return the legacy (healthy, draining)
+            # pair; the default carries the /ready body as a third
+            # element — the prefix-directory piggyback
+            healthy, draining = res[0], res[1]
+            info = res[2] if len(res) > 2 else None
             with self._lock:
                 b.healthy = healthy
                 b.draining = draining
                 b.last_checked = time.time()
+            if isinstance(info, dict):
+                self.prefix_directory.update(
+                    b.url, info.get("prefix_digests"))
 
     @staticmethod
     def _probe_backend(b: Backend):
-        return probe_backend(b.url)
+        return probe_backend_info(b.url)
 
     def start_health_loop(self):
         def loop():
@@ -706,6 +814,15 @@ class RouterServer:
                 deadline = self._deadline()
                 pool = self._pick_pool()
                 outcome["pool"] = pool
+                # fleet prefix directory: if some replica owns this
+                # request's prefix, remember it — a forward landing
+                # ELSEWHERE names the owner as a KV donor peer
+                peer_hint = None
+                if affinity and outer.router.policy == "cache_aware":
+                    peer_hint = outer.router.prefix_directory.lookup(
+                        prefix_digest(affinity))
+                    if peer_hint is not None:
+                        outer.router.inc("prefix_directory_hits_total")
                 tried: set = set()
                 last_err = "no healthy backends"
                 # `failures` counts TRANSPORT failures only; a draining
@@ -756,8 +873,12 @@ class RouterServer:
                         aspan.set(backend=backend.url,
                                   retries=failures)
                     try:
-                        result = self._forward(backend, body, stream,
-                                               deadline, trace=child)
+                        result = self._forward(
+                            backend, body, stream, deadline,
+                            trace=child,
+                            prefix_peer=(peer_hint
+                                         if peer_hint != backend.url
+                                         else None))
                         outer.router.note_result(backend, ok=True)
                         outcome["status"] = "ok"
                         if aspan is not None:
@@ -826,7 +947,7 @@ class RouterServer:
 
             def _forward(self, backend: Backend, body: bytes,
                          stream: bool, deadline: Optional[float] = None,
-                         trace=None):
+                         trace=None, prefix_peer: Optional[str] = None):
                 from .. import faults
 
                 # deterministic fault injection: an armed rule makes
@@ -843,6 +964,14 @@ class RouterServer:
                     # the engine's admission/scheduling decisions need
                     # the tenant class the client declared
                     headers["X-OME-Priority"] = pri
+                if prefix_peer:
+                    # cross-replica prefix reuse: the chosen backend
+                    # does not own this prefix — name the replica that
+                    # does, so it can fetch the KV over /pd/prefill
+                    # (engine/peering.py) instead of recomputing it
+                    headers["X-OME-Prefix-Peer"] = prefix_peer
+                    outer.router.inc(
+                        "prefix_directory_peer_fetches_total")
                 timeout = 600.0
                 if deadline is not None:
                     # propagate the client deadline downstream and

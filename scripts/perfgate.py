@@ -64,6 +64,13 @@ NESTED_POLICY = (
     (re.compile(r"^decode_ms_breakdown\.\w+\.step$"), (False, 0.08)),
     (re.compile(r"^multistep\.\d+\.tokens_per_sec$"), (True, 0.06)),
     (re.compile(r"^multistep\.\d+\.step_ms$"), (False, 0.08)),
+    # paged decode sweep (batch x pool dtype): throughput gates like
+    # the other decode families; bytes/slot is a deterministic byte
+    # model, so ANY growth is a pool-layout regression (band 0)
+    (re.compile(r"^paged_sweep\.\w+\.\d+\.tokens_per_sec$"),
+     (True, 0.06)),
+    (re.compile(r"^paged_sweep\.\w+\.\d+\.hbm_per_slot_bytes$"),
+     (False, 0.0)),
 )
 
 
@@ -181,6 +188,15 @@ def cost_table(parsed: dict, source: str) -> dict:
         table["programs"]["decode_paged_b64"] = {
             "tokens_per_sec":
                 parsed["paged_decode_tokens_per_sec_batch64"]}
+    for mode, pts in (parsed.get("paged_sweep") or {}).items():
+        if not isinstance(pts, dict):
+            continue  # scalar keys like capacity_ratio_*
+        for b, row in pts.items():
+            if isinstance(row, dict) and "tokens_per_sec" in row:
+                table["programs"][f"decode_paged_{mode}_b{b}"] = {
+                    "tokens_per_sec": row["tokens_per_sec"],
+                    "hbm_per_slot_bytes":
+                        row.get("hbm_per_slot_bytes")}
     if "dispatch_ms" in parsed:
         table["dispatch_ms"] = parsed["dispatch_ms"]
     for k in ("value", "decode_effective_gbps", "achievable_gbps",

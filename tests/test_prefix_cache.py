@@ -171,3 +171,160 @@ class TestPrefixCacheUnit:
         pc.put([9, 10, 11, 12], k3, v3, 4, 4)  # evicts [5,6,7,8]
         assert pc.match([1, 2, 3, 4, 0]) is not None
         assert pc.match([5, 6, 7, 8, 0]) is None
+
+
+BLOCK_BYTES = 2 * (1 * 1 * 4 * 1 * 2 * 4)  # one _kv(4) block, k + v
+
+
+class TestHostTier:
+    """Host-DRAM spill tier (--prefix-cache-host-mb): eviction spills
+    instead of dropping; a host hit enqueues an ASYNC swap-in and the
+    current request recomputes; the next same-prefix request hits on
+    device. docs/kv-hierarchy.md Tier 1."""
+
+    _kv = staticmethod(TestPrefixCacheUnit._kv)
+
+    def _pc(self, dev_blocks=2, host_blocks=8):
+        return PrefixCache(capacity_bytes=dev_blocks * BLOCK_BYTES,
+                           block=4, min_prefix=4,
+                           host_capacity_bytes=host_blocks
+                           * BLOCK_BYTES)
+
+    def test_evict_spills_then_next_request_hits_after_swapin(self):
+        pc = self._pc(dev_blocks=2)
+        ka, va = self._kv(4)
+        pc.put([1, 2, 3, 4], ka, va, 4, 4)
+        pc.put([5, 6, 7, 8], *self._kv(4), 4, 4)
+        pc.put([9, 10, 11, 12], *self._kv(4), 4, 4)  # spills [1..4]
+        assert pc.evictions == 1
+        assert pc.host_bytes == BLOCK_BYTES
+        # the admitting request gets NO device hit — it recomputes —
+        # but the host hit queues the block for swap-in
+        assert pc.match([1, 2, 3, 4, 0]) is None
+        assert (pc.host_hits, pc.host_recomputes) == (1, 1)
+        pc.drain_swapins()
+        assert pc.host_swapins == 1
+        # swapped in; the NEXT same-prefix request serves from device,
+        # with the ORIGINAL bytes (spill->swap-in round trips exactly)
+        hit = pc.match([1, 2, 3, 4, 0])
+        assert hit is not None and hit[2] == 4
+        np.testing.assert_array_equal(np.asarray(hit[0]),
+                                      np.asarray(ka))
+        ok, dev_blocks, host_blocks = pc.tier_conservation()
+        assert ok
+
+    def test_divergent_suffix_still_shares_swapped_block(self):
+        """A prompt diverging AFTER the swapped-in block reuses it —
+        the radix property survives the spill/swap-in round trip."""
+        pc = self._pc(dev_blocks=2)
+        pc.put([1, 2, 3, 4, 5, 6, 7, 8], *self._kv(8), 8, 8)
+        pc.put([20, 21, 22, 23], *self._kv(4), 4, 4)  # spills a leaf
+        assert pc.host_bytes > 0
+        pc.match([1, 2, 3, 4, 5, 6, 7, 8, 0])
+        pc.drain_swapins()
+        # divergent continuation: shares only the leading blocks
+        hit = pc.match([1, 2, 3, 4, 99, 98, 97, 96, 0])
+        assert hit is not None and hit[2] == 4
+        assert pc.tier_conservation()[0]
+
+    def test_host_budget_bounds_tier_lru(self):
+        pc = self._pc(dev_blocks=1, host_blocks=2)
+        for start in range(0, 24, 4):
+            pc.put(list(range(start, start + 4)), *self._kv(4), 4, 4)
+            assert pc.host_bytes <= 2 * BLOCK_BYTES
+        assert pc.tier_conservation()[0]
+        # most recent spills survived; the oldest were dropped (their
+        # paths produce no host hit, hence no swap-in queue growth)
+        before = pc.host_hits
+        assert pc.match([0, 1, 2, 3, 9]) is None
+        assert pc.host_hits == before
+
+    def test_reput_drops_stale_host_copy(self):
+        """When the device copy becomes authoritative again (a fresh
+        put of the same path), the host copy is dropped — a block must
+        never be resident in both tiers."""
+        pc = self._pc(dev_blocks=2)
+        pc.put([1, 2, 3, 4], *self._kv(4), 4, 4)
+        pc.put([5, 6, 7, 8], *self._kv(4), 4, 4)
+        pc.put([9, 10, 11, 12], *self._kv(4), 4, 4)  # spills [1..4]
+        assert pc.host_bytes == BLOCK_BYTES
+        pc.put([1, 2, 3, 4], *self._kv(4), 4, 4)     # re-authoritative
+        ok, _, host_blocks = pc.tier_conservation()
+        assert ok
+        assert ([1, 2, 3, 4] not in
+                [list(p) for p in pc._host])  # stale copy gone
+
+    def test_swapin_requires_device_resident_parent_chain(self):
+        """A hosted block whose parent chain was evicted stays hosted
+        (it would be unreachable by match()); a later deeper hit
+        re-queues it."""
+        pc = self._pc(dev_blocks=8)
+        k, v = self._kv(8)
+        orphan = (1, 2, 3, 4, 5, 6, 7, 8)
+        ks, vs = np.asarray(k[:, :, 4:8]), np.asarray(v[:, :, 4:8])
+        with pc._tier_lock:
+            pc._host[orphan] = (ks, vs, ks.nbytes + vs.nbytes)
+            pc.host_bytes += ks.nbytes + vs.nbytes
+        pc._swapin_one(orphan)
+        assert pc.host_swapins == 0 and orphan in pc._host
+        # parent appears on device -> the same swap-in now lands
+        pc.put([1, 2, 3, 4], *self._kv(4), 4, 4)
+        pc._swapin_one(orphan)
+        assert pc.host_swapins == 1 and orphan not in pc._host
+        assert pc.match([1, 2, 3, 4, 5, 6, 7, 8, 0])[2] == 8
+        assert pc.tier_conservation()[0]
+
+    def test_tier_disabled_without_budget(self):
+        pc = PrefixCache(capacity_bytes=2 * BLOCK_BYTES, block=4,
+                         min_prefix=4)
+        for start in range(0, 16, 4):
+            pc.put(list(range(start, start + 4)), *self._kv(4), 4, 4)
+        assert pc.host_bytes == 0 and pc.host_hits == 0
+        assert pc.tier_conservation()[0]
+
+
+def test_engine_host_tier_spill_swapin_divergent_suffix():
+    """Engine-level Tier 1 flow (prefix_host_bytes): evict -> spill,
+    host hit -> recompute with the SAME tokens as a cold engine, drain
+    -> device hit, and a divergent suffix decodes correctly off the
+    swapped-in prefix. kv_conservation() folds the two-tier check."""
+    cfg = _cfg()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    base1 = list(range(2, 40))    # one cached 32-block each
+    base2 = list(range(100, 138))
+    p1 = base1 + [77, 78, 79]
+    p2 = base1 + [90, 91, 92]     # divergent suffix, same block
+
+    cold = InferenceEngine(params, cfg, max_slots=2, max_seq=128,
+                           prefill_buckets=[16, 32, 64, 128])
+    want1, want2 = _greedy(cold, p1), _greedy(cold, p2)
+
+    # device capacity: exactly ONE 32-block (measured, not assumed)
+    probe = InferenceEngine(params, cfg, max_slots=2, max_seq=128,
+                            prefill_buckets=[16, 32, 64, 128],
+                            prefix_cache_bytes=MB64)
+    _greedy(probe, base1)
+    one_block = probe.prefix_cache.bytes
+    assert one_block > 0
+
+    eng = InferenceEngine(params, cfg, max_slots=2, max_seq=128,
+                          prefill_buckets=[16, 32, 64, 128],
+                          prefix_cache_bytes=one_block,
+                          prefix_host_bytes=MB64)
+    pc = eng.prefix_cache
+    _greedy(eng, base1)           # seeds [base1 block]
+    _greedy(eng, base2)           # evicts it -> host tier
+    assert pc.host_bytes == one_block
+    # host-resident prefix: this request recomputes (cold-identical
+    # tokens) and queues the swap-in
+    got1 = _greedy(eng, p1)
+    assert got1 == want1
+    assert pc.host_hits >= 1 and pc.host_recomputes >= 1
+    pc.drain_swapins()
+    assert pc.host_swapins >= 1
+    # next same-prefix request, divergent suffix: device hit
+    hits_before = pc.hits
+    got2 = _greedy(eng, p2)
+    assert got2 == want2
+    assert pc.hits == hits_before + 1
+    assert eng.kv_conservation()[0]

@@ -436,3 +436,110 @@ class TestInflightAccounting:
         p = Project(srv.__file__, repo=repo)
         findings = ThreadSharedStateRule().run(p)
         assert not [f for f in findings if "inflight" in f.message]
+
+
+class TestPrefixDirectory:
+    """Fleet prefix directory (docs/kv-hierarchy.md Tier 2): health
+    probes carry replica-reported prefix digests into an LRU
+    directory; a cache-aware forward landing off-owner names the
+    owner in X-OME-Prefix-Peer so the backend can fetch the KV."""
+
+    def test_lru_last_reporter_wins_and_forget(self):
+        from ome_tpu.router.server import PrefixDirectory
+        d = PrefixDirectory(max_entries=3)
+        d.update("http://a", ["d1", "d2"])
+        d.update("http://b/", ["d2"])          # takeover, / stripped
+        assert d.lookup("d1") == "http://a"
+        assert d.lookup("d2") == "http://b"
+        d.update("http://a", ["d3", "d4"])     # cap 3: d1 is LRU, out
+        assert len(d) == 3 and d.lookup("d1") is None
+        d.forget("http://a")
+        assert len(d) == 1 and d.lookup("d3") is None
+        d.update("http://a", "not-a-list")     # malformed piggyback
+        d.update("http://a", [None, ""])       # junk digests ignored
+        assert len(d) == 1
+
+    def test_health_probe_piggyback_feeds_directory(self):
+        r = Router([Backend("http://a")])
+        r._probe_backend = lambda b: (True, False,
+                                      {"prefix_digests": ["d9"]})
+        r.check_health_once()
+        assert r.prefix_directory.lookup("d9") == "http://a"
+        # legacy 2-tuple probe overrides (older tests/monkeypatches)
+        # still work — they just feed the directory nothing
+        r._probe_backend = lambda b: (True, False)
+        r.check_health_once()
+        assert r.backends[0].healthy
+        assert r.prefix_directory.lookup("d9") == "http://a"
+
+    def test_remove_backend_forgets_ownership(self):
+        r = Router([Backend("http://a"), Backend("http://b")])
+        r.prefix_directory.update("http://a", ["da"])
+        r.prefix_directory.update("http://b", ["db"])
+        assert r.remove_backend("http://a")
+        assert r.prefix_directory.lookup("da") is None
+        assert r.prefix_directory.lookup("db") == "http://b"
+
+    def test_advertise_learn_inject_end_to_end(self):
+        """Full loop over real HTTP: a replica with a prefix cache
+        advertises the digest of a served prompt on /ready; the
+        router's ordinary health sweep learns it; an on-owner forward
+        counts a directory hit WITHOUT the header; a forward whose
+        owner is elsewhere carries X-OME-Prefix-Peer — proven by the
+        engine-side peer client consulting (and falling back from)
+        that owner."""
+        from ome_tpu.router.server import (PrefixDirectory,  # noqa: F401
+                                           prefix_digest)
+        cfg = cfgs.tiny_test().replace(max_seq_len=64)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        engine = InferenceEngine(params, cfg, max_slots=2,
+                                 prefill_buckets=[16, 32],
+                                 prefix_cache_bytes=64 << 20)
+        sched = Scheduler(engine)
+        srv = EngineServer(sched, tokenizer=ByteTokenizer(),
+                           model_name="m", port=0)
+        srv.start()
+        url = f"http://127.0.0.1:{srv.port}"
+        router = Router([Backend(url)], policy="cache_aware")
+        rs = RouterServer(router, host="127.0.0.1", port=0).start()
+        base = f"http://127.0.0.1:{rs.port}"
+        try:
+            def ask(prompt):
+                body = json.dumps({"model": "m", "prompt": prompt,
+                                   "max_tokens": 3}).encode()
+                req = urllib.request.Request(
+                    base + "/v1/completions", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    return json.loads(r.read())
+
+            prompt = "the shared conversation prefix right here"
+            assert ask(prompt)["usage"]["completion_tokens"] == 3
+            with urllib.request.urlopen(url + "/ready",
+                                        timeout=30) as resp:
+                digs = json.loads(resp.read())["prefix_digests"]
+            d = prefix_digest(affinity_from_payload(
+                {"prompt": prompt}))
+            assert d in digs
+            router.check_health_once()  # the probe the router makes
+            assert router.prefix_directory.lookup(d) == url
+            # owner IS the chosen backend: a hit, but no peer header
+            ask(prompt)
+            assert router.stats["prefix_directory_hits_total"] == 1
+            assert router.stats[
+                "prefix_directory_peer_fetches_total"] == 0
+            assert sched._peer_client is None
+            # owner elsewhere: the forward carries the header and the
+            # engine consults that (dead) owner, then recomputes
+            router.prefix_directory.update("http://127.0.0.1:9", [d])
+            out = ask(prompt)
+            assert out["usage"]["completion_tokens"] == 3
+            assert router.stats["prefix_directory_hits_total"] == 2
+            assert router.stats[
+                "prefix_directory_peer_fetches_total"] == 1
+            assert sched._peer_client is not None
+            assert sched._peer_client.fallbacks >= 1
+        finally:
+            rs.stop()
+            srv.stop()
+            sched.stop()
