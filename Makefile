@@ -4,7 +4,8 @@
 
 PYTHON ?= python
 
-.PHONY: lint test replay autoscale-soak noisy-neighbor benchgate
+.PHONY: lint test replay autoscale-soak noisy-neighbor router-soak \
+	benchgate
 
 # omelint: the repo's static-analysis gate (docs/static-analysis.md).
 # Runs every registered analyzer over ome_tpu/ and fails on any
@@ -42,6 +43,17 @@ noisy-neighbor:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_soak.py --seed 7 \
 		--episodes 1 --noisy-neighbor --prefill 0 --decode 0 \
 		--unified 1 --spread 4
+
+# ingress HA under router loss (docs/router-ha.md): three gossiping
+# async routers front two engines, one takes a keyed forward fault
+# and is SIGKILLed mid-replay; the driver fails over client-side and
+# the runner checks the HA invariants (no request lost or duplicated
+# fleet-wide, survivors converge on the victim's breaker
+# observations within one anti-entropy round)
+router-soak:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_soak.py --seed 3 \
+		--episodes 1 --router-loss --routers 3 --prefill 0 \
+		--decode 0 --unified 2 --requests 10 --spread 4
 
 # the closed-loop demo: bursty replayed trace + SLO-aware scaling of
 # a live engine pool, reporting engine-seconds vs static max

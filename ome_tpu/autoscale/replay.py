@@ -51,14 +51,24 @@ class ReplayResult:
     text: str = ""
     finish_reason: Optional[str] = None
     error: Optional[str] = None
+    # router fronts tried before a response arrived (HA failover)
+    failovers: int = 0
 
     @property
     def ok(self) -> bool:
         return self.status == 200 and self.error is None
 
 
-def _stream_one(url: str, result: ReplayResult,
+def _stream_one(urls, result: ReplayResult,
                 timeout: float) -> None:
+    """One request against an endpoint, or a list of router replicas
+    tried in order: a transport failure BEFORE any response bytes
+    (connection refused, reset — the front is dead) fails over to the
+    next URL; once a status line has arrived the request is never
+    retried, because retrying a request some router already answered
+    is how a client manufactures duplicates (docs/router-ha.md)."""
+    if isinstance(urls, str):
+        urls = [urls]
     payload = {
         "prompt": result.prompt, "max_tokens": result.max_tokens,
         "temperature": result.temperature, "stream": True}
@@ -69,43 +79,48 @@ def _stream_one(url: str, result: ReplayResult,
         payload["priority"] = result.priority
         headers["X-OME-Priority"] = result.priority
     body = json.dumps(payload).encode()
-    req = urllib.request.Request(
-        url + "/v1/completions", data=body, headers=headers)
     t0 = time.monotonic()
     first = last = None
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            result.status = resp.status
-            for raw in resp:
-                line = raw.decode("utf-8", "replace").strip()
-                if not line.startswith("data:"):
-                    continue
-                payload = line[5:].strip()
-                if payload == "[DONE]":
-                    break
-                try:
-                    chunk = json.loads(payload)
-                except ValueError:
-                    continue
-                for choice in chunk.get("choices", []):
-                    text = choice.get("text") or choice.get(
-                        "delta", {}).get("content")
-                    if text:
-                        now = time.monotonic()
-                        if first is None:
-                            first = now
-                        last = now
-                        result.output_tokens += 1
-                        result.text += text
-                    fin = choice.get("finish_reason")
-                    if fin:
-                        result.finish_reason = fin
-    except urllib.error.HTTPError as e:
-        result.status = e.code
-        result.error = e.read().decode("utf-8", "replace")[:200]
-        e.close()
-    except (urllib.error.URLError, OSError, TimeoutError) as e:
-        result.error = f"{type(e).__name__}: {e}"
+    for attempt, url in enumerate(urls):
+        req = urllib.request.Request(
+            url + "/v1/completions", data=body, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                result.status = resp.status
+                for raw in resp:
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line.startswith("data:"):
+                        continue
+                    data = line[5:].strip()
+                    if data == "[DONE]":
+                        break
+                    try:
+                        chunk = json.loads(data)
+                    except ValueError:
+                        continue
+                    for choice in chunk.get("choices", []):
+                        text = choice.get("text") or choice.get(
+                            "delta", {}).get("content")
+                        if text:
+                            now = time.monotonic()
+                            if first is None:
+                                first = now
+                            last = now
+                            result.output_tokens += 1
+                            result.text += text
+                        fin = choice.get("finish_reason")
+                        if fin:
+                            result.finish_reason = fin
+        except urllib.error.HTTPError as e:
+            result.status = e.code
+            result.error = e.read().decode("utf-8", "replace")[:200]
+            e.close()
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            result.error = f"{type(e).__name__}: {e}"
+            if result.status is None and attempt + 1 < len(urls):
+                result.failovers += 1
+                continue
+        break
     end = time.monotonic()
     result.e2e_s = round(end - t0, 6)
     if first is not None:
@@ -115,13 +130,16 @@ def _stream_one(url: str, result: ReplayResult,
                 (last - first) / (result.output_tokens - 1), 6)
 
 
-def replay(url: str, trace: Sequence[TraceRequest],
+def replay(url, trace: Sequence[TraceRequest],
            timeout: float = 120.0, prompt_seed: int = 0,
            on_result: Optional[Callable[[ReplayResult], None]] = None
            ) -> List[ReplayResult]:
-    """Replay ``trace`` against ``url`` (router or engine), honoring
-    arrival offsets; blocks until every request has an outcome."""
-    url = url.rstrip("/")
+    """Replay ``trace`` against ``url`` (router or engine; a LIST of
+    URLs spreads arrivals round-robin across N router replicas with
+    client-side failover), honoring arrival offsets; blocks until
+    every request has an outcome."""
+    urls = [url] if isinstance(url, str) else list(url)
+    urls = [u.rstrip("/") for u in urls]
     t0 = time.monotonic()
     results = [ReplayResult(trace_id=r.trace_id, arrival=r.arrival,
                             prompt=r.prompt_text(prompt_seed),
@@ -130,16 +148,17 @@ def replay(url: str, trace: Sequence[TraceRequest],
                             priority=getattr(r, "priority", None))
                for r in trace]
 
-    def one(r: ReplayResult):
+    def one(i: int, r: ReplayResult):
         delay = t0 + r.arrival - time.monotonic()
         if delay > 0:
             time.sleep(delay)
-        _stream_one(url, r, timeout)
+        k = i % len(urls)
+        _stream_one(urls[k:] + urls[:k], r, timeout)
         if on_result is not None:
             on_result(r)
 
-    threads = [threading.Thread(target=one, args=(r,), daemon=True)
-               for r in results]
+    threads = [threading.Thread(target=one, args=(i, r), daemon=True)
+               for i, r in enumerate(results)]
     for t in threads:
         t.start()
     for t in threads:
@@ -166,6 +185,7 @@ def _stats(results: Sequence[ReplayResult], slo_ttft_s: float,
         "requests": len(results),
         "completed": len(ok),
         "errors": len(results) - len(ok),
+        "failovers": sum(r.failovers for r in results),
         "output_tokens": sum(r.output_tokens for r in ok),
         "ttft_p50_s": _pct(ttfts, 50),
         "ttft_p95_s": _pct(ttfts, 95),
@@ -216,9 +236,12 @@ def build_parser() -> argparse.ArgumentParser:
                     "inter-arrival gaps; prints a one-line JSON SLO "
                     "report (docs/autoscaling.md). With --topology N "
                     "it spawns its own router + N CPU engines first.")
-    p.add_argument("--url", default=None,
+    p.add_argument("--url", action="append", default=None,
                    help="endpoint to replay against (router or "
-                        "engine); omit with --topology to self-spawn")
+                        "engine); repeatable — extra URLs are "
+                        "failover fronts tried on transport failure "
+                        "(docs/router-ha.md); omit with --topology "
+                        "to self-spawn")
     p.add_argument("--topology", type=int, default=0, metavar="N",
                    help="spawn a router + N engine subprocesses and "
                         "replay against them (CI / laptop mode)")
@@ -333,7 +356,8 @@ def main(argv=None) -> int:
                          prompt_seed=args.seed)
         rep = report(results, slo_ttft_s=args.slo_ttft_p99,
                      slo_e2e_s=args.slo_e2e_p99)
-        rep["endpoint"] = url
+        rep["endpoint"] = (url if isinstance(url, str)
+                           else url[0] if len(url) == 1 else url)
         print(json.dumps(rep, separators=(",", ":"), default=str))
         sys.stdout.flush()
         return 0 if rep["errors"] == 0 else 1

@@ -46,11 +46,33 @@ cannot:
      weighted-fair entitlement (read from
      ``ome_engine_class_tokens_total``); classes that are merely
      demand-limited are out of scope.
+  7. **No request is lost fleet-wide** (router HA,
+     docs/router-ha.md): every workload request driven through the
+     N-router ingress ends with exactly ONE outcome — a client that
+     fails over to a surviving router after a transport failure
+     never observes a duplicate and is never silently dropped
+     (request durability below the routers is invariant 1, checked
+     across every engine journal regardless of which router admitted
+     the request).
+  8. **Breaker observations outlive the replica that made them**:
+     the backend records a victim router served in its last pre-kill
+     gossip snapshot are held by every surviving router within one
+     anti-entropy round of the kill (LWW stamps at least as new), so
+     the fleet does not re-learn a dead backend the hard way.
 
 Invariants 5 and 6 get their workload from the ``--noisy-neighbor``
 episode kind: a seeded best-effort (batch-class) flood of at least
 ``--flood-factor``x the topology's slot capacity, steady interactive
 traffic throughout, and a mid-episode SIGKILL of a serving engine.
+
+Invariants 7 and 8 get theirs from the ``--router-loss`` episode
+kind (requires ``--routers N``, N >= 2): N asyncio routers front the
+same engine pool and gossip observations to each other
+(router/gossip.py), the seeded schedule arms a keyed
+``router_forward`` fault on one victim router so it accumulates real
+breaker state, the harness snapshots the victim's /gossip/state,
+waits one anti-entropy round, SIGKILLs it mid-replay, and the
+workload client fails over across the surviving fronts.
 
 Every schedule derives from ``random.Random(f"{seed}:{episode}")`` —
 a violation prints the seed, the exact schedule, and a one-command
@@ -109,6 +131,12 @@ ROUTER_FAULT_MENU = ("router_forward",)
 # the window itself must hold at least this many tokens to be judged
 SHARE_TOLERANCE = 0.35
 MIN_CONTENDED_TOKENS = 30.0
+
+# router health-loop cadence inside chaos topologies; gossip pulls
+# run on the same cadence, so invariant 8 (breaker convergence) gives
+# survivors one such round plus the slack to adopt the victim's state
+ROUTER_HEALTH_INTERVAL = 1.0
+GOSSIP_ROUND_SLACK = 1.5
 
 
 class ChaosError(RuntimeError):
@@ -295,8 +323,11 @@ def _serve_child(argv: List[str]) -> int:
         from .engine import serve
         return serve.main(rest)
     if role == "router":
-        from .router import server
-        return server.main(rest)
+        # every chaos topology fronts with the asyncio data path
+        # (router/aserver.py); the threaded server remains for
+        # in-process tests, but the deployable ingress is async
+        from .router import aserver
+        return aserver.main(rest)
     raise SystemExit(f"unknown --serve-child role {role!r}")
 
 
@@ -510,6 +541,10 @@ class ChaosRequest:
     text: Optional[str] = None
     finish_reason: Optional[str] = None
     error: Optional[str] = None
+    # fleet-outcome bookkeeping (invariant 7): complete HTTP
+    # responses received and transport-failure failovers taken
+    answers: int = 0
+    failovers: int = 0
 
     def payload(self) -> dict:
         out = {"prompt": self.prompt, "max_tokens": self.max_tokens,
@@ -602,16 +637,33 @@ def _gen_noisy_workload(rng: random.Random, topo: "Topology",
     return out
 
 
-def _drive(url: str, reqs: Sequence[ChaosRequest],
+def _drive(urls, reqs: Sequence[ChaosRequest],
            timeout: float = 60.0) -> None:
-    """Send every request against `url` on client threads, honoring
-    per-request start delays; blocks until all have an outcome."""
+    """Send every request against the router front on client threads,
+    honoring per-request start delays; blocks until all have an
+    outcome. `urls` is one front URL or a list of N router replicas:
+    requests spread across the fronts round-robin, and a TRANSPORT
+    failure (connection refused/reset — no HTTP response at all)
+    fails over to the next front. An HTTP error status is an answer,
+    not a failover: retrying a request the router already answered is
+    how clients manufacture duplicates (invariant 7)."""
+    if isinstance(urls, str):
+        urls = [urls]
 
-    def one(r: ChaosRequest):
+    def one(i: int, r: ChaosRequest):
         time.sleep(r.delay)
-        try:
-            status, body = _http(url + "/v1/completions", r.payload(),
-                                 timeout=timeout, headers=r.headers())
+        last = None
+        for k in range(len(urls)):
+            url = urls[(i + k) % len(urls)]
+            try:
+                status, body = _http(url + "/v1/completions",
+                                     r.payload(), timeout=timeout,
+                                     headers=r.headers())
+            except Exception as e:  # noqa: BLE001 — a dead router
+                last = f"{type(e).__name__}: {e}"  # is expected chaos
+                r.failovers += 1
+                continue
+            r.answers += 1
             r.status = status
             if status == 200 and isinstance(body, dict):
                 choice = (body.get("choices") or [{}])[0]
@@ -619,11 +671,11 @@ def _drive(url: str, reqs: Sequence[ChaosRequest],
                 r.finish_reason = choice.get("finish_reason")
             else:
                 r.error = str(body)[:200]
-        except Exception as e:  # noqa: BLE001 — a dead proxy/engine
-            r.error = f"{type(e).__name__}: {e}"  # is expected chaos
+            return
+        r.error = last or "no router front reachable"
 
-    threads = [threading.Thread(target=one, args=(r,), daemon=True)
-               for r in reqs]
+    threads = [threading.Thread(target=one, args=(i, r), daemon=True)
+               for i, r in enumerate(reqs)]
     for t in threads:
         t.start()
     for t in threads:
@@ -641,6 +693,9 @@ class Topology:
     decode: int = 2
     unified: int = 0
     router: bool = True
+    # router replicas fronting the pool; >1 turns on gossip peering
+    # between them (router_loss episodes require >= 2)
+    routers: int = 1
     kv_block: int = 16
     kv_blocks: int = 40
     max_slots: int = 2
@@ -662,7 +717,7 @@ class Episode:
     seed: int
     index: int
     topo: Topology
-    kind: str = "mixed"        # "mixed" | "noisy"
+    kind: str = "mixed"        # "mixed" | "noisy" | "router_loss"
     requests: List[ChaosRequest] = field(default_factory=list)
     fault_specs: Dict[str, str] = field(default_factory=dict)
     events: List[Tuple[float, str, str]] = field(default_factory=list)
@@ -678,7 +733,11 @@ class Episode:
                 "requests": len(self.requests)}
 
     def replay_command(self) -> str:
-        extra = " --noisy-neighbor" if self.kind == "noisy" else ""
+        extra = ""
+        if self.kind == "noisy":
+            extra = " --noisy-neighbor"
+        elif self.kind == "router_loss":
+            extra = f" --router-loss --routers {self.topo.routers}"
         return (f"python scripts/chaos_soak.py --seed {self.seed} "
                 f"--episode {self.index}{extra}")
 
@@ -717,6 +776,23 @@ def _plan_episode(seed: int, index: int, topo: Topology, n_requests: int,
         serving = decode_names + unified_names
         ep.events.append((rng.uniform(0.35, 0.6) * spread, "sigkill",
                           rng.choice(serving)))
+        return ep
+
+    if kind == "router_loss":
+        # the chaos IS losing one of N router replicas mid-replay. A
+        # keyed router_forward fault first makes the victim accumulate
+        # real breaker observations to gossip ("{serving0}" is
+        # substituted with the first serving engine's URL at start
+        # time — backend ports are not known at plan time); the
+        # harness then snapshots the victim's /gossip/state, waits
+        # one anti-entropy round so peers pull it, and SIGKILLs the
+        # victim while the workload fails over across survivors
+        victim = f"router{rng.randint(0, topo.routers - 1)}"
+        ep.fault_specs[victim] = (
+            "router_forward|{serving0}"
+            f".raise@1:{rng.randint(3, 5)}")
+        ep.events.append((rng.uniform(0.25, 0.5) * spread,
+                          "sigkill_router", victim))
         return ep
 
     # fault-point schedules: at most one rule per serving proc so an
@@ -920,19 +996,31 @@ class ChaosRunner:
                                   flight_dump_dir=epdir, debug=True),
                 port, epdir / f"{name}.log"))
 
-        router = None
+        routers: List[ManagedProc] = []
         if topo.router:
-            rport = free_port()
-            rargs = ["--bind", "127.0.0.1", "--port", str(rport),
-                     "--policy", "round_robin",
-                     "--health-interval", "1.0",
-                     "--span-log", str(epdir / "router.spans.jsonl")]
-            for s in serving:
-                rargs += ["--backend", s.url]
-            router = ManagedProc("router", "router", rargs, rport,
-                                 epdir / "router.log")
+            n_routers = max(1, topo.routers)
+            rports = [free_port() for _ in range(n_routers)]
+            for i, rport in enumerate(rports):
+                name = "router" if n_routers == 1 else f"router{i}"
+                rargs = ["--bind", "127.0.0.1", "--port", str(rport),
+                         "--policy", "round_robin",
+                         "--health-interval",
+                         str(ROUTER_HEALTH_INTERVAL),
+                         "--replica-id", name,
+                         "--debug-endpoints",
+                         "--span-log",
+                         str(epdir / f"{name}.spans.jsonl")]
+                for s in serving:
+                    rargs += ["--backend", s.url]
+                for other in rports:
+                    if other != rport:
+                        rargs += ["--gossip-peer",
+                                  f"http://127.0.0.1:{other}"]
+                routers.append(ManagedProc(
+                    name, "router", rargs, rport,
+                    epdir / f"{name}.log"))
 
-        procs = prefills + serving + ([router] if router else [])
+        procs = prefills + serving + routers
         by_name = {p.name: p for p in procs}
         watch = None
         sampler = None
@@ -941,19 +1029,20 @@ class ChaosRunner:
                 p.start(ep.fault_specs.get(p.name))
             for p in prefills + serving:
                 p.wait_ready()
-            if router:
-                router.start(ep.fault_specs.get("router"))
-                router.wait_ready()
+            for r in routers:
+                r.start(self._router_faults(ep, r.name, serving))
+            for r in routers:
+                r.wait_ready()
 
             watch = MetricsWatch(procs).start()
             if ep.kind == "noisy":
                 sampler = ShareSampler(serving).start()
-            front = (router or serving[0]).url
+            fronts = [r.url for r in routers] or [serving[0].url]
 
             # workload client threads + the kill/term schedule run
             # concurrently — that's the "mid-handoff" in the ISSUE
             driver = threading.Thread(
-                target=_drive, args=(front, ep.requests), daemon=True)
+                target=_drive, args=(fronts, ep.requests), daemon=True)
             t0 = time.monotonic()
             driver.start()
             killed: List[ManagedProc] = []
@@ -964,7 +1053,26 @@ class ChaosRunner:
                 victim = by_name.get(target)
                 if victim is None or not victim.alive():
                     continue
-                if action == "sigkill" or action == "kill_prefill":
+                if action == "sigkill_router":
+                    # invariant 8 setup: capture what the victim knew,
+                    # give peers one anti-entropy round to pull it,
+                    # THEN kill — survivors must hold that state
+                    snap = None
+                    try:
+                        status, body = _http(
+                            victim.url + "/gossip/state", timeout=5.0)
+                        if status == 200 and isinstance(body, dict):
+                            snap = body
+                    except (urllib.error.URLError, OSError):
+                        pass
+                    time.sleep(ROUTER_HEALTH_INTERVAL
+                               + GOSSIP_ROUND_SLACK)
+                    victim.kill()
+                    self._check_breaker_convergence(
+                        ep, victim.name, snap,
+                        [r for r in routers
+                         if r is not victim and r.alive()])
+                elif action == "sigkill" or action == "kill_prefill":
                     victim.kill()
                 else:
                     victim.term()
@@ -986,10 +1094,11 @@ class ChaosRunner:
                 sampler.stop()
                 sampler.poll_once()
             self._check_journals(ep, journals)
+            self._check_fleet_outcomes(ep)
             self._check_class_starvation(ep, journals)
             self._check_greedy(ep)
             self._check_kv_conservation(ep, serving)
-            self._check_draining_zero(ep, router)
+            self._check_draining_zero(ep, routers)
             if sampler is not None:
                 self._check_weighted_shares(ep, sampler)
             watch.stop()
@@ -1011,6 +1120,19 @@ class ChaosRunner:
             for p in procs:
                 p.stop()
         return ep
+
+    @staticmethod
+    def _router_faults(ep: Episode, name: str,
+                       serving: Sequence[ManagedProc]
+                       ) -> Optional[str]:
+        """A router's fault spec with plan-time placeholders bound to
+        the ports this episode actually got ("{serving0}" = first
+        serving engine's URL, the backend the victim's keyed
+        router_forward rule fails against)."""
+        spec = ep.fault_specs.get(name)
+        if spec and serving:
+            spec = spec.replace("{serving0}", serving[0].url)
+        return spec
 
     # -- violation bundle --------------------------------------------
 
@@ -1058,6 +1180,25 @@ class ChaosRunner:
         # crash recovery inside a child auto-dumps into the episode
         # dir (--flight-dump-dir): fold those lives in too
         flight_paths.extend(sorted(epdir.glob("flight-*.json")))
+
+        # per-router replica state (breaker/gossip/stream view): what
+        # each surviving front believed when the invariant broke
+        for p in procs:
+            if p.role != "router" or not p.alive():
+                continue
+            try:
+                status, doc = _http(p.url + "/debug/state",
+                                    timeout=5.0)
+            except (urllib.error.URLError, OSError):
+                continue
+            if status != 200 or not isinstance(doc, dict):
+                continue
+            try:
+                (bundle / f"router-state-{p.name}.json").write_text(
+                    json.dumps(doc, indent=2, default=str) + "\n",
+                    encoding="utf-8")
+            except OSError:
+                continue
 
         span_paths = sorted(epdir.glob("*.spans.jsonl"))
         try:
@@ -1253,26 +1394,116 @@ class ChaosRunner:
                     f"{budget}")
 
     def _check_draining_zero(self, ep: Episode,
-                             router: Optional[ManagedProc]) -> None:
-        """Invariant 4b: once the episode's drains finish, the
-        router's draining gauges return to zero (the health loop
+                             routers: Sequence[ManagedProc]) -> None:
+        """Invariant 4b: once the episode's drains finish, every live
+        router's draining gauge returns to zero (the health loop
         re-probes at --health-interval)."""
-        if router is None or not router.alive():
+        for router in routers:
+            if not router.alive():
+                continue
+            deadline = time.monotonic() + 15.0
+            last = None
+            while time.monotonic() < deadline:
+                try:
+                    sample = scrape_metrics(router.url)
+                except (ChaosError, urllib.error.URLError, OSError):
+                    last = None
+                    break
+                last = sample.get("ome_router_backends_draining", 0.0)
+                if not last:
+                    break
+                time.sleep(1.0)
+            if last:
+                ep.violations.append(
+                    f"draining gauge stuck on {router.name}: "
+                    f"ome_router_backends_draining={last} after "
+                    f"episode end")
+
+    def _check_fleet_outcomes(self, ep: Episode) -> None:
+        """Invariant 7: every workload request ends with exactly one
+        outcome fleet-wide. The failover client records how many
+        complete HTTP responses it observed; more than one is a
+        duplicate (a client retried a request some router had already
+        answered), zero with no recorded transport error is a silent
+        drop. Failing over only on transport failure — never on an
+        HTTP status — is what makes both impossible by construction;
+        this check pins that contract against client regressions."""
+        for i, r in enumerate(ep.requests):
+            if r.answers > 1:
+                ep.violations.append(
+                    f"fleet outcome: request {i} observed "
+                    f"{r.answers} answers across router fronts "
+                    f"(duplicate)")
+            if r.answers == 0 and r.error is None:
+                ep.violations.append(
+                    f"fleet outcome: request {i} vanished — no "
+                    f"response and no transport error recorded")
+
+    def _check_breaker_convergence(
+            self, ep: Episode, victim_name: str,
+            snap: Optional[dict],
+            survivors: Sequence[ManagedProc]) -> None:
+        """Invariant 8: every real observation (stamp > 0) the victim
+        router served in its last pre-kill gossip snapshot is held by
+        every surviving router within one anti-entropy round of the
+        kill — held meaning the survivor's record for that backend
+        carries an LWW stamp at least as new (its own fresher
+        observation also satisfies the invariant)."""
+        if not survivors:
             return
-        deadline = time.monotonic() + 15.0
-        last = None
-        while time.monotonic() < deadline:
-            try:
-                sample = scrape_metrics(router.url)
-            except (ChaosError, urllib.error.URLError, OSError):
-                return
-            last = sample.get("ome_router_backends_draining", 0.0)
-            if not last:
-                return
-            time.sleep(1.0)
-        ep.violations.append(
-            f"draining gauge stuck: ome_router_backends_draining="
-            f"{last} after episode end")
+        if not isinstance(snap, dict):
+            ep.violations.append(
+                f"gossip convergence: no pre-kill snapshot from "
+                f"{victim_name} (/gossip/state unreachable)")
+            return
+        needed = {
+            url: rec
+            for url, rec in (snap.get("backends") or {}).items()
+            if isinstance(rec, dict) and rec.get("stamp", 0) > 0}
+        # say what the invariant is judging so a clean episode is
+        # auditable as non-vacuous from the soak log alone
+        print(f"[chaos] invariant 8: {victim_name} served "
+              f"{len(needed)} real observation(s); checking "
+              f"{len(survivors)} survivor(s)", flush=True)
+        if not needed:
+            return
+        pending = {(s.name, url) for s in survivors for url in needed}
+        states: Dict[str, dict] = {}
+        deadline = time.monotonic() + ROUTER_HEALTH_INTERVAL \
+            + GOSSIP_ROUND_SLACK
+        while pending and time.monotonic() < deadline:
+            for s in survivors:
+                if not s.alive():
+                    pending -= {(s.name, u) for u in needed}
+                    continue
+                try:
+                    status, body = _http(s.url + "/gossip/state",
+                                         timeout=3.0)
+                except (urllib.error.URLError, OSError):
+                    continue
+                if status != 200 or not isinstance(body, dict):
+                    continue
+                have = body.get("backends") or {}
+                states[s.name] = have
+                for url, rec in needed.items():
+                    mine = have.get(url)
+                    if isinstance(mine, dict) and \
+                            (mine.get("stamp", 0.0),
+                             mine.get("origin", "")) >= \
+                            (rec.get("stamp", 0.0),
+                             rec.get("origin", "")):
+                        pending.discard((s.name, url))
+            if pending:
+                time.sleep(0.25)
+        for name, url in sorted(pending):
+            want = needed[url]
+            have = (states.get(name) or {}).get(url)
+            ep.violations.append(
+                f"gossip convergence: {name} did not adopt "
+                f"{victim_name}'s observation of {url} within one "
+                f"anti-entropy round (want stamp >= "
+                f"{want.get('stamp')} origin {want.get('origin')!r}, "
+                f"have {have and have.get('stamp')})")
 
 
 # -- soak entry ------------------------------------------------------
@@ -1359,6 +1590,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="monolithic (non-PD) engines behind the router")
     p.add_argument("--no-router", action="store_true",
                    help="drive the first serving engine directly")
+    p.add_argument("--routers", type=int, default=1,
+                   help="router replicas fronting the pool; >1 peers "
+                        "them with anti-entropy gossip and spreads "
+                        "the workload across the fronts with "
+                        "client-side failover")
     p.add_argument("--requests", type=int, default=10,
                    help="workload requests per episode")
     p.add_argument("--spread", type=float, default=4.0,
@@ -1412,6 +1648,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--flood-factor", type=int, default=5,
                    help="noisy-neighbor flood size as a multiple of "
                         "the topology's concurrent slot capacity")
+    p.add_argument("--router-loss", action="store_true",
+                   help="router-loss episodes (requires --routers "
+                        ">= 2): arm a keyed router_forward fault on "
+                        "one victim router, snapshot its gossip "
+                        "state, SIGKILL it mid-replay, and check the "
+                        "fleet invariants (exactly one outcome per "
+                        "request, survivors adopt the victim's "
+                        "breaker observations within one "
+                        "anti-entropy round)")
     return p
 
 
@@ -1422,6 +1667,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     topo = Topology(prefill=args.prefill, decode=args.decode,
                     unified=args.unified, router=not args.no_router,
+                    routers=args.routers,
                     kv_block=args.kv_block, kv_blocks=args.kv_blocks,
                     max_slots=args.max_slots,
                     prefix_host_mb=args.prefix_host_mb,
@@ -1433,6 +1679,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if topo.decode and not topo.prefill:
         build_parser().error("--decode engines need a --prefill pool "
                              "(or use --unified engines)")
+    if args.router_loss and (args.no_router or topo.routers < 2):
+        build_parser().error("--router-loss needs --routers >= 2 "
+                             "(a victim plus survivors)")
+    if args.router_loss and args.noisy_neighbor:
+        build_parser().error("--router-loss and --noisy-neighbor are "
+                             "separate episode kinds")
     if args.base_dir:
         base = pathlib.Path(args.base_dir)
         cleanup = False
@@ -1455,7 +1707,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                       journal_drain_timeout=args.journal_drain_timeout,
                       force_violation=args.force_violation,
                       workload=workload,
-                      kind="noisy" if args.noisy_neighbor else "mixed",
+                      kind=("router_loss" if args.router_loss
+                            else "noisy" if args.noisy_neighbor
+                            else "mixed"),
                       flood_factor=args.flood_factor)
     finally:
         if cleanup:

@@ -63,6 +63,7 @@ Wired sites:
 
 from __future__ import annotations
 
+import asyncio
 import os
 import threading
 import time
@@ -70,7 +71,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 __all__ = ["InjectedFault", "Rule", "FaultInjector", "parse_spec",
-           "spec_points", "install", "reset", "fire", "http",
+           "spec_points", "install", "reset", "fire", "afire", "http",
            "active"]
 
 
@@ -153,11 +154,12 @@ class FaultInjector:
         self.rules = rules
         self._lock = threading.Lock()
 
-    def fire(self, point: str, key: Optional[str] = None,
-             exc: type = InjectedFault) -> None:
-        """Consult raise/slow rules at a site. Raises ``exc`` when a
-        raise rule is armed for this hit; sleeps for armed slow
-        rules."""
+    def consult(self, point: str, key: Optional[str] = None,
+                exc: type = InjectedFault):
+        """Count a hit against raise/slow rules at a site and return
+        ``(delay_seconds, exception_or_None)`` — the caller applies
+        them with the sleep primitive of its execution domain (fire:
+        time.sleep on threads; afire: asyncio.sleep on the loop)."""
         delay = 0.0
         boom = None
         with self._lock:
@@ -172,6 +174,14 @@ class FaultInjector:
                             f"injected fault at {point}"
                             + (f"|{key}" if key else "")
                             + f" (hit {r.seen})")
+        return delay, boom
+
+    def fire(self, point: str, key: Optional[str] = None,
+             exc: type = InjectedFault) -> None:
+        """Consult raise/slow rules at a site. Raises ``exc`` when a
+        raise rule is armed for this hit; sleeps for armed slow
+        rules."""
+        delay, boom = self.consult(point, key=key, exc=exc)
         if delay:
             time.sleep(delay)
         if boom is not None:
@@ -233,6 +243,22 @@ def fire(point: str, key: Optional[str] = None,
     inj = _get()
     if inj is not None:
         inj.fire(point, key=key, exc=exc)
+
+
+async def afire(point: str, key: Optional[str] = None,
+                exc: type = InjectedFault) -> None:
+    """fire() for coroutine sites: armed slow rules await
+    asyncio.sleep instead of blocking the event loop (a time.sleep
+    here would stall EVERY stream the loop is carrying, not just the
+    faulted one). Raise semantics are identical to fire()."""
+    inj = _get()
+    if inj is None:
+        return
+    delay, boom = inj.consult(point, key=key, exc=exc)
+    if delay:
+        await asyncio.sleep(delay)
+    if boom is not None:
+        raise boom
 
 
 def http(point: str, key: Optional[str] = None) -> Optional[int]:

@@ -304,6 +304,22 @@ class CallGraph:
                 sites.append((sub.lineno, targets))
         return sites
 
+    def resolve_call(self, sf: SourceFile, qual: str,
+                     call: ast.Call) -> Set[str]:
+        """Callee node keys for ONE call expression, function
+        references in its arguments included — for analyzers that
+        need per-call control, e.g. blocking-in-async breaking
+        traversal at executor hops (call_sites merges every call on
+        a line, so a hop and its blocking payload would blur)."""
+        imports = self._imports.get(sf.rel)
+        if imports is None:
+            imports = self._imports[sf.rel] = self._module_imports(sf)
+        targets = set(self._resolve_call(sf, qual, call.func, imports))
+        for arg in list(call.args) + [kw.value
+                                      for kw in call.keywords]:
+            targets.update(self.resolve_ref(sf, qual, arg))
+        return targets
+
     def call_sites(self, sf: SourceFile, qual: str
                    ) -> List[Tuple[int, Set[str]]]:
         """[(line, resolved callee node keys)] for every call in the

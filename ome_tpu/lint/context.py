@@ -1,11 +1,21 @@
 """Shared analysis context: the expensive project-wide indexes
-(call graph, lock model) built at most once per run and handed to
-every plugin — adding an analyzer costs an AST walk, not a re-parse
-or a graph rebuild."""
+(call graph, lock model, execution-domain seeds) built at most once
+per run and handed to every plugin — adding an analyzer costs an AST
+walk, not a re-parse or a graph rebuild.
+
+Execution domains are seeded structurally, never by file list. The
+thread domains (http handlers, ``Thread(target=…)`` closures) are
+seeded inside plugins/thread_shared_state.py; the COROUTINE domain is
+seeded here because more than one analyzer needs a single definition
+of "runs on the event loop": every ``async def`` in the project is an
+event-loop node, exactly the way every handler ``do_*`` method is an
+http-thread node."""
 
 from __future__ import annotations
 
-from .callgraph import CallGraph
+import ast
+
+from .callgraph import CallGraph, node_key
 from .core import Project
 from .lockmodel import LockModel
 
@@ -15,6 +25,7 @@ class Context:
         self.project = project
         self._graph = None
         self._locks = None
+        self._async_nodes = None
 
     @property
     def graph(self) -> CallGraph:
@@ -27,3 +38,20 @@ class Context:
         if self._locks is None:
             self._locks = LockModel(self.project)
         return self._locks
+
+    @property
+    def async_nodes(self) -> frozenset:
+        """Node keys of every coroutine (``async def``) in the
+        project — the event-loop execution domain. One blocking call
+        anywhere in this domain freezes every stream the loop is
+        multiplexing, which is why blocking-in-async treats these as
+        roots the same way the thread rules treat handler methods and
+        Thread targets."""
+        if self._async_nodes is None:
+            nodes = set()
+            for sf in self.project.files:
+                for qual, node in sf.defs.items():
+                    if isinstance(node, ast.AsyncFunctionDef):
+                        nodes.add(node_key(sf, qual))
+            self._async_nodes = frozenset(nodes)
+        return self._async_nodes

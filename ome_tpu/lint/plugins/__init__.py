@@ -7,6 +7,7 @@ CLI (`scripts/omelint.py`) and the test suite discover them from
 here.
 """
 
+from .async_blocking import AsyncBlockingRule
 from .catalog_drift import FaultCatalogRule, MetricsNamingRule
 from .hot_path_sync import HotPathSyncRule
 from .label_cardinality import MetricsLabelCardinalityRule
@@ -17,6 +18,7 @@ ALL_RULES = (
     HotPathSyncRule,
     LockDisciplineRule,
     ThreadSharedStateRule,
+    AsyncBlockingRule,
     FaultCatalogRule,
     MetricsNamingRule,
     MetricsLabelCardinalityRule,
@@ -37,5 +39,6 @@ def make_rule(name: str):
 
 __all__ = ["ALL_RULES", "rule_names", "make_rule",
            "HotPathSyncRule", "LockDisciplineRule",
-           "ThreadSharedStateRule", "FaultCatalogRule",
-           "MetricsNamingRule", "MetricsLabelCardinalityRule"]
+           "ThreadSharedStateRule", "AsyncBlockingRule",
+           "FaultCatalogRule", "MetricsNamingRule",
+           "MetricsLabelCardinalityRule"]

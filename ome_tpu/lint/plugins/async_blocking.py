@@ -1,0 +1,167 @@
+"""blocking-in-async: cataloged blocking operations reachable from a
+coroutine without an executor hop.
+
+The asyncio router data path (router/aserver.py) multiplexes tens of
+thousands of SSE streams on ONE event-loop thread. A blocking call
+there — ``os.fsync``, ``urlopen``, a socket resolve, ``time.sleep``,
+a device fetch — does not slow one request the way it does on a
+thread-per-request server; it freezes EVERY stream the loop carries
+until the call returns. That asymmetry is why the threaded router
+could call ``probe_backend_info`` inline and the async one must not.
+
+A finding is any call from the blocking catalog (the same one
+lock-discipline consults, ``plugins/lock_discipline.blocking_label``)
+that is:
+
+  * textually inside an ``async def`` body, or
+  * reachable from one through the call graph WITHOUT passing an
+    executor hop — ``loop.run_in_executor(...)``,
+    ``asyncio.to_thread(...)``, or a ``Thread``/``Timer`` spawn. Work
+    handed to an executor leaves the event-loop domain by
+    construction, so traversal stops there: the hop's function
+    arguments are exactly the code that is ALLOWED to block.
+
+Coroutine roots come from ``Context.async_nodes`` (every ``async
+def`` in the project — the event-loop domain seed, structural like
+the http/background thread domains). The traversal walks call sites
+itself rather than using ``graph.reachable``: the graph links
+function references passed as arguments (a Thread target is as called
+as anything else), which is the right over-approximation for thread
+rules and exactly wrong here — the argument of an executor hop must
+NOT extend the event-loop domain.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..callgraph import body_walk
+from ..context import Context
+from ..core import Finding, Project, Rule
+from .lock_discipline import blocking_label
+
+# calls that move their payload OFF the event loop: traversal never
+# follows their arguments (that code runs on a thread, where the
+# blocking catalog does not apply)
+_EXECUTOR_HOPS = frozenset(
+    ("run_in_executor", "to_thread", "Thread", "Timer"))
+
+
+def _call_name(call: ast.Call) -> str:
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+class AsyncBlockingRule(Rule):
+    name = "blocking-in-async"
+    description = ("cataloged blocking operations (fsync/urlopen/"
+                   "socket/sleep/device fetch) reachable from an "
+                   "async def without an executor hop")
+
+    def run(self, project: Project, ctx: Context = None
+            ) -> List[Finding]:
+        ctx = ctx or Context(project)
+        graph = ctx.graph
+
+        # per function node: direct blocking calls in its own body
+        # and its non-hop callees (hop payloads excluded — see module
+        # docstring)
+        info: Dict[str, Tuple[List[Tuple[int, str]], Set[str]]] = {}
+
+        def node_info(node: str) -> Tuple[List[Tuple[int, str]],
+                                          Set[str]]:
+            cached = info.get(node)
+            if cached is not None:
+                return cached
+            rel, qual = node.split("::", 1)
+            sf = project.file(rel)
+            fn = sf.defs.get(qual) if sf is not None else None
+            blocking: List[Tuple[int, str]] = []
+            callees: Set[str] = set()
+            if fn is not None and not isinstance(fn, ast.ClassDef):
+                for sub in body_walk(fn):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    if _call_name(sub) in _EXECUTOR_HOPS:
+                        continue  # payload leaves the loop domain
+                    label = blocking_label(sub)
+                    if label:
+                        blocking.append((sub.lineno, label))
+                    callees |= graph.resolve_call(sf, qual, sub)
+            info[node] = (blocking, callees)
+            return info[node]
+
+        # memoized sink search over the sync portion of the graph;
+        # cycles are cut by the in-progress guard (a cycle member
+        # under-memoizes, never over-reports)
+        sink_cache: Dict[str, Set[Tuple[str, str]]] = {}
+
+        def sinks_from(node: str,
+                       stack: Set[str]) -> Set[Tuple[str, str]]:
+            cached = sink_cache.get(node)
+            if cached is not None:
+                return cached
+            if node in stack:
+                return set()
+            stack.add(node)
+            blocking, callees = node_info(node)
+            out = {(node, label) for _line, label in blocking}
+            for callee in callees:
+                # a coroutine callee reports its own body directly;
+                # following it here would double-report every sink
+                if callee in async_nodes:
+                    continue
+                out |= sinks_from(callee, stack)
+            stack.discard(node)
+            sink_cache[node] = out
+            return out
+
+        async_nodes = ctx.async_nodes
+        findings: List[Finding] = []
+        for root in sorted(async_nodes):
+            rel, qual = root.split("::", 1)
+            sf = project.file(rel)
+            fn = sf.defs.get(qual) if sf is not None else None
+            if fn is None:
+                continue
+            short = qual.rsplit(".", 1)[-1]
+            blocking, _ = node_info(root)
+            for line, label in blocking:
+                hint = (" (use asyncio.sleep)"
+                        if label == "time.sleep" else
+                        " (await it via loop.run_in_executor)")
+                findings.append(self.finding(
+                    sf, line,
+                    f"blocking {label}(...) inside async def "
+                    f"{short} stalls every stream on the event "
+                    f"loop{hint}"))
+            reported: Set[Tuple[str, str]] = set()
+            for sub in body_walk(fn):
+                if not isinstance(sub, ast.Call) or \
+                        _call_name(sub) in _EXECUTOR_HOPS:
+                    continue
+                for target in sorted(
+                        graph.resolve_call(sf, qual, sub)):
+                    if target == root or target in async_nodes:
+                        continue
+                    for sink, label in sorted(
+                            sinks_from(target, set())):
+                        sink_short = sink.split("::", 1)[1]
+                        key = (sink_short, label)
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        findings.append(self.finding(
+                            sf, sub.lineno,
+                            f"call chain from async def {short} "
+                            f"reaches blocking {label}(...) in "
+                            f"{sink_short} with no executor hop — "
+                            "the event loop stalls for its full "
+                            "duration"))
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings

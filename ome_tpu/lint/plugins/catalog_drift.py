@@ -3,10 +3,10 @@ re-homed from scripts/check_fault_points.py and check_metrics.py so
 one runner owns every invariant.
 
 ``fault-catalog`` — every literal ``faults.fire("<point>")`` /
-``faults.http("<point>")`` site must have a row in the fault-point
-catalog table of docs/failure-semantics.md (one-directional by
-design: documenting ahead of landing is allowed, firing undocumented
-points is not).
+``faults.afire("<point>")`` / ``faults.http("<point>")`` site must
+have a row in the fault-point catalog table of
+docs/failure-semantics.md (one-directional by design: documenting
+ahead of landing is allowed, firing undocumented points is not).
 
 ``metrics-naming`` — registry declarations (``.counter`` /
 ``.gauge`` / ``.histogram``) must carry an approved prefix, counters
@@ -33,7 +33,7 @@ from ..core import Finding, Project, Rule, SourceFile
 
 # ---------------------------------------------------------------- fault
 
-FAULT_METHODS = ("fire", "http")
+FAULT_METHODS = ("fire", "afire", "http")
 CATALOG_HEADING = "fault-point catalog"
 
 

@@ -118,6 +118,15 @@ class Backend:
         self.fails = 0       # consecutive request failures
         self.cb_trips = 0    # times opened (drives the backoff)
         self._probe_inflight = False
+        # half-open probe idempotency: every admitted probe carries a
+        # token (minted by begin_probe); a failure verdict charges the
+        # breaker AT MOST ONCE per token. Two routers probing the same
+        # recovering backend concurrently (multi-replica ingress, or a
+        # gossip merge releasing _probe_inflight mid-probe) would
+        # otherwise double-charge cb_trips and double the cooldown
+        # twice for one real failure.
+        self._probe_token = 0     # last token minted
+        self._probe_charged = 0   # highest token already charged
         # drain-aware routing: a draining backend (SIGTERM, finishing
         # in-flight work) leaves rotation WITHOUT being a failure —
         # distinct from the breaker's `open` (which punishes) and
@@ -141,12 +150,33 @@ class Backend:
             self._probe_inflight = False
             self.healthy = True
 
-    def record_failure(self, now: float):
+    def begin_probe(self) -> int:
+        """Admit ONE half-open probe and mint its idempotency token.
+        The caller passes the token back to record_failure so a
+        duplicate verdict for the same probe is a no-op."""
         with self._lock:
+            self._probe_inflight = True
+            self._probe_token += 1
+            return self._probe_token
+
+    def record_failure(self, now: float,
+                       probe_token: Optional[int] = None):
+        with self._lock:
+            half_open = self.cb_state == "half_open"
+            if half_open:
+                # idempotency gate: a probe verdict without a token
+                # adopts the latest minted one (legacy callers), and a
+                # token at or below the charged high-water mark has
+                # already been counted — release the slot and return.
+                tok = probe_token if probe_token is not None \
+                    else self._probe_token
+                if tok and tok <= self._probe_charged:
+                    self._probe_inflight = False
+                    return
+                self._probe_charged = max(self._probe_charged, tok)
             self.fails += 1
             self._probe_inflight = False
-            if self.cb_state == "half_open" or \
-                    self.fails >= self.cb_threshold:
+            if half_open or self.fails >= self.cb_threshold:
                 self.cb_trips += 1
                 self.cb_state = "open"
                 self.cb_open_until = now + min(
@@ -281,6 +311,13 @@ class PrefixDirectory:
     def lookup(self, digest: str) -> Optional[str]:
         with self._lock:
             return self._owners.get(digest)
+
+    def export(self) -> List[tuple]:
+        """(digest, owner) pairs in LRU order (oldest first) — the
+        gossip snapshot's view of the directory. Re-importing via
+        update() in this order reproduces the same LRU recency."""
+        with self._lock:
+            return list(self._owners.items())
 
     def __len__(self) -> int:
         with self._lock:
@@ -469,7 +506,7 @@ class Router:
             else:
                 chosen = alive[next(self._rr) % len(alive)]
             if chosen.cb_state == "half_open":
-                chosen._probe_inflight = True
+                chosen.begin_probe()
             return chosen
 
     def note_result(self, backend: Backend, ok: bool):

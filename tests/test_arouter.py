@@ -1,0 +1,453 @@
+"""Asyncio router data path (ome_tpu/router/aserver.py): surface
+parity with the threaded RouterServer, SSE relay correctness, the
+disconnect watcher cancelling the upstream fetch, bounded per-stream
+buffers under a slow client, and the marked-slow concurrency soak —
+thousands of simultaneous SSE streams through ONE event-loop thread
+with bounded threads and memory (docs/router-ha.md)."""
+
+import asyncio
+import json
+import resource
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from ome_tpu.router.aserver import AsyncRouterServer, _Headers
+from ome_tpu.router.gossip import GossipState
+from ome_tpu.router.server import Backend, Router
+
+
+class _StubUpstream:
+    """Threaded stand-in engine: JSON completions, chunked SSE
+    streaming (`stream: true`), optional slow streaming so a client
+    disconnect mid-stream is observable upstream."""
+
+    def __init__(self, stream_events=3, event_delay=0.0):
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _chunk(self, data: bytes):
+                self.wfile.write(f"{len(data):x}\r\n".encode()
+                                 + data + b"\r\n")
+
+            def do_GET(self):
+                body = json.dumps({"ready": True,
+                                   "draining": False}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                stub.hits += 1
+                if not payload.get("stream"):
+                    body = json.dumps({
+                        "object": "text_completion",
+                        "choices": [{"text": "ok"}]}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for i in range(stub.stream_events):
+                        self._chunk(
+                            f'data: {{"text": "t{i}"}}\n\n'.encode())
+                        self.wfile.flush()
+                        if stub.event_delay:
+                            time.sleep(stub.event_delay)
+                    self._chunk(b"data: [DONE]\n\n")
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError,
+                        OSError):
+                    stub.aborted += 1
+
+        self.hits = 0
+        self.aborted = 0
+        self.stream_events = stream_events
+        self.event_delay = event_delay
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _post(base, payload, timeout=30):
+    req = urllib.request.Request(
+        base + "/v1/completions", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+class TestSurfaceParity:
+    """Every endpoint the threaded RouterServer exposes answers
+    identically on the asyncio front."""
+
+    def test_health_metrics_debug_gossip(self):
+        stub = _StubUpstream()
+        router = Router([Backend(stub.url)], policy="round_robin")
+        gossip = GossipState(router, "r0")
+        srv = AsyncRouterServer(router, host="127.0.0.1", port=0,
+                                debug_endpoints=True,
+                                gossip=gossip).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            with urllib.request.urlopen(base + "/health",
+                                        timeout=30) as r:
+                h = json.loads(r.read())
+            assert h["status"] == "ok" and len(h["backends"]) == 1
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=30) as r:
+                text = r.read().decode()
+            for name in ("ome_router_open_streams",
+                         "ome_router_stream_backpressure_total",
+                         "ome_router_client_disconnects_total"):
+                assert name in text
+            with urllib.request.urlopen(base + "/backends",
+                                        timeout=30) as r:
+                assert json.loads(r.read())["backends"][0]["url"] \
+                    == stub.url
+            with urllib.request.urlopen(base + "/debug/state",
+                                        timeout=30) as r:
+                dbg = json.loads(r.read())
+            assert dbg["gossip"]["replica"] == "r0"
+            assert dbg["streams"]["open"] == 0
+            with urllib.request.urlopen(base + "/gossip/state",
+                                        timeout=30) as r:
+                snap = json.loads(r.read())
+            assert snap["replica"] == "r0"
+            assert stub.url in snap["backends"]
+        finally:
+            srv.stop()
+            stub.close()
+
+    def test_debug_surfaces_guarded_and_gossip_optional(self):
+        stub = _StubUpstream()
+        router = Router([Backend(stub.url)])
+        srv = AsyncRouterServer(router, host="127.0.0.1",
+                                port=0).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            for path in ("/backends", "/debug/state"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(base + path, timeout=30)
+                assert ei.value.code == 403
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/gossip/state",
+                                       timeout=30)
+            assert ei.value.code == 404       # gossip not configured
+        finally:
+            srv.stop()
+            stub.close()
+
+    def test_backend_mutation_api(self):
+        stub = _StubUpstream()
+        router = Router([Backend(stub.url)])
+        srv = AsyncRouterServer(router, host="127.0.0.1", port=0,
+                                debug_endpoints=True).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            req = urllib.request.Request(
+                base + "/backends",
+                data=json.dumps({"url": "http://new:1"}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert json.loads(r.read())["ok"]
+            assert len(router.backends) == 2
+            req = urllib.request.Request(
+                base + "/backends",
+                data=json.dumps({"url": "http://new:1"}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="DELETE")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert json.loads(r.read())["ok"]
+            assert len(router.backends) == 1
+        finally:
+            srv.stop()
+            stub.close()
+
+    def test_completions_and_failover(self):
+        stub = _StubUpstream()
+        router = Router([Backend("http://127.0.0.1:9"),
+                         Backend(stub.url)], policy="round_robin")
+        srv = AsyncRouterServer(router, host="127.0.0.1",
+                                port=0).start()
+        base = f"http://127.0.0.1:{srv.port}"
+        try:
+            for _ in range(2):   # round robin provably hits the corpse
+                code, body = _post(base, {"prompt": "hi"})
+                assert code == 200
+                assert json.loads(body)["choices"][0]["text"] == "ok"
+            assert not router.backends[0].healthy
+        finally:
+            srv.stop()
+            stub.close()
+
+    def test_all_backends_down_503(self):
+        router = Router([Backend("http://127.0.0.1:9")])
+        srv = AsyncRouterServer(router, host="127.0.0.1",
+                                port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"http://127.0.0.1:{srv.port}", {"prompt": "x"})
+            assert ei.value.code == 503
+            assert ei.value.headers.get("Retry-After") == "1"
+        finally:
+            srv.stop()
+
+
+class TestStreaming:
+    def test_sse_relay_end_to_end(self):
+        stub = _StubUpstream(stream_events=5)
+        router = Router([Backend(stub.url)])
+        srv = AsyncRouterServer(router, host="127.0.0.1",
+                                port=0).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions",
+                data=json.dumps({"prompt": "hi",
+                                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            events = []
+            with urllib.request.urlopen(req, timeout=30) as r:
+                assert "text/event-stream" in r.headers["Content-Type"]
+                for raw in r:
+                    line = raw.decode().strip()
+                    if line.startswith("data:"):
+                        events.append(line)
+            assert events[-1] == "data: [DONE]"
+            assert len(events) == 6          # 5 tokens + [DONE]
+            # the loop runs the relay's finally just after the client
+            # sees the terminal chunk — give accounting a beat
+            deadline = time.time() + 5
+            while srv._open_streams and time.time() < deadline:
+                time.sleep(0.01)
+            assert srv._open_streams == 0    # accounting drained
+        finally:
+            srv.stop()
+            stub.close()
+
+    def test_client_disconnect_cancels_upstream(self):
+        """The watcher coroutine turns a client hangup into upstream
+        cancellation: the engine-side socket closes (the stub observes
+        the broken pipe) instead of generating for a viewer that
+        left, and the disconnect counter records it."""
+        stub = _StubUpstream(stream_events=200, event_delay=0.05)
+        router = Router([Backend(stub.url)])
+        srv = AsyncRouterServer(router, host="127.0.0.1",
+                                port=0).start()
+        try:
+            body = json.dumps({"prompt": "hi", "stream": True}).encode()
+            s = socket.create_connection(("127.0.0.1", srv.port),
+                                         timeout=30)
+            s.sendall(b"POST /v1/completions HTTP/1.1\r\n"
+                      b"Host: t\r\nContent-Type: application/json\r\n"
+                      + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                      + body)
+            got = b""
+            while b"t0" not in got:           # first event arrived
+                got += s.recv(4096)
+            s.close()                         # viewer leaves
+            deadline = time.time() + 10
+            while time.time() < deadline and (
+                    stub.aborted == 0
+                    or srv._c_disconnects.value == 0):
+                time.sleep(0.05)
+            assert stub.aborted >= 1          # upstream fetch cancelled
+            assert srv._c_disconnects.value >= 1
+        finally:
+            srv.stop()
+            stub.close()
+
+
+class TestBackpressure:
+    def test_slow_client_bounds_buffer_not_upstream(self):
+        """Unit-level relay: upstream floods faster than the client
+        drains. The per-stream queue (maxsize=stream_buffer) fills —
+        counted by the backpressure metric — but every chunk still
+        arrives, in order; memory per stream is the bounded queue,
+        never the whole response."""
+        router = Router([Backend("http://x")])
+        srv = AsyncRouterServer(router, host="127.0.0.1", port=0,
+                                stream_buffer=2)
+        payloads = [f"data: tok{i}\n\n".encode() for i in range(40)]
+
+        class _SlowWriter:
+            def __init__(self):
+                self.buf = b""
+
+            def write(self, data):
+                self.buf += data
+
+            async def drain(self):
+                await asyncio.sleep(0.002)   # slow client
+
+        async def scenario():
+            up = asyncio.StreamReader()
+            for p in payloads:               # whole body ready at once
+                up.feed_data(f"{len(p):x}\r\n".encode() + p + b"\r\n")
+            up.feed_data(b"0\r\n\r\n")
+            up.feed_eof()
+            w = _SlowWriter()
+            await srv._relay_stream(
+                up, _Headers({"transfer-encoding": "chunked"}),
+                200, w, time.monotonic() + 30)
+            return w.buf
+
+        out = asyncio.run(scenario())
+        router.stop()
+        pos = -1
+        for p in payloads:                   # all chunks, in order
+            nxt = out.find(p)
+            assert nxt > pos
+            pos = nxt
+        assert out.endswith(b"0\r\n\r\n")
+        assert srv._c_backpressure.value > 0  # the buffer DID fill
+        assert srv._open_streams == 0
+
+
+# ---------------------------------------------------------------------------
+# concurrency soak (slow tier)
+# ---------------------------------------------------------------------------
+
+
+def _stream_budget(target=10000):
+    """Streams the process fd limit can carry: each held-open stream
+    costs 4 fds here (client socket, router accept, router→stub
+    socket, stub accept — router and stubs share this process).
+    Raises the soft limit to the hard cap first, targets 10k, and
+    clamps to what the box allows."""
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+            soft = hard
+        except (ValueError, OSError):
+            pass
+    return max(64, min(target, (soft - 1500) // 4))
+
+
+@pytest.mark.slow
+class TestConcurrentStreamSoak:
+    def test_thousands_of_held_open_streams_one_event_loop(self):
+        n = _stream_budget()
+        router = Router([Backend("http://127.0.0.1:1")])  # rewired below
+        srv = AsyncRouterServer(router, host="127.0.0.1", port=0,
+                                stream_buffer=8).start()
+        threads_before = threading.active_count()
+        rss_before = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+
+        async def soak():
+            release = asyncio.Event()
+            opened = asyncio.Semaphore(0)
+
+            async def stub_handle(reader, writer):
+                try:
+                    clen = 0
+                    while True:
+                        line = await reader.readline()
+                        if line in (b"\r\n", b"\n", b""):
+                            break
+                        if line.lower().startswith(b"content-length"):
+                            clen = int(line.split(b":")[1])
+                    await reader.readexactly(clen)
+                    first = b"data: tok\n\n"
+                    writer.write(
+                        b"HTTP/1.1 200 OK\r\n"
+                        b"Content-Type: text/event-stream\r\n"
+                        b"Transfer-Encoding: chunked\r\n\r\n"
+                        + f"{len(first):x}\r\n".encode()
+                        + first + b"\r\n")
+                    await writer.drain()
+                    await release.wait()      # hold the stream open
+                    done = b"data: [DONE]\n\n"
+                    writer.write(f"{len(done):x}\r\n".encode()
+                                 + done + b"\r\n0\r\n\r\n")
+                    await writer.drain()
+                except (OSError, asyncio.IncompleteReadError):
+                    pass
+                finally:
+                    writer.close()
+
+            stub = await asyncio.start_server(
+                stub_handle, "127.0.0.1", 0, backlog=4096)
+            stub_port = stub.sockets[0].getsockname()[1]
+            router.backends[0].url = f"http://127.0.0.1:{stub_port}"
+            body = json.dumps({"prompt": "x", "stream": True}).encode()
+            head = (b"POST /v1/completions HTTP/1.1\r\nHost: t\r\n"
+                    b"Content-Type: application/json\r\n"
+                    + f"Content-Length: {len(body)}\r\n\r\n".encode())
+            gate = asyncio.Semaphore(256)     # bound connect bursts
+
+            async def one_stream():
+                async with gate:
+                    r, w = await asyncio.open_connection(
+                        "127.0.0.1", srv.port)
+                w.write(head + body)
+                await w.drain()
+                buf = b""
+                while b"data: tok" not in buf:
+                    got = await r.read(4096)
+                    assert got, "stream closed before first event"
+                    buf += got
+                opened.release()              # held open from here on
+                while b"[DONE]" not in buf:
+                    got = await r.read(65536)
+                    if not got:
+                        break
+                    buf += got
+                w.close()
+                return b"[DONE]" in buf
+
+            tasks = [asyncio.create_task(one_stream())
+                     for _ in range(n)]
+            for _ in range(n):                # every stream delivered
+                await asyncio.wait_for(opened.acquire(), timeout=120)
+            peak = srv._open_streams          # all concurrently open
+            release.set()
+            done = await asyncio.wait_for(asyncio.gather(*tasks),
+                                          timeout=120)
+            stub.close()
+            await stub.wait_closed()
+            return peak, done
+
+        try:
+            peak, done = asyncio.run(soak())
+        finally:
+            srv.stop()
+        assert peak == n                      # genuinely concurrent
+        assert all(done)                      # every stream completed
+        assert srv._open_streams == 0
+        # no thread-per-stream anywhere: the whole soak ran on the
+        # router's one event-loop thread plus this test's loop (the
+        # threaded server would have needed ~n handler threads)
+        assert threading.active_count() <= threads_before + 8
+        rss_after = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # bounded buffers: growth stays far under what unbounded
+        # per-stream buffering of the response would cost
+        assert rss_after - rss_before < 2 * 1024 * 1024  # KiB (2 GiB)

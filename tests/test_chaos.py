@@ -121,6 +121,40 @@ def test_fixed_seed_noisy_neighbor_episode(tmp_path):
         ep.violations + [ep.replay_command()])
 
 
+def test_fixed_seed_router_loss_episode(tmp_path):
+    """Router-loss episode (docs/router-ha.md): TWO async router
+    replicas gossiping front two engines; one router takes a keyed
+    forward fault (tripping a breaker on one backend) and is then
+    SIGKILLed mid-replay. The driver fails over client-side, and the
+    runner checks the HA invariants on top of the usual ones: no
+    admitted request is lost or duplicated fleet-wide (invariant 7)
+    and the survivor holds the dead replica's breaker observations
+    within one anti-entropy round (invariant 8)."""
+    topo = chaos.Topology(prefill=0, decode=0, unified=2, router=True,
+                          routers=2, kv_block=16, kv_blocks=40)
+    runner = chaos.ChaosRunner(topo, pathlib.Path(tmp_path),
+                               journal_drain_timeout=60.0)
+    try:
+        ep = chaos._plan_episode(3, 0, topo, 4, 1.5,
+                                 kind="router_loss")
+        assert ep.kind == "router_loss"
+        # the plan always derives the shape the episode exists for
+        victims = [t for _, act, t in ep.events
+                   if act == "sigkill_router"]
+        assert len(victims) == 1 and victims[0].startswith("router")
+        assert ep.fault_specs[victims[0]].startswith(
+            "router_forward|")
+        assert "--router-loss" in ep.replay_command()
+        assert "--routers 2" in ep.replay_command()
+        runner.run_episode(ep)
+    finally:
+        runner.close()
+    assert ep.violations == [], "\n".join(
+        ep.violations + [ep.replay_command()])
+    # every request got exactly one answer across the fleet
+    assert all(r.answers == 1 for r in ep.requests)
+
+
 def test_forced_violation_collects_bundle(tmp_path):
     """A violating episode leaves a replay bundle: the schedule +
     violations, one flight-recorder dump per live engine child

@@ -1,0 +1,236 @@
+"""Router anti-entropy (ome_tpu/router/gossip.py): the LWW merge
+algebra proven property-style over random replica orderings, the
+pristine-record rule that keeps a late-booting replica from erasing
+fleet observations, and the two-router end-to-end guarantee the chaos
+invariant leans on — a breaker opened on replica A is honored by
+replica B within ONE anti-entropy pull (docs/router-ha.md)."""
+
+import random
+import time
+
+from ome_tpu.router.aserver import AsyncRouterServer
+from ome_tpu.router.gossip import (GossipAgent, GossipState, lww_wins,
+                                   merge_backends, merge_records)
+from ome_tpu.router.server import Backend, Router
+
+# ---------------------------------------------------------------------------
+# merge algebra, property-style
+# ---------------------------------------------------------------------------
+
+
+def _record(rng):
+    """A random observation record whose CONTENT is a pure function
+    of its (stamp, origin) identity — the invariant real snapshots
+    hold (a record is re-stamped whenever content changes), and the
+    precondition for LWW merge being commutative: two records that
+    compare equal under the total order ARE the same observation."""
+    stamp = rng.choice([0.0, round(rng.uniform(1.0, 100.0), 3)])
+    origin = "" if stamp == 0.0 else rng.choice(["r0", "r1", "r2"])
+    body = random.Random(hash((stamp, origin)))
+    return {"pool": "engine",
+            "healthy": body.random() < 0.7,
+            "draining": body.random() < 0.2,
+            "cb_state": body.choice(["closed", "half_open", "open"]),
+            "fails": body.randint(0, 5),
+            "cb_trips": body.randint(0, 3),
+            "stamp": stamp, "origin": origin}
+
+
+def _obs_map(rng):
+    return {f"http://e{i}": _record(rng)
+            for i in range(5) if rng.random() < 0.7}
+
+
+class TestMergeAlgebra:
+    def test_lww_total_order(self):
+        lo = {"stamp": 1.0, "origin": "a"}
+        hi = {"stamp": 1.0, "origin": "b"}
+        assert lww_wins(hi, lo) and not lww_wins(lo, hi)
+        assert not lww_wins(lo, lo)          # irreflexive
+        assert lww_wins(lo, None) and not lww_wins(None, lo)
+        assert merge_records(None, None) is None
+
+    def test_merge_commutative(self):
+        rng = random.Random(11)
+        for _ in range(300):
+            a, b = _obs_map(rng), _obs_map(rng)
+            assert merge_backends(a, b) == merge_backends(b, a)
+
+    def test_merge_associative(self):
+        rng = random.Random(13)
+        for _ in range(300):
+            a, b, c = _obs_map(rng), _obs_map(rng), _obs_map(rng)
+            assert merge_backends(merge_backends(a, b), c) == \
+                merge_backends(a, merge_backends(b, c))
+
+    def test_merge_idempotent(self):
+        rng = random.Random(17)
+        for _ in range(300):
+            a, b = _obs_map(rng), _obs_map(rng)
+            assert merge_backends(a, a) == a
+            ab = merge_backends(a, b)
+            assert merge_backends(ab, b) == ab
+            assert merge_backends(ab, a) == ab
+
+    def test_any_pull_order_converges(self):
+        """N replicas, random pairwise pulls: once every replica's
+        snapshot has reached every other (directly or transitively),
+        all replicas hold the SAME map — the property that makes the
+        chaos convergence invariant independent of pull topology."""
+        rng = random.Random(19)
+        for _ in range(50):
+            n = rng.randint(2, 4)
+            initial = [_obs_map(rng) for _ in range(n)]
+            views = [dict(m) for m in initial]
+            # full random gossip: enough random pulls that every
+            # ordered pair has occurred at least once
+            pairs = [(i, j) for i in range(n) for j in range(n)
+                     if i != j]
+            schedule = pairs * 2
+            rng.shuffle(schedule)
+            for dst, src in schedule:
+                views[dst] = merge_backends(views[dst], views[src])
+            want = {}
+            for m in initial:
+                want = merge_backends(want, m)
+            assert all(v == want for v in views)
+
+
+# ---------------------------------------------------------------------------
+# GossipState semantics
+# ---------------------------------------------------------------------------
+
+
+def _router(urls, **kw):
+    kw.setdefault("policy", "round_robin")
+    return Router([Backend(u) for u in urls], **kw)
+
+
+class TestGossipState:
+    def test_pristine_boot_never_outranks_observation(self):
+        """A freshly booted replica's default 'healthy/closed' view of
+        a backend carries stamp 0 — it must not overwrite a peer's
+        real breaker observation just because it was serialized
+        later (wall clock) than the peer's record."""
+        ra = _router(["http://e1"], cb_threshold=1)
+        rb = _router(["http://e1"])
+        sa = GossipState(ra, "ra")
+        sb = GossipState(rb, "rb")
+        ra.note_result(ra.backends[0], ok=False)     # A trips breaker
+        snap_a = sa.snapshot()
+        assert snap_a["backends"]["http://e1"]["cb_state"] == "open"
+        # B boots AFTER the trip: its own record is pristine
+        snap_b = sb.snapshot()
+        assert snap_b["backends"]["http://e1"]["stamp"] == 0.0
+        # A merging late-booted B keeps its observation...
+        assert sa.merge(snap_b) == 0
+        assert ra.backends[0].cb_state == "open"
+        # ...and B merging A adopts it
+        assert sb.merge(snap_a) >= 1
+        assert rb.backends[0].cb_state == "open"
+
+    def test_merge_order_independent_across_states(self):
+        """Replicas that saw different things converge to the same
+        observation map regardless of which snapshot merges first."""
+        def fleet():
+            ra = _router(["http://e1", "http://e2"], cb_threshold=1)
+            rb = _router(["http://e1", "http://e2"], cb_threshold=1)
+            sa, sb = GossipState(ra, "ra"), GossipState(rb, "rb")
+            ra.note_result(ra.backends[0], ok=False)
+            time.sleep(0.01)                 # distinct wall stamps
+            rb.note_result(rb.backends[1], ok=False)
+            return ra, rb, sa, sb
+
+        def obs(state):
+            return {u: (r["cb_state"], r["stamp"], r["origin"])
+                    for u, r in state.snapshot()["backends"].items()}
+
+        ra1, rb1, sa1, sb1 = fleet()
+        a_snap, b_snap = sa1.snapshot(), sb1.snapshot()
+        sa1.merge(b_snap)
+        sb1.merge(a_snap)
+        assert obs(sa1) == obs(sb1)
+        assert ra1.backends[1].cb_state == "open"    # adopted B's
+        assert rb1.backends[0].cb_state == "open"    # adopted A's
+
+    def test_merge_skips_unknown_urls(self):
+        """Membership is NOT gossiped: an observation about a backend
+        this replica does not route to is dropped, not adopted."""
+        ra = _router(["http://e1", "http://weird"], cb_threshold=1)
+        rb = _router(["http://e1"])
+        sa, sb = GossipState(ra, "ra"), GossipState(rb, "rb")
+        ra.note_result(ra.backends[1], ok=False)
+        assert sb.merge(sa.snapshot()) == 0
+        assert [b.url for b in rb.backends] == ["http://e1"]
+
+    def test_version_skips_noop_merges(self):
+        ra = _router(["http://e1"], cb_threshold=1)
+        rb = _router(["http://e1"])
+        sa, sb = GossipState(ra, "ra"), GossipState(rb, "rb")
+        ra.note_result(ra.backends[0], ok=False)
+        snap = sa.snapshot()
+        assert sb.merge(snap) >= 1
+        v = sb.stats()["version"]
+        assert sb.merge(snap) == 0           # same replica version:
+        assert sb.stats()["version"] == v    # cached, no re-merge
+
+    def test_cooldown_reanchored_not_copied(self):
+        """cb_open_until is a monotonic deadline that cannot travel
+        between processes; the snapshot carries remaining seconds and
+        the merge re-anchors onto the local clock."""
+        ra = _router(["http://e1"], cb_threshold=1, cb_cooldown=5.0)
+        rb = _router(["http://e1"])
+        sa, sb = GossipState(ra, "ra"), GossipState(rb, "rb")
+        ra.note_result(ra.backends[0], ok=False)
+        snap = sa.snapshot()
+        rem = snap["backends"]["http://e1"]["cb_open_remaining"]
+        assert 0.0 < rem <= 5.0
+        before = time.monotonic()
+        assert sb.merge(snap) >= 1
+        b = rb.backends[0]
+        assert b.cb_state == "open"
+        assert before < b.cb_open_until <= time.monotonic() + rem + 0.1
+
+    def test_prefix_directory_travels(self):
+        ra = _router(["http://e1"])
+        rb = _router(["http://e1"])
+        sa, sb = GossipState(ra, "ra"), GossipState(rb, "rb")
+        ra.prefix_directory.update("http://e1", ["d42"])
+        assert sb.merge(sa.snapshot()) >= 1
+        assert rb.prefix_directory.lookup("d42") == "http://e1"
+
+
+# ---------------------------------------------------------------------------
+# two real routers over HTTP: one pull suffices
+# ---------------------------------------------------------------------------
+
+
+class TestTwoRouterEndToEnd:
+    def test_breaker_opened_on_a_honored_by_b_within_one_pull(self):
+        """The convergence bound the router_loss chaos invariant
+        asserts, reproduced deterministically: replica A trips a
+        breaker; replica B's very next anti-entropy pull adopts the
+        open state and stops routing to that backend — B never burns
+        its own cb_threshold failures discovering the same corpse."""
+        backend_url = "http://127.0.0.1:9"   # nothing listens there
+        ra = _router([backend_url], cb_threshold=1, cb_cooldown=30.0)
+        rb = _router([backend_url], cb_threshold=3)
+        sa = GossipState(ra, "ra")
+        sb = GossipState(rb, "rb")
+        a_srv = AsyncRouterServer(ra, host="127.0.0.1", port=0,
+                                  gossip=sa).start()
+        try:
+            ra.note_result(ra.backends[0], ok=False)  # A observes it
+            assert ra.backends[0].cb_state == "open"
+            assert rb.backends[0].cb_state == "closed"
+            agent = GossipAgent(
+                sb, [f"http://127.0.0.1:{a_srv.port}"], interval=3600)
+            assert agent.pull_once() >= 1            # ONE pull...
+            b = rb.backends[0]
+            assert b.cb_state == "open"              # ...suffices
+            assert not b.healthy
+            assert rb.pick("engine") is None
+            assert sb.stats()["seen"]["ra"] >= 1 or \
+                sb.stats()["version"] >= 1
+        finally:
+            a_srv.stop()

@@ -40,6 +40,7 @@ from ome_tpu.lint.core import (Baseline, Finding, Project,
                                apply_suppressions, parse_suppressions)
 from ome_tpu.lint.lockmodel import LockModel, find_cycles
 from ome_tpu.lint.plugins import ALL_RULES, make_rule, rule_names
+from ome_tpu.lint.plugins.async_blocking import AsyncBlockingRule
 from ome_tpu.lint.plugins.catalog_drift import (FaultCatalogRule,
                                                 MetricsNamingRule)
 from ome_tpu.lint.plugins.hot_path_sync import HotPathSyncRule
@@ -325,6 +326,74 @@ class TestThreadSharedStateFixtures:
         assert ThreadSharedStateRule().run(p) == []
 
 
+class TestAsyncBlockingFixtures:
+    def test_direct_blocking_in_coroutine_flagged(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        import time
+        async def handler():
+            time.sleep(1)
+        """)
+        fs = AsyncBlockingRule().run(p)
+        assert len(fs) == 1
+        assert "time.sleep" in fs[0].message
+        assert "asyncio.sleep" in fs[0].message  # the fix hint
+
+    def test_chain_to_blocking_sink_flagged_at_call_site(
+            self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        from urllib.request import urlopen
+        def probe(url):
+            return urlopen(url).read()
+        async def handler(url):
+            x = 1
+            probe(url)
+        """)
+        fs = AsyncBlockingRule().run(p)
+        assert len(fs) == 1
+        assert "urlopen" in fs[0].message
+        assert "probe" in fs[0].message
+        assert fs[0].line == 7           # anchored where it enters
+
+    def test_executor_hop_payload_clean(self, tmp_path):
+        """Work handed to an executor leaves the event-loop domain:
+        the hop's arguments are exactly the code ALLOWED to block."""
+        p = _project(tmp_path, "m.py", """
+        import asyncio
+        from urllib.request import urlopen
+        def probe(url):
+            return urlopen(url).read()
+        async def handler(url):
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, probe, url)
+            await asyncio.to_thread(probe, url)
+            await asyncio.sleep(1)
+        """)
+        assert AsyncBlockingRule().run(p) == []
+
+    def test_async_callee_reports_its_own_body_once(self, tmp_path):
+        """A coroutine calling a blocking coroutine yields ONE finding
+        (in the callee) — the chain traversal stops at async callees
+        so the same sink is never double-reported per caller."""
+        p = _project(tmp_path, "m.py", """
+        import time
+        async def inner():
+            time.sleep(1)
+        async def outer():
+            await inner()
+        """)
+        fs = AsyncBlockingRule().run(p)
+        assert len(fs) == 1
+        assert "inner" in fs[0].message
+
+    def test_sync_only_code_never_flagged(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        import time
+        def worker():
+            time.sleep(1)
+        """)
+        assert AsyncBlockingRule().run(p) == []
+
+
 class TestFaultCatalogFixtures:
     DOC = """\
 ## Fault-point catalog
@@ -349,6 +418,20 @@ class TestFaultCatalogFixtures:
         fs = FaultCatalogRule(doc=doc).run(p)
         assert len(fs) == 1
         assert "mystery_point" in fs[0].message
+
+    def test_afire_sites_scanned_too(self, tmp_path):
+        """The async fault hook is the same catalog surface: a
+        faults.afire point missing from the docs is drift."""
+        doc = self._doc(tmp_path)
+        p = _project(tmp_path, "m.py", """
+        from ome_tpu import faults
+        async def f():
+            await faults.afire("async_mystery")
+            await faults.afire("known_point")
+        """)
+        fs = FaultCatalogRule(doc=doc).run(p)
+        assert len(fs) == 1
+        assert "async_mystery" in fs[0].message
 
     def test_documented_point_clean(self, tmp_path):
         doc = self._doc(tmp_path)
@@ -408,7 +491,7 @@ class TestRegistry:
     def test_all_rules_registered(self):
         assert set(rule_names()) == {
             "hot-path-sync", "lock-discipline", "thread-shared-state",
-            "fault-catalog", "metrics-naming",
+            "blocking-in-async", "fault-catalog", "metrics-naming",
             "metrics-label-cardinality"}
 
     def test_unknown_rule_rejected(self):

@@ -266,6 +266,66 @@ class TestProbeSlotRelease:
         assert r.pick("engine") is b
 
 
+class TestProbeTokenIdempotency:
+    """Regression: a half-open FAILURE verdict must charge the
+    breaker at most once per probe token. With N router replicas the
+    same recovering backend gets probed concurrently, and a gossip
+    merge can release _probe_inflight mid-probe — both deliver two
+    verdicts for one real failure. Without the token gate each
+    duplicate bumped cb_trips, doubling the exponential cooldown for
+    a failure that happened once."""
+
+    def _half_open(self):
+        r = Router([Backend("http://a", cb_cooldown=1.0)],
+                   policy="round_robin")
+        b = r.backends[0]
+        b.cb_state = "half_open"
+        return r, b
+
+    def test_stale_duplicate_verdict_is_a_noop(self):
+        r, b = self._half_open()
+        tok = b.begin_probe()
+        b.record_failure(0.0, probe_token=tok)
+        assert b.cb_state == "open" and b.cb_trips == 1
+        assert b.cb_open_until == 1.0      # cooldown * 2**(trips-1)
+        # cooldown over, a second replica re-tests the backend...
+        b.cb_state = "half_open"
+        tok2 = b.begin_probe()
+        # ...and the FIRST probe's verdict arrives again (delayed
+        # duplicate). Charged high-water mark swallows it: no trip,
+        # no cooldown doubling — but the slot IS released, the probe
+        # path must never wedge.
+        b.record_failure(5.0, probe_token=tok)
+        assert b.cb_trips == 1
+        assert b.cb_state == "half_open"
+        assert not b._probe_inflight
+        # the live probe's own verdict still charges normally
+        b.record_failure(5.0, probe_token=tok2)
+        assert b.cb_trips == 2 and b.cb_state == "open"
+        assert b.cb_open_until == 5.0 + 2.0
+
+    def test_legacy_verdict_without_token_adopts_latest(self):
+        r, b = self._half_open()
+        b.begin_probe()
+        b.record_failure(0.0)              # older caller, no token
+        assert b.cb_trips == 1
+        b.cb_state = "half_open"
+        b.record_failure(1.0)              # adopted token: charged,
+        assert b.cb_trips == 1             # so the repeat is a no-op
+        assert not b._probe_inflight
+
+    def test_success_resets_and_new_probes_charge_again(self):
+        r, b = self._half_open()
+        tok = b.begin_probe()
+        b.record_failure(0.0, probe_token=tok)
+        b.record_success()                 # backend genuinely back
+        assert b.cb_state == "closed" and b.cb_trips == 0
+        b.cb_state = "half_open"           # ...then degrades again
+        tok = b.begin_probe()
+        b.record_failure(9.0, probe_token=tok)
+        assert b.cb_trips == 1             # fresh token, fresh charge
+
+
 class TestDrainAwareRouting:
     def test_draining_excluded_from_selection(self):
         r = Router([Backend("http://a"), Backend("http://b")],
