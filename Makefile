@@ -5,7 +5,7 @@
 PYTHON ?= python
 
 .PHONY: lint test replay autoscale-soak noisy-neighbor router-soak \
-	benchgate
+	benchgate simulate
 
 # omelint: the repo's static-analysis gate (docs/static-analysis.md).
 # Runs every registered analyzer over ome_tpu/ and fails on any
@@ -26,6 +26,14 @@ test:
 # accepted regressions go in bench-waivers.json with a reason.
 benchgate:
 	$(PYTHON) scripts/perfgate.py --run
+
+# fleet simulator smoke (docs/simulation.md): the autoscale scenario
+# (diurnal + flash-crowd trace through the real controller on virtual
+# time) run twice with the same seed; fails unless the two reports —
+# decision log included — are byte-identical
+simulate:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/simulate.py \
+		--scenario autoscale --seed 7 --check-determinism --full
 
 # trace replay against a self-spawned router + CPU engine: the quick
 # "does the load generator work here" check (docs/autoscaling.md);

@@ -78,11 +78,17 @@ class Decision:
     pressure: float
     target: int
     signals: Dict[str, float] = field(default_factory=dict)
+    # injected-clock reading at decision time (virtual seconds under
+    # the simulator, monotonic seconds live); None from legacy paths
+    at: Optional[float] = None
 
     def to_dict(self) -> dict:
-        return {"tick": self.tick, "pool": self.pool,
-                "size": self.size, "pressure": self.pressure,
-                "target": self.target, "signals": self.signals}
+        out = {"tick": self.tick, "pool": self.pool,
+               "size": self.size, "pressure": self.pressure,
+               "target": self.target, "signals": self.signals}
+        if self.at is not None:
+            out["at"] = self.at
+        return out
 
 
 class ScaleController:
@@ -101,13 +107,26 @@ class ScaleController:
                  router_url: Optional[str] = None,
                  registry: Optional[Registry] = None,
                  fetch_fn=scrape.fetch_metrics,
-                 interval: float = 1.0):
+                 interval: float = 1.0,
+                 clock=None):
         self.pools = pools
         self.policies = policies
         self.slo = slo
         self.router_url = router_url.rstrip("/") if router_url else None
         self.fetch_fn = fetch_fn
         self.interval = interval
+        # the ONE clock the decision path reads, injected end to end:
+        # decision stamps, histogram-window staleness, and the
+        # policies' last_action_at all see the same time source. The
+        # default is deliberately None — NOT wall time — so the
+        # decision path stays tick-deterministic unless a caller
+        # opts into timestamps (the CLI passes time.monotonic, the
+        # simulator its VirtualClock).
+        self.clock = clock
+        if clock is not None:
+            for policy in policies.values():
+                if policy.clock is None:
+                    policy.clock = clock
         self.registry = registry or Registry()
         self.decisions: List[Decision] = []
         self.tick_count = 0
@@ -115,17 +134,17 @@ class ScaleController:
                       if getattr(slo, "priority_class", None) else None)
         self._windows: Dict[str, Dict[str, scrape.HistogramWindow]] = {
             name: {"ttft": scrape.HistogramWindow(
-                       "ome_engine_ttft_seconds"),
+                       "ome_engine_ttft_seconds", clock=clock),
                    "queue_wait": scrape.HistogramWindow(
-                       "ome_engine_queue_wait_seconds"),
+                       "ome_engine_queue_wait_seconds", clock=clock),
                    # per-class windows answer first; the global pair
                    # is the fallback when the class saw no traffic
                    "class_ttft": scrape.HistogramWindow(
                        "ome_engine_class_ttft_seconds",
-                       labels=cls_filter),
+                       labels=cls_filter, clock=clock),
                    "class_queue_wait": scrape.HistogramWindow(
                        "ome_engine_class_queue_wait_seconds",
-                       labels=cls_filter)}
+                       labels=cls_filter, clock=clock)}
             for name in pools}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -240,7 +259,10 @@ class ScaleController:
             target = self.policies[name].decide(size, pressure)
             decision = Decision(tick=self.tick_count, pool=name,
                                 size=size, pressure=pressure,
-                                target=target, signals=signals)
+                                target=target, signals=signals,
+                                at=(round(self.clock(), 6)
+                                    if self.clock is not None
+                                    else None))
             made.append(decision)
             if len(self.decisions) < self.MAX_DECISIONS:
                 self.decisions.append(decision)
@@ -433,7 +455,8 @@ def run_closed_loop(args) -> dict:
             down_threshold=args.down_threshold))
         controller = ScaleController(
             {"engine": pool}, {"engine": policy}, slo,
-            router_url=router.url, interval=args.interval).start()
+            router_url=router.url, interval=args.interval,
+            clock=time.monotonic).start()
 
         results = replay_mod.replay(router.url, tr)
         time.sleep(args.settle_seconds)

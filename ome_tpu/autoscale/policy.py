@@ -20,11 +20,19 @@ Deliberately clockless: ticks, not seconds, are the unit, so a given
 pressure series maps to EXACTLY one decision sequence regardless of
 wall-clock jitter — the property the seeded-replay determinism test
 asserts. The controller owns the tick cadence.
+
+There is consequently no hidden wall-clock default anywhere in this
+module: the optional ``clock`` a PoolPolicy accepts is injection-only
+(the controller passes its own — real or virtual — so decisions can
+be timestamped), and a policy built without one never reads time at
+all. Under the simulator's virtual clock the same pressure series
+therefore yields the same decisions AND the same timestamps.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Callable, Optional
 
 
 @dataclass
@@ -53,10 +61,18 @@ class PolicyConfig:
 
 class PoolPolicy:
     """One pool's decision state. ``decide(size, pressure)`` returns
-    the target size for this tick (== size means hold)."""
+    the target size for this tick (== size means hold).
 
-    def __init__(self, config: PolicyConfig):
+    ``clock`` is optional and injection-only (no wall-clock default):
+    when present, ``last_action_at`` records the clock reading of the
+    most recent scale action — the controller injects its own clock
+    so real and simulated runs stamp decisions identically."""
+
+    def __init__(self, config: PolicyConfig,
+                 clock: Optional[Callable[[], float]] = None):
         self.config = config.validate()
+        self.clock = clock
+        self.last_action_at: Optional[float] = None
         self._above = 0      # consecutive ticks at/over up_threshold
         self._below = 0      # consecutive ticks under down_threshold
         self._cooldown = 0   # ticks until the next action may fire
@@ -91,6 +107,8 @@ class PoolPolicy:
         self._above = 0
         self._below = 0
         self._cooldown = self.config.cooldown_ticks
+        if self.clock is not None:
+            self.last_action_at = self.clock()
 
     def _clamp(self, size: int) -> int:
         return min(max(size, self.config.min_size),

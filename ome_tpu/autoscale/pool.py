@@ -69,7 +69,8 @@ class EnginePool:
                  base_dir: pathlib.Path, router_pool: str = "engine",
                  ready_timeout: float = 120.0,
                  drain_exit_timeout: float = 60.0,
-                 resume_timeout: float = 60.0):
+                 resume_timeout: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
         self.name = name
         self.router_url = (router_url.rstrip("/")
                            if router_url else None)
@@ -80,6 +81,10 @@ class EnginePool:
         self.ready_timeout = ready_timeout
         self.drain_exit_timeout = drain_exit_timeout
         self.resume_timeout = resume_timeout
+        # clock for capacity ACCOUNTING (engine_seconds). Drain-exit
+        # and resume deadlines stay on real time deliberately: they
+        # bound real subprocess exits, which no virtual clock governs.
+        self.clock = clock
         self._lock = threading.Lock()
         self._members: List[PoolMember] = []
         self._waiters: List[threading.Thread] = []
@@ -118,7 +123,7 @@ class EnginePool:
         """Capacity cost so far: summed lifetime of every member,
         live ones included — the number the soak compares against
         static max-provisioning."""
-        now = time.monotonic()
+        now = self.clock()
         with self._lock:
             live = sum(now - m.started_mono for m in self._members)
             return self._engine_seconds + live
@@ -141,7 +146,7 @@ class EnginePool:
         with self._lock:
             self._members.append(PoolMember(
                 proc=proc, journal=journal_dir / "requests.jsonl",
-                started_mono=time.monotonic()))
+                started_mono=self.clock()))
         log.info("pool %s: spawned %s on %s", self.name, name, proc.url)
         return proc
 
@@ -200,7 +205,7 @@ class EnginePool:
                 record.detail = f"{type(e).__name__}: {e}"
                 proc.kill()
         self._deregister(proc.url)
-        now = time.monotonic()
+        now = self.clock()
         with self._lock:
             if member in self._members:
                 self._members.remove(member)
@@ -261,7 +266,7 @@ class EnginePool:
         with self._lock:
             members = list(self._members)
             self._members = []
-        now = time.monotonic()
+        now = self.clock()
         for m in members:
             m.proc.stop()
             with self._lock:

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import math
 import re
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..chaos import _http
 
@@ -128,19 +128,31 @@ class HistogramWindow:
     (backend URL); ``quantile(q)`` answers over the observations that
     arrived between the previous update and this one, across ALL
     sources. Counter resets re-base silently. ``labels`` narrows the
-    family to matching children (per-class SLO windows)."""
+    family to matching children (per-class SLO windows).
+
+    ``clock`` is optional and injection-only — the window itself
+    keeps NO hidden wall-clock default. When a clock is injected
+    (the controller passes its own, real or virtual), every update
+    is stamped and ``staleness(source)`` answers how old a source's
+    latest scrape is in that clock's units; without one, the window
+    is purely scrape-ordered, exactly as before."""
 
     def __init__(self, family: str,
-                 labels: Optional[Dict[str, str]] = None):
+                 labels: Optional[Dict[str, str]] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self.family = family
         self.labels = dict(labels) if labels else None
+        self.clock = clock
         self._prev: Dict[str, List[Tuple[float, float]]] = {}
         self._window: Dict[str, List[Tuple[float, float]]] = {}
+        self._updated_at: Dict[str, float] = {}
 
     def update(self, source: str, samples: Dict[str, float]) -> None:
         cur = bucket_counts(samples, self.family, self.labels)
         prev = self._prev.get(source)
         self._prev[source] = cur
+        if self.clock is not None:
+            self._updated_at[source] = self.clock()
         if prev is None or len(prev) != len(cur):
             self._window.pop(source, None)
             return
@@ -155,6 +167,15 @@ class HistogramWindow:
     def forget(self, source: str) -> None:
         self._prev.pop(source, None)
         self._window.pop(source, None)
+        self._updated_at.pop(source, None)
+
+    def staleness(self, source: str) -> Optional[float]:
+        """Clock units since ``source`` was last updated; None when
+        no clock was injected or the source was never seen."""
+        if self.clock is None:
+            return None
+        at = self._updated_at.get(source)
+        return None if at is None else self.clock() - at
 
     def window_count(self) -> float:
         return sum(d[-1][1] for d in self._window.values() if d)

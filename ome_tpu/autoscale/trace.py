@@ -21,6 +21,7 @@ sharper spike). Both are deterministic.
 from __future__ import annotations
 
 import json
+import math
 import pathlib
 import random
 from dataclasses import asdict, dataclass
@@ -127,6 +128,87 @@ def synthetic_trace(seed: int, n: int = 40, base_rate: float = 4.0,
             max_tokens=rng.randint(*max_tokens),
             temperature=0.0 if greedy else 0.7,
             trace_id=f"syn-{seed}-{i}"))
+    return out
+
+
+def diurnal_trace(seed: int, n: int = 500, period_s: float = 120.0,
+                  base_rate: float = 2.0, peak_factor: float = 4.0,
+                  cycles: float = 2.0,
+                  prompt_tokens: Sequence[int] = (4, 12),
+                  max_tokens: Sequence[int] = (6, 16)
+                  ) -> List[TraceRequest]:
+    """Seeded diurnal workload: a sinusoidal arrival rate swinging
+    between ``base_rate`` and ``base_rate * peak_factor`` over
+    ``cycles`` periods of ``period_s`` seconds — the canonical
+    scale-up-by-day / scale-down-by-night shape the autoscaler's
+    no-oscillation regression replays. Fully deterministic in its
+    arguments (same seeding discipline as synthetic_trace)."""
+    if peak_factor < 1.0:
+        raise ValueError("peak_factor must be >= 1")
+    rng = random.Random(f"autoscale-trace:{seed}")
+    out: List[TraceRequest] = []
+    at = 0.0
+    horizon = period_s * cycles
+    for i in range(n):
+        # rate at the CURRENT point of the cycle; trough at t=0 so a
+        # min-size fleet starts calm and the first peak forces the
+        # first scale-up
+        phase = 2.0 * math.pi * (at / period_s)
+        swing = 0.5 * (1.0 - math.cos(phase))  # 0 at trough, 1 at peak
+        rate = base_rate * (1.0 + (peak_factor - 1.0) * swing)
+        if i:
+            at += rng.expovariate(rate)
+        if at > horizon:
+            break
+        out.append(TraceRequest(
+            arrival=round(at, 6),
+            prompt_tokens=rng.randint(*prompt_tokens),
+            max_tokens=rng.randint(*max_tokens),
+            temperature=0.0,
+            trace_id=f"diurnal-{seed}-{i}"))
+    return out
+
+
+def flash_crowd_trace(seed: int, n: int = 400,
+                      base_rate: float = 2.0,
+                      crowd_at: float = 30.0,
+                      crowd_duration: float = 10.0,
+                      crowd_factor: float = 10.0,
+                      prompt_tokens: Sequence[int] = (4, 12),
+                      max_tokens: Sequence[int] = (6, 16)
+                      ) -> List[TraceRequest]:
+    """Seeded flash crowd: steady ``base_rate`` arrivals with a
+    ``crowd_factor`` x rate spike in the ``crowd_duration`` seconds
+    starting at ``crowd_at`` — a step change, not a ramp, which is
+    what stresses the policy's stability windows (react fast, don't
+    flap when the crowd leaves). Deterministic in its arguments."""
+    if crowd_factor < 1.0:
+        raise ValueError("crowd_factor must be >= 1")
+    rng = random.Random(f"autoscale-trace:{seed}")
+    out: List[TraceRequest] = []
+    at = 0.0
+    for i in range(n):
+        in_crowd = crowd_at <= at < crowd_at + crowd_duration
+        rate = base_rate * (crowd_factor if in_crowd else 1.0)
+        if i:
+            at += rng.expovariate(rate)
+        out.append(TraceRequest(
+            arrival=round(at, 6),
+            prompt_tokens=rng.randint(*prompt_tokens),
+            max_tokens=rng.randint(*max_tokens),
+            temperature=0.0,
+            trace_id=f"flash-{seed}-{i}"))
+    return out
+
+
+def merge_traces(*traces: Sequence[TraceRequest]
+                 ) -> List[TraceRequest]:
+    """Overlay traces on one timeline, sorted by arrival — e.g. a
+    diurnal baseline plus a flash crowd landing mid-cycle."""
+    out: List[TraceRequest] = []
+    for tr in traces:
+        out.extend(tr)
+    out.sort(key=lambda r: r.arrival)
     return out
 
 

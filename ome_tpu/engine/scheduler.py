@@ -146,18 +146,38 @@ class ClassQueues:
     cannot hoard credit and later burst past its share. With a single
     class enqueued — or with ``enabled=False`` — every pick
     degenerates to plain FIFO, which keeps single-class streams
-    byte-identical to the pre-priority scheduler."""
+    byte-identical to the pre-priority scheduler.
+
+    ``classes`` generalizes the rotation beyond the fixed priority
+    enum: the fleet simulator's WDRR-fairness scenarios instantiate
+    hundreds of tenant classes against the SAME pick loop the
+    production scheduler runs. Default (None) keeps the priority
+    enum and the default weight table, bit-for-bit the historical
+    behavior; with explicit classes, ``weights`` maps class -> weight
+    directly (missing classes weigh 1)."""
 
     def __init__(self, maxsize: int, weights=None,
-                 enabled: bool = True):
+                 enabled: bool = True, classes=None):
         self.maxsize = maxsize
         self.enabled = bool(enabled)
-        self.weights = _weights_table(weights)
+        if classes is None:
+            self.classes = PRIORITY_CLASSES
+            self.weights = _weights_table(weights)
+            self._default_class = DEFAULT_PRIORITY
+        else:
+            self.classes = tuple(classes)
+            if not self.classes:
+                raise ValueError("classes must be non-empty")
+            self.weights = {c: max(1, int((weights or {}).get(c, 1)))
+                            for c in self.classes}
+            self._default_class = (DEFAULT_PRIORITY
+                                   if DEFAULT_PRIORITY in self.classes
+                                   else self.classes[0])
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._q: Dict[str, "collections.deque[Request]"] = {
-            c: collections.deque() for c in PRIORITY_CLASSES}
-        self._deficit = {c: 0.0 for c in PRIORITY_CLASSES}
+            c: collections.deque() for c in self.classes}
+        self._deficit = {c: 0.0 for c in self.classes}
         self._cursor = 0
         # True when the cursor has just ARRIVED at a class: the DRR
         # quantum is credited once per arrival, not once per pick —
@@ -168,9 +188,9 @@ class ClassQueues:
 
     def _cls(self, req) -> str:
         if not self.enabled:
-            return DEFAULT_PRIORITY
-        cls = getattr(req, "priority", DEFAULT_PRIORITY)
-        return cls if cls in self._q else DEFAULT_PRIORITY
+            return self._default_class
+        cls = getattr(req, "priority", self._default_class)
+        return cls if cls in self._q else self._default_class
 
     def qsize(self, cls: Optional[str] = None) -> int:
         with self._lock:
@@ -191,7 +211,7 @@ class ClassQueues:
         the `pending.queue` view debug surfaces and tests read."""
         with self._lock:
             out: List[Request] = []
-            for c in PRIORITY_CLASSES:
+            for c in self.classes:
                 out.extend(self._q[c])
             return out
 
@@ -206,9 +226,9 @@ class ClassQueues:
     def _pick_locked(self) -> Optional["Request"]:
         if all(not d for d in self._q.values()):
             return None
-        n = len(PRIORITY_CLASSES)
+        n = len(self.classes)
         while True:
-            cls = PRIORITY_CLASSES[self._cursor % n]
+            cls = self.classes[self._cursor % n]
             dq = self._q[cls]
             if not dq:
                 # an empty class forfeits banked credit (classic DRR)

@@ -12,6 +12,7 @@ from .catalog_drift import FaultCatalogRule, MetricsNamingRule
 from .hot_path_sync import HotPathSyncRule
 from .label_cardinality import MetricsLabelCardinalityRule
 from .lock_discipline import LockDisciplineRule
+from .sim_wall_clock import SimWallClockRule
 from .thread_shared_state import ThreadSharedStateRule
 
 ALL_RULES = (
@@ -22,6 +23,7 @@ ALL_RULES = (
     FaultCatalogRule,
     MetricsNamingRule,
     MetricsLabelCardinalityRule,
+    SimWallClockRule,
 )
 
 
@@ -41,4 +43,4 @@ __all__ = ["ALL_RULES", "rule_names", "make_rule",
            "HotPathSyncRule", "LockDisciplineRule",
            "ThreadSharedStateRule", "AsyncBlockingRule",
            "FaultCatalogRule", "MetricsNamingRule",
-           "MetricsLabelCardinalityRule"]
+           "MetricsLabelCardinalityRule", "SimWallClockRule"]

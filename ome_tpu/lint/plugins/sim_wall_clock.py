@@ -1,0 +1,116 @@
+"""sim-wall-clock: no wall-clock reads on the simulator's event path.
+
+The fleet simulator's whole determinism contract is that every
+timestamp on the event path comes from the injected VirtualClock. One
+stray ``time.monotonic()`` in code the sim shares with production —
+the router's breaker arithmetic, the controller's decision stamps, a
+scheduler queue — silently mixes wall time into virtual time: the run
+still completes, but run-to-run byte-identity is gone and simulated
+breaker cooldowns/staleness windows measure REAL milliseconds against
+VIRTUAL hours.
+
+The function set is REACHABILITY from the sim's event-loop roots
+(SimEngine's admission/chunk events, the SimFleet client, the
+controller tick as the sim schedules it) over the project call graph,
+so shared control-plane code pulled onto the event path is linted
+automatically. Flagged: direct calls to ``time.time``,
+``time.monotonic``, ``time.sleep``, ``time.perf_counter``. The stop
+set names the sanctioned boundaries — the clock module itself and the
+blocking ``ClassQueues.get``, which the sim never calls (events use
+``get_nowait``) but which name-resolution would otherwise pull in.
+
+Suppressions follow the framework's rule: every baseline entry
+carries a mandatory reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from ..callgraph import body_walk
+from ..context import Context
+from ..core import Finding, Project, Rule
+
+ROOT_SPECS = (
+    # the engine-side event callbacks
+    "sim/engine.py::SimEngine.submit",
+    "sim/engine.py::SimEngine._admit",
+    "sim/engine.py::SimEngine._run_chunk",
+    "sim/engine.py::SimEngine._activate",
+    "sim/engine.py::SimEngine.kill",
+    # the fleet-side event callbacks (client, controller tick,
+    # health sweep, pool lifecycle)
+    "sim/fleet.py::SimFleet._client_submit",
+    "sim/fleet.py::SimFleet._request_done",
+    "sim/fleet.py::SimFleet.add_controller",
+    "sim/fleet.py::SimFleet.start_health_loop",
+    "sim/fleet.py::SimPool.spawn",
+    "sim/fleet.py::SimPool.drain_one",
+)
+# sanctioned boundaries: reachability stops here. clock.py holds the
+# virtual time source itself; ClassQueues.get is the BLOCKING api the
+# sim never uses (events go through get_nowait) but that shares a
+# class with it.
+ALLOWED = frozenset(("VirtualClock", "EventLoop", "get"))
+
+_TIME_CALLS = frozenset(("time", "monotonic", "sleep",
+                         "perf_counter", "monotonic_ns", "time_ns"))
+
+
+def wall_clock_label(call: ast.Call) -> str:
+    """Non-empty label when ``call`` reads or waits on wall time."""
+    func = call.func
+    if isinstance(func, ast.Attribute) \
+            and isinstance(func.value, ast.Name) \
+            and func.value.id == "time" \
+            and func.attr in _TIME_CALLS:
+        return f"time.{func.attr}"
+    return ""
+
+
+class SimWallClockRule(Rule):
+    name = "sim-wall-clock"
+    description = ("wall-clock reads (time.time/monotonic/sleep) in "
+                   "functions reachable from the simulator's "
+                   "event-loop roots; sim-path code must use the "
+                   "injected virtual clock")
+
+    def __init__(self, root_specs: Sequence[str] = ROOT_SPECS,
+                 allowed: Sequence[str] = tuple(ALLOWED)):
+        self.root_specs = tuple(root_specs)
+        self.allowed = frozenset(allowed)
+
+    def run(self, project: Project, ctx: Context = None
+            ) -> List[Finding]:
+        ctx = ctx or Context(project)
+        graph = ctx.graph
+        roots: List[str] = []
+        for spec in self.root_specs:
+            roots.extend(graph.resolve_spec(spec))
+        if not roots:
+            return []  # project without the sim package
+        reach = graph.reachable(roots, stop=set(self.allowed))
+        findings: List[Finding] = []
+        for node in sorted(reach):
+            rel, qual = node.split("::", 1)
+            sf = project.file(rel)
+            fn = sf.defs.get(qual) if sf is not None else None
+            if fn is None or isinstance(fn, ast.ClassDef):
+                continue
+            short = qual.rsplit(".", 1)[-1]
+            if short in self.allowed:
+                continue
+            for sub in body_walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                label = wall_clock_label(sub)
+                if label:
+                    findings.append(self.finding(
+                        sf, sub.lineno,
+                        f"{label}(...) in sim-path function "
+                        f"{short!r} mixes wall time into virtual "
+                        "time and breaks run-to-run determinism; "
+                        "read the injected clock instead"))
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings

@@ -330,8 +330,13 @@ class Router:
                  health_interval: float = 10.0,
                  cb_threshold: Optional[int] = None,
                  cb_cooldown: Optional[float] = None,
-                 registry: Optional[Registry] = None):
+                 registry: Optional[Registry] = None,
+                 clock=time.monotonic):
         self.backends = backends
+        # the time source the selection/breaker path reads (pick,
+        # note_result, check_health_once); the simulator injects its
+        # virtual clock so breaker cooldowns elapse in simulated time
+        self._clock = clock
         for b in backends:  # router-level CB settings apply uniformly
             if cb_threshold is not None:
                 b.cb_threshold = cb_threshold
@@ -486,7 +491,7 @@ class Router:
 
     def pick(self, pool: str, affinity_key: str = "",
              exclude: Optional[set] = None) -> Optional[Backend]:
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             alive = [b for b in self.backends
                      if b.pool == pool and b.selectable(now)
@@ -518,7 +523,7 @@ class Router:
                 backend.record_success()
             else:
                 was_open = backend.cb_state == "open"
-                backend.record_failure(time.monotonic())
+                backend.record_failure(self._clock())
                 backend.healthy = False
                 opened = backend.cb_state == "open" and not was_open
         if opened:
@@ -570,7 +575,7 @@ class Router:
             with self._lock:
                 b.healthy = healthy
                 b.draining = draining
-                b.last_checked = time.time()
+                b.last_checked = self._clock()
             if isinstance(info, dict):
                 self.prefix_directory.update(
                     b.url, info.get("prefix_digests"))

@@ -1,0 +1,33 @@
+"""Fleet-in-a-process: a calibrated discrete-event simulator.
+
+ROADMAP item 6 (docs/simulation.md): thousand-replica scenario
+sweeps — WDRR fairness at hundreds of tenant classes, autoscaler
+oscillation under diurnal + flash-crowd load, capacity planning
+against a TTFT SLO — on one CPU, in seconds, deterministically.
+
+The simulator reuses the REAL control-plane code paths:
+
+  * `engine.scheduler.ClassQueues` — the weighted deficit round-robin
+    pick order, byte-for-byte the production implementation;
+  * `router.server.Router` — backend selection, circuit breakers,
+    draining, rendezvous hashing, retry budget;
+  * `autoscale.policy.PoolPolicy` / `autoscale.controller
+    .ScaleController` / `autoscale.scrape.HistogramWindow` — the
+    scrape -> pressure -> decide -> act loop, fed through the same
+    Prometheus text exposition the real controller parses.
+
+Only two things are replaced: the device step (a calibrated cost
+model fitted from the perfgate cost table, `config/cost-table.json`)
+and the wall clock (`sim.clock.VirtualClock` + a seeded event loop).
+Everything downstream — queue-wait, TTFT, per-class SLO reports —
+is derived the same way the real scheduler produces it.
+"""
+
+from .clock import EventLoop, VirtualClock
+from .costmodel import CostModel
+from .engine import SimEngine
+from .fleet import SimFleet, SimPool
+from .transport import SimTransport
+
+__all__ = ["EventLoop", "VirtualClock", "CostModel", "SimEngine",
+           "SimFleet", "SimPool", "SimTransport"]

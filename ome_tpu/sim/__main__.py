@@ -1,0 +1,12 @@
+"""``python -m ome_tpu.sim`` — the scenario runner, same CLI as
+scripts/simulate.py."""
+
+import os
+import runpy
+import sys
+
+_here = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.argv[0] = "simulate"
+runpy.run_path(os.path.join(_here, "scripts", "simulate.py"),
+               run_name="__main__")

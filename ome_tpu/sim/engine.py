@@ -1,0 +1,358 @@
+"""SimEngine: one simulated replica — real control plane, modeled device.
+
+The admission surface, the WDRR pending queue (the REAL
+engine/scheduler.ClassQueues, including its deficit rotation and
+per-class bounds), KV-page accounting, drain semantics, and the
+/metrics exposition are the production code paths or faithful
+transcriptions of their formulas. What is replaced is exactly the
+device: instead of dispatching a compiled decode program, a chunk
+event advances every active slot by ``fused_k`` iterations after
+``CostModel.step_ms`` virtual milliseconds.
+
+Metric families reuse the REAL engine names and bucket layouts
+(``ome_engine_ttft_seconds``, ``ome_engine_queue_wait_seconds``, the
+per-class pair, the queue-depth and KV-utilization gauges), so the
+autoscale controller's scrape loop — windows, per-class SLO keying,
+pressure formula — runs UNMODIFIED against a simulated replica.
+
+Everything here is event-driven on the injected virtual clock; no
+code on this path may read wall time (the sim-wall-clock lint rule
+enforces that transitively).
+"""
+
+from __future__ import annotations
+
+import math
+import queue
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..engine.scheduler import ClassQueues
+from ..priority import DEFAULT_PRIORITY, PRIORITY_CLASSES
+from ..telemetry import Registry
+from .clock import EventLoop, VirtualClock
+from .costmodel import CostModel
+
+# same buckets as telemetry.registry DEFAULT_BUCKETS / the real
+# engine's latency histograms — the controller's windowed-quantile
+# estimator interpolates inside these exact bounds on both sides of
+# the fidelity gate
+_LATENCY_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                    1.0, 2.5, 5.0, 10.0)
+
+
+@dataclass
+class SimRequest:
+    """The simulator's request record. Carries the same lifecycle
+    timestamps as engine/scheduler.Request (created -> scheduled ->
+    first token -> finished) but in VIRTUAL seconds, set directly by
+    events — never via Request.emit/finish, which read wall time."""
+
+    prompt_tokens: int
+    max_new_tokens: int
+    priority: str = DEFAULT_PRIORITY
+    temperature: float = 0.0
+    trace_id: Optional[str] = None
+    arrival: float = 0.0
+    prompt: str = ""
+    # lifecycle (virtual seconds)
+    created: float = 0.0
+    scheduled_at: Optional[float] = None
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    output_tokens: int = 0
+    finish_reason: Optional[str] = None
+    status: Optional[int] = None
+    failovers: int = 0
+    # device-side progress in fractional tokens (spec decode yields
+    # >1 token per iteration in expectation)
+    _progress: float = field(default=0.0, repr=False)
+    _pages: int = field(default=0, repr=False)
+
+
+class SimEngine:
+    """One simulated serving replica on a shared clock + event loop.
+
+    ``classes``/``class_weights`` pass straight through to the real
+    ClassQueues — the WDRR fairness scenarios instantiate hundreds of
+    tenant classes against the production pick loop.
+    """
+
+    def __init__(self, name: str, clock: VirtualClock, loop: EventLoop,
+                 cost: CostModel, *,
+                 max_slots: int = 8, kv_pages: int = 256,
+                 kv_block: int = 16, max_pending: int = 512,
+                 fused_k: int = 1, spec_accept: float = 0.0,
+                 classes=None, class_weights=None,
+                 on_finish: Optional[Callable[["SimRequest"], None]]
+                 = None):
+        self.name = name
+        self.clock = clock
+        self.loop = loop
+        self.cost = cost
+        self.max_slots = max(int(max_slots), 1)
+        self.kv_pages = max(int(kv_pages), 1)
+        self.kv_block = max(int(kv_block), 1)
+        self.fused_k = max(int(fused_k), 1)
+        self.spec_accept = float(spec_accept)
+        self.on_finish = on_finish
+        self.pending = ClassQueues(max_pending, weights=class_weights,
+                                   classes=classes)
+        self.active: List[SimRequest] = []
+        self.pages_used = 0
+        # one popped-but-unplaceable request parks here until pages
+        # free up, preserving the WDRR pick the queue already made
+        self._stalled: Optional[SimRequest] = None
+        self.draining = False
+        self.killed = False
+        self._on_drained: Optional[Callable[[], None]] = None
+        self._chunk_event = None
+        self.stats: Dict[str, int] = {
+            "requests_total": 0, "rejected_total": 0,
+            "tokens_generated_total": 0, "chunks_total": 0}
+        self._per_class_tokens: Dict[str, int] = {}
+        self._build_metrics()
+
+    # -- metrics (the controller's scrape surface) ---------------------
+
+    def _build_metrics(self) -> None:
+        R = self.registry = Registry()
+        self._c_requests = R.counter(
+            "ome_engine_requests_total",
+            "Requests submitted to the scheduler")
+        self._c_rejected = R.counter(
+            "ome_engine_rejected_total",
+            "Requests rejected at admission (429)")
+        self._c_tokens = R.counter(
+            "ome_engine_tokens_generated_total",
+            "Decode tokens emitted across requests")
+        self._h_ttft = R.histogram(
+            "ome_engine_ttft_seconds",
+            "Time to first token (admission to first emit)",
+            buckets=_LATENCY_BUCKETS)
+        self._h_queue_wait = R.histogram(
+            "ome_engine_queue_wait_seconds",
+            "Seconds between admission and first decode slot",
+            buckets=_LATENCY_BUCKETS)
+        self._h_e2e = R.histogram(
+            "ome_engine_e2e_seconds",
+            "End-to-end request seconds (admission to finish)",
+            buckets=_LATENCY_BUCKETS)
+        self._g_depth = R.gauge(
+            "ome_engine_queue_depth", "Pending-queue depth")
+        self._g_active = R.gauge(
+            "ome_engine_active_slots", "Occupied decode slots")
+        self._g_kv = R.gauge(
+            "ome_engine_kv_block_utilization_ratio",
+            "Fraction of the paged-KV block pool in use")
+        # per-class children pre-created for the fixed priority enum
+        # ONLY (bounded label cardinality by construction); tenant-
+        # class scenarios beyond the enum get no per-class children
+        def _by_class(fam):
+            return {c: fam.labels(**{"class": c})
+                    for c in PRIORITY_CLASSES}
+        self._h_class_ttft = _by_class(R.histogram(
+            "ome_engine_class_ttft_seconds",
+            "Time to first token, by priority class",
+            labelnames=("class",), buckets=_LATENCY_BUCKETS))
+        self._h_class_queue_wait = _by_class(R.histogram(
+            "ome_engine_class_queue_wait_seconds",
+            "Admission-to-first-slot seconds, by priority class",
+            labelnames=("class",), buckets=_LATENCY_BUCKETS))
+        self._c_sim_chunks = R.counter(
+            "ome_sim_chunks_total",
+            "Fused decode chunks executed by the simulated device")
+
+    def metrics_text(self) -> str:
+        """The /metrics body a scrape would see, gauges refreshed at
+        scrape time exactly like the real engine's update_gauges."""
+        self._g_depth.set(self.pending.qsize()
+                          + (1 if self._stalled is not None else 0))
+        self._g_active.set(len(self.active))
+        self._g_kv.set(self.pages_used / self.kv_pages)
+        return self.registry.render()
+
+    def ready_info(self) -> dict:
+        return {"ready": not self.draining and not self.killed,
+                "draining": self.draining}
+
+    # -- admission (mirrors scheduler.submit's shed ladder) ------------
+
+    def submit(self, req: SimRequest) -> int:
+        """Admit a request; returns the HTTP-ish status the real
+        serve layer would answer (200 admitted, 503 draining, 429
+        overloaded)."""
+        if self.killed:
+            raise OSError(f"sim engine {self.name} is down")
+        if self.draining:
+            return 503
+        req.created = self.clock.now()
+        try:
+            self.pending.put_nowait(req)
+        except queue.Full:
+            self.stats["rejected_total"] += 1
+            self._c_rejected.inc()
+            return 429
+        self.stats["requests_total"] += 1
+        self._c_requests.inc()
+        self._admit()
+        return 200
+
+    def _request_pages(self, req: SimRequest) -> int:
+        return max(1, math.ceil(
+            (req.prompt_tokens + req.max_new_tokens) / self.kv_block))
+
+    def _admit(self) -> None:
+        """Fill free slots from the WDRR queue while KV pages last.
+        Each admitted request schedules its own prefill-completion
+        event; decode chunks pick the slot up afterwards."""
+        if self.killed:
+            return
+        now = self.clock.now()
+        while len(self.active) < self.max_slots:
+            req = self._stalled
+            self._stalled = None
+            if req is None:
+                try:
+                    req = self.pending.get_nowait()
+                except queue.Empty:
+                    break
+            pages = self._request_pages(req)
+            if self.pages_used + pages > self.kv_pages:
+                self._stalled = req  # wait for a slot to free pages
+                break
+            req._pages = pages
+            self.pages_used += pages
+            req.scheduled_at = now
+            wait = now - req.created
+            self._h_queue_wait.observe(wait)
+            hq = self._h_class_queue_wait.get(req.priority)
+            if hq is not None:
+                hq.observe(wait)
+            self.loop.call_later(
+                self.cost.prefill_ms(req.prompt_tokens) / 1000.0,
+                lambda r=req: self._activate(r))
+            self.active.append(req)
+        self._maybe_drained()
+
+    def _activate(self, req: SimRequest) -> None:
+        """Prefill finished: the first token emits, the slot joins
+        the decode batch from the next chunk on."""
+        if self.killed or req.finish_reason is not None:
+            return
+        now = self.clock.now()
+        req.first_token_at = now
+        req.output_tokens = 1
+        req._progress = 1.0
+        self.stats["tokens_generated_total"] += 1
+        self._c_tokens.inc()
+        ttft = now - req.created
+        self._h_ttft.observe(ttft)
+        ht = self._h_class_ttft.get(req.priority)
+        if ht is not None:
+            ht.observe(ttft)
+        if req.max_new_tokens <= 1:
+            self._finish(req, "stop")
+        self._schedule_chunk()
+
+    # -- the modeled device --------------------------------------------
+
+    def _schedule_chunk(self) -> None:
+        if self._chunk_event is not None or self.killed:
+            return
+        batch = [r for r in self.active if r.first_token_at is not None
+                 and r.finish_reason is None]
+        if not batch:
+            return
+        pages = float(sum(r._pages for r in batch))
+        dt = self.cost.step_ms(len(batch), pages=pages,
+                               fused_k=self.fused_k,
+                               spec_accept=self.spec_accept) / 1000.0
+        self._chunk_event = self.loop.call_later(dt, self._run_chunk)
+
+    def _run_chunk(self) -> None:
+        self._chunk_event = None
+        if self.killed:
+            return
+        self.stats["chunks_total"] += 1
+        self._c_sim_chunks.inc()
+        gained = self.fused_k * self.cost.tokens_per_iteration(
+            self.spec_accept)
+        for req in list(self.active):
+            if req.first_token_at is None \
+                    or req.finish_reason is not None:
+                continue
+            before = req.output_tokens
+            req._progress = min(req._progress + gained,
+                                float(req.max_new_tokens))
+            req.output_tokens = int(req._progress)
+            emitted = req.output_tokens - before
+            if emitted > 0:
+                self.stats["tokens_generated_total"] += emitted
+                self._c_tokens.inc(emitted)
+                tc = self._per_class_tokens
+                tc[req.priority] = tc.get(req.priority, 0) + emitted
+            if req.output_tokens >= req.max_new_tokens:
+                self._finish(req, "stop")
+        self._admit()
+        self._schedule_chunk()
+
+    def _finish(self, req: SimRequest, reason: str) -> None:
+        if req.finish_reason is not None:
+            return
+        req.finish_reason = reason
+        req.status = 200 if reason == "stop" else 599
+        req.finished_at = self.clock.now()
+        self._h_e2e.observe(req.finished_at - req.created)
+        if req in self.active:
+            self.active.remove(req)
+            self.pages_used -= req._pages
+        if self.on_finish is not None:
+            self.on_finish(req)
+        self._maybe_drained()
+
+    # -- lifecycle ------------------------------------------------------
+
+    def drain(self, on_drained: Optional[Callable[[], None]]
+              = None) -> None:
+        """Graceful drain: stop admitting, finish in-flight + queued
+        work, then fire ``on_drained`` (the SimPool's deregistration
+        hook) — the same contract as the real SIGTERM drain."""
+        self.draining = True
+        self._on_drained = on_drained
+        self._maybe_drained()
+
+    def _maybe_drained(self) -> None:
+        if (self.draining and not self.active
+                and self.pending.empty() and self._stalled is None
+                and self._on_drained is not None):
+            cb, self._on_drained = self._on_drained, None
+            cb()
+
+    def kill(self) -> None:
+        """Abrupt death (chaos): every in-flight and queued request
+        fails; probes and scrapes start raising at the transport."""
+        self.killed = True
+        victims = list(self.active)
+        if self._stalled is not None:
+            victims.append(self._stalled)
+            self._stalled = None
+        while True:
+            try:
+                victims.append(self.pending.get_nowait())
+            except queue.Empty:
+                break
+        self.active = []
+        self.pages_used = 0
+        for req in victims:
+            req.finish_reason = "killed"
+            req.status = 599
+            req.finished_at = self.clock.now()
+            if self.on_finish is not None:
+                self.on_finish(req)
+
+    def tokens_by_class(self) -> Dict[str, int]:
+        """Decode tokens served per class (ALL classes, including
+        tenant classes beyond the metric enum) — the WDRR fairness
+        scenarios' measurement surface."""
+        return dict(self._per_class_tokens)

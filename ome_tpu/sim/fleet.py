@@ -1,0 +1,339 @@
+"""SimFleet: N simulated replicas behind the REAL router and the
+REAL autoscale controller, on one virtual clock.
+
+The router here is the production ``router.server.Router`` — its
+rendezvous/round-robin selection, per-backend circuit breakers, and
+health sweep run unmodified; only the probe goes through the
+in-process transport and the clock is the virtual one. Likewise the
+controller is the production ``ScaleController``: its scrape windows,
+per-class SLO keying, pressure formula, and hysteresis policy all run
+against simulated /metrics bodies, driven by event-loop ticks instead
+of a thread.
+
+The client side mirrors the router HTTP handler's forwarding
+discipline in miniature: pick with prefix affinity, fail over on
+transport errors while the retry budget allows, never retry once a
+status arrived, count draining answers as deliberate (note_draining,
+no breaker penalty).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..autoscale.controller import ScaleController, SLOConfig
+from ..autoscale.policy import PolicyConfig, PoolPolicy
+from ..autoscale.pool import DrainRecord
+from ..autoscale.replay import ReplayResult
+from ..autoscale.trace import TraceRequest
+from ..router.server import Backend, RetryBudget, Router
+from ..telemetry import Registry
+from .clock import EventLoop, VirtualClock
+from .costmodel import CostModel
+from .engine import SimEngine, SimRequest
+from .transport import SimTransport
+
+_MAX_ATTEMPTS = 3  # pick + up to two failovers, like replay's fronts
+
+
+class SimRouter(Router):
+    """The real Router with its probe routed through the transport
+    and its clock virtual. Everything else — selection policies,
+    breakers, retry budget, gauges — is inherited production code."""
+
+    def __init__(self, transport, clock, **kw):
+        super().__init__([], clock=clock, **kw)
+        self._transport = transport
+
+    def _probe_backend(self, b: Backend):
+        return self._transport.probe(b.url)
+
+
+@dataclass
+class SimPoolMember:
+    name: str
+    url: str
+    engine: SimEngine
+    started_at: float
+    ready: bool = False
+    draining: bool = False
+
+
+class SimPool:
+    """EnginePool's controller-facing surface over simulated
+    replicas: size()/member_urls()/draining_count()/spawn()/
+    drain_one()/engine_seconds()/journals()/drains — the duck type
+    ScaleController.tick drives. Spawn readiness and drains happen in
+    virtual time; registration follows the real pool's discipline
+    (never enters rotation before it can serve, DELETEd only after
+    the drain completed)."""
+
+    def __init__(self, name: str, fleet: "SimFleet",
+                 spawn_delay: float = 2.0):
+        self.name = name
+        self.fleet = fleet
+        self.spawn_delay = float(spawn_delay)
+        self.members: List[SimPoolMember] = []
+        self.drains: List[DrainRecord] = []
+        self._seq = 0
+        self._engine_seconds = 0.0
+
+    # -- observation ---------------------------------------------------
+
+    def size(self) -> int:
+        return sum(1 for m in self.members if not m.draining)
+
+    def member_urls(self) -> List[str]:
+        return [m.url for m in self.members
+                if m.ready and not m.draining]
+
+    def draining_count(self) -> int:
+        return sum(1 for m in self.members if m.draining)
+
+    def journals(self) -> List:
+        return []  # durability is out of sim scope (docs/simulation.md)
+
+    def engine_seconds(self) -> float:
+        now = self.fleet.clock.now()
+        live = sum(now - m.started_at for m in self.members)
+        return self._engine_seconds + live
+
+    # -- scale up -------------------------------------------------------
+
+    def spawn(self) -> SimPoolMember:
+        self._seq += 1
+        name = f"{self.name}{self._seq}"
+        url = f"sim://{name}"
+        member = SimPoolMember(
+            name=name, url=url,
+            engine=self.fleet.new_engine(name, url),
+            started_at=self.fleet.clock.now())
+        self.members.append(member)
+        if self.spawn_delay > 0:
+            self.fleet.loop.call_later(
+                self.spawn_delay, lambda: self._ready(member))
+        else:
+            self._ready(member)
+        return member
+
+    def _ready(self, member: SimPoolMember) -> None:
+        if member.draining or member.ready:
+            return
+        member.ready = True
+        self.fleet.transport.register(member.url, member.engine)
+        self.fleet.router.add_backend(member.url, pool=self.name)
+
+    # -- scale down -----------------------------------------------------
+
+    def drain_one(self) -> Optional[str]:
+        victim: Optional[SimPoolMember] = None
+        for m in reversed(self.members):
+            if not m.draining:
+                victim = m
+                break
+        if victim is None:
+            return None
+        victim.draining = True
+        victim.engine.drain(
+            on_drained=lambda: self._finish_drain(victim))
+        return victim.name
+
+    def _finish_drain(self, member: SimPoolMember) -> None:
+        if member.ready:
+            self.fleet.router.remove_backend(member.url)
+            self.fleet.transport.forget(member.url)
+        now = self.fleet.clock.now()
+        if member in self.members:
+            self.members.remove(member)
+            self._engine_seconds += now - member.started_at
+        self.drains.append(DrainRecord(
+            name=member.name, url=member.url, ok=True))
+
+    def join_drains(self, timeout: float = 0.0) -> None:
+        pass  # drains complete inside the event loop
+
+    def stop_all(self) -> None:
+        pass
+
+
+class SimFleet:
+    """The harness: clock + loop + transport + router + pool (+
+    optionally the controller), plus the open-loop client that plays
+    a trace through the router."""
+
+    def __init__(self, cost: CostModel, *, seed: int = 0,
+                 policy: str = "round_robin",
+                 health_interval: float = 2.0,
+                 spawn_delay: float = 2.0,
+                 engine_kw: Optional[dict] = None):
+        self.cost = cost
+        self.seed = seed
+        self.clock = VirtualClock()
+        self.loop = EventLoop(self.clock)
+        self.transport = SimTransport()
+        self.engine_kw = dict(engine_kw or {})
+        self.router = SimRouter(self.transport, self.clock,
+                                policy=policy,
+                                health_interval=health_interval)
+        self.pool = SimPool("engine", self, spawn_delay=spawn_delay)
+        self.controller: Optional[ScaleController] = None
+        self.retry_budget = RetryBudget()
+        self.results: List[ReplayResult] = []
+        self._inflight: Dict[int, tuple] = {}
+        self.registry = Registry()
+        self._g_virtual = self.registry.gauge(
+            "ome_sim_virtual_seconds",
+            "Current virtual-clock reading of the simulation")
+        self._c_events = self.registry.counter(
+            "ome_sim_events_total",
+            "Events executed by the simulation loop")
+
+    # -- topology -------------------------------------------------------
+
+    def new_engine(self, name: str, url: str) -> SimEngine:
+        return SimEngine(
+            name, self.clock, self.loop, self.cost,
+            on_finish=lambda r, u=url: self._request_done(u, r),
+            **self.engine_kw)
+
+    def add_engines(self, n: int) -> None:
+        """Pre-provision n replicas, ready immediately (t=0 fleets
+        skip the spawn delay — there is nothing to warm)."""
+        delay, self.pool.spawn_delay = self.pool.spawn_delay, 0.0
+        try:
+            for _ in range(n):
+                self.pool.spawn()
+        finally:
+            self.pool.spawn_delay = delay
+
+    def add_controller(self, policy_cfg: PolicyConfig,
+                       slo: Optional[SLOConfig] = None,
+                       interval: float = 1.0) -> ScaleController:
+        self.controller = ScaleController(
+            {self.pool.name: self.pool},
+            {self.pool.name: PoolPolicy(policy_cfg)},
+            slo or SLOConfig(),
+            fetch_fn=self.transport.fetch_metrics,
+            interval=interval, clock=self.clock)
+
+        def tick():
+            self.controller.tick()
+            self.loop.call_later(interval, tick)
+        self.loop.call_later(interval, tick)
+        return self.controller
+
+    def start_health_loop(self) -> None:
+        def sweep():
+            self.router.check_health_once()
+            self.loop.call_later(self.router.health_interval, sweep)
+        self.loop.call_later(self.router.health_interval, sweep)
+
+    def kill_backend(self, url: str) -> None:
+        eng = self.transport.engine(url)
+        if eng is not None:
+            eng.kill()
+
+    # -- the open-loop client -------------------------------------------
+
+    def submit_trace(self, trace: List[TraceRequest]) -> None:
+        for t in trace:
+            self.loop.call_at(
+                t.arrival, lambda t=t: self._client_submit(t))
+
+    def _client_submit(self, t: TraceRequest,
+                       failovers: int = 0,
+                       exclude: Optional[set] = None) -> None:
+        now = self.clock.now()
+        result = ReplayResult(
+            trace_id=t.trace_id, arrival=t.arrival,
+            prompt=t.prompt or "", max_tokens=t.max_tokens,
+            temperature=t.temperature, priority=t.priority,
+            failovers=failovers)
+        affinity = (t.prompt or t.prompt_text(self.seed))[:256]
+        backend = self.router.pick(self.pool.name,
+                                   affinity_key=affinity,
+                                   exclude=exclude)
+        if backend is None:
+            result.status = 503
+            result.error = "no backend available"
+            self.results.append(result)
+            return
+        req = SimRequest(
+            prompt_tokens=t.prompt_tokens,
+            max_new_tokens=t.max_tokens,
+            priority=t.priority or "standard",
+            temperature=t.temperature, trace_id=t.trace_id,
+            arrival=t.arrival, prompt=affinity)
+        try:
+            status = self.transport.submit(backend.url, req)
+        except OSError as e:
+            self.router.note_result(backend, ok=False)
+            if (failovers + 1 < _MAX_ATTEMPTS
+                    and self.retry_budget.withdraw()):
+                ex = set(exclude or ())
+                ex.add(backend.url)
+                self._client_submit(t, failovers + 1, ex)
+            else:
+                result.status = 502
+                result.error = f"{type(e).__name__}: {e}"
+                self.results.append(result)
+            return
+        self.retry_budget.deposit()
+        if status == 503:
+            # deliberate drain answer: out of rotation, no penalty
+            self.router.note_draining(backend)
+            if failovers + 1 < _MAX_ATTEMPTS:
+                ex = set(exclude or ())
+                ex.add(backend.url)
+                self._client_submit(t, failovers + 1, ex)
+            else:
+                result.status = 503
+                result.error = "backend draining"
+                self.results.append(result)
+            return
+        if status != 200:
+            result.status = status
+            result.error = f"admission answered {status}"
+            self.results.append(result)
+            return
+        self.router.adjust_inflight(backend, 1)
+        self._inflight[id(req)] = (backend, result, now)
+
+    def _request_done(self, url: str, req: SimRequest) -> None:
+        entry = self._inflight.pop(id(req), None)
+        if entry is None:
+            return
+        backend, result, t0 = entry
+        self.router.adjust_inflight(backend, -1)
+        ok = req.finish_reason == "stop"
+        self.router.note_result(backend, ok=ok)
+        result.status = req.status
+        result.output_tokens = req.output_tokens
+        result.finish_reason = req.finish_reason
+        if not ok:
+            result.error = "backend died mid-request"
+        if req.first_token_at is not None:
+            result.ttft_s = round(req.first_token_at - t0, 6)
+        if req.finished_at is not None:
+            result.e2e_s = round(req.finished_at - t0, 6)
+            if req.first_token_at is not None \
+                    and req.output_tokens > 1:
+                result.tpot_s = round(
+                    (req.finished_at - req.first_token_at)
+                    / (req.output_tokens - 1), 6)
+        self.results.append(result)
+
+    # -- running --------------------------------------------------------
+
+    def run_until(self, t_end: float) -> None:
+        self.loop.run_until(t_end)
+        self._g_virtual.set(self.clock.now())
+        self._c_events.inc(self.loop.executed - self._c_events.value)
+
+    def sim_stats(self) -> dict:
+        return {"virtual_seconds": round(self.clock.now(), 6),
+                "events": self.loop.executed,
+                "engines_spawned": self.pool._seq,
+                "engine_seconds": round(
+                    self.pool.engine_seconds(), 3)}

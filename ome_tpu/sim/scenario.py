@@ -1,0 +1,275 @@
+"""Canned simulation scenarios + the report plumbing.
+
+Each scenario builds a SimFleet, plays a seeded trace through it on
+virtual time, and folds the results through the REAL
+``autoscale.replay.report`` — so a simulated run and a live replay
+emit the same per-class SLO report shape and are directly
+comparable.
+
+Reports are serialized through ``canonical_json`` (sorted keys, no
+whitespace): the fixed-seed smoke test asserts two runs of the same
+scenario are BYTE-identical, which is the determinism contract the
+whole simulator is built around.
+
+The two fleet-scale regressions the ISSUE pinned live here:
+
+  * ``wdrr_fairness`` — hundreds of tenant classes through the real
+    ClassQueues deficit rotation; served tokens must track the
+    weight shares.
+  * ``autoscale_stability`` — a diurnal baseline with a flash crowd
+    on top; the controller must follow the load up and down WITHOUT
+    flapping (no up/down pair within a stability window).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from ..autoscale import replay as replay_mod
+from ..autoscale import trace as trace_mod
+from ..autoscale.controller import SLOConfig
+from ..autoscale.policy import PolicyConfig
+from .clock import EventLoop, VirtualClock
+from .costmodel import CostModel
+from .engine import SimEngine, SimRequest
+from .fleet import SimFleet
+
+
+def canonical_json(doc: dict) -> str:
+    """The byte-identity serialization the determinism smoke
+    compares: sorted keys, minimal separators, newline-terminated."""
+    return json.dumps(doc, sort_keys=True,
+                      separators=(",", ":")) + "\n"
+
+
+def default_cost_model(path: Optional[str] = None,
+                       mode: Optional[str] = None) -> CostModel:
+    if path:
+        return CostModel.load(path, mode=mode)
+    # synthetic fallback so scenarios run without a checked-in table
+    return CostModel(weights_ms=4.3, attn_ms=1.3, dispatch_ms=2.8,
+                     prefill_ms_per_token=0.031)
+
+
+# -- steady-state replay ----------------------------------------------
+
+
+def run_steady(seed: int = 0, engines: int = 2, requests: int = 200,
+               cost: Optional[CostModel] = None,
+               base_rate: float = 8.0,
+               settle_s: float = 60.0, **engine_kw) -> dict:
+    """Fixed-size fleet, bursty synthetic trace, no autoscaler — the
+    baseline scenario (and the perf harness when scaled up)."""
+    cost = cost or default_cost_model()
+    fleet = SimFleet(cost, seed=seed,
+                     engine_kw=dict({"max_slots": 4,
+                                     "kv_pages": 512,
+                                     "fused_k": 4}, **engine_kw))
+    fleet.add_engines(engines)
+    fleet.start_health_loop()
+    tr = trace_mod.synthetic_trace(seed, n=requests,
+                                   base_rate=base_rate)
+    fleet.submit_trace(tr)
+    horizon = (max(r.arrival for r in tr) if tr else 0.0) + settle_s
+    fleet.run_until(horizon)
+    rep = replay_mod.report(fleet.results, slo_ttft_s=2.0)
+    rep["scenario"] = "steady"
+    rep["engines"] = engines
+    rep["sim"] = fleet.sim_stats()
+    return rep
+
+
+# -- autoscaler stability under diurnal + flash crowd -----------------
+
+
+def oscillation_pairs(decisions: List[dict],
+                      window_ticks: int = 5) -> int:
+    """Count up/down action pairs landing within ``window_ticks`` of
+    each other — the flap metric. A controller tracking a diurnal
+    swing acts repeatedly, but opposite-direction actions in quick
+    succession mean it is fighting its own last decision."""
+    acts = [(d["tick"], 1 if d["target"] > d["size"] else -1)
+            for d in decisions if d["target"] != d["size"]]
+    flaps = 0
+    for (t0, d0), (t1, d1) in zip(acts, acts[1:]):
+        if d0 != d1 and (t1 - t0) <= window_ticks:
+            flaps += 1
+    return flaps
+
+
+def run_autoscale(seed: int = 0, cost: Optional[CostModel] = None,
+                  min_engines: int = 1, max_engines: int = 4,
+                  interval: float = 1.0,
+                  period_s: float = 60.0, cycles: float = 2.0,
+                  crowd_at: float = 95.0,
+                  crowd_factor: float = 8.0,
+                  settle_s: float = 45.0) -> dict:
+    """Diurnal baseline + flash crowd through the REAL controller:
+    scrape -> windows -> pressure -> hysteresis policy -> spawn/drain,
+    all on virtual time. The report carries the full decision log
+    and the oscillation metric the stability regression asserts on."""
+    cost = cost or default_cost_model()
+    fleet = SimFleet(cost, seed=seed, health_interval=2.0,
+                     spawn_delay=2.0,
+                     engine_kw={"max_slots": 2, "kv_pages": 96,
+                                "kv_block": 16, "fused_k": 1})
+    fleet.add_engines(min_engines)
+    fleet.start_health_loop()
+    fleet.add_controller(
+        PolicyConfig(min_size=min_engines, max_size=max_engines,
+                     up_stable_ticks=2, down_stable_ticks=8,
+                     cooldown_ticks=4, down_threshold=0.3),
+        SLOConfig(ttft_p99_s=2.0, queue_wait_p99_s=1.0,
+                  queue_depth_high=4.0),
+        interval=interval)
+    tr = trace_mod.merge_traces(
+        trace_mod.diurnal_trace(seed, n=900, period_s=period_s,
+                                cycles=cycles, base_rate=1.0,
+                                peak_factor=10.0,
+                                prompt_tokens=(16, 64),
+                                max_tokens=(32, 64)),
+        trace_mod.flash_crowd_trace(seed + 1, n=150,
+                                    base_rate=0.5,
+                                    crowd_at=crowd_at,
+                                    crowd_duration=8.0,
+                                    crowd_factor=crowd_factor,
+                                    prompt_tokens=(16, 64),
+                                    max_tokens=(24, 48)))
+    fleet.submit_trace(tr)
+    horizon = max(r.arrival for r in tr) + settle_s
+    fleet.run_until(horizon)
+    rep = replay_mod.report(fleet.results, slo_ttft_s=2.0)
+    rep["scenario"] = "autoscale"
+    decisions = [d.to_dict() for d in fleet.controller.decisions]
+    rep["decisions"] = decisions
+    actions = [d for d in decisions if d["target"] != d["size"]]
+    rep["scale_ups"] = sum(1 for d in actions
+                           if d["target"] > d["size"])
+    rep["scale_downs"] = sum(1 for d in actions
+                             if d["target"] < d["size"])
+    rep["oscillation_pairs"] = oscillation_pairs(decisions)
+    rep["final_size"] = fleet.pool.size()
+    rep["sim"] = fleet.sim_stats()
+    return rep
+
+
+# -- WDRR fairness at fleet-tenant class counts -----------------------
+
+
+def run_wdrr_fairness(seed: int = 0, n_classes: int = 120,
+                      tokens_each: int = 16,
+                      cost: Optional[CostModel] = None,
+                      rotations: float = 10.0) -> dict:
+    """Saturate ONE simulated engine with ``n_classes`` tenant
+    classes (weights cycling 1/2/4/8) through the real ClassQueues
+    WDRR rotation, closed-loop: every finished request immediately
+    resubmits under the same class, so EVERY class stays backlogged
+    — the regime Shreedhar & Varghese fairness applies to. After
+    ``rotations`` full deficit rotations' worth of service, the
+    served-token share per weight tier must match the weight share;
+    the report carries the worst relative error."""
+    cost = cost or default_cost_model()
+    classes = [f"tenant-{i:03d}" for i in range(n_classes)]
+    weights = {c: (1, 2, 4, 8)[i % 4]
+               for i, c in enumerate(classes)}
+    clock = VirtualClock()
+    loop = EventLoop(clock)
+    # per-class backlog must EXCEED the largest per-visit credit
+    # (w_max x QUANTUM_TOKENS / cost requests), else a visit drains
+    # the class to empty, it forfeits its deficit, and every class
+    # degenerates to one-queue-flush-per-rotation (equal shares)
+    from ..engine.scheduler import QUANTUM_TOKENS
+    depth = (8 * QUANTUM_TOKENS) // tokens_each + 8
+    eng = SimEngine("wdrr", clock, loop, cost,
+                    max_slots=16, kv_pages=100000, kv_block=16,
+                    max_pending=depth + 8,
+                    fused_k=8, classes=classes,
+                    class_weights=weights)
+
+    def resubmit(req):
+        # closed loop: the class replaces its served request, so the
+        # backlog never drains and shares converge to the weights
+        eng.submit(SimRequest(
+            prompt_tokens=8, max_new_tokens=tokens_each,
+            priority=req.priority))
+    eng.on_finish = resubmit
+    for c in classes:
+        for j in range(depth):
+            eng.submit(SimRequest(
+                prompt_tokens=8, max_new_tokens=tokens_each,
+                priority=c, trace_id=f"{c}-{j}"))
+    # one full rotation serves sum(weight) x QUANTUM_TOKENS tokens
+    target = rotations * sum(weights.values()) * QUANTUM_TOKENS
+    t = 0.0
+    while sum(eng.tokens_by_class().values()) < target \
+            and loop.pending():
+        t += 5.0
+        loop.run_until(t)
+    by_class = eng.tokens_by_class()
+    tier_tokens: Dict[int, float] = {}
+    tier_count: Dict[int, int] = {}
+    for c in classes:
+        w = weights[c]
+        tier_tokens[w] = tier_tokens.get(w, 0.0) + by_class.get(c, 0)
+        tier_count[w] = tier_count.get(w, 0) + 1
+    total_served = sum(tier_tokens.values())
+    total_weight = sum(weights.values())
+    tiers = {}
+    worst = 0.0
+    for w in sorted(tier_tokens):
+        # expected share of service for ONE class of weight w
+        expected = w / total_weight
+        got = (tier_tokens[w] / tier_count[w]) / total_served
+        err = abs(got / expected - 1.0)
+        worst = max(worst, err)
+        tiers[str(w)] = {"classes": tier_count[w],
+                         "tokens": round(tier_tokens[w], 1),
+                         "share_per_class": round(got, 5),
+                         "expected_share": round(expected, 5),
+                         "rel_error": round(err, 4)}
+    return {"scenario": "wdrr_fairness", "n_classes": n_classes,
+            "served_tokens": round(total_served, 1),
+            "tiers": tiers, "worst_rel_error": round(worst, 4),
+            "virtual_seconds": round(clock.now(), 6),
+            "events": loop.executed}
+
+
+# -- fleet-scale throughput (the perf acceptance) ---------------------
+
+
+def run_fleet_scale(seed: int = 0, engines: int = 1000,
+                    requests: int = 50000, duration_s: float = 120.0,
+                    cost: Optional[CostModel] = None) -> dict:
+    """1,000 engines x 50k requests: the perf acceptance scenario.
+    Round-robin router, health sweeps, no controller (scraping a
+    thousand registries is a dashboard's job, not the replay's).
+    Wall-clock budget is measured by the caller; this function is
+    pure virtual time."""
+    cost = cost or default_cost_model()
+    fleet = SimFleet(cost, seed=seed, policy="round_robin",
+                     health_interval=30.0,
+                     engine_kw={"max_slots": 8, "kv_pages": 1024,
+                                "fused_k": 4})
+    fleet.add_engines(engines)
+    fleet.start_health_loop()
+    rate = requests / duration_s
+    tr = trace_mod.synthetic_trace(seed, n=requests, base_rate=rate,
+                                   burst_factor=2.0,
+                                   prompt_tokens=(4, 16),
+                                   max_tokens=(4, 12))
+    fleet.submit_trace(tr)
+    fleet.run_until(max(r.arrival for r in tr) + 60.0)
+    rep = replay_mod.report(fleet.results, slo_ttft_s=2.0)
+    rep["scenario"] = "fleet_scale"
+    rep["engines"] = engines
+    rep["sim"] = fleet.sim_stats()
+    return rep
+
+
+SCENARIOS = {
+    "steady": run_steady,
+    "autoscale": run_autoscale,
+    "wdrr": run_wdrr_fairness,
+    "fleet": run_fleet_scale,
+}

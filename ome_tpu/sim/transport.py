@@ -1,0 +1,64 @@
+"""In-process transport shim: the simulator's network.
+
+Maps fake backend URLs to SimEngines and presents the THREE surfaces
+the real control plane consumes over HTTP, with identical signatures
+and failure modes, so the router's health loop and the autoscale
+controller's scrape loop run unmodified:
+
+  * ``fetch_metrics(url)`` — the controller's ``fetch_fn``: renders
+    the engine's registry to the Prometheus text exposition and
+    parses it back through the REAL ``scrape.parse_exposition``, so
+    the bytes crossing this boundary are exactly what a live scrape
+    would carry. A dead engine raises OSError, the same exception
+    family a refused connection produces.
+  * ``probe(url)`` — the router's ``_probe_backend`` contract:
+    ``(healthy, draining, info)`` from the engine's /ready view;
+    ``(False, False, None)`` for dead or unknown backends.
+  * ``submit(url, req)`` — the generate path: the engine's admission
+    status (200/503/429), or OSError when the backend is gone.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..autoscale.scrape import parse_exposition
+from .engine import SimEngine, SimRequest
+
+
+class SimTransport:
+    def __init__(self):
+        self._engines: Dict[str, SimEngine] = {}
+
+    # -- membership ----------------------------------------------------
+
+    def register(self, url: str, engine: SimEngine) -> None:
+        self._engines[url.rstrip("/")] = engine
+
+    def forget(self, url: str) -> None:
+        self._engines.pop(url.rstrip("/"), None)
+
+    def engine(self, url: str) -> Optional[SimEngine]:
+        return self._engines.get(url.rstrip("/"))
+
+    # -- the three wire surfaces ---------------------------------------
+
+    def fetch_metrics(self, url: str, timeout: float = 5.0):
+        del timeout  # signature parity with scrape.fetch_metrics
+        eng = self.engine(url)
+        if eng is None or eng.killed:
+            raise OSError(f"connection refused: {url}")
+        return parse_exposition(eng.metrics_text())
+
+    def probe(self, url: str):
+        eng = self.engine(url)
+        if eng is None or eng.killed:
+            return (False, False, None)
+        info = eng.ready_info()
+        return (info["ready"], info["draining"], info)
+
+    def submit(self, url: str, req: SimRequest) -> int:
+        eng = self.engine(url)
+        if eng is None or eng.killed:
+            raise OSError(f"connection refused: {url}")
+        return eng.submit(req)

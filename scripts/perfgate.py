@@ -165,8 +165,12 @@ def compare(base: dict, fresh: dict, waivers: dict):
 def cost_table(parsed: dict, source: str) -> dict:
     """Fitted per-program cost table from one bench round — device
     step costs the fleet capacity simulator replays (ROADMAP item 6).
-    Every field is optional: rounds grew the schema over time."""
-    table = {"source": source, "programs": {}}
+    Every field is optional: rounds grew the schema over time.
+    ``schema_version`` is the exception — the simulator's
+    CostModel.load refuses tables from another major, so bump it in
+    lockstep with ome_tpu/sim/costmodel.py SCHEMA_VERSION whenever
+    the shape changes incompatibly."""
+    table = {"schema_version": 1, "source": source, "programs": {}}
     br = parsed.get("decode_ms_breakdown") or {}
     for mode, phases in br.items():
         if isinstance(phases, dict) and "step" in phases:

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Scenario runner for the fleet simulator (docs/simulation.md).
+
+Replays a scenario — synthetic or reqlog-derived — through N
+simulated replicas on virtual time and prints the same per-class SLO
+report shape as scripts/replay.py, as canonical JSON (sorted keys):
+two runs with the same seed are byte-identical.
+
+  python scripts/simulate.py --scenario steady --engines 4
+  python scripts/simulate.py --scenario autoscale --seed 7
+  python scripts/simulate.py --scenario wdrr --classes 200
+  python scripts/simulate.py --scenario fleet --engines 1000 \\
+      --requests 50000           # the perf acceptance run
+  python scripts/simulate.py --scenario steady --trace reqlog.jsonl
+
+`--check-determinism` runs the scenario twice and fails unless the
+two reports agree byte-for-byte.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ome_tpu.autoscale import trace as trace_mod  # noqa: E402
+from ome_tpu.sim import scenario as scen  # noqa: E402
+from ome_tpu.sim.costmodel import CostModel  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_TABLE = os.path.join(REPO, "config", "cost-table.json")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="simulate", description=__doc__.splitlines()[0])
+    p.add_argument("--scenario", default="steady",
+                   choices=sorted(scen.SCENARIOS))
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--engines", type=int, default=None,
+                   help="fleet size (steady/fleet scenarios)")
+    p.add_argument("--requests", type=int, default=None,
+                   help="trace length (steady/fleet scenarios)")
+    p.add_argument("--classes", type=int, default=None,
+                   help="tenant classes (wdrr scenario)")
+    p.add_argument("--cost-table", default=None,
+                   help="perfgate cost table "
+                        f"(default: {DEFAULT_TABLE} when present, "
+                        "else a synthetic model)")
+    p.add_argument("--mode", default=None,
+                   help="decode program mode from the table "
+                        "(int8/int4/bf16; default: best available)")
+    p.add_argument("--trace", default=None,
+                   help="replay a saved trace / engine reqlog "
+                        "through the steady scenario instead of the "
+                        "synthetic workload")
+    p.add_argument("--check-determinism", action="store_true",
+                   help="run twice, fail on any byte difference")
+    p.add_argument("--full", action="store_true",
+                   help="include the full decision log / per-request "
+                        "detail instead of the summary report")
+    return p
+
+
+def _cost(args) -> CostModel:
+    path = args.cost_table
+    if path is None and os.path.exists(DEFAULT_TABLE):
+        path = DEFAULT_TABLE
+    return scen.default_cost_model(path, mode=args.mode)
+
+
+def run_once(args) -> dict:
+    kw = {"seed": args.seed, "cost": _cost(args)}
+    if args.scenario in ("steady", "fleet"):
+        if args.engines is not None:
+            kw["engines"] = args.engines
+        if args.requests is not None:
+            kw["requests"] = args.requests
+    if args.scenario == "wdrr" and args.classes is not None:
+        kw["n_classes"] = args.classes
+    if args.scenario == "steady" and args.trace:
+        return _run_trace_replay(args, kw)
+    return scen.SCENARIOS[args.scenario](**kw)
+
+
+def _run_trace_replay(args, kw) -> dict:
+    """steady topology, but the workload comes from a file: a
+    save_trace JSONL or an engine reqlog (same fallback order as the
+    autoscale CLI)."""
+    from ome_tpu.autoscale import replay as replay_mod
+    from ome_tpu.sim.fleet import SimFleet
+    try:
+        tr = trace_mod.load_trace(args.trace)
+    except (KeyError, ValueError):
+        tr = trace_mod.load_reqlog(args.trace)
+    if not tr:
+        raise SystemExit(f"empty trace: {args.trace}")
+    fleet = SimFleet(kw["cost"], seed=kw["seed"],
+                     engine_kw={"max_slots": 4, "kv_pages": 512,
+                                "fused_k": 4})
+    fleet.add_engines(args.engines or 2)
+    fleet.start_health_loop()
+    fleet.submit_trace(tr)
+    fleet.run_until(max(r.arrival for r in tr) + 60.0)
+    rep = replay_mod.report(fleet.results, slo_ttft_s=2.0)
+    rep["scenario"] = "steady"
+    rep["trace_file"] = os.path.basename(args.trace)
+    rep["sim"] = fleet.sim_stats()
+    return rep
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    t0 = time.monotonic()
+    rep = run_once(args)
+    wall = time.monotonic() - t0
+    if args.check_determinism:
+        second = run_once(args)
+        if scen.canonical_json(rep) != scen.canonical_json(second):
+            sys.stderr.write("simulate: NON-DETERMINISTIC — two runs "
+                             "with the same seed diverged\n")
+            return 1
+        sys.stderr.write("simulate: determinism check OK\n")
+    if not args.full:
+        rep = {k: v for k, v in rep.items() if k != "decisions"}
+    sys.stderr.write(
+        f"simulate: {args.scenario} done in {wall:.2f}s wall "
+        f"({rep.get('sim', {}).get('virtual_seconds', '?')} virtual "
+        "seconds)\n")
+    sys.stdout.write(scen.canonical_json(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -45,6 +45,7 @@ from ome_tpu.lint.plugins.catalog_drift import (FaultCatalogRule,
                                                 MetricsNamingRule)
 from ome_tpu.lint.plugins.hot_path_sync import HotPathSyncRule
 from ome_tpu.lint.plugins.lock_discipline import LockDisciplineRule
+from ome_tpu.lint.plugins.sim_wall_clock import SimWallClockRule
 from ome_tpu.lint.plugins.thread_shared_state import \
     ThreadSharedStateRule
 
@@ -484,6 +485,60 @@ class TestMetricsNamingFixtures:
         ]
 
 
+class TestSimWallClockFixtures:
+    def test_wall_clock_in_reachable_helper_flagged(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        import time
+        class E:
+            def submit(self, req):
+                self._admit(req)
+            def _admit(self, req):
+                req.created = time.monotonic()
+        """)
+        fs = SimWallClockRule(
+            root_specs=("m.py::E.submit",)).run(p)
+        assert len(fs) == 1
+        assert "time.monotonic" in fs[0].message
+        assert "_admit" in fs[0].message  # found via reachability
+
+    def test_injected_clock_clean(self, tmp_path):
+        p = _project(tmp_path, "m.py", """
+        class E:
+            def submit(self, req):
+                self._admit(req)
+            def _admit(self, req):
+                req.created = self.clock.now()
+        """)
+        assert SimWallClockRule(
+            root_specs=("m.py::E.submit",)).run(p) == []
+
+    def test_stop_set_shields_sanctioned_boundary(self, tmp_path):
+        # the clock module itself may read wall time; traversal must
+        # stop at the allowed names instead of flagging through them
+        p = _project(tmp_path, "m.py", """
+        import time
+        class VirtualClock:
+            def now(self):
+                return time.time()
+        class E:
+            def submit(self, req):
+                self.clock.now()
+        """)
+        assert SimWallClockRule(
+            root_specs=("m.py::E.submit",),
+            allowed=("VirtualClock", "now")).run(p) == []
+
+    def test_no_roots_means_no_findings(self, tmp_path):
+        # a tree without the sim package resolves zero roots; the
+        # rule must be a no-op, not an error
+        p = _project(tmp_path, "m.py", """
+        import time
+        def anything():
+            time.sleep(1)
+        """)
+        assert SimWallClockRule().run(p) == []
+
+
 # -- plugin registry --------------------------------------------------
 
 
@@ -492,7 +547,7 @@ class TestRegistry:
         assert set(rule_names()) == {
             "hot-path-sync", "lock-discipline", "thread-shared-state",
             "blocking-in-async", "fault-catalog", "metrics-naming",
-            "metrics-label-cardinality"}
+            "metrics-label-cardinality", "sim-wall-clock"}
 
     def test_unknown_rule_rejected(self):
         with pytest.raises(KeyError):

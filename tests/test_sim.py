@@ -1,0 +1,578 @@
+"""Fleet simulator (ome_tpu/sim/, docs/simulation.md).
+
+Units cover the pure layers: the virtual clock + seeded event loop
+(FIFO at equal timestamps, cancellation, past-due clamping), the
+calibrated cost model (round-trip from the checked-in perfgate table,
+schema-version rejection, analytic-shape properties), the diurnal and
+flash-crowd trace generators (determinism + shape), and one simulated
+engine's admission ladder / KV stall / drain / kill semantics.
+
+Integration covers the full harness: the real router + controller over
+simulated replicas — run-to-run BYTE-identity of the autoscale report
+including its decision log (the determinism contract), the two
+fleet-scale regressions the ISSUE pinned (WDRR fairness at 120 tenant
+classes, no-oscillation under diurnal + flash crowd), failover when a
+backend dies mid-trace, and the scenario CLI.
+
+`slow` holds the perf acceptance (1,000 engines x 50k requests under
+the wall budget) and the sim-vs-real fidelity gate: the same trace
+through a live 2-engine subprocess topology and through the simulator
+calibrated from the live run's own measurements, agreeing on TTFT
+p50/p99, throughput, and the net scale-decision sequence within the
+error bands documented in docs/simulation.md.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ome_tpu.autoscale import replay as replay_mod
+from ome_tpu.autoscale import trace as trace_mod
+from ome_tpu.autoscale.controller import SLOConfig
+from ome_tpu.autoscale.policy import PolicyConfig
+from ome_tpu.sim import scenario as scen
+from ome_tpu.sim.clock import EventLoop, VirtualClock
+from ome_tpu.sim.costmodel import SCHEMA_VERSION, CostModel
+from ome_tpu.sim.engine import SimEngine, SimRequest
+from ome_tpu.sim.fleet import SimFleet
+from ome_tpu.sim.transport import SimTransport
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+COST_TABLE = REPO / "config" / "cost-table.json"
+SIMULATE = REPO / "scripts" / "simulate.py"
+
+
+# -- virtual clock + event loop ---------------------------------------
+
+
+class TestEventLoop:
+    def test_equal_timestamps_fire_in_scheduling_order(self):
+        loop = EventLoop()
+        order = []
+        loop.call_at(1.0, lambda: order.append("a"))
+        loop.call_at(1.0, lambda: order.append("b"))
+        loop.call_at(0.5, lambda: order.append("first"))
+        loop.run_until(2.0)
+        assert order == ["first", "a", "b"]
+        assert loop.clock.now() == 2.0  # lands exactly on t_end
+
+    def test_cancelled_event_skipped(self):
+        loop = EventLoop()
+        order = []
+        ev = loop.call_at(1.0, lambda: order.append("cancelled"))
+        loop.call_at(1.0, lambda: order.append("kept"))
+        ev.cancel()
+        assert loop.pending() == 1
+        loop.run_until(2.0)
+        assert order == ["kept"]
+
+    def test_past_due_clamps_to_now(self):
+        loop = EventLoop()
+        loop.run_until(5.0)
+        fired_at = []
+        loop.call_at(1.0, lambda: fired_at.append(loop.clock.now()))
+        loop.run_until(5.0)
+        assert fired_at == [5.0]
+
+    def test_clock_never_runs_backwards(self):
+        clock = VirtualClock(10.0)
+        with pytest.raises(ValueError):
+            clock.advance_to(9.0)
+
+    def test_events_scheduled_by_events_run_same_pass(self):
+        loop = EventLoop()
+        order = []
+
+        def outer():
+            order.append("outer")
+            loop.call_later(0.5, lambda: order.append("inner"))
+        loop.call_at(1.0, outer)
+        loop.run_until(2.0)
+        assert order == ["outer", "inner"]
+        assert loop.executed == 2
+
+
+# -- cost model --------------------------------------------------------
+
+
+class TestCostModel:
+    def test_checked_in_table_round_trips(self):
+        """The satellite contract: scripts/perfgate.py --cost-table
+        emitted config/cost-table.json with a schema_version, and the
+        loader accepts exactly that shape."""
+        doc = json.loads(COST_TABLE.read_text())
+        assert doc["schema_version"] == SCHEMA_VERSION
+        cm = CostModel.load(COST_TABLE)
+        assert cm.source == doc["source"]
+        assert cm.weights_ms > 0
+        assert cm.prefill_ms_per_token > 0
+        # mode preference lands on the int8 decode breakdown
+        int8 = doc["programs"]["decode_int8"]["phases_ms"]
+        assert cm.weights_ms == pytest.approx(
+            int8["weights_sampling"])
+
+    def test_wrong_schema_version_rejected(self, tmp_path):
+        doc = json.loads(COST_TABLE.read_text())
+        doc["schema_version"] = SCHEMA_VERSION + 1
+        bad = tmp_path / "table.json"
+        bad.write_text(json.dumps(doc))
+        with pytest.raises(ValueError, match="perfgate"):
+            CostModel.load(bad)
+
+    def test_step_shape(self):
+        cm = CostModel(weights_ms=4.0, attn_ms=1.0, dispatch_ms=2.0,
+                       prefill_ms_per_token=0.03)
+        # fused chunks amortize dispatch: k iterations cost far less
+        # than k separate steps
+        assert cm.step_ms(8, fused_k=4) < 4 * cm.step_ms(8)
+        # attention term grows with batch; weights term does not
+        assert cm.step_ms(16) > cm.step_ms(1)
+        # more resident KV pages per slot -> slower attention
+        assert cm.step_ms(8, pages=256.0) > cm.step_ms(8, pages=64.0)
+
+    def test_spec_accept_changes_tokens_not_time(self):
+        cm = CostModel(weights_ms=4.0, attn_ms=1.0, dispatch_ms=2.0,
+                       prefill_ms_per_token=0.03)
+        assert cm.step_ms(8, spec_accept=2.0) == cm.step_ms(8)
+        assert cm.tokens_per_iteration(2.0) == 3.0
+        assert cm.tokens_per_iteration(99.0) == 5.0  # clamped
+
+    def test_from_measurements_flat_model(self):
+        cm = CostModel.from_measurements(
+            tpot_ms=12.0, prefill_ms_per_token=0.5, batch_ref=1)
+        # per-iteration cost is batch-invariant (attn_ms == 0), so a
+        # CPU topology's TPOT carries over at any batch
+        assert cm.step_ms(1) == pytest.approx(cm.step_ms(8))
+        assert cm.step_ms(1) == pytest.approx(12.0)
+        assert cm.source == "measured"
+
+    def test_from_measurements_compute_bound(self):
+        cm = CostModel.from_measurements(
+            tpot_ms=10.0, prefill_ms_per_token=0.5,
+            compute_bound=True, pages_per_slot=5.0)
+        # batch-linear: N slots each decode N x slower, so TOTAL
+        # throughput is invariant at ~1/tpot — the CPU shape
+        assert cm.step_ms(1, pages=5.0) == pytest.approx(10.0)
+        assert cm.step_ms(4, pages=20.0) == pytest.approx(40.0)
+
+
+# -- trace generators --------------------------------------------------
+
+
+def _density(trace, t0, t1):
+    n = sum(1 for r in trace if t0 <= r.arrival < t1)
+    return n / (t1 - t0)
+
+
+class TestTraceGenerators:
+    def test_diurnal_deterministic(self):
+        a = trace_mod.diurnal_trace(11, n=200)
+        b = trace_mod.diurnal_trace(11, n=200)
+        assert [(r.arrival, r.trace_id) for r in a] \
+            == [(r.arrival, r.trace_id) for r in b]
+        c = trace_mod.diurnal_trace(12, n=200)
+        assert [r.arrival for r in a] != [r.arrival for r in c]
+
+    def test_diurnal_shape(self):
+        period = 100.0
+        tr = trace_mod.diurnal_trace(3, n=800, period_s=period,
+                                     base_rate=2.0, peak_factor=4.0,
+                                     cycles=1.0)
+        # rate peaks at period/2 and troughs at 0 and period
+        peak = _density(tr, 0.35 * period, 0.65 * period)
+        trough = _density(tr, 0.0, 0.15 * period)
+        assert peak > 2.0 * trough, (peak, trough)
+        assert all(r.arrival <= period * 1.001 for r in tr)
+
+    def test_flash_crowd_shape(self):
+        tr = trace_mod.flash_crowd_trace(5, n=600, base_rate=2.0,
+                                         crowd_at=30.0,
+                                         crowd_duration=10.0,
+                                         crowd_factor=10.0)
+        crowd = _density(tr, 30.0, 40.0)
+        before = _density(tr, 0.0, 30.0)
+        assert crowd > 4.0 * before, (crowd, before)
+
+    def test_merge_overlays_sorted(self):
+        a = trace_mod.diurnal_trace(1, n=50)
+        b = trace_mod.flash_crowd_trace(2, n=50)
+        merged = trace_mod.merge_traces(a, b)
+        assert len(merged) == 100
+        arr = [r.arrival for r in merged]
+        assert arr == sorted(arr)
+
+
+# -- one simulated engine ----------------------------------------------
+
+
+def _engine(loop, **kw):
+    cost = CostModel(weights_ms=4.0, attn_ms=1.0, dispatch_ms=2.0,
+                     prefill_ms_per_token=0.05)
+    return SimEngine("e0", loop.clock, loop, cost, **kw)
+
+
+class TestSimEngine:
+    def test_lifecycle_timestamps(self):
+        loop = EventLoop()
+        done = []
+        eng = _engine(loop, on_finish=done.append)
+        assert eng.submit(SimRequest(prompt_tokens=16,
+                                     max_new_tokens=8)) == 200
+        loop.run()
+        (req,) = done
+        assert req.finish_reason == "stop"
+        assert req.output_tokens == 8
+        assert 0 < req.first_token_at < req.finished_at
+        assert eng.active == [] and eng.pages_used == 0
+        assert eng.tokens_by_class() == {"standard": 7}  # post-TTFT
+
+    def test_admission_ladder(self):
+        loop = EventLoop()
+        eng = _engine(loop, max_slots=1, max_pending=1)
+        assert eng.submit(SimRequest(8, 4)) == 200   # takes the slot
+        assert eng.submit(SimRequest(8, 4)) == 200   # queues
+        assert eng.submit(SimRequest(8, 4)) == 429   # queue full
+        eng.draining = True
+        assert eng.submit(SimRequest(8, 4)) == 503
+        eng.killed = True
+        with pytest.raises(OSError):
+            eng.submit(SimRequest(8, 4))
+
+    def test_kv_pressure_stalls_then_completes(self):
+        loop = EventLoop()
+        done = []
+        # pages for one request: ceil((8+56)/16) = 4 — the pool only
+        # holds one at a time
+        eng = _engine(loop, max_slots=4, kv_pages=5, kv_block=16,
+                      on_finish=done.append)
+        assert eng.submit(SimRequest(8, 56)) == 200
+        assert eng.submit(SimRequest(8, 56)) == 200
+        loop.run_until(0.2)
+        assert len(eng.active) == 1  # second stalled on pages
+        loop.run()
+        assert len(done) == 2
+        assert all(r.finish_reason == "stop" for r in done)
+
+    def test_drain_finishes_queued_work_then_fires(self):
+        loop = EventLoop()
+        eng = _engine(loop, max_slots=1)
+        eng.submit(SimRequest(8, 8))
+        eng.submit(SimRequest(8, 8))
+        drained = []
+        eng.drain(on_drained=lambda: drained.append(loop.clock.now()))
+        assert drained == []  # work outstanding
+        assert eng.submit(SimRequest(8, 8)) == 503
+        loop.run()
+        assert len(drained) == 1 and drained[0] > 0
+
+    def test_kill_fails_everything(self):
+        loop = EventLoop()
+        done = []
+        eng = _engine(loop, max_slots=1, on_finish=done.append)
+        eng.submit(SimRequest(8, 64))
+        eng.submit(SimRequest(8, 64))
+        loop.run_until(0.1)  # mid-decode: one active, one queued
+        eng.kill()
+        assert sorted(r.finish_reason for r in done) \
+            == ["killed", "killed"]
+        assert all(r.status == 599 for r in done)
+
+    def test_scrape_surface(self):
+        loop = EventLoop()
+        eng = _engine(loop)
+        eng.submit(SimRequest(8, 4))
+        loop.run()
+        tx = SimTransport()
+        tx.register("sim://e0", eng)
+        samples = tx.fetch_metrics("sim://e0")
+        assert samples["ome_engine_requests_total"] == 1.0
+        assert samples["ome_engine_tokens_generated_total"] == 4.0
+        assert any(k.startswith("ome_engine_ttft_seconds_bucket")
+                   for k in samples)
+        assert tx.probe("sim://e0") == (
+            True, False, {"ready": True, "draining": False})
+        eng.kill()
+        assert tx.probe("sim://e0")[:2] == (False, False)
+        with pytest.raises(OSError):
+            tx.fetch_metrics("sim://e0")
+
+
+# -- the determinism contract (tier-1 smoke) ---------------------------
+
+
+class TestDeterminism:
+    def test_steady_report_byte_identical(self):
+        a = scen.canonical_json(scen.run_steady(seed=3, requests=80))
+        b = scen.canonical_json(scen.run_steady(seed=3, requests=80))
+        assert a == b
+
+    def test_autoscale_decision_log_byte_identical(self):
+        """The satellite-5 smoke: two same-seed runs of the full
+        closed loop — scrape, windows, policy, spawn/drain — produce
+        byte-identical reports INCLUDING the decision log."""
+        a = scen.run_autoscale(seed=7)
+        b = scen.run_autoscale(seed=7)
+        assert scen.canonical_json(a) == scen.canonical_json(b)
+        assert a["decisions"]  # the log is actually in the bytes
+
+
+# -- fleet-scale regressions ------------------------------------------
+
+
+class TestWdrrFairness:
+    def test_120_tenant_classes_track_weight_shares(self):
+        rep = scen.run_wdrr_fairness(seed=0, n_classes=120)
+        assert rep["n_classes"] == 120
+        assert set(rep["tiers"]) == {"1", "2", "4", "8"}
+        assert rep["worst_rel_error"] < 0.05, rep["tiers"]
+        # heavier tiers really got more service per class
+        shares = [rep["tiers"][w]["share_per_class"]
+                  for w in ("1", "2", "4", "8")]
+        assert shares == sorted(shares)
+
+
+class TestAutoscaleStability:
+    def test_diurnal_flash_crowd_no_oscillation(self):
+        rep = scen.run_autoscale(seed=7)
+        assert rep["scale_ups"] >= 2, rep["decisions"][-20:]
+        assert rep["scale_downs"] >= 2
+        assert rep["oscillation_pairs"] == 0
+        assert rep["final_size"] == 1  # back to min after the day
+        assert rep["completed"] > 0.9 * rep["requests"]
+
+
+class TestFailover:
+    def test_backend_death_mid_trace_fails_over(self):
+        fleet = SimFleet(
+            CostModel(weights_ms=4.0, attn_ms=1.0, dispatch_ms=2.0,
+                      prefill_ms_per_token=0.05),
+            seed=5, policy="round_robin", health_interval=30.0,
+            engine_kw={"max_slots": 4, "kv_pages": 512, "fused_k": 4})
+        fleet.add_engines(2)
+        fleet.start_health_loop()
+        tr = trace_mod.synthetic_trace(5, n=60, base_rate=6.0)
+        fleet.submit_trace(tr)
+        # the victim is ALREADY dead when the trace starts: no
+        # in-flight deaths to mark it unhealthy early, so the first
+        # pick that lands on it takes the transport-error path —
+        # note_result(False) + retry-budget failover to the survivor
+        # (a mid-flight kill marks the backend unhealthy from the
+        # dying stream itself and nothing ever needs to retry)
+        fleet.kill_backend(fleet.pool.members[0].url)
+        fleet.run_until(max(r.arrival for r in tr) + 60.0)
+        rep = replay_mod.report(fleet.results, slo_ttft_s=2.0)
+        assert rep["requests"] == 60  # every request accounted for
+        assert rep["failovers"] > 0   # dead backend was retried away
+        assert rep["completed"] == 60, rep  # nothing was in flight
+
+
+# -- the scenario CLI --------------------------------------------------
+
+
+class TestSimulateCli:
+    def test_check_determinism_exits_zero(self):
+        proc = subprocess.run(
+            [sys.executable, str(SIMULATE), "--scenario", "steady",
+             "--requests", "60", "--check-determinism"],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        rep = json.loads(proc.stdout)
+        assert rep["scenario"] == "steady"
+        assert rep["completed"] > 0
+        assert "determinism check OK" in proc.stderr
+
+
+# -- slow: perf acceptance + the fidelity gate -------------------------
+
+
+@pytest.mark.slow
+class TestFleetScalePerf:
+    def test_1000_engines_50k_requests_under_budget(self):
+        t0 = time.monotonic()
+        rep = scen.run_fleet_scale(seed=0, engines=1000,
+                                   requests=50000)
+        wall = time.monotonic() - t0
+        assert rep["requests"] == 50000
+        assert rep["errors"] == 0, rep
+        assert rep["sim"]["engines_spawned"] == 1000
+        # the acceptance budget is ~2 CPU-minutes; leave headroom for
+        # slow CI hosts
+        assert wall < 120.0, f"{wall:.1f}s wall"
+
+
+def _sign_sequence(decisions):
+    """Compressed up/down action sequence: [+1, -1] means 'scaled up
+    some amount, then back down' whatever the tick spacing."""
+    seq = []
+    for d in decisions:
+        s = (d.target > d.size) - (d.target < d.size)
+        if s and (not seq or seq[-1] != s):
+            seq.append(s)
+    return seq
+
+
+@pytest.mark.slow
+class TestFidelityGate:
+    def test_sim_matches_real_two_engine_topology(self, tmp_path):
+        """The sim-vs-real gate (calibration recipe + bands
+        documented in docs/simulation.md "Fidelity"): play ONE
+        overload trace through a live closed loop (1 engine scaling
+        to 2, subprocess router + controller), calibrate the cost
+        model from that run's own measurements — TPOT-under-load,
+        warm prefill, spawn+compile delay, observed output lengths —
+        then replay the same workload through the simulator and
+        require agreement on TTFT p50/p99, throughput, and the net
+        scale-decision sequence."""
+        from ome_tpu.autoscale import controller as ctl_mod
+        from ome_tpu.autoscale.policy import PoolPolicy
+        from ome_tpu.autoscale.pool import EnginePool
+        from ome_tpu.chaos import ManagedProc, free_port
+
+        # constant-rate overload: offered token rate well above one
+        # warm engine's capacity, under two engines' — the scale-up
+        # is CAPACITY-driven, not an artifact of host noise
+        trace = trace_mod.synthetic_trace(
+            7, n=60, base_rate=12.0, burst_factor=1.0,
+            max_tokens=(48, 96))
+        policy = PolicyConfig(min_size=1, max_size=2,
+                              up_stable_ticks=2, down_stable_ticks=4,
+                              cooldown_ticks=3, down_threshold=0.3)
+        slo = SLOConfig(ttft_p99_s=0.4, queue_wait_p99_s=0.2,
+                        queue_depth_high=1.5)
+
+        # -- the real side ------------------------------------------
+        model_dir = tmp_path / "model"
+        model_dir.mkdir()
+
+        def engine_args(port, name, journal_dir):
+            return ["--model-dir", str(model_dir),
+                    "--random-weights", "--dtype", "float32",
+                    "--host", "127.0.0.1", "--port", str(port),
+                    "--max-slots", "2", "--kv-block", "16",
+                    "--kv-blocks", "40", "--drain-grace", "6.0",
+                    "--journal", str(journal_dir)]
+
+        pool = EnginePool("engine", None, engine_args, tmp_path,
+                          drain_exit_timeout=60.0)
+        router = None
+        ctl = None
+        try:
+            t0 = time.monotonic()
+            pool.spawn()
+            spawn_s = time.monotonic() - t0
+            rport = free_port()
+            rargs = ["--bind", "127.0.0.1", "--port", str(rport),
+                     "--policy", "round_robin",
+                     "--health-interval", "0.5",
+                     "--debug-endpoints"]  # the pool registers
+            # scale-ups through POST /backends
+            for url in pool.member_urls():
+                rargs += ["--backend", url]
+            router = ManagedProc("router", "router", rargs, rport,
+                                 tmp_path / "router.log")
+            router.start()
+            router.wait_ready()
+            pool.router_url = router.url
+            # warm sequentially: the first request pays XLA compile
+            # (its wall time calibrates the sim's spawn delay — a
+            # freshly scaled-up engine pays it too); the second gives
+            # a clean single-stream prefill TTFT
+            warm = [trace_mod.TraceRequest(
+                trace_id=f"warm-{i}", arrival=0.0, prompt_tokens=8,
+                max_tokens=48, temperature=0.0) for i in range(2)]
+            t0 = time.monotonic()
+            replay_mod.replay(router.url, warm[:1], timeout=180)
+            compile_s = time.monotonic() - t0
+            (w1,) = replay_mod.replay(router.url, warm[1:],
+                                      timeout=180)
+            assert w1.ok and w1.ttft_s, vars(w1)
+            ctl = ctl_mod.ScaleController(
+                {"engine": pool},
+                {"engine": PoolPolicy(policy)}, slo,
+                router_url=router.url, interval=0.5,
+                clock=time.monotonic).start()
+            real_results = replay_mod.replay(router.url, trace,
+                                             timeout=180)
+            deadline = time.monotonic() + 45.0
+            while time.monotonic() < deadline:
+                if (any(d.target < d.size for d in ctl.decisions)
+                        and pool.draining_count() == 0
+                        and pool.size() == 1):
+                    break
+                time.sleep(0.5)
+            ctl.stop()
+            pool.join_drains(timeout=90.0)
+            real_final = pool.size()
+            real_decisions = list(ctl.decisions)
+        finally:
+            if ctl is not None:
+                ctl.stop()
+            pool.stop_all()
+            if router is not None:
+                router.stop()
+
+        real = replay_mod.report(real_results, slo_ttft_s=0.4)
+        assert real["errors"] == 0, real
+        assert real["tpot_p50_s"], real
+
+        # -- calibrate from the real run ----------------------------
+        # greedy decode on random weights hits EOS early, so the sim
+        # replays the OBSERVED output length of each request — the
+        # simulator models service, not token content
+        lengths = {r.trace_id: max(r.output_tokens, 1)
+                   for r in real_results}
+        sim_trace = [trace_mod.TraceRequest(
+            trace_id=t.trace_id, arrival=t.arrival,
+            prompt_tokens=t.prompt_tokens,
+            max_tokens=lengths.get(t.trace_id, t.max_tokens),
+            temperature=0.0, priority=t.priority) for t in trace]
+        avg_prompt = sum(t.prompt_tokens for t in trace) / len(trace)
+        cost = CostModel.from_measurements(
+            tpot_ms=real["tpot_p50_s"] * 1000.0,
+            prefill_ms_per_token=max(
+                w1.ttft_s * 1000.0 / avg_prompt, 0.01))
+
+        # -- the simulated side -------------------------------------
+        fleet = SimFleet(cost, seed=7,
+                         spawn_delay=spawn_s + compile_s,
+                         health_interval=0.5,
+                         engine_kw={"max_slots": 2, "kv_pages": 40,
+                                    "kv_block": 16, "fused_k": 1})
+        fleet.add_engines(1)
+        fleet.start_health_loop()
+        fleet.add_controller(policy, slo, interval=0.5)
+        fleet.submit_trace(sim_trace)
+        horizon = max(r.arrival for r in sim_trace) + 60.0
+        fleet.run_until(horizon)
+        sim = replay_mod.report(fleet.results, slo_ttft_s=0.4)
+        assert sim["errors"] == 0, sim
+        assert sim["output_tokens"] == real["output_tokens"]
+
+        # -- the bands (docs/simulation.md "Fidelity") --------------
+        def within(name, a, b, rel, abs_s):
+            assert abs(a - b) <= max(rel * b, abs_s), \
+                f"{name}: sim={a} real={b}"
+
+        within("ttft_p50", sim["ttft_p50_s"], real["ttft_p50_s"],
+               rel=0.6, abs_s=1.0)
+        within("ttft_p99", sim["ttft_p99_s"], real["ttft_p99_s"],
+               rel=0.6, abs_s=1.5)
+
+        def throughput(results):
+            done = [r for r in results if r.ok and r.e2e_s]
+            span = (max(r.arrival + r.e2e_s for r in done)
+                    - min(r.arrival for r in done))
+            return sum(r.output_tokens for r in done) / span
+
+        within("throughput", throughput(fleet.results),
+               throughput(real_results), rel=0.5, abs_s=0.0)
+
+        # net scale story must match: up under the overload, back
+        # down after it, same resting size
+        assert _sign_sequence(fleet.controller.decisions) \
+            == _sign_sequence(real_decisions) == [1, -1]
+        assert fleet.pool.size() == real_final == 1
