@@ -5,7 +5,7 @@
 PYTHON ?= python
 
 .PHONY: lint test replay autoscale-soak noisy-neighbor router-soak \
-	benchgate simulate
+	benchgate simulate chaos-sim
 
 # omelint: the repo's static-analysis gate (docs/static-analysis.md).
 # Runs every registered analyzer over ome_tpu/ and fails on any
@@ -34,6 +34,17 @@ benchgate:
 simulate:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/simulate.py \
 		--scenario autoscale --seed 7 --check-determinism --full
+
+# fleet-scale chaos in the simulator (docs/simulation.md): a seeded
+# fault schedule — kill/restart, slow/stuck replicas, partitions,
+# transport faults — against 100 engines with the fleet-wide
+# durability invariants checked (no admitted request lost, every
+# journal reconciled), run twice for byte-identity. Exit 2 =
+# invariant violation; add --shrink --bundle-dir to minimize it.
+chaos-sim:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/simulate.py \
+		--scenario chaos --seed 7 --engines 100 --requests 2000 \
+		--kills 12 --check-determinism
 
 # trace replay against a self-spawned router + CPU engine: the quick
 # "does the load generator work here" check (docs/autoscaling.md);

@@ -133,6 +133,10 @@ def main() -> None:
     n_params = llama.param_count(params)
     log(f"bench: params={n_params/1e9:.2f}B")
     disp_ms = None  # measured after the first mode's compile+warmup
+    # cold-start cost: first mode's prefill + decode compile+warm
+    # wall time — the cost table's warmup_ms, which the fleet
+    # simulator adds to replica spawn delay (sim/costmodel.py)
+    warm_ms = None
 
     @jax.jit
     def prefill(params, tokens, cache):
@@ -249,7 +253,7 @@ def main() -> None:
 
     def run_mode(p, label: str):
         """-> (tok/s, step_ms, weights_ms, attn_ms)."""
-        nonlocal disp_ms
+        nonlocal disp_ms, warm_ms
         per, top = split_layers(p)
         t0 = time.perf_counter()
         tok, cache = prefill(p, prompt,
@@ -261,6 +265,8 @@ def main() -> None:
         sync(st[0])
         log(f"bench: [{label}] prefill(batch={BATCH}, len={PREFILL}) "
             f"+ compile {time.perf_counter()-t0:.1f}s")
+        if warm_ms is None:
+            warm_ms = (time.perf_counter() - t0) * 1000
         if disp_ms is None:
             disp_ms = dispatch_ms()
             log(f"bench: dispatch floor {disp_ms:.2f} ms")
@@ -801,6 +807,7 @@ def main() -> None:
         "prefill_ms_batch32x128": round(pbest * 1000, 1),
         "prefill_mfu": round(mfu, 3),
         "dispatch_ms": round(disp_ms, 2),
+        "warmup_ms": round(warm_ms or 0.0, 1),
         "step_phase_ms": step_phase_ms,
         "step_phase_coverage": round(phase_cov, 3),
         "decode_step_gap_ms": {"sync": round(gap_sync, 2),

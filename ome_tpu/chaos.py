@@ -1515,7 +1515,9 @@ def run_soak(seed: int, episodes: Sequence[int], topo: Topology,
              journal_drain_timeout: float = 90.0,
              force_violation: bool = False,
              workload: Optional[Sequence[ChaosRequest]] = None,
-             kind: str = "mixed", flood_factor: int = 5) -> int:
+             kind: str = "mixed", flood_factor: int = 5,
+             override_events: Optional[Sequence[Tuple[float, str, str]]]
+             = None) -> int:
     from .telemetry import Registry
     registry = Registry()
     c_episodes = registry.counter("ome_chaos_episodes_total",
@@ -1534,6 +1536,13 @@ def run_soak(seed: int, episodes: Sequence[int], topo: Topology,
             ep = _plan_episode(seed, index, topo, n_requests, spread,
                                workload=workload, kind=kind,
                                flood_factor=flood_factor)
+            if override_events is not None:
+                # a down-converted sim schedule is authoritative: its
+                # kills replace the seed-derived events, and the
+                # fault-point specs (sim transport points have no
+                # subprocess analog) are cleared
+                ep.events = [tuple(e) for e in override_events]
+                ep.fault_specs = {}
             print(f"[chaos] episode {index} ({ep.kind}): "
                   f"{len(ep.requests)} requests, faults="
                   f"{ep.fault_specs or '{}'}, events="
@@ -1607,6 +1616,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "workload; the fault/kill schedule stays "
                         "seed-derived, and --spread grows to cover "
                         "the trace duration")
+    p.add_argument("--schedule", default=None,
+                   help="fidelity spot-check: down-convert a "
+                        "simulator FaultSchedule JSON "
+                        "(sim/faultplan.py) onto this topology — its "
+                        "kill events become SIGKILLs of the real "
+                        "serving engines (round-robin), its seed "
+                        "drives the workload, and the SAME "
+                        "invariants are checked; runs one episode")
     p.add_argument("--kv-block", type=int, default=16,
                    help="paged-KV block size for the engines (0 = "
                         "dense; disables the conservation invariant)")
@@ -1700,8 +1717,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         workload = requests_from_trace(pathlib.Path(args.trace))
         # kill/drain events must land inside the replayed traffic
         spread = max(spread, max(r.delay for r in workload))
+    seed = args.seed
+    override_events = None
+    if args.schedule:
+        from .sim.faultplan import FaultSchedule, to_chaos_events
+        sched = FaultSchedule.load(args.schedule)
+        serving = ([f"decode{i}" for i in range(topo.decode)]
+                   + [f"unified{i}" for i in range(topo.unified)])
+        override_events = to_chaos_events(sched, serving, spread)
+        seed = sched.seed
+        episodes = [args.episode if args.episode is not None else 0]
+        print(f"[chaos] schedule {args.schedule}: "
+              f"{len(override_events)} kill(s) down-converted onto "
+              f"{len(serving)} serving engine(s), seed {seed}",
+              flush=True)
     try:
-        rc = run_soak(args.seed, episodes, topo, base,
+        rc = run_soak(seed, episodes, topo, base,
                       n_requests=args.requests, spread=spread,
                       keep_logs=args.keep_logs,
                       journal_drain_timeout=args.journal_drain_timeout,
@@ -1710,7 +1741,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                       kind=("router_loss" if args.router_loss
                             else "noisy" if args.noisy_neighbor
                             else "mixed"),
-                      flood_factor=args.flood_factor)
+                      flood_factor=args.flood_factor,
+                      override_events=override_events)
     finally:
         if cleanup:
             import shutil

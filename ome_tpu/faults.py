@@ -58,7 +58,14 @@ Wired sites:
   * ``journal_replay`` — journal scan at startup (raise makes resume
     fail open: the engine starts empty instead of crashing);
   * ``drain_timeout``  — graceful-drain grace expiry (slow extends
-    the drain window to exercise the force path).
+    the drain window to exercise the force path);
+  * ``sim_transport_submit`` / ``sim_transport_probe`` /
+    ``sim_transport_scrape`` — the fleet simulator's in-process
+    transport (key=backend URL). Consulted through :func:`check`
+    (never :func:`fire` — the sim cannot sleep wall time): raise
+    surfaces as the same OSError family a refused connection
+    produces; an armed slow at submit counts as a client timeout
+    once it reaches the transport's timeout budget.
 """
 
 from __future__ import annotations
@@ -72,7 +79,7 @@ from typing import List, Optional
 
 __all__ = ["InjectedFault", "Rule", "FaultInjector", "parse_spec",
            "spec_points", "install", "reset", "fire", "afire", "http",
-           "active"]
+           "check", "active"]
 
 
 class InjectedFault(RuntimeError):
@@ -243,6 +250,20 @@ def fire(point: str, key: Optional[str] = None,
     inj = _get()
     if inj is not None:
         inj.fire(point, key=key, exc=exc)
+
+
+def check(point: str, key: Optional[str] = None,
+          exc: type = InjectedFault):
+    """fire() for sites that own their execution domain: counts the
+    hit and returns ``(delay_seconds, exception_or_None)`` WITHOUT
+    sleeping or raising. The fleet simulator's transport consults its
+    points through this — a ``time.sleep`` there would mix wall time
+    into virtual time (the sim-wall-clock lint forbids it), so the
+    sim maps an armed slow rule onto its own timeout semantics."""
+    inj = _get()
+    if inj is None:
+        return 0.0, None
+    return inj.consult(point, key=key, exc=exc)
 
 
 async def afire(point: str, key: Optional[str] = None,

@@ -3,10 +3,12 @@ re-homed from scripts/check_fault_points.py and check_metrics.py so
 one runner owns every invariant.
 
 ``fault-catalog`` — every literal ``faults.fire("<point>")`` /
-``faults.afire("<point>")`` / ``faults.http("<point>")`` site must
-have a row in the fault-point catalog table of
-docs/failure-semantics.md (one-directional by design: documenting
-ahead of landing is allowed, firing undocumented points is not).
+``faults.afire("<point>")`` / ``faults.http("<point>")`` /
+``faults.check("<point>")`` site (the last is the simulator
+transport's consult-without-sleeping form) must have a row in the
+fault-point catalog table of docs/failure-semantics.md
+(one-directional by design: documenting ahead of landing is allowed,
+firing undocumented points is not).
 
 ``metrics-naming`` — registry declarations (``.counter`` /
 ``.gauge`` / ``.histogram``) must carry an approved prefix, counters
@@ -33,7 +35,7 @@ from ..core import Finding, Project, Rule, SourceFile
 
 # ---------------------------------------------------------------- fault
 
-FAULT_METHODS = ("fire", "afire", "http")
+FAULT_METHODS = ("fire", "afire", "http", "check")
 CATALOG_HEADING = "fault-point catalog"
 
 

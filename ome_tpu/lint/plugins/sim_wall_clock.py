@@ -47,6 +47,17 @@ ROOT_SPECS = (
     "sim/fleet.py::SimFleet.start_health_loop",
     "sim/fleet.py::SimPool.spawn",
     "sim/fleet.py::SimPool.drain_one",
+    # chaos fault events fire on the event loop too: the schedule
+    # runner, end-of-schedule recovery, and restart-resume (which
+    # pulls in the virtual-journal fold and SimEngine.resume paths)
+    "sim/fleet.py::SimFleet.apply_fault",
+    "sim/fleet.py::SimFleet.recover_all",
+    "sim/engine.py::SimEngine.resume_from_journal",
+    # the transport's fault consults (faults.check never sleeps; the
+    # rule proves that transitively)
+    "sim/transport.py::SimTransport.submit",
+    "sim/transport.py::SimTransport.probe",
+    "sim/transport.py::SimTransport.fetch_metrics",
 )
 # sanctioned boundaries: reachability stops here. clock.py holds the
 # virtual time source itself; ClassQueues.get is the BLOCKING api the

@@ -21,13 +21,23 @@ model fitted from the perfgate cost table, `config/cost-table.json`)
 and the wall clock (`sim.clock.VirtualClock` + a seeded event loop).
 Everything downstream — queue-wait, TTFT, per-class SLO reports —
 is derived the same way the real scheduler produces it.
+
+Chaos at simulator scale (docs/simulation.md): `sim.durability`
+gives every engine name a virtual request journal across
+incarnations, `sim.faultplan` defines the declarative fault-schedule
+format shared with the subprocess harness (plus the shrinker and the
+replay bundle), and `scenario.run_chaos` plays a schedule against
+the fleet and checks the fleet-wide durability invariants.
 """
 
 from .clock import EventLoop, VirtualClock
 from .costmodel import CostModel
+from .durability import JournalSet, SimJournal
 from .engine import SimEngine
+from .faultplan import FaultEvent, FaultSchedule
 from .fleet import SimFleet, SimPool
 from .transport import SimTransport
 
 __all__ = ["EventLoop", "VirtualClock", "CostModel", "SimEngine",
-           "SimFleet", "SimPool", "SimTransport"]
+           "SimFleet", "SimPool", "SimTransport", "SimJournal",
+           "JournalSet", "FaultEvent", "FaultSchedule"]

@@ -55,6 +55,10 @@ class CostModel:
     prefill_ms_per_token: float
     batch_ref: int = DEFAULT_BATCH_REF
     pages_ref: float = DEFAULT_PAGES_REF
+    # compile/warmup time of a cold replica (program compilation +
+    # first-dispatch warmup) — optional in the table; 0 keeps the
+    # historical constant-spawn-delay behavior
+    warmup_ms: float = 0.0
     source: str = "synthetic"
 
     # -- construction --------------------------------------------------
@@ -110,6 +114,7 @@ class CostModel:
         return CostModel(
             weights_ms=weights, attn_ms=attn, dispatch_ms=dispatch,
             prefill_ms_per_token=per_token,
+            warmup_ms=float(table.get("warmup_ms") or 0.0),
             source=str(table.get("source") or "cost-table"))
 
     @staticmethod
@@ -184,4 +189,5 @@ class CostModel:
                 "prefill_ms_per_token": self.prefill_ms_per_token,
                 "batch_ref": self.batch_ref,
                 "pages_ref": self.pages_ref,
+                "warmup_ms": self.warmup_ms,
                 "source": self.source}

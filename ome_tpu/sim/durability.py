@@ -1,0 +1,132 @@
+"""Virtual request journal: the simulator's durability model.
+
+One ``SimJournal`` per engine *name* plays the role of the on-disk
+request journal (engine/journal.py): it outlives the SimEngine object
+the same way the WAL outlives a SIGKILLed process, and it carries the
+same record stream — ``admit`` / ``prog`` / ``fin`` — per engine
+*incarnation*, so the chaos invariants the subprocess harness checks
+on real journal files (no admitted request lost, every admit
+eventually tombstoned) check fleet-wide at simulator scale.
+
+Record shape (virtual analog of the JSONL WAL)::
+
+    {"t": "admit", "jid": 7, "inc": 1, "prompt_tokens": 32,
+     "max_new": 64, "cls": "standard", "trace_id": ...}
+    {"t": "prog",  "jid": 7, "inc": 1, "n": 4}    # 4 more tokens
+    {"t": "fin",   "jid": 7, "inc": 2, "reason": "stop"}
+
+The sim has no token ids, so ``prog`` carries a count where the real
+record carries the ids; the fold logic is otherwise
+``chaos.journal_live_entries`` verbatim: admits minus fins, with
+progress accumulated onto the live entry.
+
+``resume_entries`` is the restart side: the live entries a new
+incarnation must re-admit, folded exactly like
+``Scheduler.resume_from_journal`` folds ``prompt_ids + output_ids``
+(here: produced tokens join the prompt for recompute, the original
+``max_new`` budget stands, and an entry whose budget was already
+produced finishes ``length`` immediately — only its tombstone was
+lost to the crash).
+
+``drop_resume`` is the seeded-bug knob the shrinker acceptance test
+uses: a journal constructed with ``drop_resume=N`` silently loses the
+first N live entries on every resume — the exact class of durability
+bug (resume skips an admit record) the fleet-wide invariants exist to
+catch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+class SimJournal:
+    """Append-only virtual WAL for one engine name, across
+    incarnations."""
+
+    def __init__(self, name: str, drop_resume: int = 0):
+        self.name = name
+        self.records: List[dict] = []
+        self.drop_resume = int(drop_resume)
+        self._next_jid = 1
+
+    # -- the WAL writes (SimEngine's journaling hooks) -----------------
+
+    def admit(self, req, incarnation: int) -> int:
+        jid = self._next_jid
+        self._next_jid += 1
+        self.records.append({
+            "t": "admit", "jid": jid, "inc": incarnation,
+            "prompt_tokens": req.prompt_tokens,
+            "max_new": req.max_new_tokens,
+            "cls": req.priority, "trace_id": req.trace_id})
+        return jid
+
+    def progress(self, jid: int, incarnation: int, n: int) -> None:
+        if n > 0:
+            self.records.append({"t": "prog", "jid": jid,
+                                 "inc": incarnation, "n": int(n)})
+
+    def finish(self, jid: int, incarnation: int, reason: str) -> None:
+        self.records.append({"t": "fin", "jid": jid,
+                             "inc": incarnation, "reason": reason})
+
+    # -- reconciliation (chaos.journal_live_entries, virtualized) ------
+
+    def live_entries(self) -> Dict[int, dict]:
+        """Admitted-but-untombstoned requests: the fold the chaos
+        harness runs over real journal files. Empty at quiescence is
+        the journal-reconciliation invariant."""
+        live: Dict[int, dict] = {}
+        for rec in self.records:
+            t, jid = rec.get("t"), rec.get("jid")
+            if t == "admit":
+                live[jid] = dict(rec, produced=0)
+            elif t == "prog" and jid in live:
+                live[jid]["produced"] += rec.get("n", 0)
+            elif t == "fin":
+                live.pop(jid, None)
+        return live
+
+    def resume_entries(self) -> List[dict]:
+        """The restart-resume view, in admit order. Applies the
+        seeded ``drop_resume`` bug when armed (once per journal, like
+        a real one-off replay defect)."""
+        entries = [live for _, live in sorted(self.live_entries()
+                                              .items())]
+        if self.drop_resume > 0 and entries:
+            dropped = min(self.drop_resume, len(entries))
+            entries = entries[dropped:]
+            self.drop_resume = 0
+        return entries
+
+
+class JournalSet:
+    """The fleet's journal directory: one SimJournal per engine name,
+    created on first use and surviving engine kills — the analog of
+    the per-engine journal dirs the subprocess harness keeps."""
+
+    def __init__(self):
+        self._journals: Dict[str, SimJournal] = {}
+
+    def get(self, name: str) -> SimJournal:
+        j = self._journals.get(name)
+        if j is None:
+            j = SimJournal(name)
+            self._journals[name] = j
+        return j
+
+    def arm_drop_resume(self, name: str, n: int = 1) -> None:
+        """Seed the drop-resume bug into one engine's journal."""
+        self.get(name).drop_resume = max(int(n), 1)
+
+    def items(self):
+        return sorted(self._journals.items())
+
+    def live_by_engine(self) -> Dict[str, Dict[int, dict]]:
+        out: Dict[str, Dict[int, dict]] = {}
+        for name, j in self.items():
+            live = j.live_entries()
+            if live:
+                out[name] = live
+        return out

@@ -28,10 +28,12 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from ..engine.scheduler import ClassQueues
-from ..priority import DEFAULT_PRIORITY, PRIORITY_CLASSES
+from ..priority import (DEFAULT_PRIORITY, PRIORITY_CLASSES,
+                        class_wait_caps)
 from ..telemetry import Registry
 from .clock import EventLoop, VirtualClock
 from .costmodel import CostModel
+from .durability import SimJournal
 
 # same buckets as telemetry.registry DEFAULT_BUCKETS / the real
 # engine's latency histograms — the controller's windowed-quantile
@@ -68,6 +70,10 @@ class SimRequest:
     # >1 token per iteration in expectation)
     _progress: float = field(default=0.0, repr=False)
     _pages: int = field(default=0, repr=False)
+    # journal id: assigned at admit, carried across restart-resume (a
+    # resumed clone keeps the jid so fin tombstones the original
+    # admit record, never a duplicate admit)
+    _jid: Optional[int] = field(default=None, repr=False)
 
 
 class SimEngine:
@@ -84,6 +90,9 @@ class SimEngine:
                  kv_block: int = 16, max_pending: int = 512,
                  fused_k: int = 1, spec_accept: float = 0.0,
                  classes=None, class_weights=None,
+                 max_queue_wait: Optional[float] = 30.0,
+                 journal: Optional[SimJournal] = None,
+                 incarnation: int = 1,
                  on_finish: Optional[Callable[["SimRequest"], None]]
                  = None):
         self.name = name
@@ -107,9 +116,31 @@ class SimEngine:
         self.killed = False
         self._on_drained: Optional[Callable[[], None]] = None
         self._chunk_event = None
+        self._chunk_dt = 0.0
+        # durability (docs/simulation.md): the virtual WAL this
+        # incarnation journals into; it outlives kill() so a restart
+        # incarnation can resume_from_journal
+        self.journal = journal
+        self.incarnation = max(int(incarnation), 1)
+        # chaos fault state: step-time inflation (slow replica) and a
+        # full decode stall (stuck replica); both leave admission and
+        # the metrics surface serving, exactly like a wedged device
+        self.slow_factor = 1.0
+        self.stuck = False
+        # admission control (scheduler.submit's shed ladder): reject
+        # 429 when the estimated queue wait exceeds the per-class
+        # cap; None disables (saturation scenarios drive the queue as
+        # the regime under test). EWMAs mirror the real scheduler's
+        # alphas: 0.1 on step seconds, 0.2 on per-request steps.
+        self.max_queue_wait = max_queue_wait
+        self.class_wait_caps = (class_wait_caps(max_queue_wait)
+                                if max_queue_wait is not None else {})
+        self._ewma_step_s: Optional[float] = None
+        self._ewma_req_steps: Optional[float] = None
         self.stats: Dict[str, int] = {
             "requests_total": 0, "rejected_total": 0,
-            "tokens_generated_total": 0, "chunks_total": 0}
+            "tokens_generated_total": 0, "chunks_total": 0,
+            "resumed_total": 0}
         self._per_class_tokens: Dict[str, int] = {}
         self._build_metrics()
 
@@ -162,6 +193,15 @@ class SimEngine:
         self._c_sim_chunks = R.counter(
             "ome_sim_chunks_total",
             "Fused decode chunks executed by the simulated device")
+        self._g_incarnation = R.gauge(
+            "ome_sim_engine_incarnation",
+            "Incarnation number of this simulated replica (bumps "
+            "when a chaos restart resumes its virtual journal)")
+        self._g_incarnation.set(self.incarnation)
+        self._c_resumed = R.counter(
+            "ome_sim_resumed_requests_total",
+            "Requests re-admitted from the virtual journal after a "
+            "simulated crash restart")
 
     def metrics_text(self) -> str:
         """The /metrics body a scrape would see, gauges refreshed at
@@ -181,12 +221,17 @@ class SimEngine:
     def submit(self, req: SimRequest) -> int:
         """Admit a request; returns the HTTP-ish status the real
         serve layer would answer (200 admitted, 503 draining, 429
-        overloaded)."""
+        overloaded — by queue bound or by the estimated-wait shed
+        ladder, exactly scheduler.submit's admission control)."""
         if self.killed:
             raise OSError(f"sim engine {self.name} is down")
         if self.draining:
             return 503
         req.created = self.clock.now()
+        if self._shed(req):
+            self.stats["rejected_total"] += 1
+            self._c_rejected.inc()
+            return 429
         try:
             self.pending.put_nowait(req)
         except queue.Full:
@@ -195,8 +240,66 @@ class SimEngine:
             return 429
         self.stats["requests_total"] += 1
         self._c_requests.inc()
+        if self.journal is not None and req._jid is None:
+            req._jid = self.journal.admit(req, self.incarnation)
         self._admit()
         return 200
+
+    # -- admission control (scheduler.submit's shed ladder) ------------
+
+    def _queue_wait_estimate(self, depth: int) -> Optional[float]:
+        """Rough seconds until a newly queued request would start
+        decoding — the real scheduler's formula on sim-observed
+        EWMAs: queue depth in batch waves x per-request decode steps
+        x step seconds. None until both EWMAs have samples (cold
+        start admits optimistically)."""
+        if depth <= 0 or self._ewma_step_s is None \
+                or self._ewma_req_steps is None:
+            return None
+        waves = math.ceil(depth / self.max_slots)
+        return waves * self._ewma_req_steps * self._ewma_step_s
+
+    def _class_wait_estimate(self, cls: str,
+                             depth: int) -> Optional[float]:
+        """Per-class estimate: the plain estimate scaled up by the
+        inverse of the class's weight share over the active classes
+        (the real _class_wait_estimate, generalized to whatever
+        class set the queue was built with)."""
+        base = self._queue_wait_estimate(depth)
+        if base is None:
+            return base
+        w = self.pending.weights
+        if cls not in w:
+            return base
+        active = {c for c in w if self.pending.qsize(c) > 0}
+        active.add(cls)
+        share = sum(w[c] for c in active)
+        return base * (share / w[cls]) if share else base
+
+    def _shed(self, req: SimRequest) -> bool:
+        """True when the estimated queue wait for this request's
+        class exceeds its cap (shed with 429 before the queue bound
+        is even reached — the deep-saturation behavior the real
+        serve layer shows)."""
+        if self.max_queue_wait is None:
+            return False
+        cls = req.priority
+        if cls in self.class_wait_caps:
+            depth = self.pending.qsize(cls)
+            cap = self.class_wait_caps[cls]
+        else:
+            depth = self.pending.qsize()
+            cap = self.max_queue_wait
+        est = self._class_wait_estimate(cls, depth + 1)
+        return est is not None and est > cap
+
+    def retry_after_hint(self, default: float = 1.0) -> int:
+        """Seconds a rejected client should back off, from the live
+        queue-wait estimate, clamped to [1, 30] — what the real
+        server puts in Retry-After on its 429/503 answers."""
+        est = self._queue_wait_estimate(self.pending.qsize() + 1)
+        val = est if est is not None else default
+        return int(min(max(math.ceil(val), 1), 30))
 
     def _request_pages(self, req: SimRequest) -> int:
         return max(1, math.ceil(
@@ -230,7 +333,8 @@ class SimEngine:
             if hq is not None:
                 hq.observe(wait)
             self.loop.call_later(
-                self.cost.prefill_ms(req.prompt_tokens) / 1000.0,
+                self.cost.prefill_ms(req.prompt_tokens) / 1000.0
+                * self.slow_factor,
                 lambda r=req: self._activate(r))
             self.active.append(req)
         self._maybe_drained()
@@ -242,23 +346,27 @@ class SimEngine:
             return
         now = self.clock.now()
         req.first_token_at = now
-        req.output_tokens = 1
-        req._progress = 1.0
+        # resumed requests carry their journaled progress; prefill
+        # recomputed the folded prompt and this emit continues the
+        # stream where the dead incarnation stopped
+        req._progress += 1.0
+        req.output_tokens = int(req._progress)
         self.stats["tokens_generated_total"] += 1
         self._c_tokens.inc()
+        self._journal_prog(req, 1)
         ttft = now - req.created
         self._h_ttft.observe(ttft)
         ht = self._h_class_ttft.get(req.priority)
         if ht is not None:
             ht.observe(ttft)
-        if req.max_new_tokens <= 1:
+        if req.output_tokens >= req.max_new_tokens:
             self._finish(req, "stop")
         self._schedule_chunk()
 
     # -- the modeled device --------------------------------------------
 
     def _schedule_chunk(self) -> None:
-        if self._chunk_event is not None or self.killed:
+        if self._chunk_event is not None or self.killed or self.stuck:
             return
         batch = [r for r in self.active if r.first_token_at is not None
                  and r.finish_reason is None]
@@ -267,7 +375,9 @@ class SimEngine:
         pages = float(sum(r._pages for r in batch))
         dt = self.cost.step_ms(len(batch), pages=pages,
                                fused_k=self.fused_k,
-                               spec_accept=self.spec_accept) / 1000.0
+                               spec_accept=self.spec_accept) / 1000.0 \
+            * self.slow_factor
+        self._chunk_dt = dt
         self._chunk_event = self.loop.call_later(dt, self._run_chunk)
 
     def _run_chunk(self) -> None:
@@ -276,6 +386,11 @@ class SimEngine:
             return
         self.stats["chunks_total"] += 1
         self._c_sim_chunks.inc()
+        # feed the admission ladder's step EWMA (alpha 0.1, like the
+        # real decode loop's observation of its own step time)
+        dt_step = self._chunk_dt / self.fused_k
+        self._ewma_step_s = dt_step if self._ewma_step_s is None \
+            else 0.9 * self._ewma_step_s + 0.1 * dt_step
         gained = self.fused_k * self.cost.tokens_per_iteration(
             self.spec_accept)
         for req in list(self.active):
@@ -290,6 +405,7 @@ class SimEngine:
             if emitted > 0:
                 self.stats["tokens_generated_total"] += emitted
                 self._c_tokens.inc(emitted)
+                self._journal_prog(req, emitted)
                 tc = self._per_class_tokens
                 tc[req.priority] = tc.get(req.priority, 0) + emitted
             if req.output_tokens >= req.max_new_tokens:
@@ -307,9 +423,21 @@ class SimEngine:
         if req in self.active:
             self.active.remove(req)
             self.pages_used -= req._pages
+        if self.journal is not None and req._jid is not None:
+            self.journal.finish(req._jid, self.incarnation, reason)
+        # per-request steps EWMA (alpha 0.2) for the shed ladder
+        steps = req.output_tokens / self.cost.tokens_per_iteration(
+            self.spec_accept)
+        self._ewma_req_steps = steps \
+            if self._ewma_req_steps is None \
+            else 0.8 * self._ewma_req_steps + 0.2 * steps
         if self.on_finish is not None:
             self.on_finish(req)
         self._maybe_drained()
+
+    def _journal_prog(self, req: SimRequest, n: int) -> None:
+        if self.journal is not None and req._jid is not None:
+            self.journal.progress(req._jid, self.incarnation, n)
 
     # -- lifecycle ------------------------------------------------------
 
@@ -330,8 +458,12 @@ class SimEngine:
             cb()
 
     def kill(self) -> None:
-        """Abrupt death (chaos): every in-flight and queued request
-        fails; probes and scrapes start raising at the transport."""
+        """Abrupt death (SIGKILL analog): every in-flight and queued
+        request fails client-side; probes and scrapes start raising
+        at the transport. The virtual journal is NOT tombstoned —
+        like the real WAL, the admits (and any progress records)
+        survive the crash and a restart incarnation must resume
+        them."""
         self.killed = True
         victims = list(self.active)
         if self._stalled is not None:
@@ -350,6 +482,55 @@ class SimEngine:
             req.finished_at = self.clock.now()
             if self.on_finish is not None:
                 self.on_finish(req)
+
+    # -- chaos fault surface (sim/faultplan.py events) -----------------
+
+    def set_slow(self, factor: float) -> None:
+        """Step-time inflation: decode chunks and prefills take
+        ``factor`` x their modeled time until cleared (factor 1)."""
+        self.slow_factor = max(float(factor), 1.0)
+
+    def set_stuck(self, stuck: bool) -> None:
+        """Full decode stall: no chunk completes while stuck (the
+        wedged-device shape — admission and /metrics keep serving, so
+        the controller and router see a live replica going dark on
+        progress). Unsticking reschedules the chunk loop."""
+        self.stuck = bool(stuck)
+        if not stuck:
+            self._schedule_chunk()
+
+    def resume_from_journal(self) -> int:
+        """Re-admit every live entry from the virtual journal — the
+        Scheduler.resume_from_journal fold, virtualized: produced
+        tokens join the prompt (recompute resume), the original
+        budget stands, and an entry whose whole budget was produced
+        finishes ``length`` (only its tombstone was lost). Entries
+        the admission ladder bounces stay live for the next restart.
+        Returns the number of requests re-admitted."""
+        if self.journal is None:
+            return 0
+        n = 0
+        for e in self.journal.resume_entries():
+            produced = e.get("produced", 0)
+            if produced >= e["max_new"]:
+                self.journal.finish(e["jid"], self.incarnation,
+                                    "length")
+                continue
+            req = SimRequest(
+                prompt_tokens=e["prompt_tokens"] + produced,
+                max_new_tokens=e["max_new"],
+                priority=e.get("cls") or DEFAULT_PRIORITY,
+                trace_id=e.get("trace_id"))
+            req._jid = e["jid"]
+            req._progress = float(produced)
+            req.output_tokens = produced
+            if self.submit(req) != 200:
+                continue  # more journal than queue: stays live
+            n += 1
+        if n:
+            self.stats["resumed_total"] += n
+            self._c_resumed.inc(n)
+        return n
 
     def tokens_by_class(self) -> Dict[str, int]:
         """Decode tokens served per class (ALL classes, including

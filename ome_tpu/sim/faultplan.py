@@ -1,0 +1,343 @@
+"""Declarative fault schedules: one JSON document drives chaos in
+BOTH harnesses.
+
+A ``FaultSchedule`` is the portable description of a chaos run —
+fleet size, workload length, a ``faults.py`` spec string for the
+transport points, a list of timed events in the simulator's fault
+vocabulary (kill / restart / slow / stuck / partition / heal), and
+optionally a seeded durability bug for shrinker acceptance tests.
+Everything is derived from ONE seed, so a schedule file and the two
+numbers it carries replay exactly.
+
+The same document serves two runners:
+
+  * the simulator (``scenario.run_chaos``) applies the events on the
+    virtual event loop across hundreds of SimEngines — minutes of
+    fleet time per CPU-second, where schedules are explored;
+  * the subprocess harness (``chaos_soak --schedule``) down-converts
+    the kill events onto its real-process topology for a fidelity
+    spot-check — the sim found it, the real stack confirms it.
+
+Only process-death events survive down-conversion: slow / stuck /
+partition / heal are simulator expressivity (the subprocess harness
+expresses those through fault-point specs instead), while a ``kill``
+maps onto a real SIGKILL and the harness's unconditional
+respawn-and-resume covers the ``restart`` half.
+
+``shrink`` is the counterexample minimizer: given a failing schedule
+and a runner, ddmin over the event list, then halve the fleet, then
+truncate the workload — keeping every step only if the run still
+fails with the SAME violation kinds (prefix before the first ``:``),
+so an unrelated failure mode cannot hijack the reduction. The result
+plus ``write_bundle`` is the standard replay bundle: schedule.json,
+violation.json, and a one-command repro.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import random
+from dataclasses import dataclass, field, replace
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from .fleet import FAULT_KINDS
+
+SCHEMA_VERSION = 1
+
+# fault-spec string armed for every sim chaos run unless the schedule
+# overrides it: a handful of early submit failures, charging the
+# client failover + retry-budget + breaker paths
+DEFAULT_FAULT_SPEC = "sim_transport_submit.raise@2:3"
+
+
+@dataclass
+class FaultEvent:
+    at: float          # virtual seconds from run start
+    action: str        # one of fleet.FAULT_KINDS
+    target: str        # engine member name, e.g. "engine3"
+    param: float = 0.0  # slow factor for "slow"; unused otherwise
+
+    def to_dict(self) -> dict:
+        d = {"at": round(self.at, 3), "action": self.action,
+             "target": self.target}
+        if self.param:
+            d["param"] = self.param
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "FaultEvent":
+        return FaultEvent(at=float(d["at"]), action=str(d["action"]),
+                          target=str(d["target"]),
+                          param=float(d.get("param", 0.0)))
+
+
+@dataclass
+class FaultSchedule:
+    seed: int
+    engines: int
+    requests: int
+    duration_s: float = 60.0
+    events: List[FaultEvent] = field(default_factory=list)
+    # faults.py grammar; installed process-wide for the run
+    fault_spec: str = ""
+    # seeded durability bug for shrinker acceptance:
+    # {"kind": "drop_resume", "target": "engine1", "n": 1}
+    inject_bug: Optional[dict] = None
+
+    # -- serialization (the portable artifact) -------------------------
+
+    def to_dict(self) -> dict:
+        d = {"schema_version": SCHEMA_VERSION, "seed": self.seed,
+             "engines": self.engines, "requests": self.requests,
+             "duration_s": self.duration_s,
+             "events": [e.to_dict() for e in self.events]}
+        if self.fault_spec:
+            d["fault_spec"] = self.fault_spec
+        if self.inject_bug:
+            d["inject_bug"] = self.inject_bug
+        return d
+
+    def dumps(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True,
+                          indent=1) + "\n"
+
+    @staticmethod
+    def from_dict(doc: dict) -> "FaultSchedule":
+        ver = doc.get("schema_version")
+        if ver != SCHEMA_VERSION:
+            raise ValueError(
+                f"fault schedule: schema_version {ver!r} != "
+                f"{SCHEMA_VERSION}")
+        return FaultSchedule(
+            seed=int(doc["seed"]), engines=int(doc["engines"]),
+            requests=int(doc["requests"]),
+            duration_s=float(doc.get("duration_s", 60.0)),
+            events=[FaultEvent.from_dict(e)
+                    for e in doc.get("events", [])],
+            fault_spec=str(doc.get("fault_spec", "")),
+            inject_bug=doc.get("inject_bug"))
+
+    @staticmethod
+    def load(path) -> "FaultSchedule":
+        doc = json.loads(pathlib.Path(path).read_text(
+            encoding="utf-8"))
+        return FaultSchedule.from_dict(doc)
+
+    def save(self, path) -> None:
+        pathlib.Path(path).write_text(self.dumps(), encoding="utf-8")
+
+    def replay_command(self,
+                       schedule_path: str = "schedule.json") -> str:
+        return ("python scripts/simulate.py --scenario chaos "
+                f"--schedule {schedule_path}")
+
+
+# -- validation --------------------------------------------------------
+
+
+def preflight(schedule: FaultSchedule) -> None:
+    """Refuse a schedule that uses an unknown event action or — via
+    the SAME catalog check the subprocess harness runs — injects a
+    fault point absent from docs/failure-semantics.md."""
+    for e in schedule.events:
+        if e.action not in FAULT_KINDS:
+            raise ValueError(
+                f"fault schedule: unknown event action {e.action!r} "
+                f"(known: {', '.join(FAULT_KINDS)})")
+    if schedule.fault_spec:
+        from ..chaos import preflight_fault_points
+        preflight_fault_points([schedule.fault_spec])
+
+
+# -- seed-derived generation -------------------------------------------
+
+
+def generate(seed: int, engines: int = 8, requests: int = 400,
+             kills: int = 4, duration_s: float = 60.0,
+             slow: int = 1, partitions: int = 1,
+             fault_spec: str = DEFAULT_FAULT_SPEC,
+             inject_bug: Optional[dict] = None) -> FaultSchedule:
+    """Everything random comes from ONE generator seeded by
+    ``f"{seed}:sim"`` (the sim-side analog of the subprocess
+    harness's ``f"{seed}:{index}"`` discipline): kill/restart pairs,
+    slow/heal pairs, partition/heal pairs, all landing inside the
+    trace window so the invariants are exercised under load."""
+    rng = random.Random(f"{seed}:sim")
+    names = [f"engine{i + 1}" for i in range(engines)]
+    events: List[FaultEvent] = []
+    lo, hi = 0.1 * duration_s, 0.8 * duration_s
+    # times rounded to the millisecond at GENERATION so the schedule
+    # object and its JSON serialization are the same artifact (the
+    # round trip is exact, not truncating)
+    for _ in range(max(int(kills), 0)):
+        t = round(rng.uniform(lo, hi), 3)
+        victim = rng.choice(names)
+        events.append(FaultEvent(t, "kill", victim))
+        events.append(FaultEvent(round(t + rng.uniform(2.0, 8.0), 3),
+                                 "restart", victim))
+    for _ in range(max(int(slow), 0)):
+        t = round(rng.uniform(lo, hi), 3)
+        victim = rng.choice(names)
+        kind = rng.choice(("slow", "stuck"))
+        param = round(rng.uniform(2.0, 6.0), 2) \
+            if kind == "slow" else 0.0
+        events.append(FaultEvent(t, kind, victim, param))
+        events.append(FaultEvent(round(t + rng.uniform(3.0, 10.0), 3),
+                                 "heal", victim))
+    for _ in range(max(int(partitions), 0)):
+        t = round(rng.uniform(lo, hi), 3)
+        victim = rng.choice(names)
+        events.append(FaultEvent(t, "partition", victim))
+        events.append(FaultEvent(round(t + rng.uniform(2.0, 6.0), 3),
+                                 "heal", victim))
+    events.sort(key=lambda e: (e.at, e.target, e.action))
+    return FaultSchedule(seed=seed, engines=engines,
+                         requests=requests, duration_s=duration_s,
+                         events=events, fault_spec=fault_spec,
+                         inject_bug=inject_bug)
+
+
+# -- down-conversion (sim schedule -> subprocess episode) --------------
+
+
+def to_chaos_events(schedule: FaultSchedule,
+                    serving: Sequence[str],
+                    spread: float) -> List[Tuple[float, str, str]]:
+    """Map the schedule's kill events onto the subprocess topology's
+    serving engines: round-robin over the real engine names, times
+    rescaled into the episode's [0.2, 0.9] x spread window (the
+    subprocess fleet is a few engines, not hundreds — what transfers
+    is the kill COUNT and ordering, not the sim target names). The
+    harness's unconditional respawn-and-resume stands in for the
+    ``restart`` half of each pair; non-process events do not
+    down-convert (see module docstring)."""
+    kills = [e for e in schedule.events if e.action == "kill"]
+    if not kills or not serving:
+        return []
+    t_hi = max(e.at for e in kills)
+    t_lo = min(e.at for e in kills)
+    span = (t_hi - t_lo) or 1.0
+    out = []
+    for i, e in enumerate(sorted(kills, key=lambda e: e.at)):
+        frac = (e.at - t_lo) / span
+        at = (0.2 + 0.7 * frac) * spread
+        out.append((round(at, 3), "sigkill",
+                    serving[i % len(serving)]))
+    return out
+
+
+# -- the shrinker ------------------------------------------------------
+
+
+def violation_kinds(violations: Sequence[str]) -> Set[str]:
+    """The stable prefix before the first ':' — the failure-mode
+    identity the reduction must preserve."""
+    return {v.split(":", 1)[0].strip() for v in violations}
+
+
+def shrink(schedule: FaultSchedule,
+           run_fn: Callable[[FaultSchedule], List[str]],
+           violations: Optional[List[str]] = None,
+           max_runs: int = 48,
+           min_requests: int = 16) -> Tuple[FaultSchedule, dict]:
+    """Minimize a failing schedule to a still-failing counterexample.
+
+    ``run_fn(schedule) -> violations`` runs one candidate (a full sim
+    chaos run). Reduction order: ddmin over the event list, halve the
+    fleet, truncate the workload — each step kept only when the
+    candidate still fails with an overlapping violation-kind set.
+    Dropped event targets that no longer exist in a halved fleet are
+    harmless: ``apply_fault`` no-ops on unknown members.
+
+    Returns (minimal schedule, stats dict: runs used, sizes
+    before/after)."""
+    if violations is None:
+        violations = run_fn(schedule)
+    kinds = violation_kinds(violations)
+    if not kinds:
+        raise ValueError("shrink: schedule does not fail — nothing "
+                         "to minimize")
+    runs = {"n": 1}
+
+    def failing(cand: FaultSchedule) -> bool:
+        if runs["n"] >= max_runs:
+            return False
+        runs["n"] += 1
+        return bool(violation_kinds(run_fn(cand)) & kinds)
+
+    before = {"events": len(schedule.events),
+              "engines": schedule.engines,
+              "requests": schedule.requests}
+
+    # 1. ddmin over events (Zeller's algorithm on complements)
+    events = list(schedule.events)
+    n = 2
+    while len(events) >= 2:
+        chunk = max(len(events) // n, 1)
+        reduced = False
+        for i in range(0, len(events), chunk):
+            cand_events = events[:i] + events[i + chunk:]
+            if failing(replace(schedule, events=cand_events)):
+                events = cand_events
+                n = max(n - 1, 2)
+                reduced = True
+                break
+        if not reduced:
+            if chunk <= 1:
+                break
+            n = min(len(events), n * 2)
+    schedule = replace(schedule, events=events)
+    # an empty event list can still fail (the bug may be in the
+    # workload path); try dropping the last survivor too
+    if len(events) == 1 and failing(replace(schedule, events=[])):
+        schedule = replace(schedule, events=[])
+
+    # 2. halve the fleet
+    while schedule.engines > 1:
+        cand = replace(schedule,
+                       engines=max(schedule.engines // 2, 1))
+        if not failing(cand):
+            break
+        schedule = cand
+
+    # 3. truncate the workload
+    while schedule.requests > min_requests:
+        cand = replace(schedule,
+                       requests=max(schedule.requests // 2,
+                                    min_requests))
+        if not failing(cand):
+            break
+        schedule = cand
+
+    stats = {"runs": runs["n"], "before": before,
+             "after": {"events": len(schedule.events),
+                       "engines": schedule.engines,
+                       "requests": schedule.requests}}
+    return schedule, stats
+
+
+# -- the replay bundle -------------------------------------------------
+
+
+def write_bundle(bundle_dir, schedule: FaultSchedule,
+                 violations: Sequence[str],
+                 shrink_stats: Optional[dict] = None) -> str:
+    """The standard chaos replay bundle: schedule.json (the minimal
+    counterexample), violation.json (what failed + how to reproduce),
+    and the returned one-command repro string."""
+    d = pathlib.Path(bundle_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    sched_path = d / "schedule.json"
+    schedule.save(sched_path)
+    cmd = schedule.replay_command(str(sched_path))
+    doc: Dict[str, object] = {
+        "violations": list(violations),
+        "schedule": schedule.to_dict(),
+        "replay": cmd}
+    if shrink_stats:
+        doc["shrink"] = shrink_stats
+    (d / "violation.json").write_text(
+        json.dumps(doc, sort_keys=True, indent=1) + "\n",
+        encoding="utf-8")
+    return cmd

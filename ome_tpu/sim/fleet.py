@@ -31,8 +31,14 @@ from ..router.server import Backend, RetryBudget, Router
 from ..telemetry import Registry
 from .clock import EventLoop, VirtualClock
 from .costmodel import CostModel
+from .durability import JournalSet, SimJournal
 from .engine import SimEngine, SimRequest
 from .transport import SimTransport
+
+# fault-event kinds the schedule runner applies (bounded metric label
+# cardinality by construction — see _build_metrics)
+FAULT_KINDS = ("kill", "restart", "slow", "stuck", "partition",
+               "heal")
 
 _MAX_ATTEMPTS = 3  # pick + up to two failovers, like replay's fronts
 
@@ -70,10 +76,15 @@ class SimPool:
     the drain completed)."""
 
     def __init__(self, name: str, fleet: "SimFleet",
-                 spawn_delay: float = 2.0):
+                 spawn_delay: float = 2.0,
+                 warmup_delay: float = 0.0):
         self.name = name
         self.fleet = fleet
         self.spawn_delay = float(spawn_delay)
+        # compile/warmup time on top of process spawn (from the cost
+        # table's warmup_ms) — a cold replica is NOT ready the moment
+        # the process exists, and autoscale scenarios price that
+        self.warmup_delay = float(warmup_delay)
         self.members: List[SimPoolMember] = []
         self.drains: List[DrainRecord] = []
         self._seq = 0
@@ -91,8 +102,18 @@ class SimPool:
     def draining_count(self) -> int:
         return sum(1 for m in self.members if m.draining)
 
-    def journals(self) -> List:
-        return []  # durability is out of sim scope (docs/simulation.md)
+    def journals(self) -> List[SimJournal]:
+        """The pool's virtual journals (durability model,
+        docs/simulation.md) — SimJournal objects rather than the real
+        pool's file paths; the sim-side invariant checks fold them
+        with the same admit/prog/fin logic chaos runs on files."""
+        return [j for _, j in self.fleet.sim_journals.items()]
+
+    def member(self, name: str) -> Optional[SimPoolMember]:
+        for m in self.members:
+            if m.name == name:
+                return m
+        return None
 
     def engine_seconds(self) -> float:
         now = self.fleet.clock.now()
@@ -101,7 +122,11 @@ class SimPool:
 
     # -- scale up -------------------------------------------------------
 
-    def spawn(self) -> SimPoolMember:
+    def spawn(self, delay: Optional[float] = None) -> SimPoolMember:
+        """Provision one replica. ``delay`` overrides the cold-start
+        time (spawn + warmup) for THIS spawn only — the scoped form
+        of the old mutate-and-restore of ``spawn_delay``, which an
+        exception mid-block could leave permanently zeroed."""
         self._seq += 1
         name = f"{self.name}{self._seq}"
         url = f"sim://{name}"
@@ -110,9 +135,11 @@ class SimPool:
             engine=self.fleet.new_engine(name, url),
             started_at=self.fleet.clock.now())
         self.members.append(member)
-        if self.spawn_delay > 0:
+        if delay is None:
+            delay = self.spawn_delay + self.warmup_delay
+        if delay > 0:
             self.fleet.loop.call_later(
-                self.spawn_delay, lambda: self._ready(member))
+                delay, lambda: self._ready(member))
         else:
             self._ready(member)
         return member
@@ -166,6 +193,7 @@ class SimFleet:
                  policy: str = "round_robin",
                  health_interval: float = 2.0,
                  spawn_delay: float = 2.0,
+                 durability: bool = True,
                  engine_kw: Optional[dict] = None):
         self.cost = cost
         self.seed = seed
@@ -176,11 +204,20 @@ class SimFleet:
         self.router = SimRouter(self.transport, self.clock,
                                 policy=policy,
                                 health_interval=health_interval)
-        self.pool = SimPool("engine", self, spawn_delay=spawn_delay)
+        self.pool = SimPool("engine", self, spawn_delay=spawn_delay,
+                            warmup_delay=cost.warmup_ms / 1000.0)
         self.controller: Optional[ScaleController] = None
         self.retry_budget = RetryBudget()
         self.results: List[ReplayResult] = []
         self._inflight: Dict[int, tuple] = {}
+        # durability model: one virtual journal per engine NAME,
+        # surviving kill() so a restart incarnation resumes it
+        self.durability = bool(durability)
+        self.sim_journals = JournalSet()
+        # applied fault events, in virtual-time order — part of the
+        # chaos report, so the determinism smoke byte-compares the
+        # fault path too
+        self.fault_log: List[dict] = []
         self.registry = Registry()
         self._g_virtual = self.registry.gauge(
             "ome_sim_virtual_seconds",
@@ -188,24 +225,30 @@ class SimFleet:
         self._c_events = self.registry.counter(
             "ome_sim_events_total",
             "Events executed by the simulation loop")
+        fam = self.registry.counter(
+            "ome_sim_fault_events_total",
+            "Chaos fault events applied by the schedule runner, by "
+            "kind", labelnames=("kind",))
+        self._c_faults = {k: fam.labels(kind=k) for k in FAULT_KINDS}
 
     # -- topology -------------------------------------------------------
 
-    def new_engine(self, name: str, url: str) -> SimEngine:
+    def new_engine(self, name: str, url: str,
+                   incarnation: int = 1) -> SimEngine:
+        journal = self.sim_journals.get(name) if self.durability \
+            else None
         return SimEngine(
             name, self.clock, self.loop, self.cost,
+            journal=journal, incarnation=incarnation,
             on_finish=lambda r, u=url: self._request_done(u, r),
             **self.engine_kw)
 
     def add_engines(self, n: int) -> None:
         """Pre-provision n replicas, ready immediately (t=0 fleets
-        skip the spawn delay — there is nothing to warm)."""
-        delay, self.pool.spawn_delay = self.pool.spawn_delay, 0.0
-        try:
-            for _ in range(n):
-                self.pool.spawn()
-        finally:
-            self.pool.spawn_delay = delay
+        skip the spawn and warmup delays — there is nothing to
+        warm)."""
+        for _ in range(n):
+            self.pool.spawn(delay=0.0)
 
     def add_controller(self, policy_cfg: PolicyConfig,
                        slo: Optional[SLOConfig] = None,
@@ -233,6 +276,88 @@ class SimFleet:
         eng = self.transport.engine(url)
         if eng is not None:
             eng.kill()
+
+    # -- chaos fault events (sim/faultplan.py schedules) ----------------
+
+    def restart_engine(self, name: str) -> bool:
+        """Respawn a killed replica in place: same name, same URL,
+        same router Backend (whose breaker/health state carries over
+        — the real recovery shape), incarnation bumped, virtual
+        journal resumed with progress folded."""
+        member = self.pool.member(name)
+        if member is None or not member.engine.killed:
+            return False
+        eng = self.new_engine(
+            name, member.url,
+            incarnation=member.engine.incarnation + 1)
+        member.engine = eng
+        self.transport.register(member.url, eng)
+        eng.resume_from_journal()
+        return True
+
+    def apply_fault(self, action: str, target: str,
+                    param: float = 0.0) -> bool:
+        """Apply one fault event NOW (schedules call this from
+        event-loop callbacks via ``at_fault``). Unknown targets and
+        no-op transitions (restarting a live engine) return False
+        without touching anything — a shrinker dropping one half of
+        a kill/restart pair must degrade gracefully, not crash the
+        run."""
+        member = self.pool.member(target)
+        if member is None:
+            return False
+        applied = False
+        eng = member.engine
+        if action == "kill":
+            if not eng.killed:
+                eng.kill()
+                applied = True
+        elif action == "restart":
+            applied = self.restart_engine(target)
+        elif action == "slow":
+            if not eng.killed:
+                eng.set_slow(param if param > 1.0 else 2.0)
+                applied = True
+        elif action == "stuck":
+            if not eng.killed:
+                eng.set_stuck(True)
+                applied = True
+        elif action == "partition":
+            self.transport.partition(member.url)
+            applied = True
+        elif action == "heal":
+            self.transport.heal(member.url)
+            if not eng.killed:
+                eng.set_slow(1.0)
+                eng.set_stuck(False)
+            applied = True
+        if applied:
+            c = self._c_faults.get(action)
+            if c is not None:
+                c.inc()
+            self.fault_log.append(
+                {"t": round(self.clock.now(), 6), "action": action,
+                 "target": target, "param": param})
+        return applied
+
+    def at_fault(self, at: float, action: str, target: str,
+                 param: float = 0.0) -> None:
+        """Schedule one fault event on the sim loop."""
+        self.loop.call_at(
+            at, lambda: self.apply_fault(action, target, param))
+
+    def recover_all(self) -> None:
+        """End-of-schedule recovery, mirroring the subprocess
+        harness: every killed engine respawns fault-free and resumes
+        its journal, every partition heals, every slow/stuck replica
+        clears — then the settle window lets invariants quiesce."""
+        for m in list(self.pool.members):
+            self.transport.heal(m.url)
+            if m.engine.killed:
+                self.apply_fault("restart", m.name)
+            else:
+                m.engine.set_slow(1.0)
+                m.engine.set_stuck(False)
 
     # -- the open-loop client -------------------------------------------
 
@@ -294,7 +419,10 @@ class SimFleet:
             return
         if status != 200:
             result.status = status
-            result.error = f"admission answered {status}"
+            retry = self.transport.retry_after(backend.url)
+            result.error = (f"admission answered {status}"
+                            + (f" (retry after {retry}s)"
+                               if retry is not None else ""))
             self.results.append(result)
             return
         self.router.adjust_inflight(backend, 1)
@@ -332,8 +460,13 @@ class SimFleet:
         self._c_events.inc(self.loop.executed - self._c_events.value)
 
     def sim_stats(self) -> dict:
-        return {"virtual_seconds": round(self.clock.now(), 6),
-                "events": self.loop.executed,
-                "engines_spawned": self.pool._seq,
-                "engine_seconds": round(
-                    self.pool.engine_seconds(), 3)}
+        stats = {"virtual_seconds": round(self.clock.now(), 6),
+                 "events": self.loop.executed,
+                 "engines_spawned": self.pool._seq,
+                 "engine_seconds": round(
+                     self.pool.engine_seconds(), 3)}
+        if self.fault_log:
+            stats["fault_events_applied"] = len(self.fault_log)
+            stats["incarnations"] = sum(
+                m.engine.incarnation for m in self.pool.members)
+        return stats

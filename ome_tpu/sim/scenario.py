@@ -185,7 +185,9 @@ def run_wdrr_fairness(seed: int = 0, n_classes: int = 120,
                     max_slots=16, kv_pages=100000, kv_block=16,
                     max_pending=depth + 8,
                     fused_k=8, classes=classes,
-                    class_weights=weights)
+                    class_weights=weights,
+                    max_queue_wait=None)  # saturation IS the regime
+    # under test here — the shed ladder must not thin the backlog
 
     def resubmit(req):
         # closed loop: the class replaces its served request, so the
@@ -267,9 +269,134 @@ def run_fleet_scale(seed: int = 0, engines: int = 1000,
     return rep
 
 
+# -- fleet-scale chaos (fault schedules + invariants) -----------------
+
+
+def chaos_invariants(fleet: SimFleet, tr) -> List[str]:
+    """The fleet-wide invariants a chaos run must satisfy at
+    quiescence — the sim-side mirror of the subprocess harness's
+    checkers, over the SAME semantic contracts:
+
+      * every driven request ends with exactly ONE client outcome
+        (chaos invariant 7, fleet-wide no-loss / no-duplicate);
+      * every journaled admit is tombstoned (chaos invariant 1:
+        restart-resume finished — or answered — everything the dead
+        incarnation had accepted);
+      * KV pages return to zero on every live engine (invariant 3's
+        conservation check, virtualized).
+
+    Violation strings carry a stable ``kind:`` prefix — the shrinker
+    keys its reduction predicate on it."""
+    violations: List[str] = []
+    counts: Dict[str, int] = {}
+    for r in fleet.results:
+        if r.trace_id is not None:
+            counts[r.trace_id] = counts.get(r.trace_id, 0) + 1
+    missing = [t.trace_id for t in tr
+               if t.trace_id not in counts]
+    dups = sorted(t for t, c in counts.items() if c > 1)
+    if missing:
+        violations.append(
+            f"request-loss: {len(missing)} driven request(s) got no "
+            f"outcome (first: {missing[:3]})")
+    if dups:
+        violations.append(
+            f"fleet outcome: {len(dups)} request(s) got multiple "
+            f"outcomes (first: {dups[:3]})")
+    if fleet._inflight:
+        violations.append(
+            f"request-loss: {len(fleet._inflight)} request(s) still "
+            "in flight at quiescence")
+    live = fleet.sim_journals.live_by_engine()
+    if live:
+        total = sum(len(v) for v in live.values())
+        violations.append(
+            f"journal: {total} admitted request(s) never tombstoned "
+            f"across {len(live)} journal(s) "
+            f"({', '.join(sorted(live)[:3])})")
+    for m in fleet.pool.members:
+        eng = m.engine
+        if not eng.killed and not eng.active and eng.pages_used:
+            violations.append(
+                f"kv: {m.name} holds {eng.pages_used} page(s) at "
+                "quiescence")
+    return violations
+
+
+def run_chaos(seed: int = 0, engines: int = 8, requests: int = 400,
+              kills: int = 4, cost: Optional[CostModel] = None,
+              schedule=None, settle_s: float = 60.0,
+              inject_bug: Optional[dict] = None,
+              **engine_kw) -> dict:
+    """Fault-schedule chaos at simulator scale: a seed-derived (or
+    supplied) FaultSchedule plays kill/restart/slow/stuck/partition
+    events against the fleet while a synthetic trace drives it; the
+    end-of-schedule recovery respawns and resumes everything (the
+    subprocess harness's discipline), and the report carries the
+    fleet-wide invariant verdict plus the schedule itself, so the
+    determinism smoke byte-compares the whole chaos path."""
+    from dataclasses import replace as _dc_replace
+
+    from .. import faults
+    from . import faultplan
+    cost = cost or default_cost_model()
+    if schedule is None:
+        schedule = faultplan.generate(
+            seed, engines=engines, requests=requests, kills=kills,
+            inject_bug=inject_bug)
+    elif inject_bug is not None and schedule.inject_bug is None:
+        schedule = _dc_replace(schedule, inject_bug=inject_bug)
+    faultplan.preflight(schedule)
+    fleet = SimFleet(cost, seed=schedule.seed, policy="round_robin",
+                     health_interval=2.0,
+                     engine_kw=dict({"max_slots": 4, "kv_pages": 512,
+                                     "fused_k": 4,
+                                     "max_pending": 256},
+                                    **engine_kw))
+    fleet.add_engines(schedule.engines)
+    fleet.start_health_loop()
+    bug = schedule.inject_bug or {}
+    if bug.get("kind") == "drop_resume":
+        # target "*" arms every journal: whichever kill first catches
+        # in-flight work trips the bug (robust to scheduling drift)
+        tgt = str(bug.get("target", "*"))
+        names = ([m.name for m in fleet.pool.members]
+                 if tgt == "*" else [tgt])
+        for name in names:
+            fleet.sim_journals.arm_drop_resume(
+                name, int(bug.get("n", 1)))
+    rate = schedule.requests / max(schedule.duration_s, 1.0)
+    tr = trace_mod.synthetic_trace(schedule.seed,
+                                   n=schedule.requests,
+                                   base_rate=max(rate, 0.1),
+                                   prompt_tokens=(8, 32),
+                                   max_tokens=(8, 32))
+    fleet.submit_trace(tr)
+    for e in schedule.events:
+        fleet.at_fault(e.at, e.action, e.target, e.param)
+    t_trace = max(r.arrival for r in tr) if tr else 0.0
+    t_events = max((e.at for e in schedule.events), default=0.0)
+    t_recover = max(t_trace, t_events) + 5.0
+    fleet.loop.call_at(t_recover, fleet.recover_all)
+    faults.install(schedule.fault_spec or "")
+    try:
+        fleet.run_until(t_recover + settle_s)
+    finally:
+        faults.reset()
+    rep = replay_mod.report(fleet.results, slo_ttft_s=2.0)
+    rep["scenario"] = "chaos"
+    rep["engines"] = schedule.engines
+    rep["schedule"] = schedule.to_dict()
+    rep["fault_log"] = fleet.fault_log
+    rep["violations"] = chaos_invariants(fleet, tr)
+    rep["sim"] = fleet.sim_stats()
+    return rep
+
+
 SCENARIOS = {
     "steady": run_steady,
     "autoscale": run_autoscale,
     "wdrr": run_wdrr_fairness,
     "fleet": run_fleet_scale,
+    "chaos": run_chaos,
 }

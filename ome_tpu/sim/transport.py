@@ -16,19 +16,37 @@ controller's scrape loop run unmodified:
     ``(False, False, None)`` for dead or unknown backends.
   * ``submit(url, req)`` — the generate path: the engine's admission
     status (200/503/429), or OSError when the backend is gone.
+
+Transport faults (docs/failure-semantics.md): each surface consults a
+cataloged ``faults.py`` point — ``sim_transport_submit`` /
+``sim_transport_probe`` / ``sim_transport_scrape``, key = backend URL
+— through ``faults.check`` (never ``fire``: a wall-clock sleep on the
+sim path breaks determinism, so an armed slow rule maps onto the
+surface's own timeout semantics instead: a submit/scrape slowed past
+``TIMEOUT_S`` surfaces as the same OSError a client timeout raises; a
+slowed probe misses its deadline and reads down). ``partition(url)``
+makes one backend unreachable on all three surfaces until
+``heal(url)`` — the network-partition analog, charged against the
+same breaker/health/scrape recovery paths.
 """
 
 from __future__ import annotations
 
 from typing import Dict, Optional
 
+from .. import faults
 from ..autoscale.scrape import parse_exposition
 from .engine import SimEngine, SimRequest
+
+# the virtual client/probe timeout budget an armed slow rule is
+# measured against (the real stack's 5 s connect/read timeouts)
+TIMEOUT_S = 5.0
 
 
 class SimTransport:
     def __init__(self):
         self._engines: Dict[str, SimEngine] = {}
+        self._partitioned: Dict[str, bool] = {}
 
     # -- membership ----------------------------------------------------
 
@@ -41,24 +59,58 @@ class SimTransport:
     def engine(self, url: str) -> Optional[SimEngine]:
         return self._engines.get(url.rstrip("/"))
 
+    # -- faults --------------------------------------------------------
+
+    def partition(self, url: str) -> None:
+        """Network-partition one backend: every surface fails with
+        OSError until heal()."""
+        self._partitioned[url.rstrip("/")] = True
+
+    def heal(self, url: str) -> None:
+        self._partitioned.pop(url.rstrip("/"), None)
+
+    def _severed(self, url: str) -> bool:
+        return self._partitioned.get(url.rstrip("/"), False)
+
     # -- the three wire surfaces ---------------------------------------
 
     def fetch_metrics(self, url: str, timeout: float = 5.0):
         del timeout  # signature parity with scrape.fetch_metrics
+        delay, boom = faults.check("sim_transport_scrape", key=url,
+                                   exc=OSError)
+        if boom is not None or delay >= TIMEOUT_S:
+            raise OSError(f"scrape failed: {url}")
         eng = self.engine(url)
-        if eng is None or eng.killed:
+        if eng is None or eng.killed or self._severed(url):
             raise OSError(f"connection refused: {url}")
         return parse_exposition(eng.metrics_text())
 
     def probe(self, url: str):
+        delay, boom = faults.check("sim_transport_probe", key=url,
+                                   exc=OSError)
+        if boom is not None or delay >= TIMEOUT_S:
+            return (False, False, None)
         eng = self.engine(url)
-        if eng is None or eng.killed:
+        if eng is None or eng.killed or self._severed(url):
             return (False, False, None)
         info = eng.ready_info()
         return (info["ready"], info["draining"], info)
 
     def submit(self, url: str, req: SimRequest) -> int:
+        delay, boom = faults.check("sim_transport_submit", key=url,
+                                   exc=OSError)
+        if boom is not None:
+            raise OSError(f"connection refused: {url}")
+        if delay >= TIMEOUT_S:
+            raise OSError(f"client timeout after {TIMEOUT_S:g}s: "
+                          f"{url}")
         eng = self.engine(url)
-        if eng is None or eng.killed:
+        if eng is None or eng.killed or self._severed(url):
             raise OSError(f"connection refused: {url}")
         return eng.submit(req)
+
+    def retry_after(self, url: str) -> Optional[int]:
+        """The Retry-After seconds a 429/503 answer from this
+        backend would carry (the engine's live queue-wait hint)."""
+        eng = self.engine(url)
+        return None if eng is None else eng.retry_after_hint()

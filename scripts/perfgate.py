@@ -203,6 +203,10 @@ def cost_table(parsed: dict, source: str) -> dict:
                         row.get("hbm_per_slot_bytes")}
     if "dispatch_ms" in parsed:
         table["dispatch_ms"] = parsed["dispatch_ms"]
+    if "warmup_ms" in parsed:
+        # cold-start compile/warmup cost; the simulator adds it to
+        # replica spawn delay so autoscale prices cold starts
+        table["warmup_ms"] = parsed["warmup_ms"]
     for k in ("value", "decode_effective_gbps", "achievable_gbps",
               "best_of"):
         if k in parsed:

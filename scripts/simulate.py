@@ -12,9 +12,17 @@ two runs with the same seed are byte-identical.
   python scripts/simulate.py --scenario fleet --engines 1000 \\
       --requests 50000           # the perf acceptance run
   python scripts/simulate.py --scenario steady --trace reqlog.jsonl
+  python scripts/simulate.py --scenario chaos --kills 8   # fault
+      # schedule + fleet-wide invariants (docs/simulation.md)
+  python scripts/simulate.py --scenario chaos --schedule sched.json
+  python scripts/simulate.py --scenario chaos --seed-violation \\
+      --shrink --bundle-dir /tmp/bundle   # minimize + replay bundle
 
 `--check-determinism` runs the scenario twice and fails unless the
 two reports agree byte-for-byte.
+
+Exit codes: 0 clean, 1 non-determinism, 2 invariant violations
+(chaos scenario).
 """
 
 import argparse
@@ -56,6 +64,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="replay a saved trace / engine reqlog "
                         "through the steady scenario instead of the "
                         "synthetic workload")
+    p.add_argument("--kills", type=int, default=None,
+                   help="kill/restart pairs in the generated fault "
+                        "schedule (chaos scenario)")
+    p.add_argument("--schedule", default=None,
+                   help="run this FaultSchedule JSON instead of "
+                        "generating one from the seed (chaos "
+                        "scenario; the replay-bundle path)")
+    p.add_argument("--seed-violation", action="store_true",
+                   help="arm the drop-resume durability bug on every "
+                        "virtual journal — the invariants MUST catch "
+                        "it (chaos scenario self-test)")
+    p.add_argument("--shrink", action="store_true",
+                   help="on violation, minimize the schedule to a "
+                        "still-failing counterexample before "
+                        "reporting (chaos scenario)")
+    p.add_argument("--bundle-dir", default=None,
+                   help="write the replay bundle (schedule.json + "
+                        "violation.json) here on violation")
     p.add_argument("--check-determinism", action="store_true",
                    help="run twice, fail on any byte difference")
     p.add_argument("--full", action="store_true",
@@ -73,16 +99,57 @@ def _cost(args) -> CostModel:
 
 def run_once(args) -> dict:
     kw = {"seed": args.seed, "cost": _cost(args)}
-    if args.scenario in ("steady", "fleet"):
+    if args.scenario in ("steady", "fleet", "chaos"):
         if args.engines is not None:
             kw["engines"] = args.engines
         if args.requests is not None:
             kw["requests"] = args.requests
     if args.scenario == "wdrr" and args.classes is not None:
         kw["n_classes"] = args.classes
+    if args.scenario == "chaos":
+        from ome_tpu.sim import faultplan
+        if args.kills is not None:
+            kw["kills"] = args.kills
+        if args.schedule:
+            kw["schedule"] = faultplan.FaultSchedule.load(
+                args.schedule)
+        if args.seed_violation:
+            kw["inject_bug"] = {"kind": "drop_resume",
+                                "target": "*", "n": 1}
     if args.scenario == "steady" and args.trace:
         return _run_trace_replay(args, kw)
     return scen.SCENARIOS[args.scenario](**kw)
+
+
+def _shrink_and_bundle(args, rep: dict) -> dict:
+    """Violation post-processing for the chaos scenario: minimize
+    the failing schedule (--shrink), write the replay bundle
+    (--bundle-dir), and fold both into the report."""
+    from ome_tpu.sim import faultplan
+    schedule = faultplan.FaultSchedule.from_dict(rep["schedule"])
+    shrink_stats = None
+    if args.shrink:
+        cost = _cost(args)
+
+        def run_fn(s):
+            return scen.run_chaos(schedule=s,
+                                  cost=cost)["violations"]
+        schedule, shrink_stats = faultplan.shrink(
+            schedule, run_fn, violations=rep["violations"])
+        rep["shrink"] = shrink_stats
+        rep["minimal_schedule"] = schedule.to_dict()
+        sys.stderr.write(
+            f"simulate: shrunk to {len(schedule.events)} event(s) "
+            f"in {shrink_stats['runs']} run(s)\n")
+    if args.bundle_dir:
+        cmd = faultplan.write_bundle(args.bundle_dir, schedule,
+                                     rep["violations"],
+                                     shrink_stats=shrink_stats)
+        rep["bundle_dir"] = args.bundle_dir
+        rep["replay"] = cmd
+        sys.stderr.write(f"simulate: replay bundle in "
+                         f"{args.bundle_dir}\n  replay: {cmd}\n")
+    return rep
 
 
 def _run_trace_replay(args, kw) -> dict:
@@ -123,6 +190,11 @@ def main(argv=None) -> int:
                              "with the same seed diverged\n")
             return 1
         sys.stderr.write("simulate: determinism check OK\n")
+    violations = rep.get("violations") or []
+    if violations:
+        for v in violations:
+            sys.stderr.write(f"simulate: VIOLATION: {v}\n")
+        rep = _shrink_and_bundle(args, rep)
     if not args.full:
         rep = {k: v for k, v in rep.items() if k != "decisions"}
     sys.stderr.write(
@@ -130,7 +202,7 @@ def main(argv=None) -> int:
         f"({rep.get('sim', {}).get('virtual_seconds', '?')} virtual "
         "seconds)\n")
     sys.stdout.write(scen.canonical_json(rep))
-    return 0
+    return 2 if violations else 0
 
 
 if __name__ == "__main__":

@@ -576,3 +576,115 @@ class TestFidelityGate:
         assert _sign_sequence(fleet.controller.decisions) \
             == _sign_sequence(real_decisions) == [1, -1]
         assert fleet.pool.size() == real_final == 1
+
+    def test_deep_saturation_shed_point(self, tmp_path):
+        """The deep-saturation fidelity point: a flood far past one
+        engine's capacity, real vs simulated. Both sides run the
+        same admission ladder (queue-wait estimate vs per-class cap,
+        429 + Retry-After), so the gate checks the SHED behavior —
+        every flooded request either completes or is 429'd, both
+        sides shed, and the shed fractions track within the band."""
+        from ome_tpu.autoscale.pool import EnginePool
+
+        model_dir = tmp_path / "model"
+        model_dir.mkdir()
+
+        def engine_args(port, name, journal_dir):
+            return ["--model-dir", str(model_dir),
+                    "--random-weights", "--dtype", "float32",
+                    "--host", "127.0.0.1", "--port", str(port),
+                    "--max-slots", "2", "--kv-block", "16",
+                    "--kv-blocks", "60", "--max-queue-wait", "2.0",
+                    "--journal", str(journal_dir)]
+
+        pool = EnginePool("engine", None, engine_args, tmp_path,
+                          drain_exit_timeout=60.0)
+        try:
+            pool.spawn()
+            url = pool.member_urls()[0]
+            # warm: the first request pays XLA compile, the next two
+            # give clean TPOT samples AND warm the scheduler's
+            # queue-wait EWMAs — mirrored on the sim side below
+            warm = [trace_mod.TraceRequest(
+                trace_id=f"warm-{i}", arrival=0.0, prompt_tokens=8,
+                max_tokens=32, temperature=0.0) for i in range(3)]
+            replay_mod.replay(url, warm[:1], timeout=180)
+            wres = replay_mod.replay(url, warm[1:], timeout=180)
+            assert all(w.ok for w in wres), [vars(w) for w in wres]
+            tpots = [w.tpot_s for w in wres if w.tpot_s]
+            assert tpots, [vars(w) for w in wres]
+            # size the flood from the MEASURED speed so it provably
+            # exceeds capacity: depth n/2 must put the estimated
+            # queue wait (waves x 64 steps x tpot) well past the 2 s
+            # cap even on a fast CPU host
+            tpot = sum(tpots) / len(tpots)
+            n = min(max(int(12.0 / (64 * tpot)), 40), 300)
+            trace = trace_mod.synthetic_trace(
+                11, n=n, base_rate=float(n), burst_factor=1.0,
+                prompt_tokens=(8, 16), max_tokens=(48, 80))
+            real_results = replay_mod.replay(url, trace, timeout=300)
+        finally:
+            pool.stop_all()
+
+        real_shed = [r for r in real_results if r.status == 429]
+        real_ok = [r for r in real_results if r.ok]
+        # conservation: complete or shed, nothing in between
+        assert len(real_shed) + len(real_ok) == len(trace), \
+            [(r.trace_id, r.status, r.error) for r in real_results
+             if not r.ok and r.status != 429]
+        assert real_shed, "flood never saturated the real ladder"
+        real_ttfts = sorted(r.ttft_s for r in real_ok if r.ttft_s)
+        real_p99 = real_ttfts[int(0.99 * (len(real_ttfts) - 1))]
+
+        # -- the simulated side, calibrated from the real run -------
+        # under-LOAD tpot (the main gate's recipe): what the flooded
+        # requests actually experienced per token, so the sim's
+        # service rate — and therefore its queue-wait EWMAs — sit at
+        # the same operating point as the real scheduler's
+        load_tpots = sorted(r.tpot_s for r in real_ok if r.tpot_s)
+        tpot_load = load_tpots[len(load_tpots) // 2] if load_tpots \
+            else tpot
+        cost = CostModel.from_measurements(
+            tpot_ms=tpot_load * 1000.0,
+            prefill_ms_per_token=max(
+                (wres[0].ttft_s or 0.05) * 1000.0 / 8, 0.01))
+        loop = EventLoop()
+        done = []
+        eng = SimEngine("e0", loop.clock, loop, cost, max_slots=2,
+                        kv_pages=60, kv_block=16,
+                        max_queue_wait=2.0, on_finish=done.append)
+        for _ in range(2):  # warm the sim EWMAs like the real side
+            assert eng.submit(SimRequest(8, 32)) == 200
+            loop.run()
+        done.clear()
+        offset = loop.clock.now()
+        lengths = {r.trace_id: max(r.output_tokens, 1)
+                   for r in real_ok}
+        statuses = {}
+
+        def submit(t):
+            statuses[t.trace_id] = eng.submit(SimRequest(
+                t.prompt_tokens,
+                lengths.get(t.trace_id, t.max_tokens),
+                trace_id=t.trace_id))
+
+        for t in trace:
+            loop.call_at(offset + t.arrival, lambda t=t: submit(t))
+        loop.run()
+
+        # both ladders shed, and what they admitted they finished
+        sim_shed = sum(1 for s in statuses.values() if s == 429)
+        assert sim_shed > 0, "sim ladder never shed under the flood"
+        assert 1 <= eng.retry_after_hint() <= 30
+        admitted = sum(1 for s in statuses.values() if s == 200)
+        finished = [r for r in done if r.finish_reason == "stop"]
+        assert len(finished) == admitted
+        # the point of the ladder: accepted-request TTFT tails stay
+        # BOUNDED under deep saturation (without the shed the
+        # backlog would push p99 an order of magnitude past the
+        # cap) — and the sim tail tracks the real one
+        sim_ttfts = sorted(r.first_token_at - r.created
+                           for r in finished)
+        sim_p99 = sim_ttfts[int(0.99 * (len(sim_ttfts) - 1))]
+        assert abs(sim_p99 - real_p99) <= max(1.0 * real_p99, 1.0), \
+            f"ttft p99: sim={sim_p99:.2f}s real={real_p99:.2f}s"
