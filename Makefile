@@ -5,7 +5,7 @@
 PYTHON ?= python
 
 .PHONY: lint test replay autoscale-soak noisy-neighbor router-soak \
-	benchgate simulate chaos-sim
+	benchgate simulate chaos-sim slo-report
 
 # omelint: the repo's static-analysis gate (docs/static-analysis.md).
 # Runs every registered analyzer over ome_tpu/ and fails on any
@@ -45,6 +45,14 @@ chaos-sim:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/simulate.py \
 		--scenario chaos --seed 7 --engines 100 --requests 2000 \
 		--kills 12 --check-determinism
+
+# fleet SLO report (docs/slo.md): the steady scenario through the
+# virtual-time SLO engine, printing the per-class attainment /
+# error-budget / alert-state table to stderr (canonical JSON report
+# on stdout, pipe it somewhere if you want it)
+slo-report:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/simulate.py \
+		--scenario steady --seed 7 --slo-table >/dev/null
 
 # trace replay against a self-spawned router + CPU engine: the quick
 # "does the load generator work here" check (docs/autoscaling.md);

@@ -68,6 +68,10 @@ class SLOConfig:
     kv_util_high: float = 0.9
     queue_depth_high: float = 4.0
     priority_class: str = highest_class()
+    # error-budget burn rate that normalizes to 1.0 pressure; only
+    # consulted when the controller was built with a burn_fn (the
+    # fleet SLO rollup's max_burn; docs/slo.md)
+    burn_high: Optional[float] = None
 
 
 @dataclass
@@ -107,6 +111,7 @@ class ScaleController:
                  router_url: Optional[str] = None,
                  registry: Optional[Registry] = None,
                  fetch_fn=scrape.fetch_metrics,
+                 burn_fn=None,
                  interval: float = 1.0,
                  clock=None):
         self.pools = pools
@@ -114,6 +119,10 @@ class ScaleController:
         self.slo = slo
         self.router_url = router_url.rstrip("/") if router_url else None
         self.fetch_fn = fetch_fn
+        # optional SLO pressure input: burn_fn() -> current worst
+        # error-budget burn rate (FleetRollup.max_burn); normalized
+        # against slo.burn_high when both are set
+        self.burn_fn = burn_fn
         self.interval = interval
         # the ONE clock the decision path reads, injected end to end:
         # decision stamps, histogram-window staleness, and the
@@ -229,6 +238,9 @@ class ScaleController:
             signals["kv_util"] = round(max(kv_utils), 4)
         if depths:
             signals["queue_depth"] = round(max(depths), 4)
+        if self.burn_fn is not None \
+                and self.slo.burn_high is not None:
+            signals["burn_rate"] = round(self.burn_fn(), 4)
         return signals
 
     def _pressure(self, signals: Dict[str, float]) -> float:
@@ -244,6 +256,8 @@ class ScaleController:
         if "queue_depth" in signals:
             parts.append(signals["queue_depth"]
                          / slo.queue_depth_high)
+        if "burn_rate" in signals and slo.burn_high:
+            parts.append(signals["burn_rate"] / slo.burn_high)
         return max(parts) if parts else 0.0
 
     # -- the tick -----------------------------------------------------

@@ -224,6 +224,55 @@ def report(results: Sequence[ReplayResult],
     return out
 
 
+def slo_section(results: Sequence[ReplayResult], spec) -> dict:
+    """Client-observed SLO attainment against an ``SLOSpec`` — the
+    replay half of the sim-vs-real parity contract (docs/slo.md):
+    per-(class, objective) good/total counts that ``GET /slo`` on
+    the router must match within +-1 request on a clean run.
+    Latency objectives count completed requests (the population the
+    engine histograms observe); availability counts every answered
+    request as good unless it failed server-side (5xx, timeout,
+    transport error, aborted stream)."""
+    from ..priority import DEFAULT_PRIORITY
+    by_class: dict = {}
+    for r in results:
+        by_class.setdefault(r.priority or DEFAULT_PRIORITY,
+                            []).append(r)
+    metric = {"ttft": lambda r: r.ttft_s,
+              "e2e": lambda r: r.e2e_s,
+              "tpot": lambda r: r.tpot_s}
+    out: dict = {}
+    for cls in sorted(spec.classes):
+        rs = by_class.get(cls, [])
+        cls_out: dict = {}
+        for obj in spec.classes[cls]:
+            if obj.kind == "availability":
+                good = sum(
+                    1 for r in rs
+                    if r.status is not None and r.status < 500
+                    and not (r.status == 200 and r.error is not None))
+                total = len(rs)
+            else:
+                get = metric.get(obj.name)
+                if get is None:  # not client-measurable (queue_wait)
+                    continue
+                xs = [x for x in (get(r) for r in rs if r.ok)
+                      if x is not None]
+                good = sum(1 for x in xs if x <= obj.threshold_s)
+                total = len(xs)
+            cls_out[obj.name] = {
+                "good": good, "total": total,
+                "target": obj.target,
+                "attainment": (round(good / total, 6)
+                               if total else None),
+                "budget_consumed": (round(
+                    (total - good) / (total * obj.budget), 6)
+                    if total else 0.0),
+            }
+        out[cls] = cls_out
+    return out
+
+
 # -- CLI -------------------------------------------------------------
 
 
@@ -261,6 +310,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "this many times")
     p.add_argument("--slo-ttft-p99", type=float, default=2.0)
     p.add_argument("--slo-e2e-p99", type=float, default=None)
+    p.add_argument("--slo-spec", default=None,
+                   help="SLO spec JSON (config/slo.json format): "
+                        "adds a per-class 'slo' section of "
+                        "client-observed attainment + budget burn "
+                        "to the report (docs/slo.md)")
     p.add_argument("--timeout", type=float, default=120.0)
     p.add_argument("--save-trace", default=None,
                    help="also write the (transformed) trace to this "
@@ -356,6 +410,10 @@ def main(argv=None) -> int:
                          prompt_seed=args.seed)
         rep = report(results, slo_ttft_s=args.slo_ttft_p99,
                      slo_e2e_s=args.slo_e2e_p99)
+        if args.slo_spec:
+            from ..slo import load as load_slo
+            rep["slo"] = slo_section(results,
+                                     load_slo(args.slo_spec))
         rep["endpoint"] = (url if isinstance(url, str)
                            else url[0] if len(url) == 1 else url)
         print(json.dumps(rep, separators=(",", ":"), default=str))

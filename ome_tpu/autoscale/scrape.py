@@ -98,9 +98,13 @@ def bucket_counts(samples: Dict[str, float], family: str,
 def quantile_from_buckets(buckets: List[Tuple[float, float]],
                           q: float) -> Optional[float]:
     """histogram_quantile over cumulative buckets: find the bucket
-    holding rank q*count, interpolate linearly inside it. None when
-    the histogram is empty. The +Inf bucket clamps to the last finite
-    bound (Prometheus convention)."""
+    holding rank q*count, interpolate linearly inside it. The +Inf
+    bucket clamps to the last finite bound (Prometheus convention).
+
+    Sentinel: returns None — never NaN, never a division error —
+    when there is no estimate at all: an empty list, an all-zero
+    window (total <= 0), or a +Inf-only window (every observation
+    beyond every finite bound, so no finite bound to clamp to)."""
     if not buckets:
         return None
     total = buckets[-1][1]
@@ -111,7 +115,10 @@ def quantile_from_buckets(buckets: List[Tuple[float, float]],
     for bound, count in buckets:
         if count >= rank:
             if math.isinf(bound):
-                return prev_bound  # observation beyond every bound
+                # rank falls beyond every finite bound: clamp to the
+                # last finite bound; with no finite bucket at all
+                # (+Inf-only window) there is nothing to clamp to
+                return prev_bound if len(buckets) > 1 else None
             if count == prev_count:
                 return bound
             frac = (rank - prev_count) / (count - prev_count)
@@ -146,14 +153,26 @@ class HistogramWindow:
         self._prev: Dict[str, List[Tuple[float, float]]] = {}
         self._window: Dict[str, List[Tuple[float, float]]] = {}
         self._updated_at: Dict[str, float] = {}
+        self._incarnation: Dict[str, object] = {}
 
-    def update(self, source: str, samples: Dict[str, float]) -> None:
+    def update(self, source: str, samples: Dict[str, float],
+               incarnation: Optional[object] = None) -> None:
+        """Ingest one scrape. ``incarnation`` (engine restart
+        counter, when the source exposes one) forces a re-base when
+        it changes: a restarted engine's counters restart from zero
+        and can grow PAST the pre-restart values by the next scrape,
+        which the counts-went-backwards check alone cannot see — the
+        delta would silently mix pre- and post-restart windows."""
         cur = bucket_counts(samples, self.family, self.labels)
         prev = self._prev.get(source)
         self._prev[source] = cur
         if self.clock is not None:
             self._updated_at[source] = self.clock()
-        if prev is None or len(prev) != len(cur):
+        rebased = (incarnation is not None
+                   and incarnation != self._incarnation.get(source))
+        if incarnation is not None:
+            self._incarnation[source] = incarnation
+        if prev is None or rebased or len(prev) != len(cur):
             self._window.pop(source, None)
             return
         delta = []
@@ -168,6 +187,7 @@ class HistogramWindow:
         self._prev.pop(source, None)
         self._window.pop(source, None)
         self._updated_at.pop(source, None)
+        self._incarnation.pop(source, None)
 
     def staleness(self, source: str) -> Optional[float]:
         """Clock units since ``source`` was last updated; None when
@@ -180,10 +200,141 @@ class HistogramWindow:
     def window_count(self) -> float:
         return sum(d[-1][1] for d in self._window.values() if d)
 
-    def quantile(self, q: float) -> Optional[float]:
+    def merged(self) -> List[Tuple[float, float]]:
+        """Cumulative (bound, count) deltas merged across sources —
+        the fleet-wide distribution of observations that arrived
+        between the last two scrapes of each source."""
         merged: Dict[float, float] = {}
         for delta in self._window.values():
             for bound, count in delta:
                 merged[bound] = merged.get(bound, 0.0) + count
-        return quantile_from_buckets(
-            sorted(merged.items(), key=lambda kv: kv[0]), q)
+        return sorted(merged.items(), key=lambda kv: kv[0])
+
+    def quantile(self, q: float) -> Optional[float]:
+        return quantile_from_buckets(self.merged(), q)
+
+
+def count_le(buckets: List[Tuple[float, float]],
+             threshold: float) -> float:
+    """Observations <= ``threshold`` in cumulative (bound, count)
+    pairs: exact when the threshold sits on a bucket bound (SLO specs
+    pick thresholds on DEFAULT_BUCKETS bounds for exactly this
+    reason), linearly interpolated inside the containing bucket
+    otherwise."""
+    if not buckets:
+        return 0.0
+    prev_bound, prev_count = 0.0, 0.0
+    for bound, count in buckets:
+        if math.isinf(bound):
+            return count if math.isinf(threshold) else prev_count
+        if bound == threshold:
+            return count
+        if bound > threshold:
+            if bound == prev_bound:
+                return count
+            frac = (threshold - prev_bound) / (bound - prev_bound)
+            return prev_count + (count - prev_count) * max(
+                0.0, min(1.0, frac))
+        prev_bound, prev_count = bound, count
+    return buckets[-1][1]
+
+
+class CounterWindow:
+    """Windowed deltas for one counter family across scrapes, with
+    the same reset/incarnation re-basing discipline as
+    HistogramWindow. ``label_filter`` narrows to matching children;
+    ``total()`` sums each source's delta between its last two
+    updates."""
+
+    def __init__(self, family: str,
+                 label_filter: Optional[Dict[str, str]] = None):
+        self.family = family
+        self.labels = dict(label_filter) if label_filter else None
+        self._prev: Dict[str, float] = {}
+        self._delta: Dict[str, float] = {}
+        self._incarnation: Dict[str, object] = {}
+
+    def _value(self, samples: Dict[str, float]) -> float:
+        tot = 0.0
+        for key, value in samples.items():
+            name, labels = split_key(key)
+            if name != self.family:
+                continue
+            if self.labels and any(labels.get(k) != v
+                                   for k, v in self.labels.items()):
+                continue
+            tot += value
+        return tot
+
+    def update(self, source: str, samples: Dict[str, float],
+               incarnation: Optional[object] = None) -> None:
+        cur = self._value(samples)
+        prev = self._prev.get(source)
+        self._prev[source] = cur
+        rebased = (incarnation is not None
+                   and incarnation != self._incarnation.get(source))
+        if incarnation is not None:
+            self._incarnation[source] = incarnation
+        if prev is None or rebased or cur < prev:
+            self._delta.pop(source, None)
+            return
+        self._delta[source] = cur - prev
+
+    def forget(self, source: str) -> None:
+        self._prev.pop(source, None)
+        self._delta.pop(source, None)
+        self._incarnation.pop(source, None)
+
+    def total(self) -> float:
+        return sum(self._delta.values())
+
+
+class SharedScraper:
+    """One /metrics fetch per backend per tick, many consumers.
+
+    The autoscale controller and the fleet SLO rollup both scrape
+    every backend each tick; fetching twice not only doubles load,
+    it hands the two consumers DIFFERENT cumulative counters for the
+    "same" instant. SharedScraper memoizes one result — or one
+    raised OSError — per URL, reused while
+    ``clock() - fetched_at <= max_age`` (0.0 = same-instant only,
+    which is exactly right in the simulator where both consumers
+    tick at the same virtual time). Without an injected clock the
+    scraper degrades to a counting passthrough: every call fetches.
+
+    ``fetches`` counts underlying HTTP fetches so regression tests
+    can assert the one-fetch-per-backend-per-tick contract.
+    """
+
+    def __init__(self, fetch_fn: Callable[..., Dict[str, float]]
+                 = fetch_metrics,
+                 clock: Optional[Callable[[], float]] = None,
+                 max_age: float = 0.0):
+        self.fetch_fn = fetch_fn
+        self.clock = clock
+        self.max_age = max_age
+        self.fetches = 0
+        self._cache: Dict[str, Tuple[
+            float, Optional[Dict[str, float]], Optional[OSError]]] = {}
+
+    def fetch(self, url: str) -> Dict[str, float]:
+        if self.clock is not None:
+            now = self.clock()
+            ent = self._cache.get(url)
+            if ent is not None and now - ent[0] <= self.max_age:
+                if ent[2] is not None:
+                    raise ent[2]
+                return ent[1]
+        self.fetches += 1
+        try:
+            result = self.fetch_fn(url)
+        except OSError as exc:
+            if self.clock is not None:
+                self._cache[url] = (now, None, exc)
+            raise
+        if self.clock is not None:
+            self._cache[url] = (now, result, None)
+        return result
+
+    def forget(self, url: str) -> None:
+        self._cache.pop(url, None)

@@ -742,6 +742,11 @@ class Scheduler:
             "ome_engine_class_ttft_seconds",
             "Time to first token by priority class",
             labelnames=("class",)))
+        self._h_class_e2e = _by_class(R.histogram(
+            "ome_engine_class_e2e_seconds",
+            "End-to-end request seconds by priority class (the "
+            "fleet SLO rollup's e2e objective source; docs/slo.md)",
+            labelnames=("class",)))
         self._g_class_depth = _by_class(R.gauge(
             "ome_engine_class_queue_depth",
             "Pending-queue depth by priority class",
@@ -843,6 +848,8 @@ class Scheduler:
         end = req.finished_at if req.finished_at is not None \
             else time.monotonic()
         self._h_e2e.observe(end - req.created)
+        self._h_class_e2e[self._class_of(req)].observe(
+            end - req.created)
         if req.first_token_at is not None:
             self._h_ttft.observe(req.first_token_at - req.created)
             self._h_class_ttft[self._class_of(req)].observe(

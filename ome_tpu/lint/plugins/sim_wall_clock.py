@@ -44,6 +44,7 @@ ROOT_SPECS = (
     "sim/fleet.py::SimFleet._client_submit",
     "sim/fleet.py::SimFleet._request_done",
     "sim/fleet.py::SimFleet.add_controller",
+    "sim/fleet.py::SimFleet.add_slo",
     "sim/fleet.py::SimFleet.start_health_loop",
     "sim/fleet.py::SimPool.spawn",
     "sim/fleet.py::SimPool.drain_one",

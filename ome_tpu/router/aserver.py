@@ -111,6 +111,9 @@ class AsyncRouterServer:
         self.retries = retries
         self.retry_backoff = retry_backoff
         self.debug_endpoints = debug_endpoints
+        # fleet SLO rollup (docs/slo.md): attached by main() when
+        # --slo-spec is given; GET /slo answers 404 until then
+        self.slo_rollup = None
         self.gossip = gossip
         self.stream_buffer = max(1, stream_buffer)
         self.budget = RetryBudget(ratio=retry_budget_ratio)
@@ -292,6 +295,19 @@ class AsyncRouterServer:
                                  "(enable --debug-endpoints)"})
                 return await self._send_json(writer, 200, {
                     "backends": router.backend_snapshot()})
+            if path == "/slo":
+                # fleet SLO attainment / budget / alert state
+                # (docs/slo.md), guarded like /backends
+                if not self._guard():
+                    return await self._send_json(writer, 403, {
+                        "error": "debug endpoints disabled "
+                                 "(enable --debug-endpoints)"})
+                if self.slo_rollup is None:
+                    return await self._send_json(writer, 404, {
+                        "error": "slo rollup not configured "
+                                 "(start with --slo-spec)"})
+                return await self._send_json(
+                    writer, 200, self.slo_rollup.report())
             if path == "/debug/state":
                 if not self._guard():
                     return await self._send_json(writer, 403, {
@@ -323,6 +339,7 @@ class AsyncRouterServer:
                 payload = json.loads(body or b"{}")
             except ValueError:
                 payload = {}
+            cls = None
             if path in ("/v1/completions", "/v1/chat/completions"):
                 try:
                     cls = coerce_priority(
@@ -334,7 +351,8 @@ class AsyncRouterServer:
             stream = bool(payload.get("stream"))
             return await self._proxy(
                 method, path, headers, body, stream,
-                affinity_from_payload(payload), reader, writer)
+                affinity_from_payload(payload), reader, writer,
+                cls=cls)
         if method == "DELETE":
             if path == "/backends":
                 return await self._backends_mutate(writer, body,
@@ -386,11 +404,12 @@ class AsyncRouterServer:
             return None
 
     async def _proxy(self, method, path, headers, body, stream,
-                     affinity, reader, writer):
+                     affinity, reader, writer, cls=None):
         ctx = tracing.from_headers(headers)
         t0 = time.monotonic()
         outcome = {"backend": None, "pool": None,
-                   "status": "error", "retries": 0}
+                   "status": "error", "retries": 0,
+                   "class": cls}
         span = None
         if self.span_log.enabled:
             span = tracing.Span("router.request",
@@ -422,12 +441,24 @@ class AsyncRouterServer:
         except asyncio.CancelledError:
             if not gone["flag"]:
                 raise
-            outcome["status"] = "client_gone"
+            # real SSE clients hang up the moment they read the
+            # `data: [DONE]` sentinel — the watcher's cancellation
+            # then races the relay's own return. If the full
+            # response was already delivered the request was SERVED;
+            # only a mid-response hangup is a true client_gone
+            # (docs/slo.md availability classification)
+            outcome["status"] = ("ok" if outcome.get("delivered")
+                                 else "client_gone")
             raise _ClientGone("client closed connection") from None
         finally:
             watcher.cancel()
             dur = time.monotonic() - t0
             self._h_request.observe(dur)
+            if cls is not None and outcome["status"] != "client_gone":
+                # availability: everything the router answered is
+                # good except its own failure statuses
+                self.router.note_outcome(
+                    cls, outcome["status"] == "ok")
             if span is not None:
                 span.set(pool=outcome["pool"],
                          backend=outcome["backend"],
@@ -500,7 +531,7 @@ class AsyncRouterServer:
                     prefix_peer=(peer_hint
                                  if peer_hint != backend.url
                                  else None),
-                    writer=writer)
+                    writer=writer, outcome=outcome)
                 router.note_result(backend, ok=True)
                 outcome["status"] = "ok"
                 if aspan is not None:
@@ -631,7 +662,7 @@ class AsyncRouterServer:
 
     async def _forward(self, backend: Backend, method, path, headers,
                        body, stream, deadline, trace, prefix_peer,
-                       writer):
+                       writer, outcome=None):
         from .. import faults
 
         await faults.afire("router_forward", key=backend.url,
@@ -682,10 +713,13 @@ class AsyncRouterServer:
                     writer, status, data,
                     rheaders.get("Content-Type", "application/json"),
                     extra)
+                if outcome is not None:
+                    outcome["delivered"] = True
                 return None
             if stream:
                 await self._relay_stream(up_reader, rheaders, status,
-                                         writer, deadline_mono)
+                                         writer, deadline_mono,
+                                         outcome=outcome)
                 return None
             try:
                 data = await self._read_body(up_reader, rheaders,
@@ -697,6 +731,8 @@ class AsyncRouterServer:
             await self._send_body(
                 writer, status, data,
                 rheaders.get("Content-Type", "application/json"))
+            if outcome is not None:
+                outcome["delivered"] = True
             return None
         finally:
             self.router.adjust_inflight(backend, -1)
@@ -707,7 +743,7 @@ class AsyncRouterServer:
                 up_writer.close()
 
     async def _relay_stream(self, up_reader, rheaders, status, writer,
-                            deadline_mono):
+                            deadline_mono, outcome=None):
         """Backpressure-aware SSE relay: upstream chunks flow through
         a BOUNDED queue into the client socket. The pump (upstream
         reader) and the writer are separate coroutines, so a slow
@@ -752,6 +788,13 @@ class AsyncRouterServer:
         pump_task = asyncio.create_task(pump())
         self._open_streams += 1
         try:
+            # real SSE clients hang up the moment they read the
+            # `data: [DONE]` sentinel, without draining the trailing
+            # blank line or the chunked terminator — once the
+            # sentinel is delivered the request was SERVED, and
+            # classifying it client_gone would poison the
+            # availability SLO (docs/slo.md)
+            done_sent = False
             while True:
                 kind, payload = await q.get()
                 if kind == "eof":
@@ -763,12 +806,28 @@ class AsyncRouterServer:
                                  + payload + b"\r\n")
                     await writer.drain()
                 except (OSError, ConnectionError) as e:
+                    if done_sent:
+                        break
                     raise _ClientGone(str(e)) from e
+                if b"data: [DONE]" in payload:
+                    done_sent = True
+                    if outcome is not None:
+                        # the disconnect watcher may cancel us the
+                        # instant the client reads the sentinel —
+                        # record that the response is complete so
+                        # that cancellation classifies as served
+                        outcome["delivered"] = True
             try:
                 writer.write(b"0\r\n\r\n")
                 await writer.drain()
-            except (OSError, ConnectionError) as e:
-                raise _ClientGone(str(e)) from e
+            except (OSError, ConnectionError):
+                # upstream is drained and every body byte was
+                # relayed: a client that hangs up between the last
+                # event and the terminating chunk still received the
+                # whole response — served, not abandoned
+                pass
+            if outcome is not None:
+                outcome["delivered"] = True
         finally:
             self._open_streams -= 1
             pump_task.cancel()
@@ -793,6 +852,12 @@ def main(argv=None) -> int:
                         "(ome_tpu/faults.py grammar); also via "
                         "OME_FAULTS")
     p.add_argument("--debug-endpoints", action="store_true")
+    p.add_argument("--slo-spec", default=None,
+                   help="SLO spec JSON (config/slo.json format): "
+                        "starts the fleet rollup loop and serves "
+                        "GET /slo + ome_slo_* metrics (docs/slo.md)")
+    p.add_argument("--slo-interval", type=float, default=5.0,
+                   help="seconds between fleet SLO rollup scrapes")
     p.add_argument("--request-log", default=None)
     p.add_argument("--span-log", default=None)
     p.add_argument("--stream-buffer", type=int, default=64,
@@ -860,6 +925,22 @@ def main(argv=None) -> int:
     if args.gossip_peer:
         agent = GossipAgent(gossip, args.gossip_peer,
                             interval=args.health_interval).start()
+    if args.slo_spec:
+        from ..autoscale.scrape import SharedScraper
+        from ..slo import FleetRollup
+        from ..slo import load as load_slo
+        from ..slo.rollup import start_thread as start_slo_thread
+        scraper = SharedScraper(clock=time.monotonic,
+                                max_age=args.slo_interval / 2.0)
+        srv.slo_rollup = FleetRollup(
+            load_slo(args.slo_spec), clock=time.monotonic,
+            fetch_fn=scraper.fetch,
+            backends_fn=router.backend_snapshot,
+            registry=router.registry,
+            local_samples_fn=router.registry.snapshot)
+        start_slo_thread(srv.slo_rollup, args.slo_interval)
+        log.info("slo rollup active: %s every %.1fs",
+                 args.slo_spec, args.slo_interval)
     log.info("async router on :%d over %d backends (policy=%s, "
              "replica=%s, peers=%d)", srv.port, len(backends),
              args.policy, replica_id, len(args.gossip_peer))

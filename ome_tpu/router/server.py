@@ -390,6 +390,21 @@ class Router:
             "ome_router_prefix_directory_entries",
             "Prefix digests currently tracked by the fleet prefix "
             "directory")
+        # per-class terminal outcomes at the front door — the SLO
+        # rollup's availability signal (docs/slo.md). Children are
+        # pre-created over the two fixed enums so cardinality is
+        # bounded by construction.
+        _fam_outcomes = self.registry.counter(
+            "ome_router_class_outcomes_total",
+            "Terminal request outcomes by priority class (ok = "
+            "answered, including 4xx relays; error = 5xx/timeout/"
+            "transport failures)",
+            labelnames=("class", "result"))
+        self._c_outcomes = {
+            (cls, res): _fam_outcomes.labels(
+                **{"class": cls, "result": res})
+            for cls in PRIORITY_CLASSES
+            for res in ("ok", "error")}
 
     @property
     def stats(self) -> Dict[str, float]:
@@ -531,6 +546,16 @@ class Router:
             # (leaf-locked; kept outside _lock for uniformity)
             self.inc("circuit_open_total")
 
+    def note_outcome(self, cls: str, ok: bool):
+        """Record one terminal per-class request outcome — the SLO
+        availability signal (docs/slo.md). client_gone outcomes are
+        never reported here: the backend did nothing wrong and the
+        client saw nothing, so they belong to neither side of the
+        budget."""
+        child = self._c_outcomes.get((cls, "ok" if ok else "error"))
+        if child is not None:
+            child.inc()
+
     def note_draining(self, backend: Backend):
         """The backend announced it is draining (503 + X-OME-Draining).
         Take it out of rotation WITHOUT penalty: the drain is
@@ -650,6 +675,9 @@ class RouterServer:
         # /backends), same contract as the engine's /debug/state:
         # off by default, 403 when disabled
         self.debug_endpoints = debug_endpoints
+        # fleet SLO rollup (docs/slo.md): attached by main() when
+        # --slo-spec is given; GET /slo answers 404 until then
+        self.slo_rollup = None
         self.budget = RetryBudget(ratio=retry_budget_ratio)
         self._jitter = random.Random(1)
         self.request_log = _coerce_reqlog(request_log)
@@ -717,6 +745,16 @@ class RouterServer:
                         return None
                     return self._json(200, {
                         "backends": outer.router.backend_snapshot()})
+                if self.path == "/slo":
+                    # fleet SLO attainment / budget / alert state
+                    # (docs/slo.md), guarded like /backends
+                    if not self._backends_guard():
+                        return None
+                    if outer.slo_rollup is None:
+                        return self._json(404, {
+                            "error": "slo rollup not configured "
+                                     "(start with --slo-spec)"})
+                    return self._json(200, outer.slo_rollup.report())
                 if self.path == "/metrics":
                     outer.router.update_gauges()
                     body = outer.router.registry.render().encode()
@@ -738,6 +776,7 @@ class RouterServer:
                     payload = json.loads(body or b"{}")
                 except ValueError:
                     payload = {}
+                cls = None
                 if self.path in ("/v1/completions",
                                  "/v1/chat/completions"):
                     # account the class here but forward the request
@@ -753,7 +792,8 @@ class RouterServer:
                     outer._c_class[cls].inc()
                 stream = bool(payload.get("stream"))
                 self._proxy(body, stream=stream,
-                            affinity=affinity_from_payload(payload))
+                            affinity=affinity_from_payload(payload),
+                            cls=cls)
 
             def do_DELETE(self):
                 n = int(self.headers.get("Content-Length") or 0)
@@ -805,7 +845,8 @@ class RouterServer:
                     return None
 
             def _proxy(self, body: bytes, stream: bool,
-                       affinity: str = ""):
+                       affinity: str = "",
+                       cls: Optional[str] = None):
                 # request-lifecycle tracing: adopt the caller's
                 # traceparent or mint a fresh trace; every forwarded
                 # hop carries a CHILD span of this context, and both
@@ -813,7 +854,8 @@ class RouterServer:
                 ctx = tracing.from_headers(self.headers)
                 t0 = time.monotonic()
                 outcome = {"backend": None, "pool": None,
-                           "status": "error", "retries": 0}
+                           "status": "error", "retries": 0,
+                           "class": cls}
                 # root timeline span: reuses the context's span id, so
                 # per-attempt child spans (and through them the engine
                 # spans) all parent on this one record
@@ -830,6 +872,12 @@ class RouterServer:
                 finally:
                     dur = time.monotonic() - t0
                     outer._h_request.observe(dur)
+                    if cls is not None \
+                            and outcome["status"] != "client_gone":
+                        # availability: everything the router answered
+                        # is good except its own failure statuses
+                        outer.router.note_outcome(
+                            cls, outcome["status"] == "ok")
                     if span is not None:
                         span.set(pool=outcome["pool"],
                                  backend=outcome["backend"],
@@ -1063,6 +1111,15 @@ class RouterServer:
                         self.send_header("Transfer-Encoding", "chunked")
                         self.end_headers()
                         started = True
+                        # real SSE clients (the replay client
+                        # included) hang up the moment they read the
+                        # `data: [DONE]` sentinel, without draining
+                        # the trailing blank line or the chunked
+                        # terminator — once the sentinel is delivered
+                        # the request was SERVED, and classifying it
+                        # client_gone would poison the availability
+                        # SLO (docs/slo.md)
+                        done_sent = False
                         while True:
                             try:
                                 raw = resp.readline()
@@ -1071,14 +1128,29 @@ class RouterServer:
                                 raise _ResponseStarted(str(e)) from e
                             if not raw:
                                 break
-                            self._client_write(
-                                f"{len(raw):x}\r\n".encode() + raw
-                                + b"\r\n")
                             try:
+                                self._client_write(
+                                    f"{len(raw):x}\r\n".encode() + raw
+                                    + b"\r\n")
                                 self.wfile.flush()
-                            except (OSError, ConnectionError) as e:
+                            except (_ClientGone, OSError,
+                                    ConnectionError) as e:
+                                if done_sent:
+                                    break
+                                if isinstance(e, _ClientGone):
+                                    raise
                                 raise _ClientGone(str(e)) from e
-                        self._client_write(b"0\r\n\r\n")
+                            if raw.strip() == b"data: [DONE]":
+                                done_sent = True
+                        try:
+                            self._client_write(b"0\r\n\r\n")
+                        except _ClientGone:
+                            # upstream is drained and every body byte
+                            # was relayed: a client that hangs up
+                            # between the last event and the
+                            # terminating chunk still received the
+                            # whole response — served, not abandoned
+                            pass
                         return None
                     try:
                         data = resp.read()
@@ -1172,6 +1244,12 @@ def main(argv=None) -> int:
                         "/backends (machine-readable membership) and "
                         "POST/DELETE /backends (autoscale "
                         "registration); 403 otherwise")
+    p.add_argument("--slo-spec", default=None,
+                   help="SLO spec JSON (config/slo.json format): "
+                        "starts the fleet rollup loop and serves "
+                        "GET /slo + ome_slo_* metrics (docs/slo.md)")
+    p.add_argument("--slo-interval", type=float, default=5.0,
+                   help="seconds between fleet SLO rollup scrapes")
     p.add_argument("--request-log", default=None,
                    help="JSONL request-log path (one record per "
                         "proxied request with trace id, backend, "
@@ -1230,6 +1308,22 @@ def main(argv=None) -> int:
                        request_log=args.request_log,
                        span_log=args.span_log,
                        debug_endpoints=args.debug_endpoints).start()
+    if args.slo_spec:
+        from ..autoscale.scrape import SharedScraper
+        from ..slo import FleetRollup
+        from ..slo import load as load_slo
+        from ..slo.rollup import start_thread as start_slo_thread
+        scraper = SharedScraper(clock=time.monotonic,
+                                max_age=args.slo_interval / 2.0)
+        srv.slo_rollup = FleetRollup(
+            load_slo(args.slo_spec), clock=time.monotonic,
+            fetch_fn=scraper.fetch,
+            backends_fn=router.backend_snapshot,
+            registry=router.registry,
+            local_samples_fn=router.registry.snapshot)
+        start_slo_thread(srv.slo_rollup, args.slo_interval)
+        log.info("slo rollup active: %s every %.1fs",
+                 args.slo_spec, args.slo_interval)
     log.info("router on :%d over %d backends (policy=%s)", srv.port,
              len(backends), args.policy)
     try:

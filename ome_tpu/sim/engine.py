@@ -190,6 +190,11 @@ class SimEngine:
             "ome_engine_class_queue_wait_seconds",
             "Admission-to-first-slot seconds, by priority class",
             labelnames=("class",), buckets=_LATENCY_BUCKETS))
+        self._h_class_e2e = _by_class(R.histogram(
+            "ome_engine_class_e2e_seconds",
+            "End-to-end request seconds, by priority class (the "
+            "fleet SLO rollup's e2e objective source; docs/slo.md)",
+            labelnames=("class",), buckets=_LATENCY_BUCKETS))
         self._c_sim_chunks = R.counter(
             "ome_sim_chunks_total",
             "Fused decode chunks executed by the simulated device")
@@ -420,6 +425,9 @@ class SimEngine:
         req.status = 200 if reason == "stop" else 599
         req.finished_at = self.clock.now()
         self._h_e2e.observe(req.finished_at - req.created)
+        he = self._h_class_e2e.get(req.priority)
+        if he is not None:
+            he.observe(req.finished_at - req.created)
         if req in self.active:
             self.active.remove(req)
             self.pages_used -= req._pages

@@ -26,8 +26,10 @@ from ..autoscale.controller import ScaleController, SLOConfig
 from ..autoscale.policy import PolicyConfig, PoolPolicy
 from ..autoscale.pool import DrainRecord
 from ..autoscale.replay import ReplayResult
+from ..autoscale.scrape import SharedScraper
 from ..autoscale.trace import TraceRequest
 from ..router.server import Backend, RetryBudget, Router
+from ..slo import FleetRollup, SLOSpec, sim_spec
 from ..telemetry import Registry
 from .clock import EventLoop, VirtualClock
 from .costmodel import CostModel
@@ -207,6 +209,13 @@ class SimFleet:
         self.pool = SimPool("engine", self, spawn_delay=spawn_delay,
                             warmup_delay=cost.warmup_ms / 1000.0)
         self.controller: Optional[ScaleController] = None
+        self.slo_rollup: Optional[FleetRollup] = None
+        # one scrape result per backend per virtual instant, shared
+        # by the controller and the SLO rollup (max_age 0.0: both
+        # tick at the same virtual time, so same-instant is enough)
+        self.scraper = SharedScraper(
+            fetch_fn=self.transport.fetch_metrics,
+            clock=self.clock.now, max_age=0.0)
         self.retry_budget = RetryBudget()
         self.results: List[ReplayResult] = []
         self._inflight: Dict[int, tuple] = {}
@@ -257,7 +266,9 @@ class SimFleet:
             {self.pool.name: self.pool},
             {self.pool.name: PoolPolicy(policy_cfg)},
             slo or SLOConfig(),
-            fetch_fn=self.transport.fetch_metrics,
+            fetch_fn=self.scraper.fetch,
+            burn_fn=(self.slo_rollup.max_burn
+                     if self.slo_rollup is not None else None),
             interval=interval, clock=self.clock)
 
         def tick():
@@ -265,6 +276,26 @@ class SimFleet:
             self.loop.call_later(interval, tick)
         self.loop.call_later(interval, tick)
         return self.controller
+
+    def add_slo(self, spec: Optional[SLOSpec] = None,
+                interval: float = 1.0) -> FleetRollup:
+        """Start the fleet SLO rollup on the virtual event loop —
+        the same FleetRollup.tick the real router runs on a wall-
+        clock thread (docs/slo.md parity contract). Call BEFORE
+        add_controller if the controller should take burn rate as a
+        pressure input."""
+        self.slo_rollup = FleetRollup(
+            spec or sim_spec(), clock=self.clock.now,
+            fetch_fn=self.scraper.fetch,
+            backends_fn=self.router.backend_snapshot,
+            registry=self.registry,
+            local_samples_fn=self.router.registry.snapshot)
+
+        def tick():
+            self.slo_rollup.tick()
+            self.loop.call_later(interval, tick)
+        self.loop.call_later(interval, tick)
+        return self.slo_rollup
 
     def start_health_loop(self) -> None:
         def sweep():
@@ -370,6 +401,7 @@ class SimFleet:
                        failovers: int = 0,
                        exclude: Optional[set] = None) -> None:
         now = self.clock.now()
+        cls = t.priority or "standard"
         result = ReplayResult(
             trace_id=t.trace_id, arrival=t.arrival,
             prompt=t.prompt or "", max_tokens=t.max_tokens,
@@ -383,6 +415,7 @@ class SimFleet:
             result.status = 503
             result.error = "no backend available"
             self.results.append(result)
+            self.router.note_outcome(cls, ok=False)
             return
         req = SimRequest(
             prompt_tokens=t.prompt_tokens,
@@ -403,6 +436,7 @@ class SimFleet:
                 result.status = 502
                 result.error = f"{type(e).__name__}: {e}"
                 self.results.append(result)
+                self.router.note_outcome(cls, ok=False)
             return
         self.retry_budget.deposit()
         if status == 503:
@@ -416,6 +450,7 @@ class SimFleet:
                 result.status = 503
                 result.error = "backend draining"
                 self.results.append(result)
+                self.router.note_outcome(cls, ok=False)
             return
         if status != 200:
             result.status = status
@@ -424,6 +459,9 @@ class SimFleet:
                             + (f" (retry after {retry}s)"
                                if retry is not None else ""))
             self.results.append(result)
+            # an answered shed (429) is availability-good; only
+            # server-side failures burn the budget (docs/slo.md)
+            self.router.note_outcome(cls, ok=status < 500)
             return
         self.router.adjust_inflight(backend, 1)
         self._inflight[id(req)] = (backend, result, now)
@@ -436,6 +474,7 @@ class SimFleet:
         self.router.adjust_inflight(backend, -1)
         ok = req.finish_reason == "stop"
         self.router.note_result(backend, ok=ok)
+        self.router.note_outcome(req.priority, ok=ok)
         result.status = req.status
         result.output_tokens = req.output_tokens
         result.finish_reason = req.finish_reason

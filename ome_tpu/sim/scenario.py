@@ -68,6 +68,7 @@ def run_steady(seed: int = 0, engines: int = 2, requests: int = 200,
                                      "fused_k": 4}, **engine_kw))
     fleet.add_engines(engines)
     fleet.start_health_loop()
+    fleet.add_slo()
     tr = trace_mod.synthetic_trace(seed, n=requests,
                                    base_rate=base_rate)
     fleet.submit_trace(tr)
@@ -77,6 +78,7 @@ def run_steady(seed: int = 0, engines: int = 2, requests: int = 200,
     rep["scenario"] = "steady"
     rep["engines"] = engines
     rep["sim"] = fleet.sim_stats()
+    rep["slo"] = fleet.slo_rollup.report()
     return rep
 
 
@@ -323,6 +325,29 @@ def chaos_invariants(fleet: SimFleet, tr) -> List[str]:
     return violations
 
 
+def slo_alerting_invariants(rollup) -> List[str]:
+    """The alerting contract a chaos run must satisfy (docs/slo.md):
+    any (class, objective) whose error budget ended the run
+    exhausted must have raised a page-level burn alert at a moment
+    when budget still remained — the SRE-workbook promise that a
+    fast burn PAGES before the budget is gone, not after."""
+    violations: List[str] = []
+    rep = rollup.report()
+    paged = {(e["class"], e["objective"]) for e in rep["alerts"]
+             if e["severity"] == "page"
+             and e["budget_consumed"] < 1.0}
+    for cls in sorted(rep["classes"]):
+        for name, obj in sorted(rep["classes"][cls].items()):
+            if obj["budget_consumed"] >= 1.0 \
+                    and (cls, name) not in paged:
+                violations.append(
+                    f"slo-alerting: {cls}/{name} exhausted its "
+                    f"error budget (consumed "
+                    f"{obj['budget_consumed']}) without a prior "
+                    "page-level burn alert")
+    return violations
+
+
 def run_chaos(seed: int = 0, engines: int = 8, requests: int = 400,
               kills: int = 4, cost: Optional[CostModel] = None,
               schedule=None, settle_s: float = 60.0,
@@ -355,6 +380,7 @@ def run_chaos(seed: int = 0, engines: int = 8, requests: int = 400,
                                     **engine_kw))
     fleet.add_engines(schedule.engines)
     fleet.start_health_loop()
+    fleet.add_slo()
     bug = schedule.inject_bug or {}
     if bug.get("kind") == "drop_resume":
         # target "*" arms every journal: whichever kill first catches
@@ -388,8 +414,70 @@ def run_chaos(seed: int = 0, engines: int = 8, requests: int = 400,
     rep["engines"] = schedule.engines
     rep["schedule"] = schedule.to_dict()
     rep["fault_log"] = fleet.fault_log
-    rep["violations"] = chaos_invariants(fleet, tr)
+    rep["violations"] = (chaos_invariants(fleet, tr)
+                         + slo_alerting_invariants(fleet.slo_rollup))
     rep["sim"] = fleet.sim_stats()
+    rep["slo"] = fleet.slo_rollup.report()
+    return rep
+
+
+# -- total-outage kill storm (the alerting acceptance) ----------------
+
+
+def run_kill_storm(seed: int = 0, engines: int = 4,
+                   cost: Optional[CostModel] = None,
+                   rate: float = 4.0, requests: int = 2800,
+                   outage_tail_s: float = 70.0) -> dict:
+    """Total outage against a well-populated compliance window — the
+    non-vacuous exercise of the alerting contract. Hundreds of
+    seconds of healthy traffic first fill the rolling window (a cold
+    window exhausts its budget almost instantly, which no alert
+    policy can beat), then EVERY replica is killed with no recovery
+    while the client keeps arriving: availability hard-fails, the
+    fast-burn page must fire while budget remains, and the budget
+    must then exhaust (docs/slo.md). A run where nothing exhausts
+    means the storm is miscalibrated — reported as a violation so
+    the contract can never pass vacuously. The kill moment is
+    derived from the trace itself (its end minus ``outage_tail_s``)
+    so burst compression cannot land the storm after the traffic."""
+    cost = cost or default_cost_model()
+    fleet = SimFleet(cost, seed=seed, policy="round_robin",
+                     health_interval=2.0,
+                     engine_kw={"max_slots": 4, "kv_pages": 512,
+                                "fused_k": 4})
+    fleet.add_engines(engines)
+    fleet.start_health_loop()
+    fleet.add_slo()
+    tr = trace_mod.synthetic_trace(seed, n=requests,
+                                   base_rate=rate,
+                                   prompt_tokens=(8, 32),
+                                   max_tokens=(8, 32))
+    span = max(r.arrival for r in tr)
+    outage_at = round(max(span - outage_tail_s, 0.0), 6)
+    fleet.submit_trace(tr)
+    for m in fleet.pool.members:
+        fleet.at_fault(outage_at, "kill", m.name)
+    fleet.run_until(span + 5.0)
+    rep = replay_mod.report(fleet.results, slo_ttft_s=2.0)
+    rep["scenario"] = "killstorm"
+    rep["engines"] = engines
+    rep["outage_at"] = outage_at
+    rep["fault_log"] = fleet.fault_log
+    slo = fleet.slo_rollup.report()
+    exhausted = sorted(
+        f"{cls}/{name}"
+        for cls, objs in slo["classes"].items()
+        for name, o in objs.items() if o["budget_consumed"] >= 1.0)
+    violations = slo_alerting_invariants(fleet.slo_rollup)
+    if not exhausted:
+        violations.append(
+            "slo-alerting: kill storm exhausted no error budget — "
+            "scenario miscalibrated, the page-before-exhaust "
+            "contract was never exercised")
+    rep["exhausted"] = exhausted
+    rep["violations"] = violations
+    rep["sim"] = fleet.sim_stats()
+    rep["slo"] = slo
     return rep
 
 
@@ -399,4 +487,5 @@ SCENARIOS = {
     "wdrr": run_wdrr_fairness,
     "fleet": run_fleet_scale,
     "chaos": run_chaos,
+    "killstorm": run_kill_storm,
 }

@@ -1,0 +1,17 @@
+"""Fleet SLO engine: error budgets and burn-rate alerting.
+
+Declarative per-class objectives (config/slo.json) evaluated over
+rolling windows by a deterministic engine on an injected clock, so
+the identical code runs on wall time in the router and on virtual
+time in the simulator.  docs/slo.md covers the spec format, the
+multi-window multi-burn-rate alert policy, and the fleet rollup.
+"""
+
+from .spec import SLOSpec, Objective, BurnWindow, load, sim_spec
+from .engine import SLOEngine
+from .rollup import FleetRollup
+
+__all__ = [
+    "SLOSpec", "Objective", "BurnWindow", "load", "sim_spec",
+    "SLOEngine", "FleetRollup",
+]

@@ -84,6 +84,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "violation.json) here on violation")
     p.add_argument("--check-determinism", action="store_true",
                    help="run twice, fail on any byte difference")
+    p.add_argument("--slo-table", action="store_true",
+                   help="print the per-class SLO attainment / "
+                        "error-budget table to stderr (the `make "
+                        "slo-report` view; stdout stays canonical "
+                        "JSON)")
     p.add_argument("--full", action="store_true",
                    help="include the full decision log / per-request "
                         "detail instead of the summary report")
@@ -99,7 +104,7 @@ def _cost(args) -> CostModel:
 
 def run_once(args) -> dict:
     kw = {"seed": args.seed, "cost": _cost(args)}
-    if args.scenario in ("steady", "fleet", "chaos"):
+    if args.scenario in ("steady", "fleet", "chaos", "killstorm"):
         if args.engines is not None:
             kw["engines"] = args.engines
         if args.requests is not None:
@@ -178,6 +183,27 @@ def _run_trace_replay(args, kw) -> dict:
     return rep
 
 
+def _slo_table(rep: dict) -> str:
+    """Human-readable per-class attainment table (docs/slo.md)."""
+    slo = rep.get("slo") or {}
+    classes = slo.get("classes") or {}
+    lines = [f"{'class':<12} {'objective':<13} {'attain':>9} "
+             f"{'target':>7} {'budget':>8} {'state':>5}"]
+    for cls in sorted(classes):
+        for name in sorted(classes[cls]):
+            o = classes[cls][name]
+            att = ("-" if o["attainment"] is None
+                   else f"{o['attainment']:.4f}")
+            lines.append(
+                f"{cls:<12} {name:<13} {att:>9} "
+                f"{o['target']:>7.3f} {o['budget_remaining']:>8.3f} "
+                f"{o['alert_state']:>5}")
+    alerts = slo.get("alerts") or []
+    lines.append(f"alerts: {len(alerts)} "
+                 f"(pages: {sum(1 for a in alerts if a['severity'] == 'page')})")
+    return "\n".join(lines) + "\n"
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     t0 = time.monotonic()
@@ -194,9 +220,16 @@ def main(argv=None) -> int:
     if violations:
         for v in violations:
             sys.stderr.write(f"simulate: VIOLATION: {v}\n")
-        rep = _shrink_and_bundle(args, rep)
+        if "schedule" in rep:  # shrink/bundle need a FaultSchedule
+            rep = _shrink_and_bundle(args, rep)
     if not args.full:
         rep = {k: v for k, v in rep.items() if k != "decisions"}
+    if args.slo_table:
+        if rep.get("slo"):
+            sys.stderr.write(_slo_table(rep))
+        else:
+            sys.stderr.write("simulate: --slo-table: scenario "
+                             "produced no SLO section\n")
     sys.stderr.write(
         f"simulate: {args.scenario} done in {wall:.2f}s wall "
         f"({rep.get('sim', {}).get('virtual_seconds', '?')} virtual "
