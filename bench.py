@@ -111,8 +111,128 @@ def dispatch_ms() -> float:
     return best / n * 1000
 
 
-def main() -> None:
+def composition_main() -> None:
+    """`bench.py composition`: the StepPlan composition matrix.
+
+    Sweeps spec-tokens x steps-per-dispatch x pipeline-depth through
+    the REAL Scheduler (docs/step-plan.md) on a repetitive workload —
+    tiled 4-token prompt patterns, so greedy streams settle into the
+    short cycles the n-gram drafter feeds on. Each cell reports
+    sustained tokens/sec, the verify accept rate, and the planner's
+    degradation counts (any nonzero count means the cell silently
+    lost a composition feature — the thing this sweep exists to
+    catch). The composed cells (spec>0 x K>1 x depth 1) must beat the
+    best single-mechanism cell; perfgate gates every cell under the
+    ^composition. bands and --cost-table exports them to the fleet
+    simulator."""
+    from ome_tpu.engine.core import InferenceEngine
+    from ome_tpu.engine.scheduler import Request, Scheduler
+    from ome_tpu.models import llama
+
+    cfg = flagship_config()
+    SLOTS = int(os.environ.get("OME_BENCH_COMP_SLOTS", "8"))
+    NEW = int(os.environ.get("OME_BENCH_COMP_TOKENS", "48"))
+    SPECS = tuple(int(x) for x in os.environ.get(
+        "OME_BENCH_COMP_SPECS", "0,4").split(","))
+    KS = tuple(int(x) for x in os.environ.get(
+        "OME_BENCH_COMP_KS", "1,4,8").split(","))
+    DEPTHS = tuple(int(x) for x in os.environ.get(
+        "OME_BENCH_COMP_DEPTHS", "0,1").split(","))
+
+    log(f"bench: [composition] devices={jax.devices()}")
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    # ONE engine across all cells: each Scheduler brings its own
+    # metrics registry and slot bookkeeping, so reusing the engine
+    # amortizes the compile cache across the matrix
+    eng = InferenceEngine(params, cfg, max_slots=SLOTS,
+                          max_seq=CACHE_LEN, prefill_buckets=[16])
+
+    def run_cell(spec, k_, depth):
+        sched = Scheduler(eng, overlap=True, pipeline_depth=depth,
+                          spec_tokens=spec, steps_per_dispatch=k_)
+        sched.start()
+
+        def batch(seed):
+            rng = np.random.default_rng(seed)
+            reqs = []
+            for _ in range(SLOTS):
+                pat = rng.integers(0, cfg.vocab_size, size=4)
+                ids = [int(x) for x in np.tile(pat, 4)]
+                reqs.append(sched.submit(Request(
+                    prompt_ids=ids, max_new_tokens=NEW,
+                    stop_ids=[])))
+            for r in reqs:
+                r.done.wait(timeout=600)
+            assert all(r.done.is_set() for r in reqs), \
+                f"cell spec{spec}_k{k_}_d{depth} stalled"
+
+        batch(3)  # compile + reach the repetitive steady state
+        p0 = sched.stats["spec_proposed_tokens_total"]
+        a0 = sched.stats["spec_accepted_tokens_total"]
+        t0 = time.perf_counter()
+        batch(3)  # same prompts: the drafter's n-gram table is hot
+        dt = time.perf_counter() - t0
+        proposed = sched.stats["spec_proposed_tokens_total"] - p0
+        accepted = sched.stats["spec_accepted_tokens_total"] - a0
+        degr = dict(sched.degradations)
+        sched.stop()
+        return {
+            "tokens_per_sec": round(SLOTS * NEW / dt, 1),
+            "accept_rate": round(accepted / max(proposed, 1), 3),
+            "spec": spec, "k": k_, "depth": depth,
+            "degraded_steps": sum(degr.values()),
+        }, degr
+
+    cells = {}
+    for spec in SPECS:
+        for k_ in KS:
+            for depth in DEPTHS:
+                name = f"spec{spec}_k{k_}_d{depth}"
+                cell, degr = run_cell(spec, k_, depth)
+                cells[name] = cell
+                extra = "".join(
+                    f" {c}={n}" for c, n in degr.items() if n)
+                log(f"bench: [composition] {name}: "
+                    f"{cell['tokens_per_sec']:.1f} tok/s, accept "
+                    f"{100 * cell['accept_rate']:.0f}%{extra}")
+    # a "single-mechanism" cell enables at most one of the three
+    # features; the composed cells must beat the best of them
+    single = {n: c["tokens_per_sec"] for n, c in cells.items()
+              if (c["spec"] > 0) + (c["k"] > 1) + (c["depth"] > 0) <= 1}
+    composed = {n: c["tokens_per_sec"] for n, c in cells.items()
+                if c["spec"] > 0 and c["k"] > 1 and c["depth"] > 0}
+    best_single = max(single.values()) if single else 0.0
+    best_composed = max(composed.values()) if composed else 0.0
+    if single and composed:
+        log(f"bench: [composition] best single-mechanism "
+            f"{best_single:.1f} tok/s -> best composed "
+            f"{best_composed:.1f} tok/s "
+            f"({100 * best_composed / best_single - 100:+.0f}%)")
+    print(json.dumps({"composition": {
+        "cells": cells,
+        "best_single_tokens_per_sec": round(best_single, 1),
+        "best_composed_tokens_per_sec": round(best_composed, 1),
+        "composed_vs_best_single": round(
+            best_composed / max(best_single, 1e-9), 3),
+    }}))
+
+
+def flagship_config():
+    """~1.9B-parameter dense Llama-class config: big enough that
+    decode is genuinely HBM-bound, small enough to fit one v5e chip
+    (16G HBM) in bf16 with headroom for the KV cache.
+    OME_BENCH_COMP_CONFIG=tiny swaps in the test config for smoke
+    runs of the composition sweep off-TPU."""
     from ome_tpu.models import config as cfgs
+    if os.environ.get("OME_BENCH_COMP_CONFIG") == "tiny":
+        return cfgs.tiny_test().replace(max_seq_len=CACHE_LEN)
+    return cfgs.ModelConfig(
+        vocab_size=32768, hidden_size=2048, num_layers=24, num_heads=16,
+        num_kv_heads=8, head_dim=128, intermediate_size=8192,
+        rope_theta=500000.0, max_seq_len=CACHE_LEN)
+
+
+def main() -> None:
     from ome_tpu.models import llama
     from ome_tpu.models.llama import (_layer, _proj, _rope_frequencies,
                                       apply_rope, attention, dense_mlp,
@@ -120,13 +240,7 @@ def main() -> None:
     from ome_tpu.models.quant import QTensor, quantize_params, \
         quantized_bytes
 
-    # ~1.9B-parameter dense Llama-class config: big enough that decode is
-    # genuinely HBM-bound, small enough to fit one v5e chip (16G HBM)
-    # in bf16 with headroom for the KV cache.
-    cfg = cfgs.ModelConfig(
-        vocab_size=32768, hidden_size=2048, num_layers=24, num_heads=16,
-        num_kv_heads=8, head_dim=128, intermediate_size=8192,
-        rope_theta=500000.0, max_seq_len=CACHE_LEN)
+    cfg = flagship_config()
 
     log(f"bench: devices={jax.devices()}")
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
@@ -826,4 +940,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "composition":
+        composition_main()
+    else:
+        main()

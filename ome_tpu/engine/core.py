@@ -748,7 +748,7 @@ class InferenceEngine:
         smax = self.max_seq
 
         def _multi_body(i, carry, key, temperature, top_k, top_p,
-                        budget, stop_ids, forward_one):
+                        budget, stop_ids, forward_one, mask=None):
             """One fori_loop iteration of the multi-token decode
             program: forward the batch one position, sample on device,
             append KV, and feed the sampled token back as the next
@@ -760,11 +760,20 @@ class InferenceEngine:
             The freeze conditions are a conservative SUBSET of the
             host's finish rules: the device may run long (the host
             discards overshoot at the drain) but never stops a slot
-            the host would have continued."""
+            the host would have continued.
+
+            `mask` ([B, n, V] bool, optional) constrains iteration i's
+            sampling to mask[:, i] — the structured-output mask STACK a
+            plan precomputed by walking each slot's grammar automaton
+            through its forced token run (docs/step-plan.md). All-True
+            rows leave a slot unconstrained."""
             st, done, acc, adv = carry
             active = (~done) & (i < budget) & (st.lengths < smax)
             logits, nc = forward_one(st)
-            toks = sample(logits[:, -1], jax.random.fold_in(key, i),
+            last = logits[:, -1]
+            if mask is not None:
+                last = jnp.where(mask[:, i], last, -jnp.inf)
+            toks = sample(last, jax.random.fold_in(key, i),
                           temperature, top_k, top_p)
             toks = jnp.where(active, toks, st.tokens)
             done = done | jnp.any(toks[:, None] == stop_ids, axis=1)
@@ -779,7 +788,7 @@ class InferenceEngine:
             return st, done, acc, adv
 
         def _multi_loop(state, key, temperature, top_k, top_p, budget,
-                        stop_ids, forward_one, n: int):
+                        stop_ids, forward_one, n: int, mask=None):
             B = state.tokens.shape[0]
             # a slot whose INPUT token is already a stop (the previous
             # chunk sampled it; the host finishes on every stop token)
@@ -793,7 +802,8 @@ class InferenceEngine:
                 0, n, functools.partial(
                     _multi_body, key=key, temperature=temperature,
                     top_k=top_k, top_p=top_p, budget=budget,
-                    stop_ids=stop_ids, forward_one=forward_one),
+                    stop_ids=stop_ids, forward_one=forward_one,
+                    mask=mask),
                 carry)
             return state, acc, adv
 
@@ -849,6 +859,47 @@ class InferenceEngine:
                                budget, stop_ids, forward_one, n)
 
         @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=("n",))
+        def _decode_multi_masked(params, state: DecodeState,
+                                 temperature, top_k, top_p, key,
+                                 budget, stop_ids, mask, n: int):
+            """Multi-token decode with a [B, n, V] per-iteration mask
+            stack (structured outputs inside a fused chunk). Separate
+            program so unmasked chunks never pay the mask transfer."""
+
+            def forward_one(st):
+                cache = llama.KVCache(k=st.k, v=st.v,
+                                      index=st.lengths)
+                return llama.forward(params, cfg_, st.tokens[:, None],
+                                     cache=cache,
+                                     adapter_ids=st.adapters)
+
+            return _multi_loop(state, key, temperature, top_k, top_p,
+                               budget, stop_ids, forward_one, n,
+                               mask=mask)
+
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=("n",))
+        def _decode_multi_masked_paged(params, state: DecodeState,
+                                       table, temperature, top_k,
+                                       top_p, key, budget, stop_ids,
+                                       mask, n: int):
+
+            def forward_one(st):
+                cache = llama.PagedKVCache(k=st.k, v=st.v,
+                                           index=st.lengths,
+                                           table=table,
+                                           k_scale=st.k_scale,
+                                           v_scale=st.v_scale)
+                return llama.forward_paged(params, cfg_,
+                                           st.tokens[:, None], cache,
+                                           adapter_ids=st.adapters)
+
+            return _multi_loop(state, key, temperature, top_k, top_p,
+                               budget, stop_ids, forward_one, n,
+                               mask=mask)
+
+        @functools.partial(jax.jit, donate_argnums=(1,),
                            static_argnames=("k",))
         def _verify(params, state: DecodeState, drafts, draft_len,
                     temperature, top_k, top_p, key, k: int):
@@ -902,6 +953,60 @@ class InferenceEngine:
                                k_scale=nc.k_scale,
                                v_scale=nc.v_scale), out, accepted
 
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=("k",))
+        def _verify_masked(params, state: DecodeState, drafts,
+                           draft_len, temperature, top_k, top_p, key,
+                           mask, k: int):
+            """Verify with a [B, V] position-0 mask: masked
+            (structured-output) slots ride a verify plan at
+            draft_len 0 — their single sampled token honors the
+            grammar mask while drafting slots verify normally
+            (masked rows never draft, so positions past 0 are only
+            reached by unmasked slots). All-True rows are a no-op."""
+            toks = jnp.concatenate([state.tokens[:, None], drafts],
+                                   axis=1)
+            cache = llama.KVCache(k=state.k, v=state.v,
+                                  index=state.lengths)
+            logits, nc = llama.forward(params, cfg_, toks, cache=cache,
+                                       adapter_ids=state.adapters)
+            logits = logits.at[:, 0].set(
+                jnp.where(mask, logits[:, 0], -jnp.inf))
+            out, accepted = spec_verify(logits, drafts, draft_len, key,
+                                        temperature, top_k, top_p)
+            new_tok = jnp.take_along_axis(out, accepted[:, None],
+                                          axis=1)[:, 0]
+            return DecodeState(k=nc.k, v=nc.v,
+                               lengths=state.lengths + accepted + 1,
+                               tokens=new_tok,
+                               adapters=state.adapters), out, accepted
+
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=("k",))
+        def _verify_masked_paged(params, state: DecodeState, table,
+                                 drafts, draft_len, temperature,
+                                 top_k, top_p, key, mask, k: int):
+            toks = jnp.concatenate([state.tokens[:, None], drafts],
+                                   axis=1)
+            cache = llama.PagedKVCache(k=state.k, v=state.v,
+                                       index=state.lengths, table=table,
+                                       k_scale=state.k_scale,
+                                       v_scale=state.v_scale)
+            logits, nc = llama.forward_paged(
+                params, cfg_, toks, cache, adapter_ids=state.adapters)
+            logits = logits.at[:, 0].set(
+                jnp.where(mask, logits[:, 0], -jnp.inf))
+            out, accepted = spec_verify(logits, drafts, draft_len, key,
+                                        temperature, top_k, top_p)
+            new_tok = jnp.take_along_axis(out, accepted[:, None],
+                                          axis=1)[:, 0]
+            return DecodeState(k=nc.k, v=nc.v,
+                               lengths=state.lengths + accepted + 1,
+                               tokens=new_tok,
+                               adapters=state.adapters,
+                               k_scale=nc.k_scale,
+                               v_scale=nc.v_scale), out, accepted
+
         self._prefill_fn = _prefill
         self._prefill_masked_fn = _prefill_masked
         self._prefill_suffix_fn = _prefill_suffix
@@ -913,8 +1018,12 @@ class InferenceEngine:
         self._decode_masked_paged_fn = _decode_masked_paged
         self._decode_multi_fn = _decode_multi
         self._decode_multi_paged_fn = _decode_multi_paged
+        self._decode_multi_masked_fn = _decode_multi_masked
+        self._decode_multi_masked_paged_fn = _decode_multi_masked_paged
         self._verify_fn = _verify
         self._verify_paged_fn = _verify_paged
+        self._verify_masked_fn = _verify_masked
+        self._verify_masked_paged_fn = _verify_masked_paged
         self._step = 0
         self._root_key = jax.random.PRNGKey(0)
         # prefill (admission thread) and decode (scheduler thread) both
@@ -1522,6 +1631,7 @@ class InferenceEngine:
     def decode_multi(self, state: DecodeState, temperature, top_k,
                      top_p, steps: int, budget, stop_ids,
                      lookahead_rows: Optional[int] = None,
+                     mask: Optional[np.ndarray] = None,
                      ) -> Tuple[DecodeState, jax.Array, jax.Array]:
         """`steps` decode iterations for ALL slots in ONE device
         program — the host pays one dispatch and one sync per chunk
@@ -1533,9 +1643,12 @@ class InferenceEngine:
         never matches). Both may be host numpy or device-cached
         jax.Arrays, like the sampling params. lookahead_rows (paged
         only): KV rows to pre-allocate per slot before dispatch —
-        pipelined callers pass steps × (chunks in flight + 1) so
-        every chunk's writes land in owned blocks; defaults to
-        `steps`.
+        pipelined callers pass the summed rows of every plan in
+        flight plus this one so each dispatch's writes land in owned
+        blocks; defaults to `steps`. mask ([B, steps, V] bool,
+        optional) applies a per-iteration structured-output mask
+        stack (docs/step-plan.md) through the masked program
+        variants.
 
         Returns (state, tokens [B, steps], advanced [B]) with host
         copies of the outputs already in flight (mirroring decode()):
@@ -1556,14 +1669,37 @@ class InferenceEngine:
             if self._table_dirty or self._table_dev is None:
                 self._table_dev = jnp.asarray(self._table.copy())
                 self._table_dirty = False
-            args = (self.params, state, self._table_dev, *sampling,
-                    key, budget, stop_ids)
+            if mask is not None:
+                args = (self.params, state, self._table_dev, *sampling,
+                        key, budget, stop_ids, np.asarray(mask, bool))
+                self._ledger_capture(
+                    "decode_multi_masked_paged", f"n={n}",
+                    self._decode_multi_masked_paged_fn, args,
+                    dict(n=n), tokens=self.max_slots * n,
+                    kv_rows=n * self._kv_capacity_rows(),
+                    weight_passes=n)
+                state, toks, adv = \
+                    self._decode_multi_masked_paged_fn(*args, n=n)
+            else:
+                args = (self.params, state, self._table_dev, *sampling,
+                        key, budget, stop_ids)
+                self._ledger_capture(
+                    "decode_multi_paged", f"n={n}",
+                    self._decode_multi_paged_fn, args, dict(n=n),
+                    tokens=self.max_slots * n,
+                    kv_rows=n * self._kv_capacity_rows(),
+                    weight_passes=n)
+                state, toks, adv = \
+                    self._decode_multi_paged_fn(*args, n=n)
+        elif mask is not None:
+            args = (self.params, state, *sampling, key, budget,
+                    stop_ids, np.asarray(mask, bool))
             self._ledger_capture(
-                "decode_multi_paged", f"n={n}",
-                self._decode_multi_paged_fn, args, dict(n=n),
+                "decode_multi_masked", f"n={n}",
+                self._decode_multi_masked_fn, args, dict(n=n),
                 tokens=self.max_slots * n,
                 kv_rows=n * self._kv_capacity_rows(), weight_passes=n)
-            state, toks, adv = self._decode_multi_paged_fn(*args, n=n)
+            state, toks, adv = self._decode_multi_masked_fn(*args, n=n)
         else:
             args = (self.params, state, *sampling, key, budget,
                     stop_ids)
@@ -1580,6 +1716,8 @@ class InferenceEngine:
 
     def verify(self, state: DecodeState, drafts: np.ndarray,
                draft_len: np.ndarray, temperature, top_k, top_p,
+               lookahead_rows: Optional[int] = None,
+               mask: Optional[np.ndarray] = None,
                ) -> Tuple[DecodeState, jax.Array, jax.Array]:
         """One speculative verify step for ALL slots: score the k
         drafted tokens plus one bonus position in a single weight
@@ -1589,14 +1727,18 @@ class InferenceEngine:
 
         drafts: [B, k] int32 host array (garbage past draft_len);
         draft_len: [B] int32 in [0, k]. Sampling params as decode().
-        Returns (state, out_tokens [B, k+1], accepted [B]) with host
-        copies of the outputs already in flight, mirroring decode():
-        slot b emits out_tokens[b, :accepted[b]+1].
+        mask ([B, V] bool, optional) constrains position-0 sampling —
+        how masked (structured-output) slots ride a verify plan at
+        draft_len 0. Returns (state, out_tokens [B, k+1], accepted
+        [B]) with host copies of the outputs already in flight,
+        mirroring decode(): slot b emits out_tokens[b, :accepted[b]+1].
 
-        Dense callers may pipeline verify steps like decode steps;
-        paged callers must drain each step and commit_spec() before
-        the next (the block pre-allocation below needs the reconciled
-        host lengths)."""
+        Verify steps pipeline like decode steps; paged callers pass
+        lookahead_rows (summed rows of every plan in flight plus this
+        one's k+1, defaulting to k+1) so the block pre-allocation
+        covers in-flight plans, and reconcile each drained step with
+        commit_spec(slot, accepted+1, reserve=...) — the same surplus
+        discipline as decode_multi."""
         key = self._next_key()
         sampling = (_sampling_array(temperature, np.float32),
                     _sampling_array(top_k, np.int32),
@@ -1605,18 +1747,44 @@ class InferenceEngine:
         draft_len = np.asarray(draft_len, np.int32)
         k = int(drafts.shape[1])
         if self.kv_block:
-            self._grow_blocks_spec(k + 1)
+            rows = (k + 1 if lookahead_rows is None
+                    else int(lookahead_rows))
+            self._grow_blocks_spec(rows)
             if self._table_dirty or self._table_dev is None:
                 self._table_dev = jnp.asarray(self._table.copy())
                 self._table_dirty = False
-            args = (self.params, state, self._table_dev, drafts,
-                    draft_len, *sampling, key)
+            if mask is not None:
+                args = (self.params, state, self._table_dev, drafts,
+                        draft_len, *sampling, key,
+                        np.asarray(mask, bool))
+                self._ledger_capture(
+                    "verify_masked_paged", f"k={k}",
+                    self._verify_masked_paged_fn, args, dict(k=k),
+                    tokens=self.max_slots * (k + 1),
+                    kv_rows=self._kv_capacity_rows()
+                    + self.max_slots * (k + 1))
+                state, out, accepted = \
+                    self._verify_masked_paged_fn(*args, k=k)
+            else:
+                args = (self.params, state, self._table_dev, drafts,
+                        draft_len, *sampling, key)
+                self._ledger_capture(
+                    "verify_paged", f"k={k}", self._verify_paged_fn,
+                    args, dict(k=k),
+                    tokens=self.max_slots * (k + 1),
+                    kv_rows=self._kv_capacity_rows()
+                    + self.max_slots * (k + 1))
+                state, out, accepted = self._verify_paged_fn(*args,
+                                                             k=k)
+        elif mask is not None:
+            args = (self.params, state, drafts, draft_len, *sampling,
+                    key, np.asarray(mask, bool))
             self._ledger_capture(
-                "verify_paged", f"k={k}", self._verify_paged_fn, args,
-                dict(k=k), tokens=self.max_slots * (k + 1),
+                "verify_masked", f"k={k}", self._verify_masked_fn,
+                args, dict(k=k), tokens=self.max_slots * (k + 1),
                 kv_rows=self._kv_capacity_rows()
                 + self.max_slots * (k + 1))
-            state, out, accepted = self._verify_paged_fn(*args, k=k)
+            state, out, accepted = self._verify_masked_fn(*args, k=k)
         else:
             args = (self.params, state, drafts, draft_len, *sampling,
                     key)

@@ -214,17 +214,24 @@ class ReplicatedEngine:
     at a different op-stream position than its followers (divergent
     SPMD state)."""
 
-    # multi-token decode is NOT in the replicated op vocabulary yet:
-    # __getattr__ would leak the wrapped engine's decode_multi through
-    # and the leader would run a program the followers never see
-    # (divergent SPMD state). Scheduler degrades to steps_per_dispatch
-    # = 1 with a logged warning.
-    supports_multi_step = False
+    # multi-token decode IS in the replicated op vocabulary:
+    # decode_multi / verify / commit_spec below publish before
+    # executing, so every plan kind the scheduler can build (chunk,
+    # spec-verify, masked, pipelined) replays identically on the
+    # followers. Without these explicit methods __getattr__ would leak
+    # the wrapped engine's programs through unpublished (divergent
+    # SPMD state) — which is why the attr used to be False.
+    supports_multi_step = True
 
     def __init__(self, engine, publisher: OpPublisher):
         self._engine = engine
         self._pub = publisher
         self._oplock = threading.Lock()
+        # honest per-instance capability: replication only helps if
+        # the wrapped engine actually has the multi-step program
+        self.supports_multi_step = bool(
+            callable(getattr(engine, "decode_multi", None))
+            and getattr(engine, "supports_multi_step", False))
 
     def __getattr__(self, name):
         return getattr(self._engine, name)
@@ -342,6 +349,99 @@ class ReplicatedEngine:
             # omelint: disable=lock-discipline -- the local-replica fetch completes the op; _oplock serializes whole ops by design
             return state, host_value(toks)
 
+    def decode_multi(self, state, temperature, top_k, top_p,
+                     steps: int, budget, stop_ids,
+                     lookahead_rows=None, mask=None):
+        """Replicated multi-token chunk: the whole StepPlan payload
+        (sampling, per-slot budget, stop table, paged lookahead, the
+        [B, steps, V] mask stack) ships in the op, so followers run
+        the IDENTICAL K-step device loop."""
+        from .structured import pack_mask
+        with self._oplock:
+            self._pub.send({"op": "decode_multi",
+                            "steps": int(steps),
+                            # omelint: disable=lock-discipline -- sampling params ship host-side in the op; _oplock serializes whole ops by design
+                            "temperature": np.asarray(
+                                temperature, np.float32).tolist(),
+                            # omelint: disable=lock-discipline -- sampling params ship host-side in the op; _oplock serializes whole ops by design
+                            "top_k": np.asarray(top_k,
+                                                np.int32).tolist(),
+                            # omelint: disable=lock-discipline -- sampling params ship host-side in the op; _oplock serializes whole ops by design
+                            "top_p": np.asarray(top_p,
+                                                np.float32).tolist(),
+                            # omelint: disable=lock-discipline -- plan payloads ship host-side in the op; _oplock serializes whole ops by design
+                            "budget": np.asarray(
+                                budget, np.int32).tolist(),
+                            # omelint: disable=lock-discipline -- plan payloads ship host-side in the op; _oplock serializes whole ops by design
+                            "stop_ids": np.asarray(
+                                stop_ids, np.int32).tolist(),
+                            "lookahead_rows": None
+                            if lookahead_rows is None
+                            else int(lookahead_rows),
+                            # omelint: disable=lock-discipline -- the host-built mask stack IS the op payload; _oplock serializes whole ops by design
+                            "mask": pack_mask(mask)})
+            kw = {}
+            if lookahead_rows is not None:
+                kw["lookahead_rows"] = lookahead_rows
+            if mask is not None:
+                kw["mask"] = mask
+            state, out, adv = self._engine.decode_multi(
+                state, temperature, top_k, top_p, steps=steps,
+                budget=budget, stop_ids=stop_ids, **kw)
+            # omelint: disable=lock-discipline -- the local-replica fetch completes the op; _oplock serializes whole ops by design
+            return state, host_value(out), host_value(adv)
+
+    def verify(self, state, drafts, draft_len, temperature, top_k,
+               top_p, lookahead_rows=None, mask=None):
+        """Replicated spec-verify: the leader's host-built drafts (and
+        the position-0 mask for masked slots) ship in the op —
+        followers never run the drafter, they replay its output."""
+        from .structured import pack_mask
+        with self._oplock:
+            self._pub.send({"op": "verify",
+                            # omelint: disable=lock-discipline -- plan payloads ship host-side in the op; _oplock serializes whole ops by design
+                            "drafts": np.asarray(
+                                drafts, np.int32).tolist(),
+                            # omelint: disable=lock-discipline -- plan payloads ship host-side in the op; _oplock serializes whole ops by design
+                            "draft_len": np.asarray(
+                                draft_len, np.int32).tolist(),
+                            # omelint: disable=lock-discipline -- sampling params ship host-side in the op; _oplock serializes whole ops by design
+                            "temperature": np.asarray(
+                                temperature, np.float32).tolist(),
+                            # omelint: disable=lock-discipline -- sampling params ship host-side in the op; _oplock serializes whole ops by design
+                            "top_k": np.asarray(top_k,
+                                                np.int32).tolist(),
+                            # omelint: disable=lock-discipline -- sampling params ship host-side in the op; _oplock serializes whole ops by design
+                            "top_p": np.asarray(top_p,
+                                                np.float32).tolist(),
+                            "lookahead_rows": None
+                            if lookahead_rows is None
+                            else int(lookahead_rows),
+                            # omelint: disable=lock-discipline -- the host-built mask IS the op payload; _oplock serializes whole ops by design
+                            "mask": pack_mask(mask)})
+            kw = {}
+            if lookahead_rows is not None:
+                kw["lookahead_rows"] = lookahead_rows
+            if mask is not None:
+                kw["mask"] = mask
+            state, out, acc = self._engine.verify(
+                state, drafts, draft_len, temperature, top_k, top_p,
+                **kw)
+            # omelint: disable=lock-discipline -- the local-replica fetch completes the op; _oplock serializes whole ops by design
+            return state, host_value(out), host_value(acc)
+
+    def commit_spec(self, slot: int, advance: int,
+                    reserve: int = 0) -> None:
+        """Replicated spec/chunk commit: pure host bookkeeping, but it
+        trims speculative paged-KV blocks — followers must replay it
+        or their block tables drift from the leader's and the next
+        compiled program sees different allocations."""
+        with self._oplock:
+            self._pub.send({"op": "commit_spec", "slot": int(slot),
+                            "advance": int(advance),
+                            "reserve": int(reserve)})
+            self._engine.commit_spec(slot, advance, reserve=reserve)
+
 
 def _unknown_adapter(e: Exception) -> bool:
     try:
@@ -453,6 +553,39 @@ def follower_loop(engine, sub: OpSubscriber,
                 np.asarray(msg["temperature"], np.float32),
                 np.asarray(msg["top_k"], np.int32),
                 np.asarray(msg["top_p"], np.float32), **kwargs)
+        elif op == "decode_multi":
+            kwargs = {}
+            if msg.get("lookahead_rows") is not None:
+                kwargs["lookahead_rows"] = msg["lookahead_rows"]
+            mask = unpack_mask(msg.get("mask"))
+            if mask is not None:
+                kwargs["mask"] = mask
+            state, _, _ = engine.decode_multi(
+                state,
+                np.asarray(msg["temperature"], np.float32),
+                np.asarray(msg["top_k"], np.int32),
+                np.asarray(msg["top_p"], np.float32),
+                steps=msg["steps"],
+                budget=np.asarray(msg["budget"], np.int32),
+                stop_ids=np.asarray(msg["stop_ids"], np.int32),
+                **kwargs)
+        elif op == "verify":
+            kwargs = {}
+            if msg.get("lookahead_rows") is not None:
+                kwargs["lookahead_rows"] = msg["lookahead_rows"]
+            mask = unpack_mask(msg.get("mask"))
+            if mask is not None:
+                kwargs["mask"] = mask
+            state, _, _ = engine.verify(
+                state,
+                np.asarray(msg["drafts"], np.int32),
+                np.asarray(msg["draft_len"], np.int32),
+                np.asarray(msg["temperature"], np.float32),
+                np.asarray(msg["top_k"], np.int32),
+                np.asarray(msg["top_p"], np.float32), **kwargs)
+        elif op == "commit_spec":
+            engine.commit_spec(msg["slot"], msg["advance"],
+                               reserve=msg["reserve"])
         else:
             log.error("unknown op %r from leader", op)
             return 1

@@ -15,6 +15,16 @@ batch; `insert` is the only synchronization point. A slot semaphore
 paces admission: the thread holds at most max_slots in-flight
 prefills, and a finished request releases its slot back.
 
+The decode loop is a planner/executor pair (docs/step-plan.md):
+`_plan_step` decides once per iteration which compiled-program family
+runs (plain decode / K-token chunk / spec verify) and what it carries
+(grammar masks, chunk budgets, draft tokens); `_execute` dispatches
+any plan the same way. Pipelining, multi-token chunks, speculative
+verify, and structured-output masking are plan features that compose
+rather than modes that carve each other out; when the planner cannot
+meet a plan's precondition it flushes and counts the cause on
+`ome_engine_step_degradations_total`.
+
 Multi-host leaders (engine/multihost.ReplicatedEngine) disable the
 overlap: followers replay the leader's op stream strictly in order, so
 ops must be published from one thread in execution order.
@@ -86,14 +96,17 @@ class _SpecStep:
     """Lag-queue payload of one speculative verify step: the device-
     resident [B, k+1] emitted-token matrix and [B] accepted counts
     (host copies already in flight, like plain decode tokens), plus
-    the host-side draft lengths for acceptance-rate accounting."""
+    the host-side draft lengths for acceptance-rate accounting and
+    the dispatch timestamp for the spec_verify span (emitted when the
+    step drains — verify steps pipeline like any other plan)."""
 
-    __slots__ = ("out", "accepted", "draft_len")
+    __slots__ = ("out", "accepted", "draft_len", "t_dispatch")
 
-    def __init__(self, out, accepted, draft_len):
+    def __init__(self, out, accepted, draft_len, t_dispatch=0.0):
         self.out = out
         self.accepted = accepted
         self.draft_len = draft_len
+        self.t_dispatch = t_dispatch
 
 
 class _MultiStep:
@@ -114,6 +127,47 @@ class _MultiStep:
         # — the drain attributes program/expected_ms on the
         # decode_chunk span when present
         self.cost = cost
+
+
+class StepPlan:
+    """One scheduler iteration's device work, decided entirely at
+    plan time (docs/step-plan.md): which compiled-program family runs
+    (plain decode / K-token chunk / spec verify), the per-slot
+    constraints it carries (grammar masks, chunk budgets, draft
+    tokens), how many KV rows per slot it may commit, and whether its
+    results must drain synchronously because a sampled token the next
+    plan depends on cannot be known in advance. The executor
+    dispatches every plan the same way; composition decisions —
+    what rides with what — live only in the planner."""
+
+    __slots__ = ("kind", "k", "sync", "mask", "mask_stack", "drafts",
+                 "dlen", "budget", "rows", "mask_s")
+
+    def __init__(self, kind, k=1, sync=False, mask=None,
+                 mask_stack=None, drafts=None, dlen=None, budget=None,
+                 rows=1, mask_s=0.0):
+        self.kind = kind              # "decode" | "chunk" | "verify"
+        self.k = k                    # chunk length / max draft tokens
+        self.sync = sync              # drain everything after dispatch
+        self.mask = mask              # [B, V] allowed-token mask
+        self.mask_stack = mask_stack  # [B, k, V] per-iteration masks
+        self.drafts = drafts          # [B, k] draft tokens (verify)
+        self.dlen = dlen              # [B] draft lengths (verify)
+        self.budget = budget          # [B] per-slot chunk budget
+        self.rows = rows              # KV rows this plan writes/slot
+        self.mask_s = mask_s          # host seconds building masks
+
+
+# degradation causes the planner can count — a fixed enum so the
+# counter's label cardinality is bounded by construction. `masked`
+# and `spec_verify` name the old hard carve-outs (structured-output
+# batches forfeiting pipelining/chunking, verify steps forcing a
+# synchronous drain); with the shipped grammar maskers both stay 0 —
+# `masked` only counts for a masker whose automaton cannot be copied
+# (no grammar walk), and any other nonzero value is a composition
+# regression.
+DEGRADE_CAUSES = ("masked", "spec_verify", "spec_realign",
+                  "engine_multi_step", "engine_verify")
 
 
 # fixed width of the per-slot device stop table: stop ids past this
@@ -442,26 +496,28 @@ class Scheduler:
         # draft tokens per slot per step proposed by the host-side
         # n-gram drafter (engine/spec.py) and verified in ONE batched
         # forward. 0 = off (plain decode, the default); steps where no
-        # slot drafts, masked (structured-output) batches, and slots
-        # near the cache capacity fall back to plain decode — so the
-        # emitted streams are identical either way for greedy slots,
-        # and distributionally identical for temperature > 0.
+        # slot drafts and slots near the cache capacity fall back to
+        # plain decode — so the emitted streams are identical either
+        # way for greedy slots, and distributionally identical for
+        # temperature > 0. Verify steps pipeline and compose with
+        # chunking and grammar masks (docs/step-plan.md).
         self.spec_tokens = max(int(spec_tokens), 0)
         # decode pipelining (docs/decode-pipelining.md): number of
         # decode steps dispatched ahead of token emission. 0 = fetch
         # every step synchronously (pre-pipelining behavior); 1 = the
         # JetStream shape — step k's tokens are read only after step
-        # k+1 was dispatched, hiding the host-side bubble. Batches
-        # with structured-output (masked) slots fall back to the
-        # synchronous path per step regardless.
+        # k+1 was dispatched, hiding the host-side bubble. Plans the
+        # planner marks `sync` (a sampled token the next plan depends
+        # on) drain immediately for that step only.
         self.pipeline_depth = max(int(pipeline_depth), 0)
         # multi-token device decode (docs/multi-step-decode.md): K
         # decode iterations run inside ONE jitted program, the host
         # syncing once per K-token chunk. 1 = one dispatch per token
-        # (the pre-multi-step behavior, and the only shape masked /
-        # spec-verify / incapable-engine batches can run — those
-        # degrade per step with a throttled warning, never an exit).
+        # (the pre-multi-step behavior). Grammar-masked slots ride
+        # chunks through forced-token runs; only engines without the
+        # decode_multi op clamp K back to 1 (counted once below).
         self.steps_per_dispatch = max(int(steps_per_dispatch), 1)
+        init_degrades = []
         if self.steps_per_dispatch > 1 and not (
                 callable(getattr(engine, "decode_multi", None))
                 and getattr(engine, "supports_multi_step", False)):
@@ -471,9 +527,19 @@ class Scheduler:
                 "multi-step decode; running at 1",
                 self.steps_per_dispatch, type(engine).__name__)
             self.steps_per_dispatch = 1
-        # per-degradation-cause warn-once latch (masked / spec), so a
-        # long structured-output stream logs one line, not one per step
-        self._multi_degraded_warned: set = set()
+            init_degrades.append("engine_multi_step")
+        # speculative verify needs the engine's verify op; fakes and
+        # wrappers without one run plain (counted once, not per step)
+        self._spec_ok = callable(getattr(engine, "verify", None))
+        if self.spec_tokens > 0 and not self._spec_ok:
+            init_degrades.append("engine_verify")
+        # per-slot predicted continuation beyond the committed stream
+        # (docs/step-plan.md): [] = in sync with the device, a token
+        # list = exactly what the plans still in flight will emit
+        # (forced grammar tokens, full-accept draft predictions),
+        # None = unknown until a drain or flush re-anchors it
+        self._planned_tail: List[Optional[List[int]]] = \
+            [[] for _ in range(engine.max_slots)]
         # shared telemetry registry: the EngineServer scrapes it on
         # /metrics; stats-dict counters below are mirrored into it
         self.registry = registry or Registry()
@@ -711,6 +777,23 @@ class Scheduler:
         self._c_flight_dumps = R.counter(
             "ome_engine_flight_dumps_total",
             "Flight-recorder dumps written on crash recovery")
+        # step-plan degradation visibility (docs/step-plan.md):
+        # counted whenever the planner gives up a composition feature
+        # (a pipeline flush to re-anchor drafts, an engine capability
+        # clamp). Children are pre-created for the fixed cause enum so
+        # absent causes scrape as explicit zeros — `masked` (walkable
+        # grammars) and `spec_verify` in particular stay 0; they name
+        # the old carve-outs the plan/execute loop removed.
+        _deg = R.counter(
+            "ome_engine_step_degradations_total",
+            "Steps where the planner degraded a composition feature, "
+            "by cause (masked / spec_verify / spec_realign / "
+            "engine_multi_step / engine_verify)",
+            labelnames=("cause",))
+        self._c_degrade = {c: _deg.labels(cause=c)
+                           for c in DEGRADE_CAUSES}
+        for cause in init_degrades:
+            self._c_degrade[cause].inc()
         # per-class observability (docs/multi-tenancy.md): children
         # are pre-created for the fixed class enum ONLY, so label
         # cardinality is bounded by construction (the
@@ -784,6 +867,18 @@ class Scheduler:
     @property
     def status(self) -> str:
         return self._status
+
+    @property
+    def degradations(self) -> Dict[str, int]:
+        """Per-cause degradation counts for /health — the scrape-
+        visible view of every composition the planner had to give up
+        (docs/step-plan.md). `masked` and `spec_verify` staying 0 is
+        the contract the plan/execute refactor introduced."""
+        return {c: int(ch.value) for c, ch in self._c_degrade.items()}
+
+    def _degrade(self, cause: str) -> None:
+        self._c_degrade[cause].inc()
+        self._flight_event("step_degradation", cause=cause)
 
     # backward-compat boolean view of the tri-state (degraded still
     # accepts work, so it reads healthy)
@@ -1689,9 +1784,12 @@ class Scheduler:
     def _slot_changed(self, slot: int):
         """Every slot-occupancy change funnels through here: the
         generation bump retires any in-flight lagged token sampled for
-        the previous occupant, and the device sampling cache is
-        dropped so the next dispatch re-uploads the new [B] params."""
+        the previous occupant, the planner's predicted tail resets
+        (a new occupant has nothing beyond its committed stream), and
+        the device sampling cache is dropped so the next dispatch
+        re-uploads the new [B] params."""
         self._slot_gen[slot] += 1
+        self._planned_tail[slot] = []
         self._sampling_dev = None
         self._stops_dev = None
 
@@ -1738,6 +1836,46 @@ class Scheduler:
                 max(req.max_new_tokens - len(req.output_ids), 0), k)
         return budget
 
+    @staticmethod
+    def _flight_rows(payload) -> int:
+        """Device KV rows one lag-queue entry may commit per slot —
+        the unit the paged reserve / lookahead / spec-headroom
+        accounting sums over plans still in flight (shape reads are
+        metadata only, never a device sync)."""
+        if isinstance(payload, _SpecStep):
+            return int(payload.out.shape[1])
+        if isinstance(payload, _MultiStep):
+            return int(payload.k)
+        return 1
+
+    def _inflight_rows(self) -> int:
+        """Summed per-slot KV rows of every plan still in flight."""
+        return sum(self._flight_rows(p) for p, _, _ in self._inflight)
+
+    def _note_actual(self, slot: int, toks) -> None:
+        """Reconcile one drained slot against the planner's predicted
+        tail: an exact prefix match consumes it; any divergence marks
+        the slot's device-side continuation unknown, and the next plan
+        that needs it re-anchors by flushing (docs/step-plan.md)."""
+        tail = self._planned_tail[slot]
+        if tail is None:
+            return
+        toks = [int(t) for t in toks]
+        n = len(toks)
+        if len(tail) >= n and tail[:n] == toks:
+            self._planned_tail[slot] = tail[n:]
+        else:
+            self._planned_tail[slot] = None
+
+    def _flush_inflight(self) -> bool:
+        """Drain every lagged step and re-anchor the planner's
+        predicted tails at the committed stream (host and device now
+        agree). Returns True when the drain finished every slot."""
+        self._drain_inflight()
+        for s in range(len(self._planned_tail)):
+            self._planned_tail[s] = []
+        return not any(r is not None for r in self.slots)
+
     def _drain_inflight(self, keep: int = 0) -> bool:
         """Read dispatched steps older than the newest `keep`, oldest
         first, emitting each token whose slot still holds the SAME
@@ -1774,6 +1912,7 @@ class Scheduler:
                         or self._slot_gen[slot] != snap_gens[slot]):
                     continue
                 tok = int(host_toks[slot])
+                self._note_actual(slot, (tok,))
                 req.emit(tok)
                 self._inc("tokens_generated_total")
                 self._c_class_tokens[self._class_of(req)].inc()
@@ -1818,6 +1957,9 @@ class Scheduler:
         self._flight_event("spec_accept", proposed=proposed,
                            accepted=accepted)
         commit = getattr(self.engine, "commit_spec", None)
+        # later plans were dispatched against block pre-allocations
+        # covering their rows; commit must not trim those
+        reserve = self._inflight_rows()
         for slot, req in enumerate(snap_slots):
             if (req is None or self.slots[slot] is not req
                     or self._slot_gen[slot] != snap_gens[slot]):
@@ -1826,7 +1968,8 @@ class Scheduler:
             if commit is not None:
                 # paged KV: reconcile the host length mirror and
                 # return the speculative surplus blocks to the pool
-                commit(slot, n)
+                commit(slot, n, reserve=reserve)
+            self._note_actual(slot, host_out[slot, :n])
             self._note_decode_progress(req, tokens=n)
             for tok in host_out[slot, :n]:
                 req.emit(int(tok))
@@ -1835,6 +1978,17 @@ class Scheduler:
                 self._maybe_finish(slot, int(tok))
                 if self.slots[slot] is not req:
                     break  # finished mid-prefix: drop the tail
+        if self.span_log.enabled and step.t_dispatch:
+            # one span per verify round, timed dispatch-to-drain (the
+            # lag a pipelined verify rides shows up as span length)
+            s = Span("engine.spec_verify",
+                     trace_id=self._span_ctx.trace_id,
+                     parent_id=self._span_ctx.span_id,
+                     start_mono=step.t_dispatch,
+                     start_wall=time.time() - (time.monotonic()
+                                               - step.t_dispatch))
+            s.end().set(proposed=proposed, accepted=accepted)
+            self.span_log.write(s)
         self._ph_sample.observe(time.monotonic() - t_fetched)
 
     def _drain_multi(self, step: _MultiStep, snap_slots, snap_gens):
@@ -1856,9 +2010,9 @@ class Scheduler:
         t_fetched = time.monotonic()
         self._ph_wait.observe(t_fetched - t_read)
         commit = getattr(self.engine, "commit_spec", None)
-        # later chunks were dispatched against block pre-allocations
+        # later plans were dispatched against block pre-allocations
         # covering their rows; commit must not trim those
-        reserve = step.k * len(self._inflight)
+        reserve = self._inflight_rows()
         emitted = 0
         for slot, req in enumerate(snap_slots):
             if (req is None or self.slots[slot] is not req
@@ -1867,6 +2021,7 @@ class Scheduler:
             n = int(host_adv[slot])
             if commit is not None:
                 commit(slot, n, reserve=reserve)
+            self._note_actual(slot, host_out[slot, :n])
             if n:
                 self._note_decode_progress(req, tokens=n)
             for tok in host_out[slot, :n]:
@@ -1908,101 +2063,296 @@ class Scheduler:
         # lag queue to _recover, which drops it unread — lagged
         # tokens of a failed batch are never emitted.
         faults.fire("engine_step")
-        # structured outputs need token k ON HOST to build mask k+1,
-        # so a batch containing masked slots degrades to the
-        # synchronous path — detected per step, not globally: the
-        # batch re-pipelines as soon as its masked requests finish
-        masked = any(r is not None and r.masker is not None
-                     for r in self.slots)
-        if masked and self._inflight:
-            self._drain_inflight()
-            if not any(r is not None for r in self.slots):
-                return True  # draining finished every slot
-        mask = None
+        plan = self._plan_step()
+        if plan is None:
+            return True  # a precondition drain finished every slot
+        return self._execute(plan)
+
+    def _plan_step(self) -> Optional[StepPlan]:
+        """Build this iteration's StepPlan (docs/step-plan.md).
+
+        Composition is decided here, once: grammar-masked slots are
+        walked ahead through forced-token runs so they ride chunks
+        and the pipeline; speculative drafts are built over each
+        slot's predicted continuation so verify steps pipeline too;
+        a plan is marked `sync` only where a sampled token the NEXT
+        plan depends on cannot be known in advance (a grammar
+        boundary). Preconditions the planner cannot meet are
+        re-established by flushing the lag queue — counted in the
+        degradation counter, never silently. Returns None when such
+        a flush finished every slot."""
+        B = self.engine.max_slots
+        # with nothing in flight the committed stream IS the device
+        # state: re-anchor every predicted tail
+        if not self._inflight:
+            for s in range(B):
+                self._planned_tail[s] = []
+        k_steps = self.steps_per_dispatch
+        masked_slots = [s for s, r in enumerate(self.slots)
+                        if r is not None and r.masker is not None]
+        # -- grammar walk: advance a COPY of each masked slot's
+        # automaton over its predicted tail, then through up to
+        # `k_steps` future positions (one mask each, jumping ahead
+        # through forced tokens)
+        tm0 = time.monotonic()
         mask_s = 0.0
-        if masked:
-            tm0 = time.monotonic()
+        walks: Dict[int, tuple] = {}
+        legacy_masked = False
+        for s in masked_slots:
+            m = self.slots[s].masker
+            if (self._planned_tail[s] is None
+                    or not callable(getattr(m, "copy", None))):
+                legacy_masked = True
+                break
+            try:
+                walks[s] = self._walk_masker(s, max(k_steps, 1))
+            except AttributeError:
+                # the masker copies but its automaton cannot
+                legacy_masked = True
+                break
+        if legacy_masked:
+            # plan precondition re-established by draining: a grammar
+            # that cannot be walked ahead is only consistent with the
+            # committed stream, so nothing may be in flight when its
+            # mask is built — one synchronous masked step, exactly
+            # the pre-plan behavior for copyless maskers, and the one
+            # case that still counts as a masked degradation
+            self._degrade("masked")
+            if self._inflight and self._flush_inflight():
+                return None
             mask = self._build_mask()
             mask_s = time.monotonic() - tm0
             self._ph_mask.observe(mask_s)
-        # speculative decoding: draft with the host-side n-gram
-        # matcher and verify the whole batch in one multi-token
-        # forward. Masked batches stay non-speculative (the grammar
-        # needs token k on host to build mask k+1 — same reason they
-        # degrade to synchronous), engines without a verify op (fakes,
-        # remote wrappers) stay plain, and a batch where any slot is
-        # within k+1 rows of cache capacity falls back for the step
-        # (the verify write needs k+1 rows of headroom per slot).
+            return StepPlan("decode", sync=True, mask=mask,
+                            mask_s=mask_s)
+        if masked_slots:
+            mask_s = time.monotonic() - tm0
+            self._ph_mask.observe(mask_s)
+        # -- speculative drafts over predicted continuations. Masked
+        # slots never draft (their continuation belongs to the
+        # grammar, not the n-gram cache) but ride verify steps at
+        # draft length 0 with their position-0 mask applied in the
+        # verify program. A batch where any slot is within the
+        # in-flight-rows + k+1 headroom of cache capacity falls back
+        # for the step (the verify write needs that many rows).
         drafts = dlen = None
-        if (self.spec_tokens > 0 and mask is None
-                and getattr(self.engine, "verify", None) is not None):
-            drafts, dlen = self._build_drafts(self.spec_tokens)
-            if dlen.any() and self._inflight:
-                # drafts must align with the DEVICE's last committed
-                # token: a lagged in-flight step would shift the
-                # drafted continuation by its unread tokens, so the
-                # verify would reject nearly everything. Drain first
-                # (only when someone actually drafted — non-repetitive
-                # workloads keep the plain pipeline), then re-draft
-                # from the now-complete stream.
-                self._drain_inflight()
-                if not any(r is not None for r in self.slots):
-                    return True  # draining finished every slot
-                drafts, dlen = self._build_drafts(self.spec_tokens)
-            if not dlen.any() or not self._spec_headroom(
-                    self.spec_tokens):
-                drafts = dlen = None  # nobody drafted: plain decode
-        # verify steps run the lag queue at depth 0, like masked
-        # steps: the next round's drafts need this step's tokens on
-        # host, and paged engines must reconcile block allocation
-        # against the drained accepted counts before the next
-        # dispatch. The verify itself amortizes the sync bubble over
-        # the accepted tokens; plain fallback steps keep pipelining.
-        depth = 0 if (mask is not None or drafts is not None) \
-            else self.pipeline_depth
-        # multi-token chunks compose with pipelining (the lag queue
-        # just carries [B, K] chunks) but degrade to K=1 for masked
-        # and spec-verify steps, which both need token k on host
-        # before step k+1 can run — logged once per cause, and the
-        # batch re-chunks the moment the constraint clears
-        k_steps = self.steps_per_dispatch
-        if k_steps > 1 and (mask is not None or drafts is not None):
-            cause = "masked" if mask is not None else "spec_verify"
-            if cause not in self._multi_degraded_warned:
-                self._multi_degraded_warned.add(cause)
-                import logging
-                logging.getLogger("ome.engine").warning(
-                    "steps_per_dispatch=%d degraded to 1 for %s "
-                    "steps (token k must reach the host before step "
-                    "k+1)", k_steps, cause)
-            k_steps = 1
+        if self.spec_tokens > 0 and self._spec_ok:
+            k = self.spec_tokens
+            drafts, dlen = self._build_drafts(k)
+            if dlen.any() and self._inflight and any(
+                    dlen[s] and self._planned_tail[s] is None
+                    for s in range(B) if self.slots[s] is not None):
+                # draft positional alignment is a plan precondition:
+                # a drafting slot whose device-side continuation is
+                # unpredicted would draft against a stale stream and
+                # the verify would reject nearly everything. Flush,
+                # re-anchor, re-draft — and count it: realign
+                # flushes are the price of a mispredicted pipeline.
+                self._degrade("spec_realign")
+                if self._flush_inflight():
+                    return None
+                drafts, dlen = self._build_drafts(k)
+            if not dlen.any() or not self._spec_headroom(k):
+                drafts = dlen = None  # nobody drafted: plain/chunk
+        if drafts is not None:
+            # verify plan: a multi-token-shaped dispatch that
+            # pipelines like any chunk; sync only when a masked
+            # slot's first position is a real grammar choice
+            mask = None
+            sync = False
+            if masked_slots:
+                V = self.engine.cfg.vocab_size
+                mask = np.ones((B, V), dtype=bool)
+                for s in masked_slots:
+                    w_masks, w_forced, w_boundary = walks[s]
+                    if w_masks:
+                        mask[s] = w_masks[0]
+                    if w_boundary and not w_forced:
+                        sync = True
+            plan = StepPlan("verify", k=self.spec_tokens, sync=sync,
+                            mask=mask, drafts=drafts, dlen=dlen,
+                            rows=self.spec_tokens + 1, mask_s=mask_s)
+            self._predict_verify(plan, walks)
+            return plan
+        # -- chunk length: the device may not run PAST a grammar
+        # boundary (the token sampled there decides every later
+        # mask), so the nearest boundary clamps K for the whole
+        # batch; a boundary inside the chunk also marks it sync
+        n = max(k_steps, 1)
+        for s in masked_slots:
+            w_masks, w_forced, w_boundary = walks[s]
+            if w_boundary:
+                n = min(n, len(w_forced) + 1)
+        sync = any(walks[s][2] and len(walks[s][1]) < n
+                   for s in masked_slots)
+        if n > 1:
+            budget = self._multi_budget(n)
+            stack = None
+            if masked_slots:
+                V = self.engine.cfg.vocab_size
+                stack = np.ones((B, n, V), dtype=bool)
+                for s in masked_slots:
+                    w_masks, w_forced, w_boundary = walks[s]
+                    for i, row in enumerate(w_masks[:n]):
+                        stack[s, i] = row
+                    budget[s] = min(int(budget[s]), len(w_masks))
+            plan = StepPlan("chunk", k=n, sync=sync,
+                            mask_stack=stack, budget=budget, rows=n,
+                            mask_s=mask_s)
+        else:
+            mask = None
+            if masked_slots:
+                V = self.engine.cfg.vocab_size
+                mask = np.ones((B, V), dtype=bool)
+                for s in masked_slots:
+                    w_masks, w_forced, w_boundary = walks[s]
+                    if w_masks:
+                        mask[s] = w_masks[0]
+            plan = StepPlan("decode", sync=sync, mask=mask,
+                            mask_s=mask_s)
+        self._predict_step(plan, walks, n)
+        return plan
+
+    def _walk_masker(self, slot: int, horizon: int):
+        """Advance a COPY of the slot's grammar ahead of its
+        committed stream: feed the predicted in-flight tail, then
+        walk up to `horizon` future positions, collecting the
+        allowed-token mask at each and jumping through forced tokens
+        (positions where the grammar allows exactly one — closing
+        braces, fixed keys, separators). Returns (masks, forced,
+        boundary): one [V] mask per walked position, the forced
+        tokens (always a prefix of the walk), and whether the walk
+        stopped at a boundary — a position whose token only the
+        device can decide. Raises AttributeError when the underlying
+        automaton cannot be copied (the caller falls back to one
+        synchronous masked step)."""
+        req = self.slots[slot]
+        walker = req.masker.copy()
+        tail = self._planned_tail[slot] or []
+        for tok in tail:
+            walker.feed(tok)
+        V = self.engine.cfg.vocab_size
+        masks: list = []
+        forced: list = []
+        boundary = False
+        produced = len(req.output_ids) + len(tail)
+        for i in range(horizon):
+            if walker.done():
+                break
+            remaining = req.max_new_tokens - produced - i
+            if remaining <= 0:
+                break
+            closing = remaining <= walker.closing_distance() + 4
+            row = walker.mask(V, closing=closing, remaining=remaining)
+            masks.append(row)
+            allowed = np.flatnonzero(row)
+            if allowed.size == 1:
+                tok = int(allowed[0])
+                forced.append(tok)
+                walker.feed(tok)
+            else:
+                boundary = True
+                break
+        return masks, forced, boundary
+
+    def _predict_step(self, plan: StepPlan, walks: Dict[int, tuple],
+                      n: int) -> None:
+        """Extend each slot's predicted tail with what this
+        decode/chunk plan will deterministically emit: forced grammar
+        tokens are exact; a freely sampled position makes the slot's
+        continuation unknown until the step drains. Sync plans drain
+        immediately, so their tails re-anchor at the next plan."""
+        if plan.sync:
+            return
+        for s, r in enumerate(self.slots):
+            if r is None:
+                continue
+            tail = self._planned_tail[s]
+            if tail is None:
+                continue
+            if s in walks:
+                self._planned_tail[s] = tail + walks[s][1][:n]
+            else:
+                self._planned_tail[s] = None
+
+    def _predict_verify(self, plan: StepPlan,
+                        walks: Dict[int, tuple]) -> None:
+        """Predict each slot's continuation through a verify plan:
+        the optimistic outcome is every draft accepted plus the
+        drafter's own guess at the bonus token. Wrong predictions
+        never emit a wrong byte — the drain reconciles against what
+        the device actually produced and the next plan flushes if it
+        needs an alignment the prediction lost."""
+        if plan.sync:
+            return
+        for s, r in enumerate(self.slots):
+            if r is None:
+                continue
+            tail = self._planned_tail[s]
+            if tail is None:
+                continue
+            if s in walks:
+                # a masked slot advances exactly one (forced) token
+                self._planned_tail[s] = tail + walks[s][1][:1]
+                continue
+            d = int(plan.dlen[s])
+            if d == 0:
+                # a free position-0 sample: unknown until drained
+                self._planned_tail[s] = None
+                continue
+            drafted = [int(t) for t in plan.drafts[s, :d]]
+            stream = (list(r.prompt_ids)
+                      + list(r.output_ids[int(self._base_out[s]):])
+                      + tail + drafted)
+            bonus = spec_drafter.propose(stream, 1)
+            if bonus.size:
+                self._planned_tail[s] = (tail + drafted
+                                         + [int(bonus[0])])
+            else:
+                self._planned_tail[s] = None
+
+    def _execute(self, plan: StepPlan) -> bool:
+        """Dispatch one StepPlan, feed the lag queue, drain. Every
+        plan takes the same path: one compiled-program call keyed on
+        plan.kind, one lag-queue append, one windowed drain — the
+        generation-counter discard rules do the rest. The executor
+        never decides composition; it only honors plan.sync by
+        running this step's window at depth 0."""
         sampling = self._sampling()
         t0 = time.monotonic()
         gap_s = None
         if self._dispatch_end is not None:
             gap_s = t0 - self._dispatch_end
             self._h_step_gap.observe(gap_s)
-        if mask is not None:
-            self.state, toks = self.engine.decode(
-                self.state, *sampling, mask=mask)
-        elif drafts is not None:
+        n_steps = plan.k if plan.kind == "chunk" else 1
+        if plan.kind == "verify":
+            kw = {}
+            if getattr(self.engine, "kv_block", 0):
+                # paged pre-allocation must cover this plan AND every
+                # plan still in flight (their commits have not
+                # advanced the host length mirror yet)
+                kw["lookahead_rows"] = self._inflight_rows() + plan.rows
+            if plan.mask is not None:
+                kw["mask"] = plan.mask
             self.state, out, acc = self.engine.verify(
-                self.state, drafts, dlen, *sampling)
-            toks = _SpecStep(out, acc, dlen)
-        elif k_steps > 1:
-            # paged pre-allocation must cover this chunk AND every
-            # chunk still in flight (their commits have not advanced
-            # the host length mirror yet)
-            lookahead = k_steps * (len(self._inflight) + 1)
+                self.state, plan.drafts, plan.dlen, *sampling, **kw)
+            toks = _SpecStep(out, acc, plan.dlen, t0)
+        elif plan.kind == "chunk":
+            kw = {}
+            if plan.mask_stack is not None:
+                kw["mask"] = plan.mask_stack
             self.state, out, adv = self.engine.decode_multi(
-                self.state, *sampling, steps=k_steps,
-                budget=self._multi_budget(k_steps),
-                stop_ids=self._stop_table(),
-                lookahead_rows=lookahead)
+                self.state, *sampling, steps=plan.k,
+                budget=plan.budget, stop_ids=self._stop_table(),
+                lookahead_rows=self._inflight_rows() + plan.rows,
+                **kw)
             led = getattr(self.engine, "ledger", None)
             toks = _MultiStep(
-                out, adv, k_steps, t0,
+                out, adv, plan.k, t0,
                 cost=led.last_dispatch() if led is not None else None)
+        elif plan.mask is not None:
+            self.state, toks = self.engine.decode(
+                self.state, *sampling, mask=plan.mask)
         else:  # engine wrappers/fakes need no mask kwarg in their API
             self.state, toks = self.engine.decode(
                 self.state, *sampling)
@@ -2010,22 +2360,24 @@ class Scheduler:
         dt = self._dispatch_end - t0
         # per-STEP time (the queue-wait estimator and step histogram
         # stay per-token): a K-chunk dispatch amortizes over K steps
-        dt_step = dt / k_steps
+        dt_step = dt / n_steps
         self._ewma_step_s = dt_step if self._ewma_step_s is None \
             else 0.9 * self._ewma_step_s + 0.1 * dt_step
         self._h_decode_step.observe(dt_step)
-        if k_steps > 1:
+        if n_steps > 1:
             self._ph_device_loop.observe(dt)
         else:
             self._ph_dispatch.observe(dt)
-        self._observe_roofline(toks, dt, dt_step, k_steps,
-                               gap_s, mask_s)
-        self._inc("decode_steps_total", k_steps)
-        if drafts is not None:
+        self._observe_roofline(toks, dt, dt_step, n_steps,
+                               gap_s, plan.mask_s)
+        self._inc("decode_steps_total", n_steps)
+        if plan.kind == "verify":
             self._inc("spec_steps_total")
-            self._inc("spec_proposed_tokens_total", int(dlen.sum()))
+            self._inc("spec_proposed_tokens_total",
+                      int(plan.dlen.sum()))
         self._inflight.append(
             (toks, list(self.slots), list(self._slot_gen)))
+        depth = 0 if plan.sync else self.pipeline_depth
         # emit steps older than the pipeline window — with the next
         # step now dispatched, reading them costs no dispatch overlap
         self._drain_inflight(keep=max(depth, 1))
@@ -2064,17 +2416,6 @@ class Scheduler:
                 self._free_slots.release()
         if depth == 0:
             self._drain_inflight()
-        if drafts is not None and self.span_log.enabled:
-            # one span per verify round, timed over dispatch + drain
-            # (depth 0 forces the drain above, so _spec_last is fresh)
-            prop, acc = self._spec_last
-            s = Span("engine.spec_verify",
-                     trace_id=self._span_ctx.trace_id,
-                     parent_id=self._span_ctx.span_id,
-                     start_mono=t0,
-                     start_wall=time.time() - (time.monotonic() - t0))
-            s.end().set(proposed=prop, accepted=acc)
-            self.span_log.write(s)
         return True
 
     def _observe_roofline(self, toks, dt: float, dt_step: float,
@@ -2116,12 +2457,13 @@ class Scheduler:
 
     def _spec_headroom(self, k: int) -> bool:
         """True when every active slot has cache headroom for the k+1
-        speculative KV rows a verify step writes — including rows the
-        still-inflight steps may commit. A near-capacity slot makes
-        the whole step fall back to plain decode (it finishes with
-        reason=length within a step or two anyway); without this, a
-        clamped multi-row cache write would corrupt earlier rows."""
-        need = (len(self._inflight) + 1) * (k + 1)
+        speculative KV rows a verify step writes — plus the exact
+        rows every plan still in flight may commit. A near-capacity
+        slot makes the whole step fall back to plain decode (it
+        finishes with reason=length within a step or two anyway);
+        without this, a clamped multi-row cache write would corrupt
+        earlier rows."""
+        need = self._inflight_rows() + (k + 1)
         for slot, req in enumerate(self.slots):
             if req is None:
                 continue
@@ -2133,23 +2475,30 @@ class Scheduler:
 
     def _build_drafts(self, k: int):
         """Per-slot n-gram drafts from each request's host-visible
-        committed stream (prompt + emitted output — under pipelining
-        this lags the device by the lag-queue depth, which only costs
-        acceptance, never correctness). Returns ([B, k] int32 drafts,
-        [B] int32 draft lengths); a slot with no match drafts 0
-        tokens and degenerates to plain decode inside the verify."""
+        committed stream (prompt + emitted output) EXTENDED by its
+        predicted in-flight tail, so drafts align with where the
+        device will be when the verify runs — the precondition that
+        lets verify steps pipeline. A slot whose tail is unknown
+        drafts from the committed stream alone (the planner flushes
+        before dispatching if that draft would be misaligned).
+        Masked slots never draft: their continuation belongs to the
+        grammar walk, not the n-gram cache. Returns ([B, k] int32
+        drafts, [B] int32 draft lengths); a slot with no match
+        drafts 0 tokens and degenerates to plain decode inside the
+        verify."""
         B = self.engine.max_slots
         drafts = np.zeros((B, k), np.int32)
         dlen = np.zeros((B,), np.int32)
         for slot, req in enumerate(self.slots):
-            if req is None:
+            if req is None or req.masker is not None:
                 continue
             # outputs[:base_out] of a resumed request are already
             # folded into prompt_ids — slicing keeps the drafter's
             # view of the stream free of duplicated spans
             d = spec_drafter.propose(
                 list(req.prompt_ids)
-                + list(req.output_ids[int(self._base_out[slot]):]), k)
+                + list(req.output_ids[int(self._base_out[slot]):])
+                + (self._planned_tail[slot] or []), k)
             if d.size:
                 drafts[slot, :d.size] = d
                 dlen[slot] = d.size

@@ -156,17 +156,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "fetch/finish bookkeeping with the next "
                         "device step (one-step emission lag), 0 "
                         "restores the synchronous fetch-every-step "
-                        "loop; structured-output batches always run "
-                        "synchronously")
+                        "loop; structured-output batches stay "
+                        "pipelined through forced-token grammar runs "
+                        "(docs/step-plan.md)")
     p.add_argument("--steps-per-dispatch", type=int, default=1,
                    help="decode iterations fused into one device "
                         "program (docs/multi-step-decode.md): the "
                         "host dispatches and syncs once per K-token "
                         "chunk instead of per token; greedy output "
-                        "is byte-identical to K=1. Masked "
-                        "(structured-output), spec-verify, and "
-                        "multi-host batches degrade to 1 with a "
-                        "logged warning")
+                        "is byte-identical to K=1. Composes with "
+                        "masked, speculative, pipelined, and "
+                        "multi-host serving (docs/step-plan.md); "
+                        "engines without the decode_multi op clamp "
+                        "to 1, counted in "
+                        "ome_engine_step_degradations_total")
     p.add_argument("--spec-tokens", type=int, default=0,
                    help="speculative decoding: max draft tokens per "
                         "slot per step proposed by the host-side "
@@ -174,7 +177,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "multi-token forward "
                         "(docs/speculative-decoding.md); 0 = off "
                         "(default). Greedy output is byte-identical "
-                        "either way; single-host only")
+                        "either way; composes with multi-token "
+                        "chunks, pipelining, and multi-host serving "
+                        "(docs/step-plan.md)")
     p.add_argument("--journal", default=None, metavar="DIR",
                    help="durable requests (docs/durability.md): "
                         "append-only JSONL request journal in DIR; "
@@ -561,6 +566,38 @@ class _PrefillNodeScheduler(_NullScheduler):
         self.engine = engine
 
 
+def check_plan_preconditions(engine, args):
+    """Validate explicitly requested composition features against the
+    assembled engine stack BEFORE serving (docs/step-plan.md).
+
+    The scheduler degrades gracefully at construction (counted in
+    ome_engine_step_degradations_total), but an operator who asked
+    for a feature on the command line gets a config error naming the
+    failed plan precondition instead of a silently slower server.
+    Returns an error string, or None when every requested feature can
+    dispatch. Multi-host is NOT a refusal: ReplicatedEngine carries
+    decode_multi / verify / commit_spec in the op vocabulary, so spec
+    and multi-step compose with dist like everything else."""
+    if args.spec_tokens > 0 and not callable(
+            getattr(engine, "verify", None)):
+        return ("--spec-tokens %d: plan precondition engine.verify "
+                "unsatisfied — %s has no spec-verify op, so verify "
+                "plans cannot dispatch (docs/step-plan.md); drop "
+                "--spec-tokens or serve an engine with verify"
+                % (args.spec_tokens, type(engine).__name__))
+    if args.steps_per_dispatch > 1 and not (
+            callable(getattr(engine, "decode_multi", None))
+            and getattr(engine, "supports_multi_step", False)):
+        return ("--steps-per-dispatch %d: plan precondition "
+                "engine.decode_multi unsatisfied — %s has no "
+                "multi-step decode op, so chunk plans cannot "
+                "dispatch (docs/step-plan.md); drop "
+                "--steps-per-dispatch or serve an engine with "
+                "decode_multi"
+                % (args.steps_per_dispatch, type(engine).__name__))
+    return None
+
+
 def load_embedder(args):
     import jax
     import jax.numpy as jnp
@@ -711,22 +748,10 @@ def main(argv=None) -> int:
         # leaders publish ops from ONE thread in execution order
         # (followers replay strictly sequentially); on PD decode nodes
         # it moves the remote KV fetch off the decode thread
-        if dist is not None and args.spec_tokens > 0:
-            # the multi-host op stream replicates prefill/insert/
-            # decode only — a leader-side verify op would desync the
-            # followers' replay; refuse rather than silently diverge
-            log.error("--spec-tokens requires single-host serving "
-                      "(the multi-host op stream has no verify op)")
+        err = check_plan_preconditions(engine, args)
+        if err is not None:
+            log.error("%s", err)
             return 2
-        if dist is not None and args.steps_per_dispatch > 1:
-            # unlike spec verify this degrades instead of exiting:
-            # ReplicatedEngine publishes supports_multi_step = False,
-            # so the scheduler runs K=1 — same bytes, just per-token
-            # dispatch — and multihost deployments keep one flag set
-            log.warning("--steps-per-dispatch %d ignored under "
-                        "multi-host serving (the op stream has no "
-                        "multi-step op); running at 1",
-                        args.steps_per_dispatch)
         if args.journal:
             from .journal import RequestJournal
             provenance = None

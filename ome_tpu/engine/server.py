@@ -172,6 +172,12 @@ class EngineServer:
                             sched, "spec_tokens", 0),
                         "steps_per_dispatch": getattr(
                             sched, "steps_per_dispatch", 1),
+                        # per-cause planner degradation counts
+                        # (docs/step-plan.md): a nonzero `masked` or
+                        # `spec_verify` here means a composition
+                        # regression, visible without a metrics scrape
+                        "degradations": getattr(
+                            sched, "degradations", {}),
                         "uptime_s": round(
                             time.time() - outer.started_at, 1)})
                 elif self.path == "/ready":

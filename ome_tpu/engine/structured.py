@@ -477,6 +477,21 @@ class TokenMasker:
             cls._tables[key] = _build_token_table(tok)
         return cls._tables[key]
 
+    def copy(self) -> "TokenMasker":
+        """Independent masker at the same grammar state — what the
+        step planner walks ahead of the committed stream to build a
+        chunk's per-iteration mask stack (docs/step-plan.md) without
+        disturbing the request's real automaton. Requires the
+        underlying automaton to support copy(); maskers wrapping an
+        automaton that can't be copied raise AttributeError, and the
+        planner falls back to one mask per synchronous step."""
+        m = TokenMasker.__new__(TokenMasker)
+        m.tok = self.tok
+        m.automaton = self.automaton.copy()
+        m.table = self.table
+        m.eos_id = self.eos_id
+        return m
+
     def feed(self, token_id: int) -> None:
         """Advance past an emitted token (its bytes were validated by
         the mask, but be tolerant of forced tokens)."""

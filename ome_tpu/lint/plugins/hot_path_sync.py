@@ -33,11 +33,17 @@ ROOT_SPECS = (
     "router/server.py::RouterServer.__init__.Handler._forward",
 )
 # fallback seeds for single-file runs whose file lacks the real
-# roots (the legacy check_decode_sync fixture contract)
+# roots (the legacy check_decode_sync fixture contract); the
+# planner/executor split (docs/step-plan.md) joins the seed set so
+# fixtures exercising _plan_step/_execute stay linted without a
+# `step` entry point
 LEGACY_ROOTS = (
     "step", "_decode", "_insert_ready", "_admit", "_build_mask",
     "_maybe_finish", "_sampling", "_spec_headroom", "_build_drafts",
-    "_stop_table", "_multi_budget")
+    "_stop_table", "_multi_budget", "_plan_step", "_execute",
+    "_walk_masker", "_predict_step", "_predict_verify",
+    "_flush_inflight", "_note_actual", "_inflight_rows",
+    "_flight_rows", "_degrade")
 ALLOWED = frozenset(("_drain_inflight", "_drain_spec",
                      "_drain_multi"))
 

@@ -6,10 +6,12 @@ Thin shim over the omelint ``hot-path-sync`` analyzer
 same exit codes as the original standalone script — but the function
 set is now derived from call-graph REACHABILITY (roots:
 ``Scheduler.step`` and the router forward path; legacy step-path
-names seed fixture files that lack them) instead of a hardcoded
-frozenset, so renaming or splitting a step helper cannot silently
-un-lint it. The sanctioned drain fetches (`_drain_inflight` /
-`_drain_spec` / `_drain_multi` — the last being the once-per-chunk
+names — including the planner/executor split, ``_plan_step`` /
+``_execute`` / ``_walk_masker`` and their helpers
+(docs/step-plan.md) — seed fixture files that lack them) instead of
+a hardcoded frozenset, so renaming or splitting a step helper cannot
+silently un-lint it. The sanctioned drain fetches (`_drain_inflight`
+/ `_drain_spec` / `_drain_multi` — the last being the once-per-chunk
 sync of multi-token device decode) are a reachability stop-set. See
 docs/static-analysis.md.
 

@@ -71,6 +71,19 @@ NESTED_POLICY = (
      (True, 0.06)),
     (re.compile(r"^paged_sweep\.\w+\.\d+\.hbm_per_slot_bytes$"),
      (False, 0.0)),
+    # StepPlan composition matrix (bench.py composition,
+    # docs/step-plan.md): per-cell throughput and accept rate gate
+    # like the other decode families; degraded_steps is a composition
+    # contract — ANY step where the planner dropped a feature in a
+    # cell that ran clean before is a regression (band 0)
+    (re.compile(r"^composition\.cells\.\w+\.tokens_per_sec$"),
+     (True, 0.08)),
+    (re.compile(r"^composition\.cells\.\w+\.accept_rate$"),
+     (True, 0.10)),
+    (re.compile(r"^composition\.cells\.\w+\.degraded_steps$"),
+     (False, 0.0)),
+    (re.compile(r"^composition\.composed_vs_best_single$"),
+     (True, 0.08)),
 )
 
 
@@ -201,6 +214,15 @@ def cost_table(parsed: dict, source: str) -> dict:
                     "tokens_per_sec": row["tokens_per_sec"],
                     "hbm_per_slot_bytes":
                         row.get("hbm_per_slot_bytes")}
+    comp = (parsed.get("composition") or {}).get("cells") or {}
+    for name, row in comp.items():
+        if isinstance(row, dict) and "tokens_per_sec" in row:
+            # composed step-plan cells (spec x chunk x pipeline,
+            # docs/step-plan.md) — lets the simulator price serving
+            # configs that enable several mechanisms at once
+            table["programs"][f"composed_{name}"] = {
+                "tokens_per_sec": row["tokens_per_sec"],
+                "accept_rate": row.get("accept_rate")}
     if "dispatch_ms" in parsed:
         table["dispatch_ms"] = parsed["dispatch_ms"]
     if "warmup_ms" in parsed:

@@ -7,11 +7,15 @@ replays it in the follower loop. The leader writes its token stream to
 an output file for the test to compare against a single-process run.
 
 Usage: multihost_driver.py <pid> <nproc> <coord_port> <ctrl_port> <out>
-           [mixed <adapter_dir>]
+           [mixed <adapter_dir> | spec]
 
 The optional `mixed` mode drives the topology-matrix workload
 (json_schema + LoRA adapter + plain request through the real
-Scheduler) instead of the raw op script — r4 verdict #10.
+Scheduler) instead of the raw op script — r4 verdict #10. The `spec`
+mode drives the composed StepPlan path (spec-verify × multi-token
+chunks × pipelining) through the real Scheduler, exercising the
+decode_multi / verify / commit_spec ops on the replicated stream
+(docs/step-plan.md).
 """
 
 import json
@@ -80,8 +84,12 @@ def main() -> int:
         pub = multihost.OpPublisher(nproc - 1, port=ctrl_port,
                                     host="127.0.0.1")
         reng = multihost.ReplicatedEngine(eng, pub)
-        tokens = run_mixed(reng, adapter_dir) if mode == "mixed" \
-            else run_script(reng)
+        if mode == "mixed":
+            tokens = run_mixed(reng, adapter_dir)
+        elif mode == "spec":
+            tokens = run_spec(reng)
+        else:
+            tokens = run_script(reng)
         pub.close()
         with open(out_path, "w") as f:
             json.dump(tokens, f)
@@ -121,6 +129,36 @@ def run_mixed(engine, adapter_dir: str) -> list:
                 max_new_tokens=10, temperature=0.0, adapter="styleA",
                 stop_ids=[]),
         Request(prompt_ids=tok.encode("plain prompt"),
+                max_new_tokens=10, temperature=0.0, stop_ids=[]),
+    ]
+    for r in reqs:
+        sched.submit(r)
+    for _ in range(400):
+        if all(r.done.is_set() for r in reqs):
+            break
+        sched.step()
+    assert all(r.done.is_set() for r in reqs)
+    return [list(r.output_ids) for r in reqs]
+
+
+def run_spec(engine) -> list:
+    """Composed StepPlan workload: speculative verify (repetitive
+    prompt, so the n-gram drafter actually drafts) × multi-token
+    chunks × one-step pipelining, through the REAL Scheduler. Greedy,
+    so a group run must match a single-process run byte for byte —
+    proving verify / decode_multi / commit_spec replicate."""
+    from ome_tpu.engine.scheduler import Request, Scheduler
+    from ome_tpu.engine.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    sched = Scheduler(engine, spec_tokens=2, steps_per_dispatch=2,
+                      pipeline_depth=1)
+    assert sched.spec_tokens == 2 and sched.steps_per_dispatch == 2, \
+        "composition silently degraded under the replicated engine"
+    reqs = [
+        Request(prompt_ids=tok.encode("ababababab"),
+                max_new_tokens=12, temperature=0.0, stop_ids=[]),
+        Request(prompt_ids=tok.encode("xyzxyzxyz"),
                 max_new_tokens=10, temperature=0.0, stop_ids=[]),
     ]
     for r in reqs:

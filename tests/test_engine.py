@@ -234,7 +234,12 @@ class TestHTTPServer:
     def test_health_models_metrics(self, server):
         base = f"http://127.0.0.1:{server.port}"
         with urllib.request.urlopen(f"{base}/health", timeout=10) as r:
-            assert json.loads(r.read())["status"] == "ok"
+            health = json.loads(r.read())
+        assert health["status"] == "ok"
+        # planner degradation counts ride /health (docs/step-plan.md);
+        # a plain scheduler over a real engine degrades nothing
+        assert health["degradations"] == {
+            c: 0 for c in health["degradations"]}
         with urllib.request.urlopen(f"{base}/v1/models", timeout=10) as r:
             assert json.loads(r.read())["data"][0]["id"] == "tiny-test"
         self._post(server, "/v1/completions",

@@ -75,6 +75,43 @@ def test_two_process_group_matches_single_process():
     assert group_tokens == ref_tokens
 
 
+def test_two_process_group_spec_multistep_matches_single_process():
+    """Composed StepPlans under multi-host: spec-verify × multi-token
+    chunks × pipelining through the real Scheduler over the replicated
+    op stream (verify / decode_multi / commit_spec ops) must emit the
+    exact greedy streams of a single-process run with the same
+    composition — --spec-tokens and --steps-per-dispatch are no longer
+    single-host-only (docs/step-plan.md)."""
+    coord, ctrl = _free_port(), _free_port()
+    out_path = os.path.join("/tmp", f"mh_spec_{os.getpid()}.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, DRIVER, str(pid), "2", str(coord),
+             str(ctrl), out_path, "spec"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for pid in (0, 1)
+    ]
+    outs = [p.communicate(timeout=420) for p in procs]
+    for p, (so, se) in zip(procs, outs):
+        assert p.returncode == 0, se.decode()[-2000:]
+
+    with open(out_path) as f:
+        group_tokens = json.load(f)
+    os.unlink(out_path)
+
+    from ome_tpu.engine.sharded import ShardedInferenceEngine
+    from tests.multihost_driver import run_spec
+    cfg = tiny_test().replace(dtype=jnp.float32)
+    params = jax.tree.map(np.asarray,
+                          llama.init_params(jax.random.PRNGKey(0), cfg))
+    ref = ShardedInferenceEngine(params, cfg, tp=2, max_slots=2,
+                                 max_seq=64, prefill_buckets=[16])
+    ref_tokens = run_spec(ref)
+    assert group_tokens == ref_tokens
+
+
 def test_replicated_engine_publishes_op_stream():
     """Every device-touching call on the leader must reach followers
     in order, carrying only host args."""

@@ -17,9 +17,13 @@ the host syncs once per K tokens. Contracts under test:
   * COMPOSITION: paged pool pressure preempting between chunks and
     journal kill-resume with a chunk in flight both preserve byte
     identity;
-  * DEGRADATION: engines without decode_multi, masked (structured
-    output) batches, and spec-verify steps run at K=1 with a
-    logged warning — never silently wrong;
+  * DEGRADATION: engines without decode_multi clamp K back to 1,
+    counted in ome_engine_step_degradations_total{cause} — never
+    silently wrong. Masked (structured-output) batches ride chunks
+    through forced-token grammar runs and spec-verify steps ARE
+    multi-token-shaped dispatches (docs/step-plan.md), so neither
+    degrades K anymore; only a masker whose automaton cannot be
+    copied falls back to one synchronous masked step at a time;
   * SURFACES: the serve CLI flag, /health, the
     ome_engine_steps_per_dispatch gauge, the device_loop step phase,
     engine.decode_chunk spans, and the check_decode_sync lint's
@@ -253,25 +257,154 @@ class TestPagedPreemptionBetweenChunks:
         engine = InferenceEngine(params, cfg, max_slots=4,
                                  prefill_buckets=[32], kv_block=16,
                                  kv_blocks=5)
-        prompts = [[i + 1, 5, 9, 13, i + 2, 40, 41, 42, 43, 44, 45,
-                    46] for i in range(4)]
-        outs, preempts = {}, {}
-        for k in (1, 4):
-            for depth in (0, 1):
-                sched = Scheduler(engine, pipeline_depth=depth,
-                                  steps_per_dispatch=k)
-                reqs = [sched.submit(Request(prompt_ids=p,
-                                             max_new_tokens=8))
-                        for p in prompts]
-                _drive(sched, reqs, iters=2000)
-                assert all(len(r.output_ids) == 8 for r in reqs)
-                outs[(k, depth)] = [list(r.output_ids) for r in reqs]
-                preempts[(k, depth)] = \
-                    sched.stats["preemptions_total"]
+        # repetitive prompts: the n-gram drafter engages in the
+        # spec cells, so preemption interleaves with verify plans too
+        prompts = [[i + 1, 5, 9, 13] * 3 for i in range(4)]
+        outs, preempts, proposed = {}, {}, 0
+        for spec in (0, 2):
+            for k in (1, 4):
+                for depth in (0, 1):
+                    sched = Scheduler(engine, pipeline_depth=depth,
+                                      steps_per_dispatch=k,
+                                      spec_tokens=spec)
+                    reqs = [sched.submit(Request(prompt_ids=p,
+                                                 max_new_tokens=8))
+                            for p in prompts]
+                    _drive(sched, reqs, iters=2000)
+                    assert all(len(r.output_ids) == 8 for r in reqs)
+                    outs[(spec, k, depth)] = [list(r.output_ids)
+                                              for r in reqs]
+                    preempts[(spec, k, depth)] = \
+                        sched.stats["preemptions_total"]
+                    proposed += sched.stats[
+                        "spec_proposed_tokens_total"]
         assert all(n > 0 for n in preempts.values()), preempts
-        base = outs[(1, 0)]
+        assert proposed > 0  # the spec cells genuinely drafted
+        base = outs[(0, 1, 0)]
         for key, got in outs.items():
             assert got == base, key
+        ok, _ = engine.kv_conservation()
+        assert ok
+
+
+# -- the full composition matrix (docs/step-plan.md) ------------------
+# spec x chunks x pipeline x {dense, paged} x {masked, plain}: five
+# mechanisms as StepPlan features of ONE plan/execute loop. Greedy
+# streams must be byte-identical at every cell, and no cell may trip
+# a feature-loss degradation cause.
+
+
+COMP_PLANS = [([1, 2, 3] * 4, 12), ([5, 6] * 5, 9),
+              ([9, 8, 7, 9, 8, 7], 6), ([4, 4, 4, 4], 4)]
+
+COMP_SCHEMA = {"type": "object",
+               "properties": {"n": {"type": "integer", "minimum": 0,
+                                    "maximum": 99}},
+               "required": ["n"], "additionalProperties": False}
+
+
+def _assert_composed(degr):
+    """The composition contract: walkable grammars and spec verify
+    never cost a feature. Only spec_realign may tick — a planned
+    flush when a free-sampled tail invalidates draft alignment, which
+    trades pipeline depth for one window, not a mechanism."""
+    for cause in ("masked", "spec_verify", "engine_multi_step",
+                  "engine_verify"):
+        assert degr[cause] == 0, degr
+
+
+def _run_comp_matrix(engine, masked, specs=(0, 2), ks=(1, 4),
+                     depths=(0, 1)):
+    """Every (spec, K, depth) cell over one engine; returns the
+    per-cell streams and the count of fused multi-token dispatches
+    (device_loop phase observations)."""
+    from ome_tpu.engine.schema import SchemaAutomaton
+    from ome_tpu.engine.structured import TokenMasker
+
+    tok = ByteTokenizer()
+    outs, chunked = {}, {}
+    for spec in specs:
+        for k in ks:
+            for depth in depths:
+                sched = Scheduler(engine, pipeline_depth=depth,
+                                  steps_per_dispatch=k,
+                                  spec_tokens=spec)
+                reqs = []
+                if masked:
+                    for text in ("emit n:", "n = ", "give n "):
+                        reqs.append(sched.submit(Request(
+                            prompt_ids=tok.encode(text),
+                            max_new_tokens=14,
+                            masker=TokenMasker(
+                                tok, automaton=SchemaAutomaton(
+                                    COMP_SCHEMA)),
+                            stop_ids=[tok.eos_id])))
+                else:
+                    for p, n in COMP_PLANS:
+                        reqs.append(sched.submit(Request(
+                            prompt_ids=p, max_new_tokens=n)))
+                _drive(sched, reqs, iters=3000)
+                _assert_composed(sched.degradations)
+                if spec and not masked:
+                    # the repetitive prompts guarantee the drafter
+                    # engages — a spec cell that never drafts would
+                    # vacuously "compose"
+                    assert sched.stats[
+                        "spec_proposed_tokens_total"] > 0, \
+                        (spec, k, depth)
+                outs[(spec, k, depth)] = [list(r.output_ids)
+                                          for r in reqs]
+                chunked[(spec, k, depth)] = \
+                    sched._ph_device_loop.count
+    return outs, chunked
+
+
+class TestCompositionMatrix:
+    def test_dense_plain(self, world):
+        """All 8 (spec, K, depth) cells match the single-sequence
+        greedy reference — composing mechanisms moves WHEN tokens
+        surface, never WHICH tokens."""
+        cfg, params, engine = world
+        want = [reference_greedy(params, cfg, p, n)
+                for p, n in COMP_PLANS]
+        outs, _ = _run_comp_matrix(engine, masked=False)
+        for key, got in outs.items():
+            assert got == want, key
+
+    def test_paged_plain(self, paged_world):
+        """Same matrix over the block-table path, anchored to the
+        paged (0, 1, 0) cell (paged attention may flip a greedy
+        argmax tie vs dense); pool conserves after every cell."""
+        cfg, params, engine = paged_world
+        outs, _ = _run_comp_matrix(engine, masked=False)
+        base = outs[(0, 1, 0)]
+        for key, got in outs.items():
+            assert got == base, key
+        ok, _ = engine.kv_conservation()
+        assert ok
+
+    def test_dense_masked(self, world):
+        """A 100%-masked (json-schema) batch across the matrix:
+        byte-identical streams, zero cause=masked degradations, and
+        the grammar's forced-token runs genuinely ride fused chunks
+        (device_loop dispatches observed at K>1) — masked batches no
+        longer forfeit multi-token dispatch or pipelining."""
+        cfg, params, engine = world
+        outs, chunked = _run_comp_matrix(engine, masked=True)
+        base = outs[(0, 1, 0)]
+        for key, got in outs.items():
+            assert got == base, key
+        assert any(n > 0 for key, n in chunked.items()
+                   if key[1] > 1), chunked
+
+    def test_paged_masked(self, paged_world):
+        cfg, params, engine = paged_world
+        outs, chunked = _run_comp_matrix(engine, masked=True)
+        base = outs[(0, 1, 0)]
+        for key, got in outs.items():
+            assert got == base, key
+        assert any(n > 0 for key, n in chunked.items()
+                   if key[1] > 1), chunked
         ok, _ = engine.kv_conservation()
         assert ok
 
@@ -332,6 +465,51 @@ class TestJournalResume:
         assert resumed.finish_reason == "length"
         assert resumed.output_ids == want
 
+    def test_kill_with_composed_plan_in_flight_resumes(
+            self, world, tmp_path):
+        """The COMPOSED version: spec drafts + K=4 chunks + depth-1
+        pipelining all live when the engine dies. Whatever mix of
+        verify and chunk plans was in flight is discarded unread via
+        the generation counter; journal replay plus the same composed
+        configuration must regenerate the identical greedy stream."""
+        cfg, params, engine = world
+        prompt = [1, 2, 3] * 4  # repetitive: the drafter engages
+        want = reference_greedy(params, cfg, prompt, 12)
+
+        d = str(tmp_path)
+        faults.install("engine_step.raise@3")
+        j = RequestJournal(d, fsync="batch", fsync_interval=0.0)
+        sched = Scheduler(engine, max_restarts=0, journal=j,
+                          pipeline_depth=1, steps_per_dispatch=4,
+                          spec_tokens=2)
+        sched.start()
+        req = sched.submit(Request(prompt_ids=prompt,
+                                   max_new_tokens=12))
+        assert req.done.wait(30)
+        assert req.finish_reason == "engine_fault"
+        _wait(lambda: sched.status == "dead", timeout=30)
+        got_before = list(req.output_ids)
+        assert 0 < len(got_before) < 12
+        assert got_before == want[:len(got_before)]
+        sched.stop()
+        j.close()
+        faults.reset()
+
+        engine2 = InferenceEngine(params, cfg, max_slots=4,
+                                  prefill_buckets=[16, 32, 64])
+        j2 = RequestJournal(d)
+        sched2 = Scheduler(engine2, journal=j2, pipeline_depth=1,
+                           steps_per_dispatch=4, spec_tokens=2)
+        assert sched2.resume_from_journal() == 1
+        resumed = sched2.pending.queue[0]
+        assert resumed.output_ids == got_before
+        sched2.start()
+        assert resumed.done.wait(30)
+        sched2.stop()
+        j2.close()
+        assert resumed.finish_reason == "length"
+        assert resumed.output_ids == want
+
 
 # -- degradation: never silently wrong --------------------------------
 
@@ -348,18 +526,39 @@ class TestDegradation:
         _drive(sched, [req], iters=50)
         assert req.finish_reason == "length"
 
-    def test_replicated_engine_opts_out(self):
-        """ReplicatedEngine's __getattr__ would leak the leader-local
-        decode_multi and desync followers — the capability flag must
-        be explicitly off."""
+    def test_replicated_engine_carries_multi_step(self):
+        """ReplicatedEngine replicates decode_multi / verify /
+        commit_spec as explicit ops (docs/step-plan.md), so the
+        capability flag is honest: True over an engine with the
+        multi-step program, False over one without (where publishing
+        would replay a program the follower cannot run)."""
         from ome_tpu.engine.multihost import ReplicatedEngine
-        assert ReplicatedEngine.supports_multi_step is False
+        assert ReplicatedEngine.supports_multi_step is True
+        for op in ("decode_multi", "verify", "commit_spec"):
+            assert op in ReplicatedEngine.__dict__, \
+                f"{op} must publish, not leak through __getattr__"
+
+        class FakePub:
+            def send(self, m):
+                pass
+
+        class MultiStepEngine:
+            supports_multi_step = True
+
+            def decode_multi(self, *a, **kw):
+                pass
+
+        wrapped = ReplicatedEngine(MultiStepEngine(), FakePub())
+        assert wrapped.supports_multi_step is True
+        bare = ReplicatedEngine(CountingEngine(max_slots=1), FakePub())
+        assert bare.supports_multi_step is False
 
     def test_masked_batch_degrades_per_step(self, world, caplog):
-        """Structured-output slots need token k on host before mask
-        k+1: the batch runs at K=1 (synchronous, nothing in flight)
-        while masked, with a once-per-cause warning — and still emits
-        the greedy stream (the masker is permissive)."""
+        """A masker whose automaton cannot be copied (PassMasker has
+        no grammar walk) still runs correctly: one synchronous masked
+        step at a time, nothing in flight, streams identical — and
+        the fallback is scrape-visible on the degradation counter
+        under cause=masked instead of log-only."""
         cfg, params, engine = world
         prompt = [1, 7, 42, 99, 5]
         want = reference_greedy(params, cfg, prompt, 6)
@@ -375,10 +574,13 @@ class TestDegradation:
                 sched.step()
                 assert len(sched._inflight) == 0
         assert req.output_ids == want
-        assert "masked" in sched._multi_degraded_warned
-        # warn-once latch: exactly one degradation warning
-        assert sum("degraded" in r.message
-                   for r in caplog.records) == 1
+        # scrape-visible, not log-only: the counter carries the cause
+        assert sched.degradations["masked"] > 0
+        assert not any("degraded" in r.message
+                       for r in caplog.records)
+        # and the counter renders with its cause label
+        assert 'ome_engine_step_degradations_total{cause="masked"}' \
+            in sched.registry.render()
 
 
 # -- surfaces: CLI flag, /health, telemetry, spans, lint --------------

@@ -460,6 +460,39 @@ class TestPerfgate:
         assert table["programs"]["decode_bf16"]["step_ms"] > 0
         assert "prefill_b32x128" in table["programs"]
 
+    def test_composition_cells_gate_and_export(self, tmp_path):
+        """bench.py composition cells (docs/step-plan.md) gate under
+        the ^composition. bands and export to the cost table: a cell
+        losing throughput regresses; its fitted cost ships to the
+        fleet simulator as a composed_* program."""
+        base = json.load(open(os.path.join(REPO, "BENCH_r05.json")))
+        base["parsed"]["composition"] = {
+            "cells": {"spec4_k4_d1": {
+                "tokens_per_sec": 5000.0, "accept_rate": 0.8,
+                "spec": 4, "k": 4, "depth": 1, "degraded_steps": 0}},
+            "best_single_tokens_per_sec": 4200.0,
+            "best_composed_tokens_per_sec": 5000.0,
+            "composed_vs_best_single": 1.19}
+        hist = tmp_path / "BENCH_r90.json"
+        hist.write_text(json.dumps(base))
+        fresh = json.loads(json.dumps(base))
+        cell = fresh["parsed"]["composition"]["cells"]["spec4_k4_d1"]
+        cell["tokens_per_sec"] = 4000.0  # -20%: outside the 8% band
+        fj = tmp_path / "fresh.json"
+        fj.write_text(json.dumps(fresh))
+        r = _gate("--history", str(tmp_path / "BENCH_r*.json"),
+                  "--bench-json", str(fj))
+        assert r.returncode == 1
+        assert "composition.cells.spec4_k4_d1.tokens_per_sec" \
+            in r.stdout
+        out = tmp_path / "costs.json"
+        r = _gate("--history", str(tmp_path / "BENCH_r*.json"),
+                  "--check-only", "--cost-table", str(out))
+        assert r.returncode == 0, r.stdout + r.stderr
+        table = json.loads(out.read_text())
+        assert table["programs"]["composed_spec4_k4_d1"] == {
+            "tokens_per_sec": 5000.0, "accept_rate": 0.8}
+
     def test_missing_baseline_is_usage_error(self, tmp_path):
         r = _gate("--history", str(tmp_path / "nope_*.json"),
                   "--check-only")

@@ -110,6 +110,79 @@ def test_kv_block_with_tp_rejected_loudly(tmp_path):
         load_engine(args)
 
 
+class TestPlanPreconditions:
+    """serve-time validation of composition flags against the
+    assembled engine stack (docs/step-plan.md): a requested feature
+    the stack cannot dispatch fails loudly with the failed plan
+    precondition named; supported combinations — including the
+    formerly-refused multi-host ones — pass."""
+
+    class _Bare:
+        pass
+
+    class _Capable:
+        supports_multi_step = True
+
+        def verify(self, *a, **kw):
+            pass
+
+        def decode_multi(self, *a, **kw):
+            pass
+
+        def commit_spec(self, *a, **kw):
+            pass
+
+    @staticmethod
+    def _args(*extra):
+        from ome_tpu.engine.serve import build_parser
+        return build_parser().parse_args(["--model-dir", "x", *extra])
+
+    def test_spec_without_verify_names_precondition(self):
+        from ome_tpu.engine.serve import check_plan_preconditions
+        err = check_plan_preconditions(
+            self._Bare(), self._args("--spec-tokens", "2"))
+        assert err is not None
+        assert "--spec-tokens" in err and "engine.verify" in err
+        assert "_Bare" in err  # names the refusing engine type
+
+    def test_multistep_without_decode_multi_names_precondition(self):
+        from ome_tpu.engine.serve import check_plan_preconditions
+        err = check_plan_preconditions(
+            self._Bare(), self._args("--steps-per-dispatch", "4"))
+        assert err is not None
+        assert "--steps-per-dispatch" in err
+        assert "engine.decode_multi" in err
+
+    def test_capable_stack_passes(self):
+        from ome_tpu.engine.serve import check_plan_preconditions
+        args = self._args("--spec-tokens", "2",
+                          "--steps-per-dispatch", "4",
+                          "--pipeline-depth", "1")
+        assert check_plan_preconditions(self._Capable(), args) is None
+
+    def test_replicated_stack_passes(self):
+        """The combo that used to exit 2: spec + multi-step over the
+        multi-host ReplicatedEngine now satisfies every plan
+        precondition (decode_multi / verify / commit_spec are in the
+        replicated op vocabulary)."""
+        from ome_tpu.engine.multihost import ReplicatedEngine
+        from ome_tpu.engine.serve import check_plan_preconditions
+
+        class _Pub:
+            def send(self, m):
+                pass
+
+        eng = ReplicatedEngine(self._Capable(), _Pub())
+        args = self._args("--spec-tokens", "2",
+                          "--steps-per-dispatch", "4")
+        assert check_plan_preconditions(eng, args) is None
+
+    def test_flags_off_never_refuse(self):
+        from ome_tpu.engine.serve import check_plan_preconditions
+        assert check_plan_preconditions(
+            self._Bare(), self._args()) is None
+
+
 def test_paged_unsupported_arch_falls_back_to_dense(tmp_path, caplog):
     """An auto-selected runtime may pass --kv-block for a model the
     paged coverage guard refuses (here: sliding-window attention).
