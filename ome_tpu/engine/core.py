@@ -440,6 +440,7 @@ class InferenceEngine:
                  lora_slots: int = 0, lora_rank: int = 16,
                  kv_block: int = 0, kv_blocks: Optional[int] = None,
                  kv_dtype: Optional[str] = None,
+                 mask_table_rows: int = 64,
                  ledger=None):
         self.params = params
         self.cfg = cfg
@@ -1007,6 +1008,151 @@ class InferenceEngine:
                                k_scale=nc.k_scale,
                                v_scale=nc.v_scale), out, accepted
 
+        # -- device-resident grammar mask table (docs/structured-
+        # outputs.md): cached automaton-state masks live as rows of a
+        # [S, V] device buffer; the *_idx program variants gather each
+        # slot's row in-program from int32 state indices, so a masked
+        # step ships K ints per slot instead of K*V mask bools. Row 0
+        # is reserved all-True (the unmasked sentinel every idx array
+        # defaults to); set_mask_row() refuses to write it.
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _mask_row_set(tab, row, bits):
+            return tab.at[row].set(bits)
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _decode_masked_idx(params, state: DecodeState, temperature,
+                               top_k, top_p, key, mtab, midx,
+                               ) -> Tuple[DecodeState, jax.Array]:
+            """Decode gathering each slot's allowed-token row from the
+            device mask table by state index ([B] int32)."""
+            cache = llama.KVCache(k=state.k, v=state.v,
+                                  index=state.lengths)
+            logits, new_cache = llama.forward(
+                params, cfg_, state.tokens[:, None], cache=cache,
+                adapter_ids=state.adapters)
+            masked = jnp.where(mtab[midx], logits[:, -1], -jnp.inf)
+            toks = sample(masked, key, temperature, top_k, top_p)
+            return DecodeState(k=new_cache.k, v=new_cache.v,
+                               lengths=new_cache.index,
+                               tokens=toks,
+                               adapters=state.adapters), toks
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _decode_masked_idx_paged(params, state: DecodeState, table,
+                                     temperature, top_k, top_p, key,
+                                     mtab, midx):
+            cache = llama.PagedKVCache(k=state.k, v=state.v,
+                                       index=state.lengths, table=table,
+                                       k_scale=state.k_scale,
+                                       v_scale=state.v_scale)
+            logits, nc = llama.forward_paged(
+                params, cfg_, state.tokens[:, None], cache,
+                adapter_ids=state.adapters)
+            masked = jnp.where(mtab[midx], logits[:, -1], -jnp.inf)
+            toks = sample(masked, key, temperature, top_k, top_p)
+            return DecodeState(k=nc.k, v=nc.v, lengths=nc.index,
+                               tokens=toks,
+                               adapters=state.adapters,
+                               k_scale=nc.k_scale,
+                               v_scale=nc.v_scale), toks
+
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=("n",))
+        def _decode_multi_masked_idx(params, state: DecodeState,
+                                     temperature, top_k, top_p, key,
+                                     budget, stop_ids, mtab, midx,
+                                     n: int):
+            """Multi-token decode whose per-iteration [B, n, V] mask
+            stack is gathered from the mask table ([B, n] int32)."""
+
+            def forward_one(st):
+                cache = llama.KVCache(k=st.k, v=st.v,
+                                      index=st.lengths)
+                return llama.forward(params, cfg_, st.tokens[:, None],
+                                     cache=cache,
+                                     adapter_ids=st.adapters)
+
+            return _multi_loop(state, key, temperature, top_k, top_p,
+                               budget, stop_ids, forward_one, n,
+                               mask=mtab[midx])
+
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=("n",))
+        def _decode_multi_masked_idx_paged(params, state: DecodeState,
+                                           table, temperature, top_k,
+                                           top_p, key, budget,
+                                           stop_ids, mtab, midx,
+                                           n: int):
+
+            def forward_one(st):
+                cache = llama.PagedKVCache(k=st.k, v=st.v,
+                                           index=st.lengths,
+                                           table=table,
+                                           k_scale=st.k_scale,
+                                           v_scale=st.v_scale)
+                return llama.forward_paged(params, cfg_,
+                                           st.tokens[:, None], cache,
+                                           adapter_ids=st.adapters)
+
+            return _multi_loop(state, key, temperature, top_k, top_p,
+                               budget, stop_ids, forward_one, n,
+                               mask=mtab[midx])
+
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=("k",))
+        def _verify_masked_idx(params, state: DecodeState, drafts,
+                               draft_len, temperature, top_k, top_p,
+                               key, mtab, midx, k: int):
+            """Verify masking ALL k+1 positions from gathered table
+            rows ([B, k+1] int32) — unlike the dense variant's
+            position-0 mask, because grammar-constrained slots now
+            DRAFT (spec-through-grammar): the token emitted at a
+            rejection position comes from that position's target
+            logits, which must honor that position's mask. Unmasked
+            slots point every position at reserved row 0 (all-True)."""
+            toks = jnp.concatenate([state.tokens[:, None], drafts],
+                                   axis=1)
+            cache = llama.KVCache(k=state.k, v=state.v,
+                                  index=state.lengths)
+            logits, nc = llama.forward(params, cfg_, toks, cache=cache,
+                                       adapter_ids=state.adapters)
+            logits = jnp.where(mtab[midx], logits, -jnp.inf)
+            out, accepted = spec_verify(logits, drafts, draft_len, key,
+                                        temperature, top_k, top_p)
+            new_tok = jnp.take_along_axis(out, accepted[:, None],
+                                          axis=1)[:, 0]
+            return DecodeState(k=nc.k, v=nc.v,
+                               lengths=state.lengths + accepted + 1,
+                               tokens=new_tok,
+                               adapters=state.adapters), out, accepted
+
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnames=("k",))
+        def _verify_masked_idx_paged(params, state: DecodeState, table,
+                                     drafts, draft_len, temperature,
+                                     top_k, top_p, key, mtab, midx,
+                                     k: int):
+            toks = jnp.concatenate([state.tokens[:, None], drafts],
+                                   axis=1)
+            cache = llama.PagedKVCache(k=state.k, v=state.v,
+                                       index=state.lengths, table=table,
+                                       k_scale=state.k_scale,
+                                       v_scale=state.v_scale)
+            logits, nc = llama.forward_paged(
+                params, cfg_, toks, cache, adapter_ids=state.adapters)
+            logits = jnp.where(mtab[midx], logits, -jnp.inf)
+            out, accepted = spec_verify(logits, drafts, draft_len, key,
+                                        temperature, top_k, top_p)
+            new_tok = jnp.take_along_axis(out, accepted[:, None],
+                                          axis=1)[:, 0]
+            return DecodeState(k=nc.k, v=nc.v,
+                               lengths=state.lengths + accepted + 1,
+                               tokens=new_tok,
+                               adapters=state.adapters,
+                               k_scale=nc.k_scale,
+                               v_scale=nc.v_scale), out, accepted
+
         self._prefill_fn = _prefill
         self._prefill_masked_fn = _prefill_masked
         self._prefill_suffix_fn = _prefill_suffix
@@ -1024,6 +1170,16 @@ class InferenceEngine:
         self._verify_paged_fn = _verify_paged
         self._verify_masked_fn = _verify_masked
         self._verify_masked_paged_fn = _verify_masked_paged
+        self._mask_row_fn = _mask_row_set
+        self._decode_masked_idx_fn = _decode_masked_idx
+        self._decode_masked_idx_paged_fn = _decode_masked_idx_paged
+        self._decode_multi_masked_idx_fn = _decode_multi_masked_idx
+        self._decode_multi_masked_idx_paged_fn = \
+            _decode_multi_masked_idx_paged
+        self._verify_masked_idx_fn = _verify_masked_idx
+        self._verify_masked_idx_paged_fn = _verify_masked_idx_paged
+        self.mask_table_rows = int(mask_table_rows)
+        self._mask_table_dev = None  # lazy: [rows, V] bool, row 0 True
         self._step = 0
         self._root_key = jax.random.PRNGKey(0)
         # prefill (admission thread) and decode (scheduler thread) both
@@ -1569,14 +1725,46 @@ class InferenceEngine:
             np.asarray(token, np.int32), aid,
             bucket=bucket)
 
+    def _mask_table(self) -> jax.Array:
+        """The device-resident [mask_table_rows, V] grammar mask
+        table, created all-True on first touch (all-True rows are
+        safe: they mask nothing). Row 0 stays all-True forever — the
+        sentinel unmasked slots index."""
+        if self._mask_table_dev is None:
+            self._mask_table_dev = jnp.ones(
+                (self.mask_table_rows, self.cfg.vocab_size), bool)
+        return self._mask_table_dev
+
+    def set_mask_row(self, row: int, bits: np.ndarray) -> None:
+        """Upload one grammar-state mask as row `row` (>= 1; row 0 is
+        the reserved all-True sentinel) of the device mask table.
+        Called by the scheduler's GrammarMaskCache on cache miss;
+        eviction is just the next upload overwriting the row. The
+        update is an ordinary device computation, so it serializes
+        with in-flight decode dispatches — a row can be rewritten
+        while the plan that referenced it is still executing only
+        after that plan's gather has been issued."""
+        row = int(row)
+        if not 1 <= row < self.mask_table_rows:
+            raise ValueError(f"mask row {row} out of range "
+                             f"[1, {self.mask_table_rows})")
+        tab = self._mask_table()
+        self._mask_table_dev = self._mask_row_fn(
+            tab, np.asarray(row, np.int32), np.asarray(bits, bool))
+
     def decode(self, state: DecodeState, temperature, top_k, top_p,
                mask: Optional[np.ndarray] = None,
+               mask_idx: Optional[np.ndarray] = None,
                ) -> Tuple[DecodeState, jax.Array]:
         """One decode step for ALL slots. Sampling params: [B] arrays
         — host arrays are converted; already-device-resident
         jax.Arrays (the scheduler's sampling cache) pass straight
         through. `mask` ([B, V] bool) routes through the masked
         program (structured outputs); None keeps the maskless one.
+        `mask_idx` ([B] int32, wins over `mask`) instead gathers each
+        slot's mask row from the device-resident mask table — B ints
+        of transfer instead of B*V bools; unmasked slots pass 0 (the
+        reserved all-True row).
 
         The returned tokens stay device-resident with a host copy
         already in flight (`copy_to_host_async`), so a pipelined
@@ -1596,7 +1784,16 @@ class InferenceEngine:
                 self._table_dirty = False
             table = self._table_dev
             cap = self._kv_capacity_rows()
-            if mask is not None:
+            if mask_idx is not None:
+                args = (self.params, state, table, *sampling, key,
+                        self._mask_table(),
+                        np.asarray(mask_idx, np.int32))
+                self._ledger_capture(
+                    "decode_masked_idx_paged", "",
+                    self._decode_masked_idx_paged_fn, args, {},
+                    tokens=self.max_slots, kv_rows=cap)
+                state, toks = self._decode_masked_idx_paged_fn(*args)
+            elif mask is not None:
                 args = (self.params, state, table, *sampling, key,
                         np.asarray(mask, bool))
                 self._ledger_capture(
@@ -1610,6 +1807,14 @@ class InferenceEngine:
                     "decode_paged", "", self._decode_paged_fn, args,
                     {}, tokens=self.max_slots, kv_rows=cap)
                 state, toks = self._decode_paged_fn(*args)
+        elif mask_idx is not None:
+            args = (self.params, state, *sampling, key,
+                    self._mask_table(), np.asarray(mask_idx, np.int32))
+            self._ledger_capture(
+                "decode_masked_idx", "", self._decode_masked_idx_fn,
+                args, {}, tokens=self.max_slots,
+                kv_rows=self._kv_capacity_rows())
+            state, toks = self._decode_masked_idx_fn(*args)
         elif mask is not None:
             args = (self.params, state, *sampling, key,
                     np.asarray(mask, bool))
@@ -1632,6 +1837,7 @@ class InferenceEngine:
                      top_p, steps: int, budget, stop_ids,
                      lookahead_rows: Optional[int] = None,
                      mask: Optional[np.ndarray] = None,
+                     mask_idx: Optional[np.ndarray] = None,
                      ) -> Tuple[DecodeState, jax.Array, jax.Array]:
         """`steps` decode iterations for ALL slots in ONE device
         program — the host pays one dispatch and one sync per chunk
@@ -1648,7 +1854,9 @@ class InferenceEngine:
         blocks; defaults to `steps`. mask ([B, steps, V] bool,
         optional) applies a per-iteration structured-output mask
         stack (docs/step-plan.md) through the masked program
-        variants.
+        variants; mask_idx ([B, steps] int32, wins over mask) gathers
+        the stack from the device-resident mask table instead —
+        steps ints per slot on the wire, 0 = the all-True row.
 
         Returns (state, tokens [B, steps], advanced [B]) with host
         copies of the outputs already in flight (mirroring decode()):
@@ -1669,7 +1877,19 @@ class InferenceEngine:
             if self._table_dirty or self._table_dev is None:
                 self._table_dev = jnp.asarray(self._table.copy())
                 self._table_dirty = False
-            if mask is not None:
+            if mask_idx is not None:
+                args = (self.params, state, self._table_dev, *sampling,
+                        key, budget, stop_ids, self._mask_table(),
+                        np.asarray(mask_idx, np.int32))
+                self._ledger_capture(
+                    "decode_multi_masked_idx_paged", f"n={n}",
+                    self._decode_multi_masked_idx_paged_fn, args,
+                    dict(n=n), tokens=self.max_slots * n,
+                    kv_rows=n * self._kv_capacity_rows(),
+                    weight_passes=n)
+                state, toks, adv = \
+                    self._decode_multi_masked_idx_paged_fn(*args, n=n)
+            elif mask is not None:
                 args = (self.params, state, self._table_dev, *sampling,
                         key, budget, stop_ids, np.asarray(mask, bool))
                 self._ledger_capture(
@@ -1691,6 +1911,17 @@ class InferenceEngine:
                     weight_passes=n)
                 state, toks, adv = \
                     self._decode_multi_paged_fn(*args, n=n)
+        elif mask_idx is not None:
+            args = (self.params, state, *sampling, key, budget,
+                    stop_ids, self._mask_table(),
+                    np.asarray(mask_idx, np.int32))
+            self._ledger_capture(
+                "decode_multi_masked_idx", f"n={n}",
+                self._decode_multi_masked_idx_fn, args, dict(n=n),
+                tokens=self.max_slots * n,
+                kv_rows=n * self._kv_capacity_rows(), weight_passes=n)
+            state, toks, adv = \
+                self._decode_multi_masked_idx_fn(*args, n=n)
         elif mask is not None:
             args = (self.params, state, *sampling, key, budget,
                     stop_ids, np.asarray(mask, bool))
@@ -1718,6 +1949,7 @@ class InferenceEngine:
                draft_len: np.ndarray, temperature, top_k, top_p,
                lookahead_rows: Optional[int] = None,
                mask: Optional[np.ndarray] = None,
+               mask_idx: Optional[np.ndarray] = None,
                ) -> Tuple[DecodeState, jax.Array, jax.Array]:
         """One speculative verify step for ALL slots: score the k
         drafted tokens plus one bonus position in a single weight
@@ -1729,7 +1961,11 @@ class InferenceEngine:
         draft_len: [B] int32 in [0, k]. Sampling params as decode().
         mask ([B, V] bool, optional) constrains position-0 sampling —
         how masked (structured-output) slots ride a verify plan at
-        draft_len 0. Returns (state, out_tokens [B, k+1], accepted
+        draft_len 0. mask_idx ([B, k+1] int32, wins over mask)
+        gathers a full per-position mask from the device mask table —
+        the spec-through-grammar path, where masked slots DRAFT and
+        every scored position honors its own grammar mask (0 = the
+        all-True row). Returns (state, out_tokens [B, k+1], accepted
         [B]) with host copies of the outputs already in flight,
         mirroring decode(): slot b emits out_tokens[b, :accepted[b]+1].
 
@@ -1753,7 +1989,19 @@ class InferenceEngine:
             if self._table_dirty or self._table_dev is None:
                 self._table_dev = jnp.asarray(self._table.copy())
                 self._table_dirty = False
-            if mask is not None:
+            if mask_idx is not None:
+                args = (self.params, state, self._table_dev, drafts,
+                        draft_len, *sampling, key, self._mask_table(),
+                        np.asarray(mask_idx, np.int32))
+                self._ledger_capture(
+                    "verify_masked_idx_paged", f"k={k}",
+                    self._verify_masked_idx_paged_fn, args, dict(k=k),
+                    tokens=self.max_slots * (k + 1),
+                    kv_rows=self._kv_capacity_rows()
+                    + self.max_slots * (k + 1))
+                state, out, accepted = \
+                    self._verify_masked_idx_paged_fn(*args, k=k)
+            elif mask is not None:
                 args = (self.params, state, self._table_dev, drafts,
                         draft_len, *sampling, key,
                         np.asarray(mask, bool))
@@ -1776,6 +2024,18 @@ class InferenceEngine:
                     + self.max_slots * (k + 1))
                 state, out, accepted = self._verify_paged_fn(*args,
                                                              k=k)
+        elif mask_idx is not None:
+            args = (self.params, state, drafts, draft_len, *sampling,
+                    key, self._mask_table(),
+                    np.asarray(mask_idx, np.int32))
+            self._ledger_capture(
+                "verify_masked_idx", f"k={k}",
+                self._verify_masked_idx_fn, args, dict(k=k),
+                tokens=self.max_slots * (k + 1),
+                kv_rows=self._kv_capacity_rows()
+                + self.max_slots * (k + 1))
+            state, out, accepted = \
+                self._verify_masked_idx_fn(*args, k=k)
         elif mask is not None:
             args = (self.params, state, drafts, draft_len, *sampling,
                     key, np.asarray(mask, bool))

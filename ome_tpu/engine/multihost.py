@@ -320,10 +320,29 @@ class ReplicatedEngine:
             self._pub.send({"op": "free_slot", "slot": int(slot)})
             self._engine.free_slot(slot)
 
-    def decode(self, state, temperature, top_k, top_p, mask=None):
+    def set_mask_row(self, row: int, bits) -> None:
+        """Replicated grammar mask-table upload: the leader's
+        scheduler installs a compiled automaton-state mask; followers
+        must install the IDENTICAL row before any plan references its
+        index, which op-stream ordering guarantees (uploads publish
+        before the decode/verify ops that gather them)."""
         from .structured import pack_mask
         with self._oplock:
+            self._pub.send({"op": "set_mask_row", "row": int(row),
+                            # omelint: disable=lock-discipline -- the host-built mask row IS the op payload; _oplock serializes whole ops by design
+                            "bits": pack_mask(np.asarray(bits, bool))})
+            self._engine.set_mask_row(row, bits)
+
+    def decode(self, state, temperature, top_k, top_p, mask=None,
+               mask_idx=None):
+        from .structured import pack_mask
+        # grammar mask-table row indices (ints on the wire, vs ~V/8
+        # bytes per packed row) — converted before taking the op lock
+        midx = None if mask_idx is None \
+            else np.asarray(mask_idx, np.int32).tolist()
+        with self._oplock:
             self._pub.send({"op": "decode",
+                            "mask_idx": midx,
                             # omelint: disable=lock-discipline -- sampling params ship host-side in the op; _oplock serializes whole ops by design
                             "temperature": np.asarray(
                                 temperature, np.float32).tolist(),
@@ -340,7 +359,11 @@ class ReplicatedEngine:
                             # program — no recompute drift
                             # omelint: disable=lock-discipline -- the host-built mask IS the op payload; _oplock serializes whole ops by design
                             "mask": pack_mask(mask)})
-            if mask is not None:
+            if mask_idx is not None:
+                state, toks = self._engine.decode(
+                    state, temperature, top_k, top_p,
+                    mask_idx=mask_idx)
+            elif mask is not None:
                 state, toks = self._engine.decode(
                     state, temperature, top_k, top_p, mask=mask)
             else:
@@ -351,15 +374,20 @@ class ReplicatedEngine:
 
     def decode_multi(self, state, temperature, top_k, top_p,
                      steps: int, budget, stop_ids,
-                     lookahead_rows=None, mask=None):
+                     lookahead_rows=None, mask=None, mask_idx=None):
         """Replicated multi-token chunk: the whole StepPlan payload
         (sampling, per-slot budget, stop table, paged lookahead, the
-        [B, steps, V] mask stack) ships in the op, so followers run
-        the IDENTICAL K-step device loop."""
+        [B, steps, V] mask stack OR its [B, steps] mask-table row
+        indices) ships in the op, so followers run the IDENTICAL
+        K-step device loop."""
         from .structured import pack_mask
+        # mask-table row indices converted before taking the op lock
+        midx = None if mask_idx is None \
+            else np.asarray(mask_idx, np.int32).tolist()
         with self._oplock:
             self._pub.send({"op": "decode_multi",
                             "steps": int(steps),
+                            "mask_idx": midx,
                             # omelint: disable=lock-discipline -- sampling params ship host-side in the op; _oplock serializes whole ops by design
                             "temperature": np.asarray(
                                 temperature, np.float32).tolist(),
@@ -383,7 +411,9 @@ class ReplicatedEngine:
             kw = {}
             if lookahead_rows is not None:
                 kw["lookahead_rows"] = lookahead_rows
-            if mask is not None:
+            if mask_idx is not None:
+                kw["mask_idx"] = mask_idx
+            elif mask is not None:
                 kw["mask"] = mask
             state, out, adv = self._engine.decode_multi(
                 state, temperature, top_k, top_p, steps=steps,
@@ -392,13 +422,18 @@ class ReplicatedEngine:
             return state, host_value(out), host_value(adv)
 
     def verify(self, state, drafts, draft_len, temperature, top_k,
-               top_p, lookahead_rows=None, mask=None):
+               top_p, lookahead_rows=None, mask=None, mask_idx=None):
         """Replicated spec-verify: the leader's host-built drafts (and
-        the position-0 mask for masked slots) ship in the op —
-        followers never run the drafter, they replay its output."""
+        the position-0 mask, or per-position mask-table row indices,
+        for masked slots) ship in the op — followers never run the
+        drafter, they replay its output."""
         from .structured import pack_mask
+        # mask-table row indices converted before taking the op lock
+        midx = None if mask_idx is None \
+            else np.asarray(mask_idx, np.int32).tolist()
         with self._oplock:
             self._pub.send({"op": "verify",
+                            "mask_idx": midx,
                             # omelint: disable=lock-discipline -- plan payloads ship host-side in the op; _oplock serializes whole ops by design
                             "drafts": np.asarray(
                                 drafts, np.int32).tolist(),
@@ -422,7 +457,9 @@ class ReplicatedEngine:
             kw = {}
             if lookahead_rows is not None:
                 kw["lookahead_rows"] = lookahead_rows
-            if mask is not None:
+            if mask_idx is not None:
+                kw["mask_idx"] = mask_idx
+            elif mask is not None:
                 kw["mask"] = mask
             state, out, acc = self._engine.verify(
                 state, drafts, draft_len, temperature, top_k, top_p,
@@ -545,9 +582,18 @@ def follower_loop(engine, sub: OpSubscriber,
                 engine.unregister_adapter(msg["name"])
         elif op == "free_slot":
             engine.free_slot(msg["slot"])
+        elif op == "set_mask_row":
+            # grammar mask-table upload: install the leader's row
+            # before any subsequent op gathers its index (op-stream
+            # order guarantees the happens-before)
+            engine.set_mask_row(msg["row"],
+                                unpack_mask(msg["bits"]))
         elif op == "decode":
             mask = unpack_mask(msg.get("mask"))
             kwargs = {} if mask is None else {"mask": mask}
+            if msg.get("mask_idx") is not None:
+                kwargs = {"mask_idx": np.asarray(msg["mask_idx"],
+                                                 np.int32)}
             state, _ = engine.decode(
                 state,
                 np.asarray(msg["temperature"], np.float32),
@@ -558,7 +604,10 @@ def follower_loop(engine, sub: OpSubscriber,
             if msg.get("lookahead_rows") is not None:
                 kwargs["lookahead_rows"] = msg["lookahead_rows"]
             mask = unpack_mask(msg.get("mask"))
-            if mask is not None:
+            if msg.get("mask_idx") is not None:
+                kwargs["mask_idx"] = np.asarray(msg["mask_idx"],
+                                                np.int32)
+            elif mask is not None:
                 kwargs["mask"] = mask
             state, _, _ = engine.decode_multi(
                 state,
@@ -574,7 +623,10 @@ def follower_loop(engine, sub: OpSubscriber,
             if msg.get("lookahead_rows") is not None:
                 kwargs["lookahead_rows"] = msg["lookahead_rows"]
             mask = unpack_mask(msg.get("mask"))
-            if mask is not None:
+            if msg.get("mask_idx") is not None:
+                kwargs["mask_idx"] = np.asarray(msg["mask_idx"],
+                                                np.int32)
+            elif mask is not None:
                 kwargs["mask"] = mask
             state, _, _ = engine.verify(
                 state,

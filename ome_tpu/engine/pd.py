@@ -602,13 +602,13 @@ class RemotePrefillEngine:
         return self._engine.insert(state, kv, slot, true_len, token,
                                    bucket, **kw)
 
-    def decode(self, state, temperature, top_k, top_p, mask=None):
-        # decode runs on the LOCAL engine; the mask (structured
-        # outputs) applies to locally sampled tokens only
-        if mask is not None:
-            return self._engine.decode(state, temperature, top_k,
-                                       top_p, mask=mask)
-        return self._engine.decode(state, temperature, top_k, top_p)
+    def decode(self, state, temperature, top_k, top_p, **kw):
+        # decode runs on the LOCAL engine; grammar masks — dense
+        # (mask=) or mask-table row indices (mask_idx=) — apply to
+        # locally sampled tokens only
+        kw = {k: v for k, v in kw.items() if v is not None}
+        return self._engine.decode(state, temperature, top_k, top_p,
+                                   **kw)
 
 
 def make_pd_prefill_handler(engine):

@@ -140,17 +140,25 @@ class StepPlan:
     dispatches every plan the same way; composition decisions —
     what rides with what — live only in the planner."""
 
-    __slots__ = ("kind", "k", "sync", "mask", "mask_stack", "drafts",
-                 "dlen", "budget", "rows", "mask_s")
+    __slots__ = ("kind", "k", "sync", "mask", "mask_stack",
+                 "mask_idx", "mask_stack_idx", "drafts", "dlen",
+                 "budget", "rows", "mask_s")
 
     def __init__(self, kind, k=1, sync=False, mask=None,
-                 mask_stack=None, drafts=None, dlen=None, budget=None,
+                 mask_stack=None, mask_idx=None, mask_stack_idx=None,
+                 drafts=None, dlen=None, budget=None,
                  rows=1, mask_s=0.0):
         self.kind = kind              # "decode" | "chunk" | "verify"
         self.k = k                    # chunk length / max draft tokens
         self.sync = sync              # drain everything after dispatch
         self.mask = mask              # [B, V] allowed-token mask
         self.mask_stack = mask_stack  # [B, k, V] per-iteration masks
+        # device mask-table row indices replacing the dense arrays
+        # above when every referenced grammar state is resident
+        # (docs/structured-outputs.md): row 0 is the reserved
+        # all-True row unmasked slots point at
+        self.mask_idx = mask_idx            # [B] or [B, k+1] int32
+        self.mask_stack_idx = mask_stack_idx  # [B, k] int32
         self.drafts = drafts          # [B, k] draft tokens (verify)
         self.dlen = dlen              # [B] draft lengths (verify)
         self.budget = budget          # [B] per-slot chunk budget
@@ -454,7 +462,8 @@ class Scheduler:
                  class_weights=None,
                  class_wait_caps=None,
                  priority_scheduling: bool = True,
-                 slow_step_factor: float = 4.0):
+                 slow_step_factor: float = 4.0,
+                 grammar_table: bool = True):
         self.engine = engine
         # slow-step outlier threshold: a step slower than this factor
         # times the rolling median records a slow_step flight event
@@ -794,6 +803,39 @@ class Scheduler:
                            for c in DEGRADE_CAUSES}
         for cause in init_degrades:
             self._c_degrade[cause].inc()
+        # device-resident grammar-mask cache (engine/maskcache.py,
+        # docs/structured-outputs.md): compiled automaton-state masks
+        # live as rows of the engine's [S, V] device mask table and
+        # step plans reference them by row index instead of shipping
+        # dense [B, K, V] bools. None = dense masks only (an engine
+        # without a mask table, or grammar_table=False — the
+        # byte-identical dense baseline tests diff against).
+        self._c_gmask_hit = R.counter(
+            "ome_engine_grammar_mask_cache_hits_total",
+            "Grammar-state mask lookups served by the device-resident "
+            "row cache")
+        self._c_gmask_miss = R.counter(
+            "ome_engine_grammar_mask_cache_misses_total",
+            "Grammar-state mask lookups that compiled a fresh mask "
+            "(uploading a row when one was free)")
+        self._c_gmask_evict = R.counter(
+            "ome_engine_grammar_mask_cache_evictions_total",
+            "Grammar-state mask rows reused for a new state (LRU; the "
+            "overwriting upload is the invalidation)")
+        self._g_gmask_resident = R.gauge(
+            "ome_engine_grammar_states_resident",
+            "Automaton states currently resident in the device mask "
+            "table")
+        self._gcache = None
+        _mrows = int(getattr(engine, "mask_table_rows", 0) or 0)
+        if grammar_table and _mrows >= 2 and callable(
+                getattr(engine, "set_mask_row", None)):
+            from .maskcache import GrammarMaskCache
+            self._gcache = GrammarMaskCache(
+                _mrows, upload=engine.set_mask_row,
+                on_hit=self._c_gmask_hit.inc,
+                on_miss=self._c_gmask_miss.inc,
+                on_evict=self._c_gmask_evict.inc)
         # per-class observability (docs/multi-tenancy.md): children
         # are pre-created for the fixed class enum ONLY, so label
         # cardinality is bounded by construction (the
@@ -2091,9 +2133,15 @@ class Scheduler:
         masked_slots = [s for s, r in enumerate(self.slots)
                         if r is not None and r.masker is not None]
         # -- grammar walk: advance a COPY of each masked slot's
-        # automaton over its predicted tail, then through up to
-        # `k_steps` future positions (one mask each, jumping ahead
-        # through forced tokens)
+        # automaton over its predicted tail, then through enough
+        # future positions for whichever plan shape wins (one mask
+        # each, jumping ahead through forced tokens). Rows looked up
+        # during this plan are pinned until the next one.
+        spec_on = self.spec_tokens > 0 and self._spec_ok
+        horizon = max(k_steps, self.spec_tokens + 1 if spec_on else 1,
+                      1)
+        if self._gcache is not None and masked_slots:
+            self._gcache.begin_plan()
         tm0 = time.monotonic()
         mask_s = 0.0
         walks: Dict[int, tuple] = {}
@@ -2105,7 +2153,7 @@ class Scheduler:
                 legacy_masked = True
                 break
             try:
-                walks[s] = self._walk_masker(s, max(k_steps, 1))
+                walks[s] = self._walk_masker(s, horizon)
             except AttributeError:
                 # the masker copies but its automaton cannot
                 legacy_masked = True
@@ -2129,14 +2177,19 @@ class Scheduler:
             mask_s = time.monotonic() - tm0
             self._ph_mask.observe(mask_s)
         # -- speculative drafts over predicted continuations. Masked
-        # slots never draft (their continuation belongs to the
-        # grammar, not the n-gram cache) but ride verify steps at
-        # draft length 0 with their position-0 mask applied in the
-        # verify program. A batch where any slot is within the
-        # in-flight-rows + k+1 headroom of cache capacity falls back
-        # for the step (the verify write needs that many rows).
+        # slots draft THROUGH the grammar when their mask rows are
+        # device-resident: forced runs verbatim (the masked target
+        # distribution accepts them with certainty) plus
+        # grammar-screened n-gram proposals past a free boundary —
+        # a proposal leaving the grammar just truncates the draft.
+        # Without resident rows they ride verify steps at draft
+        # length 0 with their position-0 mask applied densely. A
+        # batch where any slot is within the in-flight-rows + k+1
+        # headroom of cache capacity falls back for the step (the
+        # verify write needs that many rows).
         drafts = dlen = None
-        if self.spec_tokens > 0 and self._spec_ok:
+        vrows = None
+        if spec_on:
             k = self.spec_tokens
             drafts, dlen = self._build_drafts(k)
             if dlen.any() and self._inflight and any(
@@ -2152,25 +2205,62 @@ class Scheduler:
                 if self._flush_inflight():
                     return None
                 drafts, dlen = self._build_drafts(k)
+            if masked_slots and self._gcache is not None and all(
+                    (not walks[s][0]) or walks[s][3][0] is not None
+                    for s in masked_slots if s in walks):
+                vrows = {}
+                for s in masked_slots:
+                    if self.slots[s] is None or not walks[s][0]:
+                        continue
+                    dm = self._draft_masked(s, walks[s], k)
+                    if dm is None:
+                        vrows = None
+                        break
+                    vrows[s] = dm
+                if vrows is not None:
+                    # only now that EVERY masked slot has resident
+                    # rows may masked drafts land: a dense fallback
+                    # masks position 0 only, so a half-applied plan
+                    # would let rejected drafts emit unmasked tokens
+                    for s, (rows_s, toks_s, bonus_free) in \
+                            vrows.items():
+                        if toks_s:
+                            drafts[s, :len(toks_s)] = toks_s
+                            dlen[s] = len(toks_s)
             if not dlen.any() or not self._spec_headroom(k):
                 drafts = dlen = None  # nobody drafted: plain/chunk
+                vrows = None
         if drafts is not None:
             # verify plan: a multi-token-shaped dispatch that
             # pipelines like any chunk; sync only when a masked
-            # slot's first position is a real grammar choice
+            # slot's next free sample lands at or before its bonus
+            # position (the token only the device can decide)
             mask = None
+            mask_idx = None
             sync = False
             if masked_slots:
                 V = self.engine.cfg.vocab_size
-                mask = np.ones((B, V), dtype=bool)
-                for s in masked_slots:
-                    w_masks, w_forced, w_boundary = walks[s]
-                    if w_masks:
-                        mask[s] = w_masks[0]
-                    if w_boundary and not w_forced:
-                        sync = True
+                if vrows is not None:
+                    mask_idx = np.zeros((B, self.spec_tokens + 1),
+                                        dtype=np.int32)
+                    for s, (rows_s, _toks, bonus_free) in \
+                            vrows.items():
+                        mask_idx[s, :len(rows_s)] = rows_s
+                        if bonus_free:
+                            sync = True
+                else:
+                    mask = np.ones((B, V), dtype=bool)
+                    for s in masked_slots:
+                        if s not in walks:
+                            continue
+                        w_masks, w_forced, w_boundary, _ = walks[s]
+                        if w_masks:
+                            mask[s] = w_masks[0]
+                        if w_boundary and not w_forced:
+                            sync = True
             plan = StepPlan("verify", k=self.spec_tokens, sync=sync,
-                            mask=mask, drafts=drafts, dlen=dlen,
+                            mask=mask, mask_idx=mask_idx,
+                            drafts=drafts, dlen=dlen,
                             rows=self.spec_tokens + 1, mask_s=mask_s)
             self._predict_verify(plan, walks)
             return plan
@@ -2180,7 +2270,7 @@ class Scheduler:
         # batch; a boundary inside the chunk also marks it sync
         n = max(k_steps, 1)
         for s in masked_slots:
-            w_masks, w_forced, w_boundary = walks[s]
+            w_masks, w_forced, w_boundary, _ = walks[s]
             if w_boundary:
                 n = min(n, len(w_forced) + 1)
         sync = any(walks[s][2] and len(walks[s][1]) < n
@@ -2188,28 +2278,50 @@ class Scheduler:
         if n > 1:
             budget = self._multi_budget(n)
             stack = None
+            stack_idx = None
             if masked_slots:
                 V = self.engine.cfg.vocab_size
-                stack = np.ones((B, n, V), dtype=bool)
-                for s in masked_slots:
-                    w_masks, w_forced, w_boundary = walks[s]
-                    for i, row in enumerate(w_masks[:n]):
-                        stack[s, i] = row
-                    budget[s] = min(int(budget[s]), len(w_masks))
+                if self._gcache is not None and all(
+                        all(r is not None for r in walks[s][3][:n])
+                        for s in masked_slots):
+                    stack_idx = np.zeros((B, n), dtype=np.int32)
+                    for s in masked_slots:
+                        rows_s = walks[s][3][:n]
+                        if rows_s:
+                            stack_idx[s, :len(rows_s)] = rows_s
+                        budget[s] = min(int(budget[s]),
+                                        len(walks[s][0]))
+                else:
+                    stack = np.ones((B, n, V), dtype=bool)
+                    for s in masked_slots:
+                        w_masks, w_forced, w_boundary, _ = walks[s]
+                        for i, row in enumerate(w_masks[:n]):
+                            stack[s, i] = row
+                        budget[s] = min(int(budget[s]), len(w_masks))
             plan = StepPlan("chunk", k=n, sync=sync,
-                            mask_stack=stack, budget=budget, rows=n,
-                            mask_s=mask_s)
+                            mask_stack=stack,
+                            mask_stack_idx=stack_idx, budget=budget,
+                            rows=n, mask_s=mask_s)
         else:
             mask = None
+            mask_idx = None
             if masked_slots:
                 V = self.engine.cfg.vocab_size
-                mask = np.ones((B, V), dtype=bool)
-                for s in masked_slots:
-                    w_masks, w_forced, w_boundary = walks[s]
-                    if w_masks:
-                        mask[s] = w_masks[0]
+                if self._gcache is not None and all(
+                        (not walks[s][0]) or walks[s][3][0] is not None
+                        for s in masked_slots):
+                    mask_idx = np.zeros(B, dtype=np.int32)
+                    for s in masked_slots:
+                        if walks[s][0]:
+                            mask_idx[s] = walks[s][3][0]
+                else:
+                    mask = np.ones((B, V), dtype=bool)
+                    for s in masked_slots:
+                        w_masks, w_forced, w_boundary, _ = walks[s]
+                        if w_masks:
+                            mask[s] = w_masks[0]
             plan = StepPlan("decode", sync=sync, mask=mask,
-                            mask_s=mask_s)
+                            mask_idx=mask_idx, mask_s=mask_s)
         self._predict_step(plan, walks, n)
         return plan
 
@@ -2220,12 +2332,15 @@ class Scheduler:
         allowed-token mask at each and jumping through forced tokens
         (positions where the grammar allows exactly one — closing
         braces, fixed keys, separators). Returns (masks, forced,
-        boundary): one [V] mask per walked position, the forced
-        tokens (always a prefix of the walk), and whether the walk
-        stopped at a boundary — a position whose token only the
-        device can decide. Raises AttributeError when the underlying
-        automaton cannot be copied (the caller falls back to one
-        synchronous masked step)."""
+        boundary, rows): one [V] mask per walked position, the
+        forced tokens (always a prefix of the walk), whether the
+        walk stopped at a boundary — a position whose token only the
+        device can decide — and one device mask-table row index per
+        position (None where the state is uncacheable or the table
+        is exhausted; plans fall back to dense masks around Nones).
+        Raises AttributeError when the underlying automaton cannot
+        be copied (the caller falls back to one synchronous masked
+        step)."""
         req = self.slots[slot]
         walker = req.masker.copy()
         tail = self._planned_tail[slot] or []
@@ -2234,6 +2349,7 @@ class Scheduler:
         V = self.engine.cfg.vocab_size
         masks: list = []
         forced: list = []
+        rows: list = []
         boundary = False
         produced = len(req.output_ids) + len(tail)
         for i in range(horizon):
@@ -2243,8 +2359,10 @@ class Scheduler:
             if remaining <= 0:
                 break
             closing = remaining <= walker.closing_distance() + 4
-            row = walker.mask(V, closing=closing, remaining=remaining)
+            row, ridx = self._lookup_mask(walker, V, closing,
+                                          remaining)
             masks.append(row)
+            rows.append(ridx)
             allowed = np.flatnonzero(row)
             if allowed.size == 1:
                 tok = int(allowed[0])
@@ -2253,7 +2371,106 @@ class Scheduler:
             else:
                 boundary = True
                 break
-        return masks, forced, boundary
+        return masks, forced, boundary, rows
+
+    def _lookup_mask(self, walker, V: int, closing: bool,
+                     remaining: Optional[int]):
+        """One walked position's allowed-token mask, served through
+        the device-resident row cache when the automaton state is
+        cacheable. A cached entry holds the state's BUDGET-FREE mask
+        plus its recorded slack — the worst closing-distance growth
+        any accepted token causes — and substitutes for the budgeted
+        dense mask exactly when `remaining - 1 >= closing_distance +
+        slack` (past that horizon the budget provably bans nothing).
+        Everything else — closing masks, tight budgets, automatons
+        without a signature, a table exhausted by pinned rows —
+        computes the dense mask host-side. Returns (bits, device row
+        index or None)."""
+        gc = self._gcache
+        if gc is not None and not closing:
+            key_fn = getattr(walker, "cache_key", None)
+            key = key_fn() if key_fn is not None else None
+            if key is not None:
+                ent = gc.get(key)
+                if ent is None:
+                    # compile + install the budget-free mask; its
+                    # slack is only known after compiling, so even a
+                    # position whose budget ends up too tight to use
+                    # it installs the entry for future positions
+                    bits, slack = walker.mask_with_slack(V)
+                    ent = gc.insert(key, bits, slack)
+                    self._g_gmask_resident.set(len(gc))
+                if ent is not None:
+                    bits, ridx, slack = ent
+                    if remaining is None or remaining - 1 \
+                            >= walker.closing_distance() + slack:
+                        return bits, ridx
+        return walker.mask(V, closing=closing,
+                           remaining=remaining), None
+
+    def _draft_masked(self, slot: int, walk, k: int):
+        """Spec through the grammar (docs/structured-outputs.md):
+        build a masked slot's draft from its walk. The forced run
+        drafts verbatim — the masked target distribution puts
+        probability 1 on each forced token at any temperature, so
+        those drafts are accepted with certainty. Past a free
+        boundary the n-gram drafter proposes and every proposal is
+        filtered through the automaton walk: a proposal the grammar
+        rejects truncates the draft (a rejected draft, never an
+        invalid emission). Returns (rows, draft tokens, bonus_free):
+        device mask rows for positions 0..len(drafts) — the verify
+        program masks every position so rejection resampling stays
+        in-grammar — and whether the position after the draft is a
+        free sample (which makes the plan sync). None when position
+        0 itself has no resident row."""
+        w_masks, w_forced, w_boundary, w_rows = walk
+        npos = len(w_masks)
+        if npos == 0 or w_rows[0] is None:
+            return None
+        # longest forced prefix whose positions 0..d all have rows
+        d = min(k, len(w_forced), npos - 1)
+        while d > 0 and any(w_rows[j] is None for j in range(d + 1)):
+            d -= 1
+        rows = [w_rows[j] for j in range(d + 1)]
+        toks = [int(t) for t in w_forced[:d]]
+        bonus_free = d >= len(w_forced) and w_boundary
+        if bonus_free and d < k:
+            req = self.slots[slot]
+            walker = req.masker.copy()
+            tail = self._planned_tail[slot] or []
+            for t in tail:
+                walker.feed(t)
+            for t in toks:
+                walker.feed(t)
+            produced = len(req.output_ids) + len(tail)
+            stream = (list(req.prompt_ids)
+                      + list(req.output_ids[int(self._base_out[slot]):])
+                      + tail + toks)
+            V = self.engine.cfg.vocab_size
+            state = {"bits": w_masks[d], "d": d}
+
+            def accept(t: int) -> bool:
+                if not state["bits"][t]:
+                    return False  # proposal exits the grammar
+                walker.feed(t)
+                rem = (req.max_new_tokens - produced
+                       - (state["d"] + 1))
+                if rem <= 0 or walker.done():
+                    return False
+                closing = rem <= walker.closing_distance() + 4
+                nbits, nrow = self._lookup_mask(walker, V, closing,
+                                                rem)
+                if nrow is None:
+                    return False  # next position not resident
+                toks.append(t)
+                rows.append(nrow)
+                state["bits"] = nbits
+                state["d"] += 1
+                return True
+
+            spec_drafter.grammar_prefix(
+                spec_drafter.propose(stream, k - d), accept)
+        return rows, toks, bonus_free
 
     def _predict_step(self, plan: StepPlan, walks: Dict[int, tuple],
                       n: int) -> None:
@@ -2292,8 +2509,14 @@ class Scheduler:
             if tail is None:
                 continue
             if s in walks:
-                # a masked slot advances exactly one (forced) token
-                self._planned_tail[s] = tail + walks[s][1][:1]
+                # a masked slot advances its forced-run draft plus
+                # the bonus: drafted forced tokens are accepted with
+                # certainty (the masked target distribution forces
+                # them) and a non-sync plan's bonus position is
+                # forced too — free-bonus plans are sync and never
+                # reach here
+                d = int(plan.dlen[s]) if plan.dlen is not None else 0
+                self._planned_tail[s] = tail + walks[s][1][:d + 1]
                 continue
             d = int(plan.dlen[s])
             if d == 0:
@@ -2332,14 +2555,18 @@ class Scheduler:
                 # plan still in flight (their commits have not
                 # advanced the host length mirror yet)
                 kw["lookahead_rows"] = self._inflight_rows() + plan.rows
-            if plan.mask is not None:
+            if plan.mask_idx is not None:
+                kw["mask_idx"] = plan.mask_idx
+            elif plan.mask is not None:
                 kw["mask"] = plan.mask
             self.state, out, acc = self.engine.verify(
                 self.state, plan.drafts, plan.dlen, *sampling, **kw)
             toks = _SpecStep(out, acc, plan.dlen, t0)
         elif plan.kind == "chunk":
             kw = {}
-            if plan.mask_stack is not None:
+            if plan.mask_stack_idx is not None:
+                kw["mask_idx"] = plan.mask_stack_idx
+            elif plan.mask_stack is not None:
                 kw["mask"] = plan.mask_stack
             self.state, out, adv = self.engine.decode_multi(
                 self.state, *sampling, steps=plan.k,
@@ -2350,6 +2577,9 @@ class Scheduler:
             toks = _MultiStep(
                 out, adv, plan.k, t0,
                 cost=led.last_dispatch() if led is not None else None)
+        elif plan.mask_idx is not None:
+            self.state, toks = self.engine.decode(
+                self.state, *sampling, mask_idx=plan.mask_idx)
         elif plan.mask is not None:
             self.state, toks = self.engine.decode(
                 self.state, *sampling, mask=plan.mask)

@@ -1165,3 +1165,31 @@ class SchemaAutomaton:
 
     def closing_distance(self) -> int:
         return min(t.closing_distance() for t in self.threads)
+
+    def signature(self, window: int):
+        """Hashable state key for the grammar-mask cache (see
+        JsonAutomaton.signature). The NFA state is the SET of thread
+        states, each windowed like the JSON automaton's stack; frames
+        hold schema Nodes by reference, so keys of distinct compiled
+        schemas can never collide (and the cache's strong reference
+        keeps those Nodes alive). States near the thread-prune limit
+        are not cached (pruning makes acceptance order-sensitive,
+        which a set signature can't represent), and neither are
+        states with any stack deeper than the window: closing
+        distance is a min over threads, so unlike the single-stack
+        JSON automaton a windowed key would not pin down the budget
+        slack — full stacks do, exactly."""
+        if len(self.threads) > 32:
+            return None
+        if any(len(t.stack) > window for t in self.threads):
+            return None
+        return ("schema", frozenset(
+            (t.complete, tuple(t.stack)) for t in self.threads))
+
+    def plain_str_interior(self) -> bool:
+        """True when every thread sits inside an unconstrained string,
+        where plain printable non-quote non-backslash bytes are legal
+        and state-preserving (pattern strings use 'pstr', never
+        'str', so they are excluded)."""
+        return all(t.stack and t.stack[-1][0] == "str"
+                   for t in self.threads)

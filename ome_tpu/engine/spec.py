@@ -11,6 +11,14 @@ slot degenerates to plain decode inside the batched verify, so a bad
 drafter can only cost throughput, never correctness — the verify
 forward accepts exactly the tokens the target model would have
 produced (docs/speculative-decoding.md).
+
+Grammar-masked slots draft through the same machinery: the planner
+walks the grammar and drafts forced-token runs directly (accepted
+with certainty — the masked target distribution has no other
+support), and at a free boundary it screens these n-gram proposals
+through the automaton walk with `grammar_prefix` — a proposal the
+grammar rejects truncates the draft, it can never emit
+(docs/structured-outputs.md).
 """
 
 from __future__ import annotations
@@ -54,3 +62,21 @@ def propose(ctx: Sequence[int], k: int, *, ngram_max: int = NGRAM_MAX,
             start = int(hits[-1]) + n
             return arr[start:start + k].copy()
     return np.zeros((0,), np.int32)
+
+
+def grammar_prefix(proposals: Sequence[int], accept) -> int:
+    """Length of the longest draftable prefix of ``proposals``.
+
+    ``accept(token) -> bool`` is the planner's probe: it advances a
+    scratch copy of the slot's grammar automaton and reports whether
+    the token keeps the draft inside the grammar AND the position
+    after it remains plannable (mask row resident, byte budget not
+    exhausted). The first refusal truncates — a truncated draft is
+    just a shorter draft; the verify step's per-position masks
+    guarantee nothing out-of-grammar can be emitted either way."""
+    n = 0
+    for t in proposals:
+        if not accept(int(t)):
+            break
+        n += 1
+    return n

@@ -10,12 +10,19 @@ GPU); this is the TPU-native redesign for the in-repo engine:
     it is tokenizer-independent, and the engine's hermetic
     ByteTokenizer maps one token to one byte, so masks there are exact
     set lookups.
-  * per decode step, a constrained slot's allowed-token mask is
-    computed HOST-side (first-byte prefilter from the automaton, then
-    full byte-walk per surviving token via a one-time token->bytes
-    table) and shipped to the device, where a masked sampling variant
-    adds -inf to forbidden logits. Unconstrained batches keep the
-    maskless compiled program — zero cost when the feature is off.
+  * masks are an ahead-of-time compiled, cached, device-resident
+    artifact (maskcache.py, after XGrammar's adaptive token-mask
+    cache): the token->bytes table compiles once per tokenizer into
+    numpy columns; a cache miss computes the state's mask with a
+    first-byte prefilter + plain-string fast path (O(surviving
+    tokens), not O(V) byte-walks) and uploads it as one row of the
+    engine's [S, V] device mask table; steady-state decode hits the
+    cache and the step plan ships per-slot ROW INDICES (K ints)
+    instead of dense [K, V] bool masks, with the device program
+    gathering rows in-program. States the cache can't hold (closing
+    masks, tight budgets, pinned-out tables) fall back to the dense
+    host-computed mask path. Unconstrained batches keep the maskless
+    compiled program — zero cost when the feature is off.
   * EOS becomes legal exactly when the automaton has accepted a
     complete JSON value; max_new_tokens still bounds pathological
     grammars.
@@ -33,6 +40,8 @@ import re
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
+
+from . import maskcache
 
 
 def pack_mask(mask: Optional[np.ndarray]) -> Optional[dict]:
@@ -369,6 +378,28 @@ class JsonAutomaton:
                 n += 2
         return n
 
+    def signature(self, window: int):
+        """Hashable state key for the grammar-mask cache
+        (maskcache.GrammarMaskCache). Within one token walk (bounded
+        by the tokenizer's max token byte length) each byte pops at
+        most two frames (a number ending pops NUM and re-dispatches
+        into a container close), so the top `window` frames plus a
+        deeper-than-window flag determine acceptance of every token
+        exactly — and a deeper stack can never be complete, so the
+        EOS bit is exact too. The budget slack is also exact: a token
+        walk only touches frames inside the window, so the
+        closing-distance delta any token causes is determined by the
+        windowed frames alone (the untouched deep suffix contributes
+        the same bytes before and after)."""
+        deep = len(self.stack) > window
+        return ("json", self.complete, deep, tuple(self.stack[-window:]))
+
+    def plain_str_interior(self) -> bool:
+        """Inside an unconstrained string: any token made purely of
+        printable non-quote non-backslash bytes is legal and leaves
+        the state unchanged — the mask compiler's fast path."""
+        return bool(self.stack) and self.stack[-1][0] == STR
+
 
 def _gpt2_uni2byte() -> Dict[str, int]:
     """Inverse of GPT-2's bytes_to_unicode table: the fixed invertible
@@ -453,12 +484,13 @@ def _build_token_table(tok) -> list:
 class TokenMasker:
     """Tokenizer-aware mask builder over a JsonAutomaton.
 
-    One token->bytes table per tokenizer (built lazily, shared across
-    requests); per step: first-byte prefilter, then a full byte-walk of
-    surviving tokens.
+    The token->bytes table compiles once per tokenizer into a
+    maskcache.CompiledTokenTable (weakref-evicted, so a collected
+    tokenizer's reused id() can never alias a stale table) and mask()
+    delegates to its prefiltered vectorized walk. cache_key() names
+    this automaton state for the scheduler's device-resident mask
+    cache when the state is cacheable (no closing/budget pressure).
     """
-
-    _tables: Dict[int, list] = {}  # id(tokenizer) -> per-token bytes
 
     def __init__(self, tokenizer, object_root: bool = False,
                  automaton=None):
@@ -467,15 +499,9 @@ class TokenMasker:
         # (e.g. schema.SchemaAutomaton for response_format json_schema)
         self.automaton = automaton if automaton is not None \
             else JsonAutomaton(object_root=object_root)
-        self.table = self._token_table(tokenizer)
+        self.ctab = maskcache.compiled_table(tokenizer)
+        self.table = self.ctab.raw
         self.eos_id = getattr(tokenizer, "eos_id", None)
-
-    @classmethod
-    def _token_table(cls, tok) -> list:
-        key = id(tok)
-        if key not in cls._tables:
-            cls._tables[key] = _build_token_table(tok)
-        return cls._tables[key]
 
     def copy(self) -> "TokenMasker":
         """Independent masker at the same grammar state — what the
@@ -488,6 +514,7 @@ class TokenMasker:
         m = TokenMasker.__new__(TokenMasker)
         m.tok = self.tok
         m.automaton = self.automaton.copy()
+        m.ctab = self.ctab
         m.table = self.table
         m.eos_id = self.eos_id
         return m
@@ -516,27 +543,43 @@ class TokenMasker:
         budget before the closing switch can re-engage. Distances are
         in bytes; every token covers >= 1 byte, so bytes upper-bound
         tokens (conservative)."""
-        m = np.zeros(vocab_size, dtype=bool)
-        a = self.automaton
-        if closing:
-            for i, data in enumerate(self.table):
-                if data and a.accepts_closing(data):
-                    m[i] = True
-        else:
-            budget = None if remaining is None else remaining - 1
-            for i, data in enumerate(self.table):
-                if not data:
-                    continue
-                w = a.copy()
-                if all(w.advance(b) for b in data):
-                    if budget is None \
-                            or w.closing_distance() <= budget:
-                        m[i] = True
-        if self.eos_id is not None and a.is_complete():
-            m[self.eos_id] = True
-        if not m.any() and self.eos_id is not None:
-            m[self.eos_id] = True  # dead end: finish rather than hang
-        return m
+        budget = None if remaining is None else remaining - 1
+        return self.ctab.mask_bits(self.automaton, self.eos_id,
+                                   vocab_size, closing=closing,
+                                   budget=budget)
+
+    def cache_window(self) -> int:
+        """Signature window in stack frames: generous multiple of the
+        max token byte length (see JsonAutomaton.signature — <= 2
+        pops per byte make 2L+2 exact; 4L+8 leaves margin for
+        automatons with deeper redispatch chains)."""
+        return 4 * max(self.ctab.max_len, 1) + 8
+
+    def cache_key(self):
+        """Hashable key naming this state's BUDGET-FREE mask, or None
+        when the state is uncacheable (automaton without a signature,
+        or a signature that declines — e.g. a schema NFA with too
+        many threads). Whether a cached mask may serve a
+        budget-limited position is decided per use from the entry's
+        recorded slack (see GrammarMaskCache), not baked into the
+        key. Keys hold the compiled table and any schema nodes by
+        strong reference, so a cached row can never alias a recycled
+        id()."""
+        sig_fn = getattr(self.automaton, "signature", None)
+        if sig_fn is None:
+            return None
+        sig = sig_fn(self.cache_window())
+        if sig is None:
+            return None
+        return (self.ctab, self.eos_id, sig)
+
+    def mask_with_slack(self, vocab_size: int):
+        """(budget-free mask, budget slack) — the cacheable artifact.
+        Slack is the worst closing-distance growth over any accepted
+        token; `remaining - 1 >= closing_distance() + slack` proves
+        the budgeted mask identical to this one."""
+        return self.ctab.mask_bits(self.automaton, self.eos_id,
+                                   vocab_size, with_slack=True)
 
     def closing_distance(self) -> int:
         return self.automaton.closing_distance()

@@ -42,10 +42,17 @@ LEGACY_ROOTS = (
     "_maybe_finish", "_sampling", "_spec_headroom", "_build_drafts",
     "_stop_table", "_multi_budget", "_plan_step", "_execute",
     "_walk_masker", "_predict_step", "_predict_verify",
+    "_lookup_mask", "_draft_masked",
     "_flush_inflight", "_note_actual", "_inflight_rows",
     "_flight_rows", "_degrade")
+# drains are the one sanctioned device->host fetch; the grammar mask
+# compiler entry points (engine/maskcache.py, reached from
+# _lookup_mask on a cache miss) are pure host-side numpy over the
+# compiled token table — no device arrays in or out — so they stop
+# the walk rather than dragging the whole compiler under a rule
+# about device fetches
 ALLOWED = frozenset(("_drain_inflight", "_drain_spec",
-                     "_drain_multi"))
+                     "_drain_multi", "mask_bits", "mask_with_slack"))
 
 _SYNC_MODULE_CALLS = frozenset((
     ("np", "asarray"), ("np", "array"),

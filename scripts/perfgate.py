@@ -84,6 +84,19 @@ NESTED_POLICY = (
      (False, 0.0)),
     (re.compile(r"^composition\.composed_vs_best_single$"),
      (True, 0.08)),
+    # structured-output sweep (bench.py structured,
+    # docs/structured-outputs.md): per-cell throughput gates like the
+    # composition cells; the headline masked-vs-unmasked ratio is the
+    # device-resident-mask-table contract (ROADMAP item 4's >=0.9);
+    # mask_apply_ms is host walk time per timed batch — noisy, so a
+    # wide band, but a blowup means the grammar cache stopped hitting
+    (re.compile(r"^structured\.cells\.\w+\.tokens_per_sec$"),
+     (True, 0.08)),
+    (re.compile(r"^structured\.cells\.\w+\.degraded_steps$"),
+     (False, 0.0)),
+    (re.compile(r"^structured\.structured_vs_unmasked$"),
+     (True, 0.08)),
+    (re.compile(r"^structured\.mask_build_ms$"), (False, 0.5)),
 )
 
 
@@ -223,6 +236,15 @@ def cost_table(parsed: dict, source: str) -> dict:
             table["programs"][f"composed_{name}"] = {
                 "tokens_per_sec": row["tokens_per_sec"],
                 "accept_rate": row.get("accept_rate")}
+    struct = (parsed.get("structured") or {}).get("cells") or {}
+    for name, row in struct.items():
+        if isinstance(row, dict) and "tokens_per_sec" in row:
+            # grammar-masked decode cells (masked share x chunk K,
+            # docs/structured-outputs.md) — lets the simulator price
+            # structured-output (JSON mode / tool call) traffic mixes
+            table["programs"][f"structured_{name}"] = {
+                "tokens_per_sec": row["tokens_per_sec"],
+                "mask_apply_ms": row.get("mask_apply_ms")}
     if "dispatch_ms" in parsed:
         table["dispatch_ms"] = parsed["dispatch_ms"]
     if "warmup_ms" in parsed:

@@ -314,10 +314,12 @@ def _assert_composed(degr):
 
 
 def _run_comp_matrix(engine, masked, specs=(0, 2), ks=(1, 4),
-                     depths=(0, 1)):
+                     depths=(0, 1), grammar_table=True):
     """Every (spec, K, depth) cell over one engine; returns the
     per-cell streams and the count of fused multi-token dispatches
-    (device_loop phase observations)."""
+    (device_loop phase observations). ``grammar_table=False`` runs
+    the dense-mask baseline the device-resident row-index path must
+    match byte-for-byte (docs/structured-outputs.md)."""
     from ome_tpu.engine.schema import SchemaAutomaton
     from ome_tpu.engine.structured import TokenMasker
 
@@ -328,7 +330,8 @@ def _run_comp_matrix(engine, masked, specs=(0, 2), ks=(1, 4),
             for depth in depths:
                 sched = Scheduler(engine, pipeline_depth=depth,
                                   steps_per_dispatch=k,
-                                  spec_tokens=spec)
+                                  spec_tokens=spec,
+                                  grammar_table=grammar_table)
                 reqs = []
                 if masked:
                     for text in ("emit n:", "n = ", "give n "):
@@ -407,6 +410,54 @@ class TestCompositionMatrix:
                    if key[1] > 1), chunked
         ok, _ = engine.kv_conservation()
         assert ok
+
+    def test_dense_masked_idx_byte_identity(self, world):
+        """The device-resident mask-table contract: plans referencing
+        cached grammar states by row index produce byte-identical
+        streams to the dense [B,K,V] mask baseline, across the whole
+        (spec, K, depth) matrix."""
+        cfg, params, engine = world
+        idx, _ = _run_comp_matrix(engine, masked=True)
+        dense, _ = _run_comp_matrix(engine, masked=True,
+                                    grammar_table=False)
+        for key in dense:
+            assert idx[key] == dense[key], key
+
+    def test_paged_masked_idx_byte_identity(self, paged_world):
+        cfg, params, engine = paged_world
+        idx, _ = _run_comp_matrix(engine, masked=True)
+        dense, _ = _run_comp_matrix(engine, masked=True,
+                                    grammar_table=False)
+        for key in dense:
+            assert idx[key] == dense[key], key
+        ok, _ = engine.kv_conservation()
+        assert ok
+
+    def test_masked_spec_cell_drafts_and_accepts(self, world):
+        """Spec through the grammar: on masked slots the drafter
+        proposes (forced grammar runs + screened n-gram extensions),
+        the verify accepts some of it, nothing degrades, and the
+        output is grammar-valid — the last masked-vs-unmasked
+        feature gap (docs/structured-outputs.md)."""
+        from ome_tpu.engine.structured import TokenMasker
+
+        cfg, params, engine = world
+        tok = ByteTokenizer()
+        for k in (1, 4):
+            sched = Scheduler(engine, pipeline_depth=1,
+                              steps_per_dispatch=k, spec_tokens=2)
+            reqs = [sched.submit(Request(
+                prompt_ids=tok.encode(text), max_new_tokens=14,
+                masker=TokenMasker(tok), stop_ids=[tok.eos_id]))
+                for text in ("emit n:", "n = ", "give n ")]
+            _drive(sched, reqs, iters=3000)
+            _assert_composed(sched.degradations)
+            proposed = sched.stats["spec_proposed_tokens_total"]
+            accepted = sched.stats["spec_accepted_tokens_total"]
+            assert proposed > 0, k
+            assert accepted > 0, k  # accept-rate > 0
+            for r in reqs:
+                json.loads(tok.decode(r.output_ids))
 
 
 # -- journal kill-resume with a chunk in flight -----------------------

@@ -94,6 +94,118 @@ class TestTokenMasker:
         assert m.mask(V)[tok.eos_id]
 
 
+class TestAutomatonProperties:
+    """Fuzz the automaton from both directions: everything it accepts
+    to completion must parse, and everything ``json.dumps`` can emit
+    must be accepted."""
+
+    # byte pool the walk-fuzzer samples from: structural JSON, string
+    # escapes, digits/exponents, and some plain text / unicode
+    POOL = (b'{}[]:,"\\/ \t\n'
+            b'0123456789-+.eE'
+            b'truefalsn'
+            b'abcXYZ_ \xc3\xa9u00e9')
+
+    def test_accepted_strings_parse(self):
+        """Drive random walks through the automaton, only ever taking
+        bytes it accepts; whenever a walk reaches a complete state,
+        the bytes so far MUST be valid JSON under json.loads."""
+        rng = np.random.default_rng(7)
+        checked = 0
+        for _ in range(60):
+            a = JsonAutomaton()
+            out = bytearray()
+            for _step in range(40):
+                candidates = rng.permutation(
+                    np.frombuffer(self.POOL, dtype=np.uint8))
+                for b in candidates:
+                    w = a.copy()
+                    if w.advance(int(b)):
+                        a = w
+                        out.append(int(b))
+                        break
+                else:
+                    break  # dead end for this pool
+                # probabilistically stop at complete states so short
+                # roots (numbers, literals) get exercised too
+                if a.is_complete() and rng.random() < 0.3:
+                    break
+            if a.is_complete() and out:
+                # the automaton is byte-level: it guarantees JSON
+                # SYNTAX, not UTF-8 well-formedness inside strings
+                # (ByteTokenizer.decode replaces invalid sequences,
+                # same as here)
+                json.loads(bytes(out).decode("utf-8",
+                                             errors="replace"))
+                checked += 1
+        assert checked >= 20  # the fuzz actually exercised the claim
+
+    def _random_str(self, rng):
+        chars = ['"', "\\", "/", "\b", "\f", "\n", "\r", "\t",
+                 "\u00e9", "\u2603", "x", " ", "{", "["]
+        return "".join(chars[rng.integers(len(chars))]
+                       for _ in range(rng.integers(0, 8)))
+
+    def _random_value(self, rng, depth=0):
+        kinds = ["int", "float", "str", "bool", "null"]
+        if depth < 3:
+            kinds += ["list", "dict"] * 2
+        kind = kinds[rng.integers(len(kinds))]
+        if kind == "int":
+            return int(rng.integers(-10**9, 10**9))
+        if kind == "float":
+            # exponents, tiny and huge magnitudes
+            return float(rng.normal() * 10.0 ** rng.integers(-12, 12))
+        if kind == "str":
+            return self._random_str(rng)
+        if kind == "bool":
+            return bool(rng.integers(2))
+        if kind == "null":
+            return None
+        if kind == "list":
+            return [self._random_value(rng, depth + 1)
+                    for _ in range(rng.integers(0, 4))]
+        return {f"k{i}_{self._random_str(rng)}":
+                self._random_value(rng, depth + 1)
+                for i in range(rng.integers(0, 4))}
+
+    @pytest.mark.parametrize("ensure_ascii", [True, False])
+    def test_dumps_output_accepted(self, ensure_ascii):
+        """Every json.dumps rendering of randomized nested values —
+        escapes, \\uXXXX, exponent notation, unicode — must walk the
+        automaton to completion."""
+        rng = np.random.default_rng(11 + ensure_ascii)
+        for _ in range(40):
+            text = json.dumps(self._random_value(rng),
+                              ensure_ascii=ensure_ascii)
+            a = JsonAutomaton()
+            for b in text.encode("utf-8"):
+                assert a.advance(b), (text, bytes([b]))
+            assert a.is_complete(), text
+
+    def test_masked_streams_always_parse(self):
+        """The masked-stream invariant, sampled hot: random-weights
+        model, nonzero temperature, many seeds — every structured
+        stream the engine emits must parse."""
+        cfg = tiny_test().replace(dtype=jnp.float32, max_seq_len=128)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        engine = InferenceEngine(params, cfg, max_slots=4,
+                                 prefill_buckets=[16])
+        tok = ByteTokenizer()
+        sched = Scheduler(engine)
+        reqs = [sched.submit(Request(
+            prompt_ids=tok.encode(f"seed {i} json: "),
+            max_new_tokens=40, temperature=1.0,
+            masker=TokenMasker(tok, object_root=bool(i % 2)),
+            stop_ids=[tok.eos_id])) for i in range(8)]
+        while not all(r.done.is_set() for r in reqs):
+            sched.step()
+        for r in reqs:
+            parsed = json.loads(tok.decode(r.output_ids))
+            if reqs.index(r) % 2:
+                assert isinstance(parsed, dict)
+
+
 def test_random_model_forced_to_valid_json():
     """The whole point: ANY model — here random weights — emits
     parseable JSON under the grammar mask, greedy or sampled."""
