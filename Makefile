@@ -5,7 +5,7 @@
 PYTHON ?= python
 
 .PHONY: lint test replay autoscale-soak noisy-neighbor router-soak \
-	benchgate simulate chaos-sim slo-report
+	benchgate simulate chaos-sim slo-report model-fleet-soak
 
 # omelint: the repo's static-analysis gate (docs/static-analysis.md).
 # Runs every registered analyzer over ome_tpu/ and fails on any
@@ -81,6 +81,16 @@ router-soak:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_soak.py --seed 3 \
 		--episodes 1 --router-loss --routers 3 --prefill 0 \
 		--decode 0 --unified 2 --requests 10 --spread 4
+
+# hardened weight plane under mid-download SIGKILLs
+# (docs/model-fleet.md): seeded episodes that kill the model agent
+# after a seed-derived number of objects are manifest-recorded, then
+# check the failure contract — serving path never partial, manifest
+# never ahead of the disk, re-run resumes from every verified object
+# and publishes a byte-identical tree
+model-fleet-soak:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/modelfleet_soak.py --seed 7 \
+		--episodes 5
 
 # the closed-loop demo: bursty replayed trace + SLO-aware scaling of
 # a live engine pool, reporting engine-seconds vs static max

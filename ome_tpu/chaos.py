@@ -1506,6 +1506,157 @@ class ChaosRunner:
                 f"have {have and have.get('stamp')})")
 
 
+# -- weight-plane kill episode (docs/model-fleet.md) -----------------
+
+
+def _hash_tree(root: pathlib.Path) -> Dict[str, str]:
+    import hashlib
+    out: Dict[str, str] = {}
+    for p in sorted(root.rglob("*")):
+        if p.is_file() and not p.name.startswith(".ome_fetch_"):
+            out[str(p.relative_to(root))] = hashlib.sha256(
+                p.read_bytes()).hexdigest()
+    return out
+
+
+def run_weight_kill_episode(seed: int, base_dir: pathlib.Path, *,
+                            n_objects: int = 24, obj_kb: int = 8,
+                            slow_s: float = 0.05,
+                            timeout: float = 120.0) -> List[str]:
+    """SIGKILL the model agent mid-download; assert the weight plane's
+    failure contract (docs/model-fleet.md):
+
+      1. the serving path NEVER holds a partial tree — until a
+         complete publish it does not exist at all, and is never
+         ``is_published``;
+      2. every object the staging manifest recorded before the kill
+         has its staged bytes intact (size + sha256 match) — the
+         ledger never gets ahead of the disk;
+      3. the re-run RESUMES: every object recorded before the kill is
+         skipped (``resumed`` counts them all), the tree publishes,
+         and the published bytes are identical to the source.
+
+    The kill lands deterministically mid-download by pacing each
+    object with a ``weight_fetch.slow`` rule and waiting until the
+    manifest has recorded a seed-derived number of objects — not by
+    racing a wall-clock sleep against process startup. Returns the
+    violation list (empty = episode clean).
+    """
+    from .modelagent import weightplane
+
+    preflight_fault_points([f"weight_fetch.slow={slow_s}@1:1"])
+    rng = random.Random(seed)
+    violations: List[str] = []
+    base_dir = pathlib.Path(base_dir)
+    src = base_dir / "source"
+    target = base_dir / "served" / "model"
+    target.parent.mkdir(parents=True, exist_ok=True)
+
+    # seed-derived source tree: sizes and bytes reproduce per seed
+    src.mkdir(parents=True, exist_ok=True)
+    for i in range(n_objects):
+        size = obj_kb * 1024 + rng.randrange(obj_kb * 1024)
+        (src / f"shard-{i:03d}.bin").write_bytes(
+            rng.getrandbits(8 * size).to_bytes(size, "little"))
+    src_hashes = _hash_tree(src)
+    kill_after = rng.randint(max(2, n_objects // 4),
+                             max(3, n_objects // 2))
+
+    argv = [sys.executable, "-m", "ome_tpu.modelagent.weightplane",
+            "--source", f"local://{src}", "--target", str(target),
+            "--name", f"chaos-seed{seed}", "--workers", "2",
+            "--faults", f"weight_fetch.slow={slow_s}@1:{n_objects}"]
+    log_path = base_dir / "agent.log"
+    staging = pathlib.Path(weightplane.staging_dir(str(target)))
+    with open(log_path, "ab") as lf:
+        proc = subprocess.Popen(argv, stdout=lf, stderr=lf,
+                                cwd=str(REPO_ROOT))
+    deadline = time.monotonic() + timeout
+    try:
+        while True:
+            m = weightplane.FetchManifest.load(str(staging))
+            if m is not None and len(m.objects) >= kill_after:
+                break
+            if proc.poll() is not None:
+                violations.append(
+                    f"agent exited (rc={proc.returncode}) before the "
+                    f"kill threshold ({kill_after} objects) — the "
+                    "episode never got to kill mid-download")
+                return violations
+            if time.monotonic() > deadline:
+                violations.append(
+                    f"manifest never reached {kill_after} objects "
+                    f"within {timeout:g}s")
+                return violations
+            # the serving path must not flicker into existence while
+            # the download is in flight
+            if target.exists():
+                violations.append(
+                    "serving path exists mid-download (invariant 1)")
+            time.sleep(0.01)
+        proc.kill()
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    # invariant 1: nothing partial at the serving path
+    if target.exists():
+        violations.append("serving path exists after mid-download "
+                          "SIGKILL (invariant 1)")
+    if weightplane.is_published(str(target)):
+        violations.append("partial tree reads as published "
+                          "(invariant 1)")
+
+    # invariant 2: the manifest never gets ahead of the disk
+    m = weightplane.FetchManifest.load(str(staging))
+    if m is None or not m.objects:
+        violations.append("no staging manifest survived the kill")
+        return violations
+    if m.complete:
+        violations.append("staging manifest marked complete before "
+                          "publish (invariant 1)")
+    recorded = dict(m.objects)
+    from .storage.base import sha256_file
+    for rel, rec in recorded.items():
+        p = staging / rel
+        if not p.is_file():
+            violations.append(f"manifest records {rel} but the staged "
+                              "file is missing (invariant 2)")
+        elif p.stat().st_size != rec["size"] \
+                or sha256_file(str(p)) != rec["sha256"]:
+            violations.append(f"staged {rel} does not match its "
+                              "manifest record (invariant 2)")
+
+    # invariant 3: the re-run resumes from verified objects and
+    # publishes a byte-identical tree
+    rerun = subprocess.run(
+        [sys.executable, "-m", "ome_tpu.modelagent.weightplane",
+         "--source", f"local://{src}", "--target", str(target),
+         "--name", f"chaos-seed{seed}", "--workers", "2"],
+        capture_output=True, text=True, timeout=timeout,
+        cwd=str(REPO_ROOT))
+    if rerun.returncode != 0:
+        violations.append(f"re-run failed (rc={rerun.returncode}): "
+                          f"{rerun.stdout[-300:]}{rerun.stderr[-300:]}")
+        return violations
+    stats = json.loads(rerun.stdout.strip().splitlines()[-1])
+    if stats.get("resumed", 0) != len(recorded):
+        violations.append(
+            f"re-run resumed {stats.get('resumed')} objects, expected "
+            f"every one of the {len(recorded)} recorded before the "
+            "kill (invariant 3)")
+    if not weightplane.is_published(str(target)):
+        violations.append("re-run did not publish (invariant 3)")
+    if staging.exists():
+        violations.append("staging dir survived publish (invariant 3)")
+    if _hash_tree(target) != src_hashes:
+        violations.append("published tree is not byte-identical to "
+                          "the source (invariant 3)")
+    return violations
+
+
 # -- soak entry ------------------------------------------------------
 
 

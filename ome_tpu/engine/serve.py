@@ -785,7 +785,14 @@ def main(argv=None) -> int:
                               args.no_priority_scheduling)
     tok = load_tokenizer(args.model_dir)
     name = args.model_name or args.model_dir.rstrip("/").rsplit("/", 1)[-1]
+    # measured weight-fetch throughput from the published fetch
+    # manifest, advertised on /ready for the router's cold-start
+    # Retry-After math (docs/model-fleet.md); None when the tree was
+    # staged by something other than the weight plane
+    from ..modelagent import weightplane
+    fetch_bps = weightplane.published_fetch_bps(args.model_dir)
     server = EngineServer(scheduler, tokenizer=tok, model_name=name,
+                          fetch_bps=fetch_bps,
                           host=args.host, port=args.port,
                           embedder=embedder, pd_prefill=pd_prefill,
                           request_log=(reqlog if reqlog is not None

@@ -65,10 +65,16 @@ class EngineServer:
                  ready_queue_limit: Optional[int] = None,
                  registry: Optional[Registry] = None,
                  request_log=None, profile_dir: Optional[str] = None,
-                 debug_endpoints: bool = False):
+                 debug_endpoints: bool = False,
+                 fetch_bps: Optional[float] = None):
         self.scheduler = scheduler
         self.tokenizer = tokenizer or load_tokenizer()
         self.model_name = model_name
+        # measured weight-fetch throughput from the published fetch
+        # manifest (weightplane.published_fetch_bps): advertised on
+        # /ready so the router's cold-start Retry-After math uses the
+        # fleet's REAL bandwidth, not a default guess
+        self.fetch_bps = fetch_bps
         self.embedder = embedder  # engine/embed.py EmbeddingEngine
         self.pd_prefill = pd_prefill  # engine/pd.py prefill-node handler
         # one registry per serving process: the scheduler already owns
@@ -200,7 +206,16 @@ class EngineServer:
                         # prefix-directory piggyback: the router's
                         # health probe carries these into the fleet
                         # prefix directory (router/server.py)
-                        "prefix_digests": outer.prefix_digests()})
+                        "prefix_digests": outer.prefix_digests(),
+                        # model advertisement (docs/model-fleet.md):
+                        # the router's model map learns which model
+                        # ids this replica serves — base + adapters —
+                        # and the measured fetch throughput feeding
+                        # its cold-start Retry-After
+                        "model": outer.model_name,
+                        "models": [outer.model_name]
+                        + outer._adapter_names(),
+                        "fetch_bps": outer.fetch_bps})
                 elif self.path == "/v1/models":
                     data = [{"id": outer.model_name, "object": "model",
                              "owned_by": "ome-tpu"}]

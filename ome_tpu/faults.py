@@ -66,6 +66,15 @@ Wired sites:
     surfaces as the same OSError family a refused connection
     produces; an armed slow at submit counts as a client timeout
     once it reaches the transport's timeout budget.
+  * ``weight_fetch``   — weight-plane object download, key=relative
+    object name (raise kills one transfer mid-fetch: the staged tree
+    stays partial, the manifest keeps only verified objects);
+  * ``weight_verify``  — post-fetch digest check, key=relative object
+    name (raise surfaces as WeightVerifyError: the object is
+    re-fetched on the next attempt, never recorded as verified);
+  * ``model_publish``  — the atomic staging->target rename, key=model
+    name (raise surfaces as PublishError BEFORE the rename: the
+    serving path never sees a partial tree).
 """
 
 from __future__ import annotations

@@ -14,10 +14,12 @@ import enum
 import logging
 import os
 import queue
+import random
 import shutil
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from .. import constants
 from ..apis import v1
@@ -29,6 +31,7 @@ from ..storage.hub import HubClient
 from ..storage.providers import open_storage
 from ..storage.uri import StorageType, parse_storage_uri
 from ..storage.xet import ChunkStore, DedupStats
+from . import weightplane
 from .metrics import METRICS
 from .reconcilers import ConfigMapReconciler, NodeLabelReconciler
 
@@ -59,6 +62,9 @@ class Gopher:
     download_retries: int = 3
     num_workers: int = 2
     endpoints: Dict[str, str] = field(default_factory=dict)
+    # injectable for tests: backoff sleeps and their jitter source
+    sleep: Callable[[float], None] = time.sleep
+    rng: Optional[random.Random] = None
 
     def __post_init__(self):
         self.tasks: "queue.Queue[Optional[GopherTask]]" = queue.Queue()
@@ -66,6 +72,8 @@ class Gopher:
         self.status_cm = ConfigMapReconciler(self.client, self.node_name)
         self._threads = []
         self._stop = threading.Event()
+        if self.rng is None:
+            self.rng = random.Random()
 
     # -- lifecycle -----------------------------------------------------
 
@@ -76,12 +84,19 @@ class Gopher:
             t.start()
             self._threads.append(t)
 
-    def stop(self):
+    def stop(self, timeout: float = 5.0):
+        """Bounded shutdown: set the stop flag, wake every worker with
+        one sentinel each, then join with a deadline shared across
+        threads. A worker mid-download finishes (or fails) its current
+        task and exits on its next queue poll; stop() itself never
+        blocks past ``timeout``."""
         self._stop.set()
         for _ in self._threads:
             self.tasks.put(None)
+        deadline = time.monotonic() + timeout
         for t in self._threads:
-            t.join(timeout=5)
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        self._threads = [t for t in self._threads if t.is_alive()]
 
     def enqueue(self, task: GopherTask):
         self.tasks.put(task)
@@ -93,16 +108,28 @@ class Gopher:
                 task = self.tasks.get_nowait()
             except queue.Empty:
                 return
-            if task is not None:
-                self.process(task)
-            self.tasks.task_done()
+            try:
+                if task is not None:
+                    self.process(task)
+            finally:
+                self.tasks.task_done()
 
     def _worker(self):
-        while not self._stop.is_set():
-            task = self.tasks.get()
-            if task is None:
-                return
+        # Every successful get() is matched by exactly one task_done()
+        # — including sentinels — so queue.join() accounting stays
+        # exact. The timed get() means a worker parked on an empty
+        # queue still notices _stop even if another worker consumed
+        # its sentinel.
+        while True:
             try:
+                task = self.tasks.get(timeout=0.2)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                if task is None:
+                    return
                 self.process(task)
             except Exception:
                 log.exception("task %s %s failed unexpectedly",
@@ -143,8 +170,10 @@ class Gopher:
 
     def _delete(self, task: GopherTask):
         target = self.model_dir(task)
-        if os.path.isdir(target):
-            shutil.rmtree(target, ignore_errors=True)
+        for tree in (target, weightplane.staging_dir(target),
+                     target.rstrip("/") + ".trash"):
+            if os.path.isdir(tree):
+                shutil.rmtree(tree, ignore_errors=True)
         self.labels.reconcile(task.model_kind, task.model_name, None)
         self.status_cm.remove(task.model_kind, task.model_namespace,
                               task.model_name)
@@ -158,12 +187,18 @@ class Gopher:
             raise ValueError(f"model {task.model_name} has no storage uri")
         target = self.model_dir(task)
         if spec.storage.download_policy == v1.DownloadPolicy.REUSE \
-                and os.path.isdir(target) and os.listdir(target):
-            return target  # ReuseIfExists (model.go:150-156)
+                and weightplane.is_published(target):
+            # ReuseIfExists (model.go:150-156) — only a tree the
+            # weight plane published complete counts; a partial tree
+            # from a killed download must be re-fetched, not served
+            return target
 
         comps = parse_storage_uri(spec.storage.storage_uri)
         last: Optional[Exception] = None
         for attempt in range(self.download_retries):
+            if attempt:
+                self.sleep(weightplane.backoff_delay(attempt - 1,
+                                                     self.rng))
             try:
                 if comps.type == StorageType.HUGGINGFACE:
                     self._download_hf(comps, target)
@@ -176,14 +211,9 @@ class Gopher:
                     if not expected:
                         raise IOError(
                             f"{spec.storage.storage_uri}: no objects found")
-                    storage.download(target, prefix, objects=expected)
-                    bad = verify_tree(target, [
-                        type(o)(o.name[len(prefix):].lstrip("/")
-                                if prefix else o.name, o.size)
-                        for o in expected])
-                    if bad:
-                        raise IOError(
-                            f"verification failed: {bad[:3]}")
+                    weightplane.fetch_and_publish(
+                        storage, prefix, expected, target,
+                        name=task.model_name, retries=1)
                 METRICS.inc("verifications_total")
                 return target
             except Exception as e:  # noqa: BLE001
@@ -194,20 +224,29 @@ class Gopher:
         raise last  # type: ignore[misc]
 
     def _download_hf(self, comps, target: str):
+        # The hub client has its own resumable transfer; the weight
+        # plane stages, hashes and atomically publishes its output so
+        # the serving path keeps the same never-partial contract.
         hub = self.hub or HubClient()
-        files = hub.snapshot_download(comps.repo_id, target,
+        staging = weightplane.staging_dir(target)
+        t0 = time.monotonic()
+        files = hub.snapshot_download(comps.repo_id, staging,
                                       revision=comps.revision)
         expected = hub.expected_objects(comps.repo_id, comps.revision)
-        bad = verify_tree(target, [o for o in expected if o.size])
+        bad = verify_tree(staging, [o for o in expected if o.size])
         if bad:
             raise IOError(f"verification failed: {bad[:3]}")
+        weightplane.seal_tree(staging,
+                              fetch_seconds=time.monotonic() - t0)
+        weightplane.publish(target, name=comps.repo_id)
         # feed the dedup store so future revisions reuse local chunks
         if self.chunk_store is not None:
             stats = DedupStats()
             for f in files:
-                rel = os.path.relpath(f, target)
+                rel = os.path.relpath(f, staging)
                 key = f"{comps.repo_id}@{comps.revision}/{rel}"
-                manifest = self.chunk_store.ingest(f, stats)
+                manifest = self.chunk_store.ingest(
+                    os.path.join(target, rel), stats)
                 self.chunk_store.save_manifest(key, manifest)
             METRICS.observe("dedup_ratio", stats.dedup_ratio)
 

@@ -349,10 +349,12 @@ class AsyncRouterServer:
                     cls = DEFAULT_PRIORITY
                 self._c_class[cls].inc()
             stream = bool(payload.get("stream"))
+            mdl = payload.get("model")
             return await self._proxy(
                 method, path, headers, body, stream,
                 affinity_from_payload(payload), reader, writer,
-                cls=cls)
+                cls=cls,
+                model=mdl if isinstance(mdl, str) else None)
         if method == "DELETE":
             if path == "/backends":
                 return await self._backends_mutate(writer, body,
@@ -404,7 +406,7 @@ class AsyncRouterServer:
             return None
 
     async def _proxy(self, method, path, headers, body, stream,
-                     affinity, reader, writer, cls=None):
+                     affinity, reader, writer, cls=None, model=None):
         ctx = tracing.from_headers(headers)
         t0 = time.monotonic()
         outcome = {"backend": None, "pool": None,
@@ -437,7 +439,7 @@ class AsyncRouterServer:
         try:
             return await self._route(method, path, headers, body,
                                      stream, affinity, ctx, outcome,
-                                     writer)
+                                     writer, model=model)
         except asyncio.CancelledError:
             if not gone["flag"]:
                 raise
@@ -479,11 +481,43 @@ class AsyncRouterServer:
                     "duration_s": round(dur, 6)})
 
     async def _route(self, method, path, headers, body, stream,
-                     affinity, ctx, outcome, writer):
+                     affinity, ctx, outcome, writer, model=None):
         router = self.router
         router.inc("requests_total")
         self.budget.deposit()
         deadline = self._deadline(headers)
+        # model-aware gate (docs/model-fleet.md) — same verdicts as
+        # the threaded router: 404 unknown, 503 + Retry-After cold,
+        # steer when serving, legacy any-backend when routing is off
+        if model:
+            verdict, _ = router.classify_model(model)
+            if verdict == "unknown":
+                router.note_model_unknown()
+                outcome["status"] = "unknown_model"
+                return await self._send_json(writer, 404, {
+                    "error": f"model {model!r} is not served "
+                             "by this fleet",
+                    "model": model})
+            if verdict == "cold":
+                ra = router.model_map.retry_after(model)
+                router.note_model_cold(model)
+                if self.span_log.enabled:
+                    cspan = tracing.Span(
+                        "router.cold_start",
+                        trace_id=ctx.trace_id,
+                        parent_id=ctx.span_id)
+                    cspan.set(model=model, retry_after=ra)
+                    self.span_log.write(cspan)
+                outcome["status"] = "cold_start"
+                return await self._send_json(writer, 503, {
+                    "error": f"model {model!r} is cold "
+                             "(no live backend yet)",
+                    "model": model, "retry_after": ra},
+                    extra={"Retry-After": str(ra)})
+            if verdict == "serving":
+                router.note_model_request(model)
+            else:
+                model = None  # routing off for this name
         pool = self._pick_pool(headers)
         outcome["pool"] = pool
         peer_hint = None
@@ -510,7 +544,8 @@ class AsyncRouterServer:
                 delay = (self.retry_backoff * (2 ** (failures - 1))
                          * (1 + self._jitter.random()))
                 await asyncio.sleep(delay)
-            backend = router.pick(pool, affinity, exclude=tried)
+            backend = router.pick(pool, affinity, exclude=tried,
+                                  model=model)
             if backend is None:
                 break
             tried.add(backend.url)
@@ -852,6 +887,12 @@ def main(argv=None) -> int:
                         "(ome_tpu/faults.py grammar); also via "
                         "OME_FAULTS")
     p.add_argument("--debug-endpoints", action="store_true")
+    p.add_argument("--model-catalog", default=None,
+                   help="model catalog JSON ({model: {warmup_ms, "
+                        "weight_bytes}}): declares the fleet's model "
+                        "set and turns on model-aware enforcement — "
+                        "unknown model 404, known-but-cold 503 + "
+                        "Retry-After (docs/model-fleet.md)")
     p.add_argument("--slo-spec", default=None,
                    help="SLO spec JSON (config/slo.json format): "
                         "starts the fleet rollup loop and serves "
@@ -911,6 +952,11 @@ def main(argv=None) -> int:
                     health_interval=args.health_interval,
                     cb_threshold=args.cb_threshold,
                     cb_cooldown=args.cb_cooldown)
+    if args.model_catalog:
+        with open(args.model_catalog, "r", encoding="utf-8") as f:
+            router.model_map.load_catalog(json.load(f))
+        log.info("model catalog loaded: %s (enforcement on)",
+                 args.model_catalog)
     router.check_health_once()
     replica_id = args.replica_id or \
         f"{args.bind}:{args.port}:{os.getpid()}"

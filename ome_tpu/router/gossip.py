@@ -46,9 +46,12 @@ log = logging.getLogger("ome.router.gossip")
 
 # observation fields that constitute content: a change to any of them
 # re-stamps the record (cb_open_remaining is volatile — it decays
-# every second — so it is carried but never compared)
+# every second — so it is carried but never compared). "models" is the
+# backend's /ready model advertisement — gossiping it lets a replica
+# steer model-routed requests onto backends it has not probed yet
+# (docs/model-fleet.md).
 _OBS_FIELDS = ("pool", "healthy", "draining", "cb_state", "fails",
-               "cb_trips")
+               "cb_trips", "models")
 
 
 def lww_wins(a: Optional[dict], b: Optional[dict]) -> bool:
@@ -125,6 +128,10 @@ class GossipState:
                     "pool": b.pool, "healthy": b.healthy,
                     "draining": b.draining, "cb_state": b.cb_state,
                     "fails": b.fails, "cb_trips": b.cb_trips}
+            # model advertisement rides the same record (leaf lock of
+            # its own; taken after the backend lock is released)
+            live[b.url]["models"] = sorted(
+                self.router.model_map.models_of(b.url))
         for url, content in live.items():
             prev = self._obs.get(url)
             if prev is None or any(prev.get(f) != content[f]
@@ -140,7 +147,8 @@ class GossipState:
                             and not content["draining"]
                             and content["cb_state"] == "closed"
                             and content["fails"] == 0
-                            and content["cb_trips"] == 0)
+                            and content["cb_trips"] == 0
+                            and not content["models"])
                 rec["stamp"] = 0.0 if pristine else now_wall
                 rec["origin"] = "" if pristine else self.replica_id
                 self._obs[url] = rec
@@ -223,6 +231,10 @@ class GossipState:
                     stored["origin"] = rec.get("origin", "")
                     self._obs[url] = stored
                     self._apply(b, rec)
+                    # adopted model advertisements feed the model map
+                    # (advertise ignores a record without the field)
+                    self.router.model_map.advertise(
+                        url, rec.get("models"))
                     adopted += 1
             rprefix = remote.get("prefix") or {}
             for digest, rec in rprefix.items():

@@ -324,6 +324,117 @@ class PrefixDirectory:
             return len(self._owners)
 
 
+# cold-start math fallback when no engine has advertised a measured
+# fetch throughput yet (matches the weight plane's default)
+DEFAULT_FETCH_BPS = 256e6
+
+
+class ModelMap:
+    """Which backends serve which model, plus the cold-start catalog —
+    the model-aware half of routing (docs/model-fleet.md).
+
+    Two information planes feed it:
+
+      * **advertisements** — every /ready probe (and gossip merge)
+        carries the backend's ``models`` list and its measured weight
+        ``fetch_bps``; advertisements steer requests whose ``model``
+        field names a served model onto the backends serving it;
+      * **the catalog** — operator-declared ``{model: {warmup_ms,
+        weight_bytes}}`` (the fleet's registered model set, cost-table
+        ``warmup_ms`` semantics). A non-empty catalog turns on
+        ENFORCEMENT: a model outside catalog+advertisements answers
+        404, a known model with no live backend answers 503 with a
+        Retry-After derived from ``warmup_ms`` + weight bytes over the
+        measured fetch throughput.
+
+    Without a catalog the map only steers — a deployment that never
+    declared its model set keeps the legacy any-backend behavior for
+    unknown names instead of 404ing them.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_url: Dict[str, frozenset] = {}
+        self._catalog: Dict[str, Dict] = {}
+        self._fetch_bps = 0.0  # EWMA over advertised measurements
+
+    def load_catalog(self, catalog: Dict[str, Dict]):
+        with self._lock:
+            for name, spec in (catalog or {}).items():
+                self._catalog[name] = {
+                    "warmup_ms": float(spec.get("warmup_ms", 0.0)),
+                    "weight_bytes": int(spec.get("weight_bytes", 0))}
+
+    def advertise(self, url: str, models, fetch_bps=None):
+        url = url.rstrip("/")
+        if isinstance(models, (list, tuple)):
+            served = frozenset(m for m in models
+                               if isinstance(m, str) and m)
+            with self._lock:
+                self._by_url[url] = served
+        if isinstance(fetch_bps, (int, float)) and fetch_bps > 0:
+            with self._lock:
+                self._fetch_bps = (fetch_bps if not self._fetch_bps
+                                   else 0.8 * self._fetch_bps
+                                   + 0.2 * fetch_bps)
+
+    def forget(self, url: str):
+        with self._lock:
+            self._by_url.pop(url.rstrip("/"), None)
+
+    def active(self) -> bool:
+        with self._lock:
+            return bool(self._by_url) or bool(self._catalog)
+
+    def enforcing(self) -> bool:
+        with self._lock:
+            return bool(self._catalog)
+
+    def cataloged(self, model: str) -> bool:
+        with self._lock:
+            return model in self._catalog
+
+    def backends_for(self, model: str) -> frozenset:
+        with self._lock:
+            return frozenset(u for u, ms in self._by_url.items()
+                             if model in ms)
+
+    def models_of(self, url: str) -> frozenset:
+        with self._lock:
+            return self._by_url.get(url.rstrip("/"), frozenset())
+
+    def backend_counts(self) -> Dict[str, int]:
+        """{model: advertising-backend count} over catalog + served
+        models — the per-model gauge's value set."""
+        with self._lock:
+            counts = {m: 0 for m in self._catalog}
+            for ms in self._by_url.values():
+                for m in ms:
+                    counts[m] = counts.get(m, 0) + 1
+            return counts
+
+    def fetch_bps(self) -> float:
+        with self._lock:
+            return self._fetch_bps
+
+    def retry_after(self, model: str) -> int:
+        """Cold-start wait hint: catalog ``warmup_ms`` plus the time
+        to fetch the model's weight bytes at the fleet's measured
+        fetch throughput (EWMA of /ready advertisements; a default
+        when nothing measured yet). Clamped to [1, 600]s."""
+        with self._lock:
+            spec = self._catalog.get(model) or {}
+            bps = self._fetch_bps or DEFAULT_FETCH_BPS
+        seconds = spec.get("warmup_ms", 0.0) / 1000.0 \
+            + spec.get("weight_bytes", 0) / bps
+        return max(1, min(600, int(seconds + 0.999)))
+
+    def export(self) -> Dict[str, List[str]]:
+        """{url: sorted models} — the gossip/debug view."""
+        with self._lock:
+            return {u: sorted(ms) for u, ms in self._by_url.items()}
+
+
 class Router:
     def __init__(self, backends: List[Backend],
                  policy: str = "cache_aware",
@@ -405,6 +516,29 @@ class Router:
                 **{"class": cls, "result": res})
             for cls in PRIORITY_CLASSES
             for res in ("ok", "error")}
+        # model-aware routing (docs/model-fleet.md): backend map fed
+        # by /ready advertisements + gossip, catalog fed by
+        # --model-catalog; per-model metric cardinality is bounded by
+        # that operator-declared set plus what the fleet advertises
+        self.model_map = ModelMap()
+        self._c_model_requests = self.registry.counter(
+            "ome_router_model_requests_total",
+            "Requests routed by model field, per known model",
+            labelnames=("model",))
+        self._c_model_cold = self.registry.counter(
+            "ome_router_model_cold_total",
+            "Requests answered 503 + Retry-After because the model "
+            "is known but has no live backend (cold start)",
+            labelnames=("model",))
+        self._c_model_unknown = self.registry.counter(
+            "ome_router_model_unknown_total",
+            "Requests answered 404 because the model is neither "
+            "cataloged nor advertised by any backend")
+        self._g_model_backends = self.registry.gauge(
+            "ome_router_model_backends",
+            "Backends currently advertising each model",
+            labelnames=("model",))
+        self._model_gauge_keys: set = set()
 
     @property
     def stats(self) -> Dict[str, float]:
@@ -451,6 +585,17 @@ class Router:
         self._g_backends_up.set(up)
         self._g_backends_draining.set(draining)
         self._g_prefix_dir.set(len(self.prefix_directory))
+        counts = self.model_map.backend_counts()
+        for model, n in counts.items():
+            # model names come from the operator catalog + engine
+            # /ready advertisements, never from client payloads
+            self._g_model_backends.labels(model=model).set(n)  # omelint: disable=metrics-label-cardinality -- catalog/advertised model names only, bounded by fleet config
+        model_seen = set(counts)
+        with self._lock:
+            stale_models = self._model_gauge_keys - model_seen
+            self._model_gauge_keys = model_seen
+        for model in stale_models:
+            self._g_model_backends.labels(model=model).set(0)  # omelint: disable=metrics-label-cardinality -- zeroing series created from the bounded catalog/advertised set above
 
     # -- membership ----------------------------------------------------
     # The autoscale controller's registration surface (POST/DELETE
@@ -484,6 +629,7 @@ class Router:
                 if b.url == u:
                     del self.backends[i]
                     self.prefix_directory.forget(u)
+                    self.model_map.forget(u)
                     return True
         return False
 
@@ -505,12 +651,18 @@ class Router:
                     if b.pool == pool and b.healthy and not b.draining]
 
     def pick(self, pool: str, affinity_key: str = "",
-             exclude: Optional[set] = None) -> Optional[Backend]:
+             exclude: Optional[set] = None,
+             model: Optional[str] = None) -> Optional[Backend]:
+        # model steering: when the request names a model the fleet
+        # serves, only backends advertising it are candidates
+        allowed = (self.model_map.backends_for(model)
+                   if model else None)
         now = self._clock()
         with self._lock:
             alive = [b for b in self.backends
                      if b.pool == pool and b.selectable(now)
-                     and (not exclude or b.url not in exclude)]
+                     and (not exclude or b.url not in exclude)
+                     and (allowed is None or b.url in allowed)]
             if not alive:
                 return None
             if self.policy == "random":
@@ -555,6 +707,49 @@ class Router:
         child = self._c_outcomes.get((cls, "ok" if ok else "error"))
         if child is not None:
             child.inc()
+
+    def classify_model(self, model: str):
+        """Route verdict for a request's ``model`` field:
+
+        * ``("off", None)`` — model routing inactive for this name
+          (no advertisements/catalog at all, or the name is unknown
+          and no catalog demands enforcement): legacy any-backend;
+        * ``("serving", urls)`` — at least one selectable backend
+          advertises it: steer onto ``urls``;
+        * ``("cold", urls)`` — known (cataloged, or advertised but
+          every advertiser gone): 503 + Retry-After;
+        * ``("unknown", None)`` — catalog enforcement on and the name
+          is neither cataloged nor advertised: 404.
+        """
+        mm = self.model_map
+        if not mm.active():
+            return "off", None
+        urls = mm.backends_for(model)
+        if urls:
+            now = self._clock()
+            with self._lock:
+                live = any(b.url in urls and b.selectable(now)
+                           for b in self.backends)
+            if live:
+                return "serving", urls
+            return "cold", urls
+        if mm.cataloged(model):
+            return "cold", frozenset()
+        if mm.enforcing():
+            return "unknown", None
+        return "off", None
+
+    def note_model_request(self, model: str):
+        # only called on a "serving" verdict, so the label set is the
+        # advertised-model universe — an arbitrary client-sent name
+        # gets 404/off and never reaches a labeled series
+        self._c_model_requests.labels(model=model).inc()  # omelint: disable=metrics-label-cardinality -- serving verdict gate bounds values to advertised models
+
+    def note_model_cold(self, model: str):
+        self._c_model_cold.labels(model=model).inc()  # omelint: disable=metrics-label-cardinality -- cold verdict gate bounds values to cataloged/advertised models
+
+    def note_model_unknown(self):
+        self._c_model_unknown.inc()
 
     def note_draining(self, backend: Backend):
         """The backend announced it is draining (503 + X-OME-Draining).
@@ -604,6 +799,12 @@ class Router:
             if isinstance(info, dict):
                 self.prefix_directory.update(
                     b.url, info.get("prefix_digests"))
+                # model advertisement piggyback: which models this
+                # backend serves + its measured weight-fetch
+                # throughput (the Retry-After math's denominator)
+                self.model_map.advertise(
+                    b.url, info.get("models"),
+                    info.get("fetch_bps"))
 
     @staticmethod
     def _probe_backend(b: Backend):
@@ -791,9 +992,11 @@ class RouterServer:
                         cls = DEFAULT_PRIORITY
                     outer._c_class[cls].inc()
                 stream = bool(payload.get("stream"))
+                mdl = payload.get("model")
                 self._proxy(body, stream=stream,
                             affinity=affinity_from_payload(payload),
-                            cls=cls)
+                            cls=cls,
+                            model=mdl if isinstance(mdl, str) else None)
 
             def do_DELETE(self):
                 n = int(self.headers.get("Content-Length") or 0)
@@ -846,7 +1049,8 @@ class RouterServer:
 
             def _proxy(self, body: bytes, stream: bool,
                        affinity: str = "",
-                       cls: Optional[str] = None):
+                       cls: Optional[str] = None,
+                       model: Optional[str] = None):
                 # request-lifecycle tracing: adopt the caller's
                 # traceparent or mint a fresh trace; every forwarded
                 # hop carries a CHILD span of this context, and both
@@ -868,7 +1072,7 @@ class RouterServer:
                     span.set(path=self.path)
                 try:
                     return self._route(body, stream, affinity, ctx,
-                                       outcome)
+                                       outcome, model=model)
                 finally:
                     dur = time.monotonic() - t0
                     outer._h_request.observe(dur)
@@ -898,10 +1102,45 @@ class RouterServer:
                             "duration_s": round(dur, 6)})
 
             def _route(self, body: bytes, stream: bool, affinity: str,
-                       ctx, outcome: dict):
+                       ctx, outcome: dict,
+                       model: Optional[str] = None):
                 outer.router.inc("requests_total")
                 outer.budget.deposit()
                 deadline = self._deadline()
+                # model-aware gate (docs/model-fleet.md): unknown
+                # model 404s, a known-but-cold model answers 503 with
+                # a Retry-After the weight plane's measured fetch
+                # throughput backs — the client knows when to retry
+                # instead of hammering a fleet that is still fetching
+                if model:
+                    verdict, _ = outer.router.classify_model(model)
+                    if verdict == "unknown":
+                        outer.router.note_model_unknown()
+                        outcome["status"] = "unknown_model"
+                        return self._json(404, {
+                            "error": f"model {model!r} is not served "
+                                     "by this fleet",
+                            "model": model})
+                    if verdict == "cold":
+                        ra = outer.router.model_map.retry_after(model)
+                        outer.router.note_model_cold(model)
+                        if outer.span_log.enabled:
+                            cspan = tracing.Span(
+                                "router.cold_start",
+                                trace_id=ctx.trace_id,
+                                parent_id=ctx.span_id)
+                            cspan.set(model=model, retry_after=ra)
+                            outer.span_log.write(cspan)
+                        outcome["status"] = "cold_start"
+                        return self._json(503, {
+                            "error": f"model {model!r} is cold "
+                                     "(no live backend yet)",
+                            "model": model, "retry_after": ra},
+                            headers={"Retry-After": str(ra)})
+                    if verdict == "serving":
+                        outer.router.note_model_request(model)
+                    else:
+                        model = None  # routing off for this name
                 pool = self._pick_pool()
                 outcome["pool"] = pool
                 # fleet prefix directory: if some replica owns this
@@ -943,7 +1182,8 @@ class RouterServer:
                                  * (1 + outer._jitter.random()))
                         time.sleep(delay)
                     backend = outer.router.pick(pool, affinity,
-                                                exclude=tried)
+                                                exclude=tried,
+                                                model=model)
                     if backend is None:
                         break
                     tried.add(backend.url)
@@ -1244,6 +1484,12 @@ def main(argv=None) -> int:
                         "/backends (machine-readable membership) and "
                         "POST/DELETE /backends (autoscale "
                         "registration); 403 otherwise")
+    p.add_argument("--model-catalog", default=None,
+                   help="model catalog JSON ({model: {warmup_ms, "
+                        "weight_bytes}}): declares the fleet's model "
+                        "set and turns on model-aware enforcement — "
+                        "unknown model 404, known-but-cold 503 + "
+                        "Retry-After (docs/model-fleet.md)")
     p.add_argument("--slo-spec", default=None,
                    help="SLO spec JSON (config/slo.json format): "
                         "starts the fleet rollup loop and serves "
@@ -1301,6 +1547,11 @@ def main(argv=None) -> int:
                     health_interval=args.health_interval,
                     cb_threshold=args.cb_threshold,
                     cb_cooldown=args.cb_cooldown)
+    if args.model_catalog:
+        with open(args.model_catalog, "r", encoding="utf-8") as f:
+            router.model_map.load_catalog(json.load(f))
+        log.info("model catalog loaded: %s (enforcement on)",
+                 args.model_catalog)
     router.check_health_once()
     srv = RouterServer(router, host=args.bind, port=args.port,
                        retries=args.retries,

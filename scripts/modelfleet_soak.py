@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Model-fleet chaos soak (docs/model-fleet.md): seeded mid-download
+SIGKILL episodes against the hardened weight plane.
+
+    python scripts/modelfleet_soak.py --seed 7 --episodes 10
+
+Each episode generates a seed-derived source tree, SIGKILLs the
+weight-plane agent mid-download (deterministically — after a
+seed-derived number of objects are manifest-recorded, not after a
+wall-clock sleep), and checks the failure contract: the serving path
+never holds a partial tree, the manifest never gets ahead of the
+disk, and the re-run resumes from every verified object before
+publishing a byte-identical tree. Non-zero exit on any violation;
+episodes replay individually via --seed/--episode.
+"""
+
+import argparse
+import os
+import pathlib
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ome_tpu.chaos import run_weight_kill_episode  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="modelfleet_soak")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--episodes", type=int, default=5)
+    p.add_argument("--episode", type=int, default=None,
+                   help="replay a single episode index")
+    p.add_argument("--objects", type=int, default=24,
+                   help="objects per seed-derived source tree")
+    p.add_argument("--object-kb", type=int, default=8)
+    p.add_argument("--slow", type=float, default=0.05,
+                   help="per-object weight_fetch.slow pacing seconds")
+    p.add_argument("--base-dir", default=None,
+                   help="scratch dir (default: fresh temp dir)")
+    p.add_argument("--keep-logs", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.base_dir:
+        base = pathlib.Path(args.base_dir)
+        cleanup = False
+    else:
+        base = pathlib.Path(tempfile.mkdtemp(prefix="ome-modelfleet-"))
+        cleanup = not args.keep_logs
+    episodes = ([args.episode] if args.episode is not None
+                else list(range(args.episodes)))
+    failed = 0
+    try:
+        for index in episodes:
+            seed = args.seed + index
+            ep_dir = base / f"ep{index}"
+            violations = run_weight_kill_episode(
+                seed, ep_dir, n_objects=args.objects,
+                obj_kb=args.object_kb, slow_s=args.slow)
+            if violations:
+                failed += 1
+                print(f"[model-fleet] EPISODE {index} (seed {seed}) "
+                      f"FAILED ({len(violations)} violation(s)):",
+                      flush=True)
+                for v in violations:
+                    print(f"  - {v}", flush=True)
+                print(f"[model-fleet] replay: {sys.argv[0]} "
+                      f"--seed {args.seed} --episode {index}",
+                      flush=True)
+            else:
+                print(f"[model-fleet] episode {index} (seed {seed}) "
+                      "OK", flush=True)
+    finally:
+        if cleanup:
+            shutil.rmtree(base, ignore_errors=True)
+        else:
+            print(f"[model-fleet] logs kept under {base}", flush=True)
+    total = len(episodes)
+    print(f"[model-fleet] soak done: {total - failed}/{total} "
+          "episodes clean", flush=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
